@@ -1,0 +1,82 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built on jax/XLA/Pallas.
+
+The public namespace mirrors the reference's ``paddle.*`` assembly
+(``python/paddle/__init__.py``): tensor ops at top level, ``nn``,
+``optimizer``, ``amp``, ``io``, ``autograd``, ``distributed``, ``jit``,
+``vision``, ``static``-less (the jit trace path subsumes it).
+
+Architecture (see SURVEY.md §7): XLA is the kernel library; ops dispatch
+through a jitted-executable cache (ops/registry.py); autograd is a
+GradNode graph over hand-written or jax.vjp backward pairs
+(autograd/engine.py); distributed training lowers ProcessMesh/placements
+to jax.sharding + GSPMD (distributed/).
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core import dtype as _dtype_mod  # noqa: F401
+from .core.dtype import (  # noqa: F401
+    bfloat16, bool_ as bool8, complex64, complex128, float16, float32,
+    float64, get_default_dtype, int8, int16, int32, int64, set_default_dtype,
+    uint8,
+)
+from .core.place import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, CustomPlace, TPUPlace, XPUPlace,
+    get_device, is_compiled_with_cuda, set_device,
+)
+from .core.tensor import EagerParamBase, Parameter, Tensor, to_tensor  # noqa: F401
+from .core.flags import get_flags, set_flags  # noqa: F401
+
+# Ops: the flat tensor-op namespace (paddle.add, paddle.matmul, ...).
+from .ops import *  # noqa: F401,F403
+from .ops import (  # noqa: F401
+    abs, all, any, max, min, pow, sum,  # shadow builtins intentionally
+)
+
+from . import amp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from .autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .framework_io import load, save  # noqa: F401
+from .ops.random import get_rng_state, seed, set_rng_state  # noqa: F401
+
+from . import device  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import distributed  # noqa: F401
+from . import vision  # noqa: F401
+from . import metric  # noqa: F401
+from . import profiler  # noqa: F401
+from . import incubate  # noqa: F401
+
+disable_static = lambda *a, **k: None  # dygraph is the default  # noqa: E731
+enable_static = lambda *a, **k: None  # noqa: E731
+
+
+def in_dynamic_mode():
+    return True
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+def device_count():
+    from .core.place import device_count as _dc
+
+    return _dc()
+
+
+def set_printoptions(**kwargs):
+    import numpy as np
+
+    np.set_printoptions(**{k: v for k, v in kwargs.items()
+                           if k in ("precision", "threshold", "edgeitems",
+                                    "linewidth")})
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    return 0

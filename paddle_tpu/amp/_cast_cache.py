@@ -1,0 +1,35 @@
+"""Per-tensor AMP cast cache.
+
+Reference: the eager AMP cache keyed by tensor identity so a parameter cast
+to fp16/bf16 once per step is reused across ops
+(``paddle/fluid/eager/amp_utils.h``).  Here a small WeakKeyDictionary-like
+cache keyed by id keeps the casted copy alive only while the source is.
+"""
+from __future__ import annotations
+
+import weakref
+
+_cache: dict = {}
+
+
+def cached_cast(t, target):
+    from ..ops.manipulation import cast
+
+    key = (id(t), str(target))
+    hit = _cache.get(key)
+    if hit is not None:
+        src_ref, out = hit
+        if src_ref() is t:
+            return out
+    out = cast(t, target)
+    try:
+        _cache[key] = (weakref.ref(t), out)
+    except TypeError:
+        pass
+    if len(_cache) > 4096:
+        _cache.clear()
+    return out
+
+
+def clear():
+    _cache.clear()

@@ -1,0 +1,78 @@
+"""AMP auto_cast + decorate.
+
+Reference: ``python/paddle/amp/auto_cast.py:1018`` (``auto_cast`` context:
+level O1 = per-op white/black list casting, O2 = cast everything except
+blacklist) and ``decorate`` (O2 casts model params + master weights).
+
+TPU-native: default low dtype is bfloat16 (MXU native; no loss scaling
+needed), float16 kept for parity.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from . import state as _state_mod
+from .state import amp_state
+
+
+@contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    st = amp_state()
+    prev = (st.enabled, st.level, st.dtype, set(st.custom_white),
+            set(st.custom_black))
+    st.enabled = bool(enable)
+    st.level = level
+    st.dtype = dtype
+    if custom_white_list:
+        st.custom_white = set(custom_white_list)
+    if custom_black_list:
+        st.custom_black = set(custom_black_list)
+    try:
+        yield
+    finally:
+        (st.enabled, st.level, st.dtype, st.custom_white,
+         st.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """O2 decoration: cast model params to the low dtype, keep master fp32
+    copies in the optimizer (reference: amp/auto_cast.py amp_decorate)."""
+    from ..core import dtype as dt
+
+    low = dt.convert_dtype(dtype)
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+
+    if level == "O2":
+        norm_types = _norm_layer_types()
+        for model in model_list:
+            for layer in model.sublayers(include_self=True):
+                if excluded_layers and isinstance(
+                        layer, tuple(excluded_layers)):
+                    continue
+                if isinstance(layer, norm_types):
+                    continue  # keep norms fp32 (paddle keeps BN fp32)
+                for _, p in layer._parameters.items():
+                    if p is not None and dt.is_floating_point(p.dtype):
+                        p._data = p._data.astype(low)
+
+    if optimizers is None:
+        return models if single_model else model_list
+    if master_weight is not False:
+        opt_list = optimizers if isinstance(optimizers, (list, tuple)) \
+            else [optimizers]
+        for opt in opt_list:
+            opt._use_master_weights = True
+    return (models if single_model else model_list), optimizers
+
+
+def _norm_layer_types():
+    from ..nn import layer_norm_types
+
+    return layer_norm_types()

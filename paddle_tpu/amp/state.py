@@ -1,0 +1,96 @@
+"""AMP global state + per-op cast policy.
+
+Reference: ``python/paddle/amp/auto_cast.py`` (amp_state, O1/O2 levels) and
+the op allow/deny lists (``python/paddle/amp/amp_lists.py``); the cast
+injection point mirrors the generated ad_func AMP block
+(``eager/auto_code_generator/generator/eager_gen.py:594``).
+
+TPU-native policy: bfloat16 is the fast dtype (MXU-native, no loss scaling
+required in most cases), fp16 supported for parity.
+"""
+from __future__ import annotations
+
+# Ops that run in low precision under O1 (matmul-class: MXU ops).
+WHITE_LIST = {
+    "matmul", "conv2d", "conv1d", "conv2d_transpose", "einsum", "addmm",
+    "scaled_dot_product_attention", "bmm", "mm",
+}
+
+# Ops that must stay fp32 (numerically sensitive).
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp",
+    "softmax_with_cross_entropy", "cross_entropy", "reduce_mean",
+    "reduce_sum", "layer_norm", "rms_norm", "group_norm", "batch_norm_stats",
+    "batch_norm_infer", "softmax", "log_softmax", "erf", "erfinv",
+    "reciprocal", "rsqrt", "pow", "elementwise_pow", "cumsum", "cumprod",
+}
+
+
+class _AmpState:
+    __slots__ = ("enabled", "level", "dtype", "custom_white", "custom_black")
+
+    def __init__(self):
+        self.enabled = False
+        self.level = "O0"
+        self.dtype = "bfloat16"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_state() -> _AmpState:
+    return _state
+
+
+def amp_enabled() -> bool:
+    return _state.enabled
+
+
+def amp_level() -> str:
+    return _state.level if _state.enabled else "O0"
+
+
+def amp_dtype():
+    from ..core import dtype as dt
+
+    return dt.convert_dtype(_state.dtype)
+
+
+def amp_transform(op_name: str, tensors):
+    """Cast op inputs per policy (the eager_gen AMP block analog)."""
+    import jax.numpy as jnp
+
+    from ..core import dtype as dt
+    from ..core.tensor import Tensor
+
+    if not _state.enabled:
+        return tensors
+    low = amp_dtype()
+    white = (WHITE_LIST | _state.custom_white) - _state.custom_black
+    in_white = op_name in white
+    in_black = op_name in (BLACK_LIST | _state.custom_black)
+
+    if _state.level == "O2":
+        target = None if in_black else low
+        if in_black:
+            target = dt.float32
+    else:  # O1
+        if in_white:
+            target = low
+        elif in_black:
+            target = dt.float32
+        else:
+            return tensors
+
+    out = []
+    for t in tensors:
+        if isinstance(t, Tensor) and jnp.issubdtype(t.dtype, jnp.floating) \
+                and t.dtype != target:
+            from . import _cast_cache
+
+            out.append(_cast_cache.cached_cast(t, target))
+        else:
+            out.append(t)
+    return tuple(out)

@@ -1,0 +1,10 @@
+"""paddle.autograd analog.
+
+Reference: ``python/paddle/autograd/`` — backward(), grad(), no_grad,
+PyLayer (``py_layer.py:280``), saved-tensor hooks.
+"""
+from . import engine  # noqa: F401
+from .engine import (  # noqa: F401
+    backward, enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled,
+)
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401

@@ -1,0 +1,364 @@
+"""Tape-free reverse-mode autograd engine.
+
+TPU-native re-design of the reference eager autograd stack:
+``GradNodeBase`` (``paddle/fluid/eager/grad_node_info.h:197``),
+``AutogradMeta`` (``autograd_meta.h:61``), the topological backward engine
+(``paddle/fluid/eager/backward.cc:439`` — in-degree map + ready queue), the
+``GradTensorHolder`` accumulation, and ``GeneralGrad`` partial gradients
+(``general_grad.h``).
+
+Autograd metadata lives directly on ``Tensor`` (``_grad_node``/``_out_slot``)
+instead of a separate AutogradMeta object; GradNodes hold either explicit
+residuals for ops with hand-written backward kernels (the reference's
+backward.yaml pairing) or a ``jax.vjp`` closure as the fallback.  All
+gradient arithmetic is jax — a backward pass over the graph is a sequence of
+XLA executable calls, and the engine also works under ``jax.jit`` tracing
+(used by ``paddle_tpu.jit.to_static``).
+"""
+from __future__ import annotations
+
+from collections import defaultdict, deque
+
+import jax.numpy as jnp
+
+# --------------------------------------------------------------------------
+# Grad mode (reference: eager/api/utils/global_utils.h Controller;
+# python/paddle/base/dygraph/base.py no_grad_)
+# --------------------------------------------------------------------------
+
+_grad_enabled = True
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled
+
+
+class no_grad:
+    """Context manager + decorator disabling gradient recording."""
+
+    _target = False
+
+    def __enter__(self):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        _grad_enabled = self._target
+        return self
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with type(self)():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad(no_grad):
+    _target = True
+
+
+class set_grad_enabled(no_grad):
+    def __init__(self, mode: bool):
+        self._target = bool(mode)
+
+
+# --------------------------------------------------------------------------
+# Grad graph nodes
+# --------------------------------------------------------------------------
+
+class GradNode:
+    """One backward step; created per differentiable forward op call.
+
+    Reference: GradNodeBase (grad_node_info.h:197).  ``saved`` is either the
+    op's explicit residuals (hand-written bwd) or a jax vjp closure.
+    """
+
+    __slots__ = ("op", "saved", "inputs", "attrs", "vjp_fallback",
+                 "diff_idx", "out_meta", "n_outs", "name", "released",
+                 "out_hooks")
+
+    def __init__(self, op, saved, inputs, attrs, vjp_fallback=False,
+                 diff_idx=None):
+        self.released = False
+        self.op = op
+        self.name = op.name if op is not None else "custom"
+        self.saved = saved
+        self.inputs = list(inputs)  # Tensor | raw array per forward slot
+        self.attrs = attrs
+        self.vjp_fallback = vjp_fallback
+        self.diff_idx = diff_idx
+        self.out_meta = None  # [(shape, dtype)] per output slot
+        self.n_outs = 0
+        self.out_hooks = None  # live per-slot hook lists (Tensor._hooks)
+
+    def bind_outputs(self, outs):
+        self.n_outs = len(outs)
+        self.out_meta = [
+            (tuple(o.shape), o.dtype) if o is not None else None for o in outs
+        ]
+        self.out_hooks = [o._hooks if o is not None else None for o in outs]
+        for i, o in enumerate(outs):
+            if o is not None:
+                o._grad_node = self
+                o._out_slot = i
+
+    def parent_edges(self):
+        """Yield ("node", i, parent_node, parent_slot) for inputs produced by
+        another node, ("leaf", i, tensor, None) for grad-requiring leaves."""
+        from ..core.tensor import Tensor
+
+        for i, t in enumerate(self.inputs):
+            if isinstance(t, Tensor) and not t.stop_gradient:
+                if t._grad_node is not None:
+                    yield ("node", i, t._grad_node, t._out_slot)
+                else:
+                    yield ("leaf", i, t, None)
+
+    def run_backward(self, grads_out):
+        """grads_out: list (len n_outs) of arrays/None -> grads per input."""
+        if self.released:
+            raise RuntimeError(
+                f"Trying to backward through {self.name} a second time, but "
+                "the saved intermediate results have already been freed. "
+                "Specify retain_graph=True on the first backward.")
+        filled = []
+        for i, g in enumerate(grads_out):
+            if g is None:
+                shape, dtype = self.out_meta[i]
+                g = jnp.zeros(shape, dtype)
+            filled.append(g)
+
+        if self.vjp_fallback:
+            cotangent = filled[0] if self.n_outs == 1 else tuple(filled)
+            diff_grads = self.saved(cotangent)
+            grads = [None] * len(self.inputs)
+            for idx, g in zip(self.diff_idx, diff_grads):
+                grads[idx] = g
+            return grads
+
+        gout = filled[0] if self.n_outs == 1 else tuple(filled)
+        grads = self.op.jit_bwd(self.saved, gout, **self.attrs)
+        if not isinstance(grads, (tuple, list)):
+            grads = (grads,)
+        return list(grads) + [None] * (len(self.inputs) - len(grads))
+
+    def release(self):
+        """Free residuals (retain_graph=False semantics)."""
+        self.saved = None
+        self.released = True
+
+    def __repr__(self):
+        return f"GradNode<{self.name}>"
+
+
+# --------------------------------------------------------------------------
+# Backward traversal (reference: eager/backward.cc:439 Backward())
+# --------------------------------------------------------------------------
+
+def _reachable_graph(root_nodes):
+    """BFS over parent edges; returns {id: node} and consumer in-degree map.
+    Reference: getInDegreeMap (backward.cc:23)."""
+    nodes = {id(n): n for n in root_nodes}
+    indeg = defaultdict(int)
+    queue = deque(root_nodes)
+    while queue:
+        node = queue.popleft()
+        for kind, _i, parent, _slot in node.parent_edges():
+            if kind != "node":
+                continue
+            indeg[id(parent)] += 1
+            if id(parent) not in nodes:
+                nodes[id(parent)] = parent
+                queue.append(parent)
+    return nodes, indeg
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False,
+                 targets=None, accumulate_into_grad=True):
+    """Core engine used by Tensor.backward() and paddle.grad().
+
+    Accumulates into leaf ``.grad`` (unless accumulate_into_grad=False);
+    if ``targets`` given, additionally captures and returns grads flowing
+    through those tensors (leaf or intermediate) as {id(tensor): array}.
+
+    Hooks (Tensor.register_hook) fire once per backward on the fully
+    accumulated gradient of the tensor — for intermediates when their
+    producing node's cotangent is finalized, for leaves after all
+    contributions are summed (reference: GradNodeBase gradient hooks).
+    """
+    from ..core.tensor import Tensor
+
+    tensors = [t for t in tensors if isinstance(t, Tensor)]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+
+    targets = targets or []
+    # Map (node id, slot) -> target tensor ids, for intermediate capture.
+    slot_targets = defaultdict(list)
+    leaf_targets = {}
+    for t in targets:
+        if t._grad_node is not None:
+            slot_targets[(id(t._grad_node), t._out_slot)].append(id(t))
+        else:
+            leaf_targets[id(t)] = t
+    captured: dict[int, object] = {}
+
+    root_nodes = []
+    node_grads: dict[int, list] = {}
+    leaf_buf: dict[int, list] = {}  # id(tensor) -> [tensor, grad]
+
+    def leaf_acc(tensor, g):
+        entry = leaf_buf.get(id(tensor))
+        if entry is None:
+            leaf_buf[id(tensor)] = [tensor, g]
+        else:
+            entry[1] = entry[1] + g
+
+    def seed(node, slot, g):
+        if id(node) not in node_grads:
+            node_grads[id(node)] = [None] * node.n_outs
+            root_nodes.append(node)
+        slots = node_grads[id(node)]
+        slots[slot] = g if slots[slot] is None else slots[slot] + g
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            continue
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}")
+            g = jnp.ones(t.shape, t.dtype)
+        else:
+            g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        if t._grad_node is None:
+            leaf_acc(t, g)
+        else:
+            seed(t._grad_node, t._out_slot, g)
+
+    nodes, indeg = _reachable_graph(root_nodes)
+    ready = deque(n for n in root_nodes if indeg[id(n)] == 0)
+    processed = set()
+
+    while ready:
+        node = ready.popleft()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+        grads_out = node_grads.pop(id(node), [None] * node.n_outs)
+
+        # Finalized cotangents for this node's outputs: apply output-tensor
+        # hooks once, then capture intermediate targets.
+        for slot in range(node.n_outs):
+            g = grads_out[slot]
+            if g is None:
+                continue
+            hooks = node.out_hooks[slot] if node.out_hooks else None
+            if hooks:
+                for hook in hooks:
+                    out = hook(Tensor(g, stop_gradient=True))
+                    if out is not None:
+                        g = out._data if isinstance(out, Tensor) else out
+                grads_out[slot] = g
+            key = (id(node), slot)
+            if key in slot_targets:
+                for tid in slot_targets[key]:
+                    captured[tid] = _acc(captured.get(tid), g)
+
+        grads_in = node.run_backward(grads_out)
+
+        for kind, i, obj, slot in node.parent_edges():
+            g = grads_in[i]
+            if kind == "leaf":
+                if g is not None:
+                    leaf_acc(obj, g)
+            else:
+                parent = obj
+                if g is not None:
+                    if id(parent) not in node_grads:
+                        node_grads[id(parent)] = [None] * parent.n_outs
+                    slots = node_grads[id(parent)]
+                    slots[slot] = g if slots[slot] is None \
+                        else slots[slot] + g
+                # The in-degree must drop even for None grads, or the
+                # parent (and everything above it) never processes.
+                indeg[id(parent)] -= 1
+                if indeg[id(parent)] <= 0:
+                    ready.append(parent)
+        if not retain_graph:
+            node.release()
+
+    # Leaf finalization: hooks on the accumulated grad, then .grad write.
+    for tid, (tensor, g) in leaf_buf.items():
+        if tensor._hooks:
+            for hook in tensor._hooks:
+                out = hook(Tensor(g, stop_gradient=True))
+                if out is not None:
+                    g = out._data if isinstance(out, Tensor) else out
+        if tid in leaf_targets:
+            captured[tid] = _acc(captured.get(tid), g)
+        if accumulate_into_grad:
+            _leaf_write(tensor, g)
+
+    return captured
+
+
+def _acc(old, g):
+    return g if old is None else old + g
+
+
+def _leaf_write(tensor, g):
+    from ..core.tensor import Tensor
+
+    new = g if tensor.grad is None else tensor.grad._data + g
+    tensor.grad = Tensor(new, stop_gradient=True)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward — accumulate into .grad."""
+    run_backward(tensors, grad_tensors, retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """paddle.grad (GeneralGrad analog, eager/general_grad.h).
+
+    Returns grads of ``outputs`` w.r.t. ``inputs`` without touching .grad.
+    """
+    from ..core.tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double grad) is not supported yet")
+    if retain_graph is None:
+        retain_graph = False
+
+    captured = run_backward(outputs, grad_outputs,
+                            retain_graph=retain_graph, targets=inputs,
+                            accumulate_into_grad=False)
+    results = []
+    for t in inputs:
+        g = captured.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused; "
+                    "pass allow_unused=True to return None for it")
+            results.append(None)
+        else:
+            results.append(Tensor(g, stop_gradient=True))
+    return results

@@ -1,0 +1,107 @@
+"""PyLayer — user-defined forward/backward pairs.
+
+Reference: ``python/paddle/autograd/py_layer.py:280`` (PyLayer with
+``forward``/``backward`` staticmethods and a context for saved tensors) +
+the C++ side ``paddle/fluid/eager/pylayer/``.  The custom node plugs into
+the same GradNode graph as built-in ops.
+"""
+from __future__ import annotations
+
+from ..autograd import engine
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    # paddle exposes it as a method too
+    def saved_tensor_list(self):
+        return list(self._saved)
+
+
+class _PyLayerNode(engine.GradNode):
+    __slots__ = ("layer_cls", "ctx")
+
+    def __init__(self, layer_cls, ctx, inputs):
+        super().__init__(None, None, inputs, {})
+        self.layer_cls = layer_cls
+        self.ctx = ctx
+        self.name = f"PyLayer<{layer_cls.__name__}>"
+
+    def run_backward(self, grads_out):
+        from ..core.tensor import Tensor
+        import jax.numpy as jnp
+
+        gs = []
+        for i, g in enumerate(grads_out):
+            if g is None and self.out_meta[i] is not None:
+                shape, dtype = self.out_meta[i]
+                g = jnp.zeros(shape, dtype)
+            gs.append(Tensor(g, stop_gradient=True) if g is not None else None)
+        with engine.no_grad():
+            result = self.layer_cls.backward(
+                self.ctx, *(gs if len(gs) > 1 else [gs[0]]))
+        if not isinstance(result, (tuple, list)):
+            result = (result,)
+        grads = []
+        for r in result:
+            if r is None:
+                grads.append(None)
+            elif isinstance(r, Tensor):
+                grads.append(r._data)
+            else:
+                grads.append(jnp.asarray(r))
+        return list(grads) + [None] * (len(self.inputs) - len(grads))
+
+    def release(self):
+        pass  # PyLayer contexts own their saved tensors
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core.tensor import Tensor
+
+        ctx = PyLayerContext()
+        with engine.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outputs, (tuple, list))
+        outs = [outputs] if single else list(outputs)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        need_grad = engine.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        if need_grad:
+            node = _PyLayerNode(cls, ctx, args)
+            bindable = [o if isinstance(o, Tensor) else None for o in outs]
+            for o in bindable:
+                if o is not None:
+                    o.stop_gradient = False
+            node.bind_outputs(bindable)
+        return outs[0] if single else tuple(outs)
+
+
+def once_differentiable(fn):
+    return fn

@@ -1,0 +1,11 @@
+from . import dtype, enforce, flags, place  # noqa: F401
+from .dtype import (  # noqa: F401
+    bfloat16, bool_, complex64, complex128, convert_dtype, float16, float32,
+    float64, float8_e4m3fn, float8_e5m2, get_default_dtype, int8, int16,
+    int32, int64, set_default_dtype, uint8,
+)
+from .place import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, CustomPlace, Place, TPUPlace,
+    XPUPlace, device_count, get_device, is_compiled_with_cuda, set_device,
+)
+from .tensor import EagerParamBase, Parameter, Tensor, to_tensor  # noqa: F401

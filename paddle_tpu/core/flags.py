@@ -1,0 +1,93 @@
+"""Global flag registry.
+
+Reference: ``paddle/common/flags.cc`` (172 ``PHI_DEFINE_EXPORTED_*`` flags,
+gflags-backed) exported to Python as ``paddle.set_flags/get_flags``
+(``python/paddle/base/framework.py:111,136``), overridable by ``FLAGS_*``
+environment variables.  Here the registry is pure Python: a typed flag table
+with env-var pickup at definition time.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "type", "doc")
+
+    def __init__(self, name, default, type_, doc):
+        self.name = name
+        self.default = default
+        self.type = type_
+        self.doc = doc
+        self.value = self._from_env(default)
+
+    def _from_env(self, default):
+        env = os.environ.get(self.name)
+        if env is None:
+            return default
+        return _parse(env, self.type)
+
+
+def _parse(text: str, type_: Callable):
+    if type_ is bool:
+        return text.strip().lower() in ("1", "true", "yes", "on")
+    return type_(text)
+
+
+_registry: dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default, doc: str = "", type_=None):
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    if type_ is None:
+        type_ = type(default)
+    flag = _Flag(name, default, type_, doc)
+    _registry[name] = flag
+    return flag
+
+
+def get_flags(flags) -> dict[str, Any]:
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for name in flags:
+        key = name if name.startswith("FLAGS_") else "FLAGS_" + name
+        if key not in _registry:
+            raise ValueError(f"Unknown flag {name!r}")
+        out[name] = _registry[key].value
+    return out
+
+
+def set_flags(flags: dict):
+    for name, value in flags.items():
+        key = name if name.startswith("FLAGS_") else "FLAGS_" + name
+        if key not in _registry:
+            raise ValueError(f"Unknown flag {name!r}")
+        f = _registry[key]
+        f.value = _parse(value, f.type) if isinstance(value, str) else f.type(value)
+
+
+def flag(name: str):
+    """Fast read of a single flag value."""
+    key = name if name.startswith("FLAGS_") else "FLAGS_" + name
+    return _registry[key].value
+
+
+# --- Core flags mirrored from the reference flag table -----------------------
+define_flag("FLAGS_check_nan_inf", False,
+            "Check every op output for NaN/Inf (reference: common/flags.cc:72)")
+define_flag("FLAGS_check_nan_inf_level", 0,
+            "0: abort on nan/inf; 1: log only (reference: common/flags.cc:86)")
+define_flag("FLAGS_benchmark", False, "Benchmark mode: sync after each op")
+define_flag("FLAGS_eager_jit_ops", True,
+            "Cache per-op jitted executables for eager dispatch")
+define_flag("FLAGS_use_bf16_matmul", False,
+            "Force bfloat16 accumulation inputs on matmul (AMP fast path)")
+define_flag("FLAGS_log_level", 0, "VLOG-style verbosity for the framework")
+define_flag("FLAGS_allocator_strategy", "auto_growth",
+            "Kept for API parity; XLA/PJRT owns HBM allocation on TPU")
+define_flag("FLAGS_embedding_deterministic", 0,
+            "Deterministic embedding grad accumulation")
+define_flag("FLAGS_cudnn_deterministic", False, "API parity; no-op on TPU")

@@ -1,0 +1,148 @@
+"""paddle.device analog.
+
+Reference: ``python/paddle/device/__init__.py`` (set_device/get_device at
+:457,633, streams/events, cuda namespace).  On TPU, streams map to XLA's
+async dispatch; synchronize blocks on all pending device work.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.place import (  # noqa: F401
+    CPUPlace, CUDAPlace, TPUPlace, XPUPlace, device_count, get_device,
+    is_compiled_with_cuda, set_device,
+)
+
+
+def synchronize(device=None):
+    """Block until all dispatched device work completes."""
+    try:
+        (jax.device_put(0) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_custom_device(device_type=None):
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+class Stream:
+    """Compatibility stream object (XLA orders work per-device already)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_stream(self, stream):
+        pass
+
+    def wait_event(self, event):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def synchronize(self):
+        synchronize()
+
+    def query(self):
+        return True
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def stream_guard(stream):
+    from contextlib import nullcontext
+
+    return nullcontext()
+
+
+class cuda:
+    """paddle.device.cuda compat namespace (maps to the TPU device)."""
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return _mem_stats().get("peak_bytes_in_use", 0)
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return _mem_stats().get("peak_bytes_in_use", 0)
+
+    @staticmethod
+    def memory_allocated(device=None):
+        return _mem_stats().get("bytes_in_use", 0)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return _mem_stats().get("bytes_in_use", 0)
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def get_device_properties(device=None):
+        class _Props:
+            name = jax.devices()[0].device_kind
+            total_memory = _mem_stats().get("bytes_limit", 0)
+            major, minor = 0, 0
+            multi_processor_count = 1
+
+        return _Props()
+
+
+def _mem_stats():
+    """HBM stats via PJRT memory_stats (the StatAllocator analog —
+    reference: phi/core/memory/stats.h)."""
+    try:
+        dev = jax.devices()[0]
+        return dev.memory_stats() or {}
+    except Exception:
+        return {}

@@ -1,0 +1,35 @@
+"""paddle.distributed analog.
+
+Reference: ``python/paddle/distributed/`` (SURVEY.md §2.4/2.5).  Assembled
+from: env (rendezvous/rank), communication (collectives over mesh axes),
+auto_parallel (ProcessMesh/placements/shard_tensor -> GSPMD), spmd (shard_map
+step helpers), fleet (hybrid-parallel wrappers), launch (CLI),
+checkpoint (sharded save/load).
+"""
+from . import env  # noqa: F401
+from .env import (  # noqa: F401
+    ParallelEnv, get_rank, get_world_size, init_parallel_env,
+    is_initialized,
+)
+from .communication import (  # noqa: F401
+    Group, P2POp, ReduceOp, all_gather, all_gather_object, all_reduce,
+    all_to_all, alltoall, alltoall_single, barrier, batch_isend_irecv,
+    broadcast, destroy_process_group, get_group, irecv, isend, new_group,
+    recv, reduce, reduce_scatter, scatter, send, stream, wait,
+)
+from .auto_parallel import (  # noqa: F401
+    DistAttr, Partial, Placement, ProcessMesh, Replicate, Shard,
+    dtensor_from_fn, get_mesh, get_placements, reshard, set_mesh,
+    shard_layer, shard_tensor, unshard_dtensor,
+)
+from . import spmd  # noqa: F401
+from . import fleet  # noqa: F401
+from .parallel import DataParallel  # noqa: F401
+
+
+def get_backend():
+    return "xla"
+
+
+def is_available():
+    return True

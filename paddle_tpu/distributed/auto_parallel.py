@@ -1,0 +1,316 @@
+"""Auto-parallel: ProcessMesh, placements, shard_tensor, reshard.
+
+Reference: ``python/paddle/distributed/auto_parallel/`` — ``ProcessMesh``
+(process_mesh.py:85), ``shard_tensor`` (api.py:132), placements
+``Shard/Replicate/Partial`` (placement_type.py), ``reshard`` (api.py:622),
+``shard_layer`` (api.py:721), ``dtensor_from_fn`` (api.py:588); C++ core
+``DistTensor`` (phi/core/distributed/auto_parallel/dist_tensor.h:39) and the
+93 SPMD rules + reshard lattice.
+
+TPU-native re-design (SURVEY.md §7.6): a ProcessMesh **is** a
+``jax.sharding.Mesh``; a placements list **is** a ``PartitionSpec``; a
+DistTensor is just a Tensor whose ``jax.Array`` carries a ``NamedSharding``
+(GSPMD owns per-op SPMD propagation — the reference's 93 rules become
+XLA's sharding propagation, validated by our rule tests); ``reshard`` is
+``jax.device_put`` with a new NamedSharding (XLA emits the collective-permute
+/ all-gather / reduce-scatter sequence the reference's reshard functions
+hand-code).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+
+
+# -- placements (reference: placement_type.py) ------------------------------
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def get_dim(self):
+        return self.dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("S", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("R")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and \
+            other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("P", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+# -- ProcessMesh ------------------------------------------------------------
+
+class ProcessMesh:
+    """Reference: auto_parallel/process_mesh.py:85.  Wraps a jax Mesh."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None,
+                 process_ids=None):
+        if mesh is not None and isinstance(mesh, Mesh):
+            self._jax_mesh = mesh
+            self._shape = tuple(mesh.devices.shape)
+            self._dim_names = list(mesh.axis_names)
+            self._process_ids = [d.id for d in mesh.devices.flat]
+            return
+        if mesh is not None:
+            arr = np.asarray(mesh)
+            shape = arr.shape
+            process_ids = arr.reshape(-1).tolist()
+        self._shape = tuple(int(s) for s in shape)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(len(self._shape))]
+        self._dim_names = list(dim_names)
+        n = int(np.prod(self._shape))
+        if process_ids is None:
+            process_ids = list(range(n))
+        self._process_ids = list(process_ids)
+        devices = np.asarray(_device_list(n))[
+            np.asarray(self._process_ids)].reshape(self._shape)
+        self._jax_mesh = Mesh(devices, tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return list(self._process_ids)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self._shape))
+
+    @property
+    def mesh(self):
+        return np.asarray(self._process_ids).reshape(self._shape)
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def get_mesh_with_dim(self, dim_name, index=None):
+        axis = self._dim_names.index(dim_name)
+        ids = self.mesh
+        moved = np.moveaxis(ids, axis, 0)
+        names = [dim_name] + [n for n in self._dim_names if n != dim_name]
+        if index is not None:
+            sub = moved[index]
+            return ProcessMesh(sub, names[1:])
+        return ProcessMesh(moved, names)
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and \
+            self._shape == other._shape and \
+            self._process_ids == other._process_ids
+
+    def __hash__(self):
+        return hash((self._shape, tuple(self._process_ids)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, " \
+               f"dim_names={self._dim_names})"
+
+
+def _device_list(n):
+    devs = jax.devices()
+    if len(devs) < n:
+        raise RuntimeError(
+            f"ProcessMesh needs {n} devices but only {len(devs)} present. "
+            "For CPU testing set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=N")
+    return devs[:n]
+
+
+_global_mesh: ProcessMesh | None = None
+
+
+def set_mesh(mesh):
+    global _global_mesh
+    _global_mesh = mesh if isinstance(mesh, ProcessMesh) else \
+        ProcessMesh(mesh)
+
+
+def get_mesh() -> ProcessMesh | None:
+    return _global_mesh
+
+
+# -- DistAttr / conversion --------------------------------------------------
+
+class DistAttr:
+    """Records (mesh, placements) on a Tensor (TensorDistAttr analog,
+    phi/core/distributed/auto_parallel/dist_attr.h)."""
+
+    def __init__(self, mesh: ProcessMesh, placements):
+        self.process_mesh = mesh
+        self.placements = list(placements)
+
+    def __repr__(self):
+        return f"DistAttr(mesh={self.process_mesh}, " \
+               f"placements={self.placements})"
+
+
+def placements_to_spec(placements, ndim) -> PartitionSpec:
+    """[Shard(0), Replicate()] over mesh dims -> PartitionSpec per tensor
+    dim.  placements[i] says what mesh dim i does to the tensor."""
+    spec = [None] * ndim
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            d = p.dim
+            if spec[d] is None:
+                spec[d] = []
+            spec[d].append(mesh_dim)
+    return PartitionSpec(*[
+        tuple(s) if s and len(s) > 1 else (s[0] if s else None)
+        for s in spec])
+
+
+def to_named_sharding(mesh: ProcessMesh, placements, ndim):
+    spec_idx = placements_to_spec(placements, ndim)
+    names = mesh.dim_names
+    parts = []
+    for entry in spec_idx:
+        if entry is None:
+            parts.append(None)
+        elif isinstance(entry, tuple):
+            parts.append(tuple(names[i] for i in entry))
+        else:
+            parts.append(names[entry])
+    return NamedSharding(mesh.jax_mesh, PartitionSpec(*parts))
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None,
+                 place=None, stop_gradient=None):
+    """Reference: auto_parallel/api.py:132.  Returns a Tensor whose array
+    carries a NamedSharding (the DistTensor)."""
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    if any(isinstance(p, Partial) for p in placements):
+        raise ValueError("shard_tensor does not accept Partial placements")
+    sharding = to_named_sharding(mesh, placements, t.ndim)
+    arr = jax.device_put(t._data, sharding)
+    out = Tensor(arr, stop_gradient=t.stop_gradient
+                 if stop_gradient is None else stop_gradient)
+    out._dist_attr = DistAttr(mesh, placements)
+    out.name = t.name
+    from ..core.tensor import EagerParamBase
+
+    if isinstance(data, EagerParamBase):
+        p = EagerParamBase(arr, name=data.name,
+                           trainable=data.trainable)
+        p._dist_attr = out._dist_attr
+        return p
+    return out
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """Reference: api.py:588 — build the tensor then shard it."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(dist_tensor, mesh, placements):
+    """Reference: api.py:622 + the C++ reshard lattice
+    (auto_parallel/reshard/*_reshard_function.cc).  XLA emits the transfer
+    collectives from the sharding delta."""
+    t = dist_tensor
+    sharding = to_named_sharding(mesh, placements, t.ndim)
+    arr = jax.device_put(t._data, sharding)
+    out = Tensor(arr, stop_gradient=t.stop_gradient)
+    out._dist_attr = DistAttr(mesh, placements)
+    return out
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
+                output_fn=None):
+    """Reference: api.py:721 — apply shard_fn(name, layer, mesh) to every
+    sublayer, sharding its parameters in place."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):
+            for pname, p in list(sublayer._parameters.items()):
+                if p is not None and p._dist_attr is None:
+                    sublayer._parameters[pname] = shard_tensor(
+                        p, mesh, [Replicate()
+                                  for _ in range(len(mesh.shape))])
+
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda lyr, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda lyr, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer
+
+
+def get_placements(tensor):
+    if tensor._dist_attr is not None:
+        return tensor._dist_attr.placements
+    return None
+
+
+def unshard_dtensor(dist_tensor):
+    """Gather a DistTensor to a dense replicated Tensor."""
+    arr = jax.device_get(dist_tensor._data)
+    return Tensor(np.asarray(arr))

@@ -1,0 +1,339 @@
+"""Collective communication API.
+
+Reference: ``python/paddle/distributed/communication/`` —
+``all_reduce/all_gather/all_to_all/reduce_scatter/broadcast/send/recv/
+scatter/barrier`` over ``Group`` objects (``communication/group.py:22``)
+backed by ProcessGroupNCCL (``fluid/distributed/collective/``).
+
+TPU-native re-design (SURVEY.md §2.5): collectives are XLA HLO ops.  Two
+execution regimes:
+
+1. **In-graph (SPMD)** — inside a ``shard_map``/``pjit`` region whose mesh
+   binds this group's axis name, the call lowers to ``jax.lax.psum`` /
+   ``all_gather`` / ``ppermute`` / ``all_to_all`` over ICI.  This is the
+   hot path: fleet wrappers run train steps under shard_map, so "EagerReducer
+   allreduce" becomes a fused in-graph collective.
+2. **Eager out-of-graph** — single-process (world=1 per group) collectives
+   are identities; cross-host eager transfer (checkpoint resharding) goes
+   through ``jax.experimental.multihost_utils``.
+
+A Group carries an optional ``axis_name`` binding it to a mesh axis; the
+``shard_map`` helpers in paddle_tpu.distributed.spmd set the active axis
+context so the same Python code works in both regimes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import env as _env_mod
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """Reference: communication/group.py:22."""
+
+    _next_id = 0
+
+    def __init__(self, ranks=None, axis_name=None, pg=None, gid=None):
+        world = _env_mod.get_world_size()
+        self.ranks = list(ranks) if ranks is not None else list(range(world))
+        self.axis_name = axis_name
+        if gid is None:
+            Group._next_id += 1
+            gid = Group._next_id
+        self.id = gid
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return len(self.ranks)
+
+    @property
+    def rank(self):
+        me = _env_mod.get_rank()
+        return self.ranks.index(me) if me in self.ranks else -1
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def is_member(self):
+        return _env_mod.get_rank() in self.ranks
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks}, " \
+               f"axis={self.axis_name})"
+
+
+_default_group: Group | None = None
+
+
+def _get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        _default_group = Group(axis_name=None, gid=0)
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    return Group(ranks=ranks, axis_name=axis_name)
+
+
+def get_group(gid=0):
+    return _get_default_group() if gid == 0 else None
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _default_group = None
+
+
+# -- axis context (set by spmd.shard_map wrappers) --------------------------
+
+_active_axes: dict[str, bool] = {}
+
+
+def _axis_active(axis_name) -> bool:
+    if axis_name is None:
+        return False
+    try:
+        jax.lax.axis_index(axis_name)  # raises if unbound
+        return True
+    except Exception:
+        return False
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _wrap_like(x, data):
+    if isinstance(x, Tensor):
+        return Tensor(data, stop_gradient=x.stop_gradient)
+    return data
+
+
+def _in_spmd(group: Group) -> bool:
+    return group is not None and group.axis_name is not None and \
+        _axis_active(group.axis_name)
+
+
+# -- collectives ------------------------------------------------------------
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    group = group or _get_default_group()
+    if _in_spmd(group):
+        d = _data(tensor)
+        if op in (ReduceOp.SUM, "sum"):
+            out = jax.lax.psum(d, group.axis_name)
+        elif op in (ReduceOp.MAX, "max"):
+            out = jax.lax.pmax(d, group.axis_name)
+        elif op in (ReduceOp.MIN, "min"):
+            out = jax.lax.pmin(d, group.axis_name)
+        elif op in (ReduceOp.AVG, "avg"):
+            out = jax.lax.pmean(d, group.axis_name)
+        else:
+            raise ValueError(f"unsupported reduce op {op}")
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return out
+    if group.nranks <= 1:
+        return tensor
+    raise RuntimeError(
+        "Eager cross-device all_reduce outside an SPMD region requires a "
+        "mesh-bound group; wrap the step with "
+        "paddle_tpu.distributed.spmd.shard_step or use auto-parallel "
+        "shardings.")
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    group = group or _get_default_group()
+    if _in_spmd(group):
+        d = _data(tensor)
+        gathered = jax.lax.all_gather(d, group.axis_name)  # [n, ...]
+        if isinstance(tensor_list, list):
+            for i in range(group.nranks):
+                tensor_list.append(_wrap_like(tensor, gathered[i]))
+            return tensor_list
+        return _wrap_like(tensor, gathered)
+    if group.nranks <= 1:
+        if isinstance(tensor_list, list):
+            tensor_list.append(tensor)
+            return tensor_list
+        return tensor
+    raise RuntimeError("all_gather outside SPMD needs a mesh-bound group")
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+    return object_list
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    group = group or _get_default_group()
+    if _in_spmd(group):
+        stacked = jnp.stack([_data(t) for t in tensor_list]) \
+            if isinstance(tensor_list, (list, tuple)) else _data(tensor_list)
+        out = jax.lax.psum_scatter(stacked, group.axis_name,
+                                   scatter_dimension=0, tiled=False)
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return out
+    if group.nranks <= 1:
+        src = tensor_list[0] if isinstance(tensor_list, (list, tuple)) \
+            else tensor_list
+        if isinstance(tensor, Tensor):
+            tensor._data = _data(src)
+            return tensor
+        return src
+    raise RuntimeError("reduce_scatter outside SPMD needs a mesh-bound group")
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    group = group or _get_default_group()
+    if _in_spmd(group):
+        d = _data(tensor)
+        src_local = group.get_group_rank(src) if src in group.ranks else src
+        out = jax.lax.all_gather(d, group.axis_name)[src_local]
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return out
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    group = group or _get_default_group()
+    if _in_spmd(group):
+        stacked = jnp.stack([_data(t) for t in tensor_list])
+        idx = jax.lax.axis_index(group.axis_name)
+        out = stacked[idx]
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return out
+    if group.nranks <= 1:
+        if tensor_list:
+            tensor._data = _data(tensor_list[0])
+        return tensor
+    raise RuntimeError("scatter outside SPMD needs a mesh-bound group")
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    group = group or _get_default_group()
+    if _in_spmd(group):
+        stacked = jnp.stack([_data(t) for t in in_tensor_list])  # [n,...]
+        swapped = jax.lax.all_to_all(stacked, group.axis_name, 0, 0,
+                                     tiled=False)
+        for i in range(group.nranks):
+            out_tensor_list.append(Tensor(swapped[i]))
+        return out_tensor_list
+    if group.nranks <= 1:
+        out_tensor_list.extend(in_tensor_list)
+        return out_tensor_list
+    raise RuntimeError("alltoall outside SPMD needs a mesh-bound group")
+
+
+all_to_all = alltoall
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    group = group or _get_default_group()
+    if _in_spmd(group):
+        d = _data(in_tensor)
+        n = group.nranks
+        reshaped = d.reshape(n, d.shape[0] // n, *d.shape[1:])
+        swapped = jax.lax.all_to_all(reshaped, group.axis_name, 0, 0,
+                                     tiled=False)
+        out = swapped.reshape(d.shape)
+        if isinstance(out_tensor, Tensor):
+            out_tensor._data = out
+            return out_tensor
+        return out
+    if group.nranks <= 1:
+        out_tensor._data = _data(in_tensor)
+        return out_tensor
+    raise RuntimeError("alltoall_single outside SPMD needs a mesh group")
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise RuntimeError(
+        "Point-to-point send/recv lower to collective_permute inside SPMD "
+        "pipeline schedules (see distributed.fleet pipeline_parallel); "
+        "eager p2p is not supported on the TPU backend.")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise RuntimeError("see send()")
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group)
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    raise RuntimeError("see send()")
+
+
+def barrier(group=None):
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor) and isinstance(tensor._data, jax.Array):
+        tensor._data.block_until_ready()
+
+
+# -- stream namespace (reference: distributed/communication/stream/) --------
+
+class stream:
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    broadcast = staticmethod(broadcast)
+    scatter = staticmethod(scatter)
+    alltoall = staticmethod(alltoall)
+    alltoall_single = staticmethod(alltoall_single)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

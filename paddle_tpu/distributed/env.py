@@ -1,0 +1,93 @@
+"""Distributed environment + rendezvous.
+
+Reference: ``python/paddle/distributed/parallel.py`` (``init_parallel_env``
+:977, ParallelEnv, global TCPStore :1133).  TPU-native mapping (SURVEY.md
+§2.5): the SPMD driver process controls all local chips via PJRT, so
+"rank" is the *process* index and "world" the process count;
+``jax.distributed.initialize`` + the TPU coordination service replace the
+TCPStore rendezvous.  Env vars keep the reference's names
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM) so launch-script compat holds.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+class ParallelEnv:
+    def __init__(self):
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID",
+                                       jax.process_index()))
+        self.world_size = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                             jax.process_count()))
+        self.device_id = int(os.environ.get("FLAGS_selected_tpus",
+                                            os.environ.get(
+                                                "FLAGS_selected_gpus", "0")))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self.trainer_endpoints = eps.split(",") if eps else []
+        self.current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def dev_id(self):
+        return self.device_id
+
+
+_parallel_env: ParallelEnv | None = None
+_initialized = False
+
+
+def _env() -> ParallelEnv:
+    global _parallel_env
+    if _parallel_env is None:
+        _parallel_env = ParallelEnv()
+    return _parallel_env
+
+
+def init_parallel_env():
+    """Reference: distributed/parallel.py:977.  Multi-host: initializes the
+    jax distributed runtime (coordination service) when the launch env
+    carries endpoints; single-host SPMD needs no rendezvous."""
+    global _initialized
+    if _initialized:
+        return _env()
+    master = os.environ.get("MASTER_ADDR")
+    port = os.environ.get("MASTER_PORT")
+    nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
+    if master and port and nnodes > 1 and jax.process_count() == 1:
+        jax.distributed.initialize(
+            coordinator_address=f"{master}:{port}",
+            num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", nnodes)),
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    _initialized = True
+    global _parallel_env
+    _parallel_env = ParallelEnv()
+    return _parallel_env
+
+
+def is_initialized():
+    return _initialized
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.get_group_rank(_env().rank)
+    return _env().rank
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return _env().world_size
+
+
+def parallel_device_count():
+    return jax.device_count()

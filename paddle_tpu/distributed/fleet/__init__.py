@@ -1,0 +1,121 @@
+"""Fleet — the hybrid-parallel orchestration API.
+
+Reference: ``python/paddle/distributed/fleet/`` — ``fleet.init``
+(fleet.py:166), ``DistributedStrategy`` (base/distributed_strategy.py:175),
+``distributed_model`` (model.py:32), ``distributed_optimizer``,
+``HybridCommunicateGroup`` (base/topology.py:178).
+"""
+from __future__ import annotations
+
+from ..env import get_rank, get_world_size, init_parallel_env
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup,
+    get_hybrid_communicate_group, set_hybrid_communicate_group,
+)
+
+
+class DistributedStrategy:
+    """Reference: fleet/base/distributed_strategy.py:175 (protobuf-backed
+    there; a plain dataclass-ish config here, same field names)."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.without_graph_optimization = False
+
+    def __setattr__(self, key, value):
+        if key == "hybrid_configs" and hasattr(self, "hybrid_configs"):
+            merged = dict(self.hybrid_configs)
+            merged.update(value)
+            object.__setattr__(self, key, merged)
+        else:
+            object.__setattr__(self, key, value)
+
+
+class _Fleet:
+    def __init__(self):
+        self._strategy = None
+        self._hcg = None
+        self._is_initialized = False
+        self.worker_index = get_rank
+        self.worker_num = get_world_size
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        init_parallel_env()
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        topo = CommunicateTopology(
+            hybrid_group_names=["data", "pipe", "sharding", "sep", "model"],
+            dims=[hc.get("dp_degree", 1), hc.get("pp_degree", 1),
+                  hc.get("sharding_degree", 1), hc.get("sep_degree", 1),
+                  hc.get("mp_degree", 1)])
+        self._hcg = HybridCommunicateGroup(topo)
+        set_hybrid_communicate_group(self._hcg)
+        self._is_initialized = True
+        return self
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def barrier_worker(self):
+        from ..communication import barrier
+
+        barrier()
+
+    def distributed_model(self, model):
+        """Reference: fleet/model.py:32,139-170 — pick the wrapper by the
+        dominant parallel mode."""
+        from ..parallel import DataParallel
+        from .meta_parallel import PipelineParallel, TensorParallel
+
+        if self._hcg is None:
+            self.init()
+        if self._hcg.get_pipe_parallel_world_size() > 1:
+            return PipelineParallel(model, self._hcg, self._strategy)
+        if self._hcg.get_model_parallel_world_size() > 1:
+            return TensorParallel(model, self._hcg, self._strategy)
+        return DataParallel(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from .hybrid_optimizer import HybridParallelOptimizer
+
+        if self._hcg is None:
+            self.init()
+        return HybridParallelOptimizer(optimizer, self._hcg,
+                                       self._strategy)
+
+
+fleet = _Fleet()
+
+# module-level API: paddle.distributed.fleet.init(...)
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+worker_index = get_rank
+worker_num = get_world_size
+
+
+def is_first_worker():
+    return get_rank() == 0

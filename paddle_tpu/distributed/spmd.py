@@ -1,0 +1,90 @@
+"""SPMD execution helpers — the bridge from eager Tensor code to
+mesh-parallel XLA programs.
+
+This is the TPU-native replacement for the reference's imperative
+ProcessGroup runtime (SURVEY.md §2.5): instead of launching collectives on
+comm streams, the train step is traced ONCE over a ``jax.sharding.Mesh``
+and GSPMD/shard_map insert the collectives (psum/all_gather/reduce_scatter/
+ppermute) over ICI.
+
+Two levels:
+- ``constrain(tensor, mesh, placements)`` — GSPMD sharding annotation
+  (``jax.lax.with_sharding_constraint``): the auto-parallel path.
+- ``shard_map_call(fn, mesh, in_placements, out_placements)`` — explicit
+  per-device programming with mesh axis names bound, so the
+  ``paddle.distributed.*`` collectives (communication.py) lower to
+  ``jax.lax`` collectives inside: the manual hybrid-parallel path.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from .auto_parallel import (
+    Placement, ProcessMesh, Replicate, Shard, placements_to_spec,
+    to_named_sharding,
+)
+
+
+def _spec_of(mesh: ProcessMesh, placements, ndim) -> PartitionSpec:
+    names = mesh.dim_names
+    spec = placements_to_spec(placements, ndim)
+    parts = []
+    for entry in spec:
+        if entry is None:
+            parts.append(None)
+        elif isinstance(entry, tuple):
+            parts.append(tuple(names[i] for i in entry))
+        else:
+            parts.append(names[entry])
+    return PartitionSpec(*parts)
+
+
+def constrain(x, mesh: ProcessMesh, placements):
+    """Annotate a (possibly traced) tensor with a sharding constraint."""
+    d = x._data if isinstance(x, Tensor) else x
+    out = jax.lax.with_sharding_constraint(
+        d, NamedSharding(mesh.jax_mesh, _spec_of(mesh, placements,
+                                                 d.ndim)))
+    if isinstance(x, Tensor):
+        t = Tensor(out, stop_gradient=x.stop_gradient)
+        t._grad_node = x._grad_node
+        t._out_slot = x._out_slot
+        return t
+    return out
+
+
+def shard_map_call(fn, mesh: ProcessMesh, in_specs, out_specs, *args,
+                   check_vma=False):
+    """Run fn(*args) under jax.shard_map with the mesh axes bound.
+
+    in_specs/out_specs: PartitionSpec, or placements lists, per arg/out.
+    Inside fn, paddle.distributed collectives with groups bound to this
+    mesh's axis names lower to lax collectives.
+    """
+
+    def to_spec(s, ndim):
+        if isinstance(s, PartitionSpec):
+            return s
+        return _spec_of(mesh, s, ndim)
+
+    datas = [a._data if isinstance(a, Tensor) else a for a in args]
+    ispecs = tuple(to_spec(s, d.ndim) for s, d in zip(in_specs, datas))
+
+    def inner(*ds):
+        outs = fn(*[Tensor(d) for d in ds])
+        return jax.tree.map(
+            lambda o: o._data if isinstance(o, Tensor) else o, outs,
+            is_leaf=lambda x: isinstance(x, Tensor))
+
+    mapped = jax.shard_map(inner, mesh=mesh.jax_mesh, in_specs=ispecs,
+                           out_specs=out_specs, check_vma=check_vma)
+    out = mapped(*datas)
+    return jax.tree.map(Tensor, out)
+
+
+def device_put_sharded(x, mesh: ProcessMesh, placements):
+    d = x._data if isinstance(x, Tensor) else x
+    arr = jax.device_put(d, to_named_sharding(mesh, placements, d.ndim))
+    return Tensor(arr) if isinstance(x, Tensor) else arr

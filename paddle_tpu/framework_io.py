@@ -1,0 +1,42 @@
+"""paddle.save / paddle.load.
+
+Reference: ``python/paddle/framework/io.py:773,1020`` — pickled state_dict
+of numpy-converted tensors (nested dicts/lists pass through).  Sharded
+distributed checkpointing lives in ``paddle_tpu.distributed.checkpoint``.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .core.tensor import Tensor
+
+
+def _to_numpy_tree(obj):
+    if isinstance(obj, Tensor):
+        return np.asarray(obj._data)
+    if isinstance(obj, dict):
+        return {k: _to_numpy_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_numpy_tree(v) for v in obj)
+    import jax
+
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return pickle.load(f)

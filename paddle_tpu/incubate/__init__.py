@@ -1,0 +1,6 @@
+"""paddle.incubate analog — experimental surfaces (fused ops, MoE).
+
+Reference: ``python/paddle/incubate/`` (nn/functional fused ops, distributed
+models MoE).
+"""
+from . import nn  # noqa: F401

@@ -1,0 +1,29 @@
+"""Fused-op functional surface.
+
+Reference: ``python/paddle/incubate/nn/functional/`` — fused rms_norm,
+swiglu, rotary embedding, fused_linear.  On TPU these are fusable XLA
+expressions (or Pallas kernels where registered); the "fused" names are
+kept for API parity.
+"""
+from ....nn.functional import rms_norm as fused_rms_norm  # noqa: F401
+from ....nn.functional import (  # noqa: F401
+    fused_rotary_position_embedding,
+)
+from ....ops import swiglu  # noqa: F401
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    from .... import ops
+
+    out = ops.matmul(x, weight, transpose_y=transpose_weight)
+    if bias is not None:
+        out = ops.add(out, bias)
+    return out
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", name=None, **kwargs):
+    from .... import ops
+
+    if bias is not None:
+        x = ops.add(x, bias)
+    return getattr(ops, act_method)(x)

@@ -1,0 +1,356 @@
+"""paddle.io analog — Dataset/DataLoader/samplers.
+
+Reference: ``python/paddle/io/`` — ``DataLoader`` with multiprocess
+prefetch workers (``dataloader_iter.py:370`` ``_DataLoaderIterMultiProcess``,
+``worker.py:281`` ``_worker_loop``), samplers, ``TensorDataset``...
+
+TPU-native notes: the loader yields host numpy batches; device transfer
+happens at first op use (or explicitly via ``to_tensor``), letting jax
+overlap H2D with compute.  ``num_workers>0`` uses a multiprocessing pool
+feeding an index queue exactly like the reference's worker loop.
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import queue
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.random import default_generator
+
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset is not subscriptable")
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors):
+        self.tensors = tensors
+
+    def __getitem__(self, idx):
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self):
+        return self.tensors[0].shape[0]
+
+
+class ComposeDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = datasets
+
+    def __len__(self):
+        return min(len(d) for d in self.datasets)
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            item = d[idx]
+            out.extend(item if isinstance(item, (tuple, list)) else [item])
+        return tuple(out)
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets):
+        self.datasets = datasets
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None):
+    total = len(dataset)
+    if all(isinstance(v, float) for v in lengths):
+        lengths = [int(round(total * v)) for v in lengths]
+        lengths[-1] = total - sum(lengths[:-1])
+    assert sum(lengths) == total
+    perm = np.random.permutation(total).tolist()
+    out = []
+    offset = 0
+    for n in lengths:
+        out.append(Subset(dataset, perm[offset:offset + n]))
+        offset += n
+    return out
+
+
+# -- samplers ---------------------------------------------------------------
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self.num_samples = num_samples or len(data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        if self.replacement:
+            return iter(np.random.randint(0, n, self.num_samples).tolist())
+        return iter(np.random.permutation(n)[:self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        self.weights = np.asarray(weights, dtype=np.float64)
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        idx = np.random.choice(len(self.weights), self.num_samples,
+                               replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False):
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        if sampler is not None:
+            self.sampler = sampler
+        elif shuffle:
+            self.sampler = RandomSampler(dataset)
+        else:
+            self.sampler = SequenceSampler(dataset)
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Reference: python/paddle/io/dataloader/batch_sampler.py
+    DistributedBatchSampler — shards indices per rank."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        from ..distributed import get_rank, get_world_size
+
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.nranks = num_replicas if num_replicas is not None \
+            else get_world_size()
+        self.local_rank = rank if rank is not None else get_rank()
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.num_samples = int(np.ceil(len(dataset) / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.RandomState(self.epoch)
+            indices = rng.permutation(n).tolist()
+            self.epoch += 1
+        else:
+            indices = list(range(n))
+        indices += indices[:(self.total_size - len(indices))]
+        indices = indices[self.local_rank:self.total_size:self.nranks]
+        batch = []
+        for idx in indices:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+
+# -- collate ----------------------------------------------------------------
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s._data) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.number)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return [default_collate_fn(list(col)) for col in transposed]
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch])
+                for k in sample}
+    return batch
+
+
+def _worker_fn(dataset, indices, collate_fn):
+    batch = [dataset[i] for i in indices]
+    return collate_fn(batch)
+
+
+class _MPWorkerIter:
+    """Multiprocess prefetch iterator (reference: _DataLoaderIterMultiProcess
+    dataloader_iter.py:370 — index queue -> worker pool -> ordered results)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.pool = mp.get_context("fork").Pool(loader.num_workers)
+        self.batches = iter(loader.batch_sampler)
+        self.pending = []
+        self.prefetch = max(2 * loader.num_workers, 2)
+        self._prime()
+
+    def _prime(self):
+        for _ in range(self.prefetch):
+            self._submit()
+
+    def _submit(self):
+        try:
+            indices = next(self.batches)
+        except StopIteration:
+            return
+        ds = self.loader.dataset
+        cf = self.loader.collate_fn or default_collate_fn
+        self.pending.append(self.pool.apply_async(_worker_fn,
+                                                  (ds, indices, cf)))
+
+    def __next__(self):
+        if not self.pending:
+            self.pool.close()
+            raise StopIteration
+        result = self.pending.pop(0).get()
+        self._submit()
+        return result
+
+    def __iter__(self):
+        return self
+
+    def __del__(self):
+        try:
+            self.pool.terminate()
+        except Exception:
+            pass
+
+
+class DataLoader:
+    """Reference: python/paddle/io/dataloader/dataloader_iter.py.  Single
+    process by default; ``num_workers>0`` -> fork pool with prefetch."""
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn
+        self.num_workers = num_workers
+        self.return_list = return_list
+        if batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        elif isinstance(dataset, IterableDataset):
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __iter__(self):
+        if self.batch_sampler is None:
+            return self._iter_iterable()
+        if self.num_workers > 0:
+            return _MPWorkerIter(self)
+        return self._iter_single()
+
+    def _iter_single(self):
+        cf = self.collate_fn or default_collate_fn
+        for indices in self.batch_sampler:
+            yield cf([self.dataset[i] for i in indices])
+
+    def _iter_iterable(self):
+        cf = self.collate_fn or default_collate_fn
+        batch = []
+        for item in self.dataset:
+            batch.append(item)
+            if len(batch) == self.batch_size:
+                yield cf(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield cf(batch)
+
+    def __len__(self):
+        if self.batch_sampler is None:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+
+def get_worker_info():
+    return None

@@ -1,0 +1,212 @@
+"""paddle.jit analog — dygraph-to-static via tracing.
+
+Reference: ``python/paddle/jit/`` (``to_static`` at api.py:197; SOT bytecode
+path + AST path).  TPU-native re-design: because every eager op runs through
+jax, a Layer's forward *is already traceable* — ``to_static`` lifts it into
+a pure function over (parameters, buffers, inputs) and ``jax.jit``s it, with
+a signature cache keyed on input shapes/dtypes + static args (the analog of
+SOT's guard cache, sot/opcode_translator).  Buffer mutation (BN running
+stats) is functionalized: the traced function returns updated buffer values
+which are written back after each call.
+
+``jit.save``/``jit.load`` serialize the lowered StableHLO text + params
+(the TranslatedLayer analog).
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+
+import numpy as np
+
+import jax
+
+from ..autograd import engine
+from ..core.tensor import Tensor
+from ..nn.layers import Layer
+
+
+class _Guard:
+    """Cache key: pytree structure + shapes/dtypes of tensor leaves +
+    values of non-tensor leaves (SOT guard analog)."""
+
+    @staticmethod
+    def key(args, kwargs):
+        leaves, treedef = jax.tree.flatten((args, kwargs),
+                                           is_leaf=lambda x: isinstance(
+                                               x, Tensor))
+        sig = []
+        for leaf in leaves:
+            if isinstance(leaf, Tensor):
+                sig.append(("T", tuple(leaf.shape), str(leaf.dtype)))
+            else:
+                try:
+                    hash(leaf)
+                    sig.append(("S", leaf))
+                except TypeError:
+                    sig.append(("S", repr(leaf)))
+        return treedef, tuple(sig)
+
+
+class StaticFunction:
+    def __init__(self, function, layer=None, input_spec=None,
+                 full_graph=True):
+        self._fn = function
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache = {}
+        functools.update_wrapper(self, function)
+
+    def _state_tensors(self):
+        if self._layer is None:
+            return []
+        tensors = [p for _, p in self._layer.named_parameters()]
+        tensors += [b for _, b in self._layer.named_buffers()]
+        return tensors
+
+    def __call__(self, *args, **kwargs):
+        state = self._state_tensors()
+        key = _Guard.key(args, kwargs)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._compile(args, kwargs, state)
+            self._cache[key] = entry
+        jitted = entry
+
+        leaves, _ = jax.tree.flatten((args, kwargs),
+                                     is_leaf=lambda x: isinstance(x, Tensor))
+        tensor_datas = [x._data for x in leaves if isinstance(x, Tensor)]
+        out_datas, new_state = jitted([t._data for t in state],
+                                      tensor_datas)
+        for t, d in zip(state, new_state):
+            t._data = d
+        return jax.tree.map(
+            lambda d: Tensor(d) if d is not None else None, out_datas)
+
+    def _compile(self, args, kwargs, state):
+        fn = self._fn
+        treedef, _ = _Guard.key(args, kwargs)
+        leaves, _ = jax.tree.flatten((args, kwargs),
+                                     is_leaf=lambda x: isinstance(x, Tensor))
+        is_tensor = [isinstance(x, Tensor) for x in leaves]
+        static_leaves = [None if t else x
+                         for t, x in zip(is_tensor, leaves)]
+
+        def pure(state_datas, input_datas):
+            saved = [t._data for t in state]
+            it = iter(input_datas)
+            rebuilt = [Tensor(next(it)) if t else s
+                       for t, s in zip(is_tensor, static_leaves)]
+            new_args, new_kwargs = jax.tree.unflatten(treedef, rebuilt)
+            try:
+                for t, d in zip(state, state_datas):
+                    t._data = d
+                with engine.no_grad():
+                    out = fn(*new_args, **new_kwargs)
+                out_datas = jax.tree.map(
+                    lambda o: o._data if isinstance(o, Tensor) else o, out,
+                    is_leaf=lambda x: isinstance(x, Tensor))
+                new_state = [t._data for t in state]
+            finally:
+                for t, d in zip(state, saved):
+                    t._data = d
+            return out_datas, new_state
+
+        return jax.jit(pure)
+
+    # Reference API parity.
+    @property
+    def code(self):
+        return "<compiled by paddle_tpu.jit (XLA)>"
+
+    def concrete_program_specify_input_spec(self, *a, **k):
+        return None
+
+    def rollback(self):
+        return self._fn
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """Reference: python/paddle/jit/api.py:197."""
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn.forward, layer=fn,
+                                input_spec=input_spec)
+            fn.forward = sf
+            return fn
+        layer = getattr(fn, "__self__", None)
+        layer = layer if isinstance(layer, Layer) else None
+        return StaticFunction(fn, layer=layer, input_spec=input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+class InputSpec:
+    """Reference: python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialize params + (when possible) the lowered StableHLO text."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state = {}
+    if isinstance(layer, Layer):
+        for name, p in layer.state_dict().items():
+            state[name] = np.asarray(p._data)
+    payload = {"state_dict": state, "format": "paddle_tpu.jit.v1"}
+    if input_spec:
+        try:
+            datas = [np.zeros(s.shape, s.dtype) if isinstance(s, InputSpec)
+                     else np.asarray(s._data) for s in input_spec]
+            fn = layer.forward if isinstance(layer, Layer) else layer
+
+            def pure(*xs):
+                with engine.no_grad():
+                    out = fn(*[Tensor(x) for x in xs])
+                return jax.tree.map(
+                    lambda o: o._data if isinstance(o, Tensor) else o, out,
+                    is_leaf=lambda x: isinstance(x, Tensor))
+
+            lowered = jax.jit(pure).lower(*datas)
+            payload["stablehlo"] = lowered.as_text()
+        except Exception as e:  # serialize params regardless
+            payload["stablehlo_error"] = str(e)
+    with open(path + ".pdparams", "wb") as f:
+        pickle.dump(payload, f)
+
+
+def load(path, **configs):
+    with open(path + ".pdparams", "rb") as f:
+        payload = pickle.load(f)
+
+    class TranslatedLayer(Layer):
+        def __init__(self, payload):
+            super().__init__()
+            self._payload = payload
+            self._state = {k: Tensor(v) for k, v in
+                           payload["state_dict"].items()}
+
+        def state_dict(self, *a, **k):
+            return dict(self._state)
+
+        def program(self):
+            return self._payload.get("stablehlo", "")
+
+    return TranslatedLayer(payload)

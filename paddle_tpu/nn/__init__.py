@@ -1,0 +1,35 @@
+"""paddle.nn analog — layers, functional, initializers, clipping.
+
+Reference surface: ``python/paddle/nn/__init__.py``.
+"""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .clip import (  # noqa: F401
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue, clip_grad_norm_,
+)
+from .common import (  # noqa: F401
+    CELU, ELU, GELU, Dropout, Dropout2D, Embedding, Flatten, Hardshrink,
+    Hardsigmoid, Hardswish, Hardtanh, Identity, LayerDict, LayerList,
+    LeakyReLU, Linear, LogSigmoid, LogSoftmax, Mish, Pad2D, ParameterList,
+    PReLU, ReLU, ReLU6, SELU, Sequential, Sigmoid, Silu, Softmax, Softplus,
+    Softshrink, Softsign, Swish, Tanh, Tanhshrink, ThresholdedReLU,
+    Upsample,
+)
+from .conv import Conv1D, Conv2D, Conv2DTranspose  # noqa: F401
+from .layers import Layer  # noqa: F401
+from .loss import (  # noqa: F401
+    BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, KLDivLoss, L1Loss,
+    MSELoss, NLLLoss, SmoothL1Loss,
+)
+from .norm import (  # noqa: F401
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,
+    InstanceNorm2D, LayerNorm, LocalResponseNorm, RMSNorm, SyncBatchNorm,
+)
+from .param_attr import ParamAttr  # noqa: F401
+from .pooling import AdaptiveAvgPool2D, AvgPool2D, MaxPool2D  # noqa: F401
+
+
+def layer_norm_types():
+    from .norm import _BatchNormBase
+
+    return (_BatchNormBase, LayerNorm, GroupNorm, RMSNorm)

@@ -1,0 +1,100 @@
+"""Gradient clipping.
+
+Reference: ``python/paddle/nn/clip.py`` — ClipGradByValue, ClipGradByNorm,
+ClipGradByGlobalNorm (the global-norm variant is what HybridParallelOptimizer
+re-implements across mesh axes; the distributed version lives in
+paddle_tpu.distributed.fleet).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._data, self.min, self.max),
+                                  stop_gradient=True)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g._data.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+            out.append((p, Tensor((g._data.astype(jnp.float32) * scale)
+                                  .astype(g.dtype), stop_gradient=True)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def __call__(self, params_grads):
+        sq = None
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                continue
+            s = jnp.sum(jnp.square(g._data.astype(jnp.float32)))
+            sq = s if sq is None else sq + s
+        if sq is None:
+            return params_grads
+        global_norm = jnp.sqrt(sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g._data.astype(jnp.float32) * scale)
+                                  .astype(g.dtype), stop_gradient=True)))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    params = [parameters] if not isinstance(parameters, (list, tuple)) \
+        else list(parameters)
+    grads = [p.grad for p in params if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros([], jnp.float32))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(g._data)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g._data.astype(jnp.float32)) ** norm_type)
+             for g in grads])) ** (1.0 / norm_type)
+    coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        if p.grad is not None:
+            p.grad = Tensor(p.grad._data * coef.astype(p.grad.dtype),
+                            stop_gradient=True)
+    return Tensor(total)

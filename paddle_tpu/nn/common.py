@@ -1,0 +1,308 @@
+"""Common layers: Linear, Embedding, Dropout, activations-as-layers,
+containers, padding, upsample.
+
+Reference: ``python/paddle/nn/layer/common.py`` (Linear/Embedding/Dropout/
+Upsample/Pad...), ``activation.py`` (layer wrappers), ``container.py``
+(Sequential/LayerList/ParameterList).
+"""
+from __future__ import annotations
+
+from .. import ops
+from ..core.tensor import Tensor
+from . import functional as F
+from . import initializer as I
+from .layers import Layer
+from .param_attr import ParamAttr
+
+
+class Identity(Layer):
+    def forward(self, x):
+        return x
+
+
+class Linear(Layer):
+    """y = xW + b; weight shape [in_features, out_features] (reference:
+    nn/layer/common.py Linear — note paddle stores W as [in, out])."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.bias = self.create_parameter(
+            shape=[out_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+    def extra_repr(self):
+        return f"in_features={self._in_features}, " \
+               f"out_features={self._out_features}"
+
+
+class Embedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
+                 sparse=False, weight_attr=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self._padding_idx = padding_idx
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal(0.0, 1.0))
+        if padding_idx is not None:
+            import jax.numpy as jnp
+
+            self.weight._data = self.weight._data.at[padding_idx].set(0.0)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x):
+        return F.dropout(x, p=self.p, training=self.training, mode=self.mode)
+
+
+class Dropout2D(Dropout):
+    pass
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start_axis = start_axis
+        self.stop_axis = stop_axis
+
+    def forward(self, x):
+        return ops.flatten(x, self.start_axis, self.stop_axis)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False, align_mode=0, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+        self.mode = mode
+        self.align_corners = align_corners
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size,
+                             scale_factor=self.scale_factor, mode=self.mode,
+                             align_corners=self.align_corners,
+                             data_format=self.data_format)
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.padding = padding
+        self.mode = mode
+        self.value = value
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode=self.mode, value=self.value)
+
+
+# -- activation layers ------------------------------------------------------
+
+def _act_layer(name, fn, **default_kwargs):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            merged = dict(default_kwargs)
+            keys = list(default_kwargs.keys())
+            for i, a in enumerate(args):
+                merged[keys[i]] = a
+            merged.update({k: v for k, v in kwargs.items() if k != "name"})
+            self._kwargs = merged
+
+        def forward(self, x):
+            return fn(x, **self._kwargs)
+
+    _Act.__name__ = name
+    _Act.__qualname__ = name
+    return _Act
+
+
+ReLU = _act_layer("ReLU", ops.relu)
+ReLU6 = _act_layer("ReLU6", ops.relu6)
+GELU = _act_layer("GELU", ops.gelu, approximate=False)
+Sigmoid = _act_layer("Sigmoid", ops.sigmoid)
+Tanh = _act_layer("Tanh", ops.tanh)
+Silu = _act_layer("Silu", ops.silu)
+LeakyReLU = _act_layer("LeakyReLU", ops.leaky_relu, negative_slope=0.01)
+ELU = _act_layer("ELU", ops.elu, alpha=1.0)
+SELU = _act_layer("SELU", ops.selu)
+CELU = _act_layer("CELU", ops.celu, alpha=1.0)
+Softplus = _act_layer("Softplus", ops.softplus, beta=1.0, threshold=20.0)
+Softsign = _act_layer("Softsign", ops.softsign)
+Hardtanh = _act_layer("Hardtanh", ops.hardtanh, min=-1.0, max=1.0)
+Hardsigmoid = _act_layer("Hardsigmoid", ops.hardsigmoid)
+Hardswish = _act_layer("Hardswish", ops.hardswish)
+Swish = _act_layer("Swish", ops.swish)
+Mish = _act_layer("Mish", ops.mish)
+Tanhshrink = _act_layer("Tanhshrink", ops.tanhshrink)
+Softshrink = _act_layer("Softshrink", ops.softshrink, threshold=0.5)
+Hardshrink = _act_layer("Hardshrink", ops.hardshrink, threshold=0.5)
+ThresholdedReLU = _act_layer("ThresholdedReLU", ops.thresholded_relu,
+                             threshold=1.0)
+LogSigmoid = _act_layer("LogSigmoid", ops.log_sigmoid)
+Softmax = _act_layer("Softmax", ops.softmax, axis=-1)
+LogSoftmax = _act_layer("LogSoftmax", ops.log_softmax, axis=-1)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            shape=[num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return ops.prelu(x, self.weight, data_format=self._data_format)
+
+
+# -- containers -------------------------------------------------------------
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and \
+                layers and isinstance(layers[0][0], (list, tuple)):
+            for name, layer in layers[0]:
+                self.add_sublayer(name, layer)
+        else:
+            for i, layer in enumerate(layers):
+                if isinstance(layer, tuple):
+                    self.add_sublayer(layer[0], layer[1])
+                else:
+                    self.add_sublayer(str(i), layer)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return Sequential(*list(self._sub_layers.values())[idx])
+        keys = list(self._sub_layers.keys())
+        return self._sub_layers[keys[idx]]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            for i, layer in enumerate(sublayers):
+                self.add_sublayer(str(i), layer)
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def extend(self, layers):
+        for layer in layers:
+            self.append(layer)
+        return self
+
+    def insert(self, index, layer):
+        all_layers = list(self._sub_layers.values())
+        all_layers.insert(index, layer)
+        self._sub_layers.clear()
+        for i, sub in enumerate(all_layers):
+            self._sub_layers[str(i)] = sub
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return LayerList(list(self._sub_layers.values())[idx])
+        return self._sub_layers[str(idx % len(self._sub_layers))]
+
+    def __setitem__(self, idx, layer):
+        self._sub_layers[str(idx)] = layer
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def append(self, parameter):
+        self.add_parameter(str(len(self._parameters)), parameter)
+        return self
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx)]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+
+class LayerDict(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            for k, v in (sublayers.items() if isinstance(sublayers, dict)
+                         else sublayers):
+                self.add_sublayer(k, v)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(key, layer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def update(self, sublayers):
+        for k, v in (sublayers.items() if isinstance(sublayers, dict)
+                     else sublayers):
+            self.add_sublayer(k, v)

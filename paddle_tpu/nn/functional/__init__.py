@@ -1,0 +1,403 @@
+"""paddle.nn.functional analog.
+
+Reference: ``python/paddle/nn/functional/`` — thin wrappers binding the op
+library to the nn API surface (linear/conv/norm/loss/attention/...).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import ops
+from ...core.tensor import Tensor
+from ...ops import (  # noqa: F401  - re-exported activations
+    celu, elu, gelu, glu, hardshrink, hardsigmoid, hardswish, hardtanh,
+    leaky_relu, log_sigmoid, log_softmax, mish, prelu, relu, relu6, selu,
+    sigmoid, silu, softmax, softplus, softshrink, softsign, swish, swiglu,
+    tanh, tanhshrink, thresholded_relu,
+)
+from ...ops import nn_ops, registry
+from ...ops.manipulation import pad  # noqa: F401
+from ...ops.nn_ops import _pair
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b (W is [in, out] like the reference, ops.yaml `linear`)."""
+    out = ops.matmul(x, weight)
+    if bias is not None:
+        out = ops.add(out, bias)
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return registry.apply(nn_ops.embedding_op, weight, x,
+                          padding_idx=padding_idx)
+
+
+def one_hot(x, num_classes, name=None):
+    return ops.one_hot(x, num_classes)
+
+
+# -- conv / pool ------------------------------------------------------------
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    out = nn_ops.conv2d_raw(x, weight, stride, padding, dilation, groups,
+                            data_format)
+    if bias is not None:
+        shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        out = ops.add(out, ops.reshape(bias, shape))
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    out = registry.apply(nn_ops.conv1d_op, x, weight, stride=int(stride),
+                         padding=int(padding) if not isinstance(
+                             padding, (list, tuple)) else int(padding[0]),
+                         dilation=int(dilation), groups=int(groups))
+    if bias is not None:
+        out = ops.add(out, ops.reshape(bias, (1, -1, 1)))
+    return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCHW", name=None):
+    out = registry.apply(
+        nn_ops.conv2d_transpose_op, x, weight, stride=_pair(stride),
+        padding=_pair(padding), output_padding=_pair(output_padding),
+        dilation=_pair(dilation), groups=int(groups),
+        data_format=data_format)
+    if bias is not None:
+        shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        out = ops.add(out, ops.reshape(bias, shape))
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    stride = stride if stride is not None else kernel_size
+    return registry.apply(nn_ops.max_pool2d_op, x,
+                          kernel_size=_pair(kernel_size),
+                          stride=_pair(stride), padding=_pair(padding),
+                          ceil_mode=bool(ceil_mode),
+                          data_format=data_format)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    stride = stride if stride is not None else kernel_size
+    return registry.apply(nn_ops.avg_pool2d_op, x,
+                          kernel_size=_pair(kernel_size),
+                          stride=_pair(stride), padding=_pair(padding),
+                          exclusive=bool(exclusive),
+                          data_format=data_format)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return registry.apply(nn_ops.adaptive_avg_pool2d_op, x,
+                          output_size=_pair(output_size),
+                          data_format=data_format)
+
+
+# -- norms ------------------------------------------------------------------
+
+def layer_norm(x, normalized_shape=None, weight=None, bias=None,
+               epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        begin = -1
+    elif normalized_shape is not None:
+        begin = x.ndim - len(tuple(normalized_shape))
+    else:
+        begin = -1
+    weight, bias = _norm_affine_pair(weight, bias)
+    args = [x] + [a for a in (weight, bias) if a is not None]
+    return registry.apply(nn_ops.layer_norm_op, *args,
+                          epsilon=float(epsilon), begin_norm_axis=begin)
+
+
+def _norm_affine_pair(weight, bias):
+    """Norm ops take (weight[, bias]) positionally; a bias without a weight
+    must not slide into the weight slot — substitute a ones weight."""
+    if weight is None and bias is not None:
+        from ... import ops as _ops
+
+        weight = _ops.ones_like(bias)
+    return weight, bias
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    if weight is not None:
+        return registry.apply(nn_ops.rms_norm_op, x, weight,
+                              epsilon=float(epsilon))
+    return registry.apply(nn_ops.rms_norm_op, x, epsilon=float(epsilon))
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    if training and not use_global_stats:
+        mean_t, var_t = registry.apply(nn_ops.batch_norm_stats_op, x,
+                                       data_format=data_format)
+        # Update running stats in place (reference batch_norm semantics).
+        with_np = running_mean is not None
+        if with_np:
+            import jax.numpy as jnp
+
+            m = momentum
+            running_mean.set_value(
+                m * running_mean._data + (1 - m) * mean_t._data)
+            running_var.set_value(
+                m * running_var._data + (1 - m) * var_t._data)
+        use_mean, use_var = mean_t, var_t
+    else:
+        use_mean, use_var = running_mean, running_var
+    weight, bias = _norm_affine_pair(weight, bias)
+    args = [x, use_mean, use_var] + [a for a in (weight, bias)
+                                     if a is not None]
+    return registry.apply(nn_ops.batch_norm_infer_op, *args,
+                          epsilon=float(epsilon), data_format=data_format)
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW", name=None):
+    weight, bias = _norm_affine_pair(weight, bias)
+    args = [x] + [a for a in (weight, bias) if a is not None]
+    return registry.apply(nn_ops.group_norm_op, *args,
+                          epsilon=float(epsilon), groups=int(num_groups),
+                          data_format=data_format)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    n = ops.norm(x, p=p, axis=axis, keepdim=True)
+    n = ops.clip(n, min=epsilon)
+    return ops.divide(x, n)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    return nn_ops.dropout_raw(x, p=p, training=training, mode=mode)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    return nn_ops.dropout_raw(x, p=p, training=training)
+
+
+# -- losses -----------------------------------------------------------------
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return ops.mean(loss)
+    if reduction == "sum":
+        return ops.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """Reference: python/paddle/nn/functional/loss.py cross_entropy."""
+    if label_smoothing > 0.0:
+        num_classes = input.shape[axis]
+        if not soft_label:
+            label = ops.one_hot(label, num_classes)
+            soft_label = True
+        label = ops.add(
+            ops.scale(label, scale=1.0 - label_smoothing),
+            ops.full([1], label_smoothing / num_classes,
+                     dtype=str(input.dtype)))
+    if not soft_label and label.ndim == input.ndim:
+        label = ops.squeeze(label, axis=axis)
+    loss = registry.apply(
+        nn_ops.softmax_with_cross_entropy_op, input, label,
+        soft_label=bool(soft_label),
+        ignore_index=int(ignore_index), axis=int(axis))
+    loss = ops.squeeze(loss, axis=-1)
+    if weight is not None and not soft_label:
+        w = ops.gather(weight, ops.reshape(label, [-1]))
+        w = ops.reshape(w, loss.shape)
+        loss = ops.multiply(loss, ops.cast(w, str(loss.dtype)))
+    if reduction == "mean" and not soft_label and ignore_index is not None \
+            and ignore_index >= 0:
+        valid = ops.cast(ops.not_equal(label, ignore_index),
+                         str(loss.dtype))
+        denom = ops.maximum(ops.sum(valid),
+                            ops.full([], 1.0, str(loss.dtype)))
+        return ops.divide(ops.sum(loss), denom)
+    return _reduce_loss(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = registry.apply(nn_ops.softmax_with_cross_entropy_op, logits,
+                          label if soft_label else ops.squeeze(label, -1)
+                          if label.ndim == logits.ndim else label,
+                          soft_label=bool(soft_label),
+                          ignore_index=int(ignore_index), axis=int(axis))
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    d = ops.subtract(input, label)
+    return _reduce_loss(ops.multiply(d, d), reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return _reduce_loss(ops.abs(ops.subtract(input, label)), reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    d = ops.subtract(input, label)
+    ad = ops.abs(d)
+    quad = ops.multiply(ops.scale(ops.multiply(d, d), scale=0.5 / delta),
+                        ops.ones_like(d))
+    lin = ops.subtract(ad, ops.full([], 0.5 * delta, str(input.dtype)))
+    loss = ops.where(ops.less_than(ad, ops.full([], delta,
+                                                str(input.dtype))),
+                     quad, lin)
+    return _reduce_loss(loss, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    picked = ops.neg(ops.squeeze(ops.take_along_axis(
+        input, ops.unsqueeze(ops.cast(label, "int64"), -1), axis=-1), -1))
+    if weight is not None:
+        w = ops.gather(weight, ops.reshape(label, [-1]))
+        picked = ops.multiply(picked, ops.reshape(w, picked.shape))
+    return _reduce_loss(picked, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    eps = 1e-12
+    clipped = ops.clip(input, min=eps, max=1 - eps)
+    loss = ops.neg(ops.add(
+        ops.multiply(label, ops.log(clipped)),
+        ops.multiply(ops.scale(label, scale=-1.0, bias=1.0),
+                     ops.log(ops.scale(clipped, scale=-1.0, bias=1.0)))))
+    if weight is not None:
+        loss = ops.multiply(loss, weight)
+    return _reduce_loss(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    # max(x,0) - x*y + log(1 + exp(-|x|))
+    neg_abs = ops.neg(ops.abs(logit))
+    loss = ops.add(
+        ops.subtract(ops.relu(logit), ops.multiply(logit, label)),
+        ops.log1p(ops.exp(neg_abs)))
+    if pos_weight is not None:
+        log_w = ops.add(
+            ops.multiply(ops.subtract(pos_weight,
+                                      ops.ones_like(pos_weight)), label),
+            ops.ones_like(label))
+        loss = ops.multiply(loss, log_w)
+    if weight is not None:
+        loss = ops.multiply(loss, weight)
+    return _reduce_loss(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    if log_target:
+        loss = ops.multiply(ops.exp(label), ops.subtract(label, input))
+    else:
+        safe = ops.maximum(label, ops.full([], 1e-12, str(label.dtype)))
+        loss = ops.multiply(label, ops.subtract(ops.log(safe), input))
+    if reduction == "batchmean":
+        return ops.divide(ops.sum(loss),
+                          ops.full([], float(input.shape[0]),
+                                   str(input.dtype)))
+    return _reduce_loss(loss, reduction)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return binary_cross_entropy(input, label, reduction="none")
+
+
+# -- attention --------------------------------------------------------------
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """[batch, seq, heads, head_dim] layout — reference:
+    python/paddle/nn/functional/flash_attention.py
+    scaled_dot_product_attention."""
+    if attn_mask is not None:
+        return registry.apply(nn_ops.sdpa_op, query, key, value, attn_mask,
+                              dropout=float(dropout_p),
+                              causal=bool(is_causal))
+    return registry.apply(nn_ops.sdpa_op, query, key, value,
+                          dropout=float(dropout_p), causal=bool(is_causal))
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, name=None):
+    out = scaled_dot_product_attention(query, key, value,
+                                       dropout_p=dropout, is_causal=causal)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True):
+    """Reference: phi fused_rope (ops/yaml/fused_ops.yaml)."""
+    import jax.numpy as jnp
+
+    qk = registry.apply(nn_ops.fused_rope_op, q, k,
+                        ops.cast(Tensor(cos._data if isinstance(cos, Tensor)
+                                        else jnp.asarray(cos)),
+                                 str(q.dtype)),
+                        ops.cast(Tensor(sin._data if isinstance(sin, Tensor)
+                                        else jnp.asarray(sin)),
+                                 str(q.dtype)))
+    qo, ko = qk
+    return qo, ko, v
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    if size is None:
+        h = int(x.shape[2] * scale_factor) if data_format == "NCHW" \
+            else int(x.shape[1] * scale_factor)
+        w = int(x.shape[3] * scale_factor) if data_format == "NCHW" \
+            else int(x.shape[2] * scale_factor)
+        size = (h, w)
+    else:
+        size = tuple(int(s) for s in size)
+    return registry.apply(nn_ops.interpolate_op, x, size=size, mode=mode,
+                          align_corners=bool(align_corners),
+                          data_format=data_format)
+
+
+upsample = interpolate
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    import jax
+
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings)
+    d = _pair(dilations)
+    n, c, h, w = x.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        x._data, filter_shape=k, window_strides=s,
+        padding=[(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    np_, cp, hp, wp = patches.shape
+    return Tensor(patches.reshape(np_, cp, hp * wp))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    n = label.shape[-1]
+    smoothed = ops.scale(label, scale=1 - epsilon, bias=epsilon / n)
+    return smoothed

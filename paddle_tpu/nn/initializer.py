@@ -1,0 +1,183 @@
+"""Parameter initializers.
+
+Reference: ``python/paddle/nn/initializer/`` (Constant, Normal,
+TruncatedNormal, Uniform, XavierNormal/Uniform, KaimingNormal/Uniform,
+Assign, Orthogonal, Dirac).  Each initializer is a callable
+``(shape, dtype) -> jax array`` drawing from the global generator so
+``paddle.seed`` controls initialization reproducibly.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.random import default_generator
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    # conv weight layout OIHW
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(tuple(shape), self.value, dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        z = jax.random.normal(default_generator.next_key(), tuple(shape),
+                              jnp.float32)
+        return (self.mean + self.std * z).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype):
+        z = jax.random.truncated_normal(default_generator.next_key(),
+                                        self.a, self.b, tuple(shape),
+                                        jnp.float32)
+        return (self.mean + self.std * z).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        u = jax.random.uniform(default_generator.next_key(), tuple(shape),
+                               jnp.float32, self.low, self.high)
+        return u.astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        std = self.gain * np.sqrt(2.0 / (fi + fo))
+        z = jax.random.normal(default_generator.next_key(), tuple(shape),
+                              jnp.float32)
+        return (std * z).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        limit = self.gain * np.sqrt(6.0 / (fi + fo))
+        u = jax.random.uniform(default_generator.next_key(), tuple(shape),
+                               jnp.float32, -limit, limit)
+        return u.astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self._fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        std = np.sqrt(2.0 / ((1 + self.negative_slope ** 2) * fi))
+        z = jax.random.normal(default_generator.next_key(), tuple(shape),
+                              jnp.float32)
+        return (std * z).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self._fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        limit = np.sqrt(6.0 / ((1 + self.negative_slope ** 2) * fi))
+        u = jax.random.uniform(default_generator.next_key(), tuple(shape),
+                               jnp.float32, -limit, limit)
+        return u.astype(dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        from ..core.tensor import Tensor
+
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v._data
+        arr = jnp.asarray(v, dtype)
+        assert tuple(arr.shape) == tuple(shape), \
+            f"Assign shape mismatch {arr.shape} vs {shape}"
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        q = jax.random.orthogonal(default_generator.next_key(),
+                                  int(shape[0])) \
+            if len(shape) == 2 and shape[0] == shape[1] else None
+        if q is None:
+            rows, cols = shape[0], int(np.prod(shape[1:]))
+            z = jax.random.normal(default_generator.next_key(),
+                                  (max(rows, cols), min(rows, cols)),
+                                  jnp.float32)
+            q, _ = jnp.linalg.qr(z)
+            q = q[:rows, :cols] if rows <= q.shape[0] else q
+            q = q.reshape(shape)
+        return (self.gain * q).astype(dtype)
+
+
+def calculate_gain(nonlinearity, param=None):
+    gains = {"sigmoid": 1.0, "tanh": 5.0 / 3, "relu": float(np.sqrt(2.0)),
+             "leaky_relu": float(np.sqrt(2.0 / (1 + (param or 0.01) ** 2))),
+             "selu": 3.0 / 4, "linear": 1.0, "conv2d": 1.0}
+    return gains.get(nonlinearity, 1.0)
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+_global_weight_init = None
+_global_bias_init = None

@@ -1,0 +1,364 @@
+"""Layer — the module base class.
+
+Reference: ``python/paddle/nn/layer/layers.py:351`` (``Layer``): parameter /
+sublayer registration via ``__setattr__``, ``create_parameter``,
+``named_parameters``/``named_sublayers`` traversal, ``state_dict`` /
+``set_state_dict``, train/eval mode, forward pre/post hooks, ``to()``.
+
+TPU-native notes: parameters are jax arrays under the hood, so
+``state_dict`` interops with orbax/np checkpointing directly, and
+``paddle_tpu.jit.to_static`` can lift a Layer into a pure function over its
+parameter pytree (get/set by the same names used here).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Iterator
+
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import EagerParamBase, Tensor
+from . import initializer as I
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, hook_id):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = dtype
+        self._parameters = OrderedDict()
+        self._sub_layers = OrderedDict()
+        self._buffers = OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = OrderedDict()
+        self._forward_post_hooks = OrderedDict()
+        self._hook_id = 0
+        self._name = name_scope or self.__class__.__name__.lower()
+
+    # -- parameter creation ------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None,
+                         is_bias=False, default_initializer=None):
+        from .param_attr import ParamAttr
+
+        dtype = dtype or self._dtype or "float32"
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        init = None
+        lr = 1.0
+        name = None
+        trainable = True
+        if attr is not None:
+            init = attr.initializer
+            lr = attr.learning_rate
+            name = attr.name
+            trainable = attr.trainable
+        if init is None:
+            init = default_initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierUniform()
+        data = init(shape, dtype_mod.convert_dtype(dtype))
+        p = EagerParamBase(data, name=name, trainable=trainable)
+        p.optimize_attr["learning_rate"] = lr
+        return p
+
+    def create_variable(self, name=None, persistable=False, dtype=None):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.zeros([1], dtype_mod.convert_dtype(
+            dtype or "float32")))
+
+    # -- attribute interception -------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, EagerParamBase):
+            if params is None:
+                raise RuntimeError(
+                    "call super().__init__() before assigning parameters")
+            params[name] = value
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError(
+                    "call super().__init__() before assigning sublayers")
+            layers[name] = value
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    params[name] = None
+                    return
+                params.pop(name, None)
+            if layers is not None and name in layers and not isinstance(
+                    value, Layer):
+                layers.pop(name, None)
+            if buffers is not None and name in buffers:
+                if isinstance(value, Tensor) or value is None:
+                    buffers[name] = value
+                    return
+                buffers.pop(name, None)
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        # Only called when normal lookup fails.
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        extras = []
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d:
+                extras.extend(d.keys())
+        return list(super().__dir__()) + extras
+
+    # -- registration API --------------------------------------------------
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter,
+                                                    EagerParamBase):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        if not isinstance(sublayer, Layer):
+            raise TypeError("add_sublayer expects a Layer")
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # -- traversal ---------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for layer_prefix, layer in self.named_sublayers(
+                prefix=prefix, include_self=True):
+            if not include_sublayers and layer is not self:
+                break
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                full = f"{layer_prefix}.{name}" if layer_prefix else name
+                yield full, p
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for layer_prefix, layer in self.named_sublayers(
+                prefix=prefix, include_self=True):
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                full = f"{layer_prefix}.{name}" if layer_prefix else name
+                yield full, b
+
+    def sublayers(self, include_self=False):
+        return [layer for _, layer in self.named_sublayers(
+            include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False,
+                        layers_set=None) -> Iterator:
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            if include_self:
+                yield from sub.named_sublayers(prefix=sub_prefix,
+                                               include_self=True,
+                                               layers_set=layers_set)
+            else:
+                yield sub_prefix, sub
+                yield from sub.named_sublayers(prefix=sub_prefix,
+                                               include_self=False,
+                                               layers_set=layers_set)
+
+    def children(self):
+        return iter(self._sub_layers.values())
+
+    def named_children(self):
+        return iter(self._sub_layers.items())
+
+    def apply(self, fn: Callable):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # -- mode --------------------------------------------------------------
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters():
+            dest[structured_name_prefix + name] = p
+        for name, b in self.named_buffers():
+            short = name.rsplit(".", 1)[-1]
+            owner = self
+            if "." in name:
+                pass
+            if short in self._non_persistable_buffer_names:
+                continue
+            dest[structured_name_prefix + name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        missing, unexpected = [], []
+        own = self.state_dict()
+        matched = set()
+        for name, target in own.items():
+            if name in state_dict:
+                value = state_dict[name]
+                v = value._data if isinstance(value, Tensor) else \
+                    np.asarray(value)
+                target.set_value(v)
+                matched.add(name)
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    def to(self, device=None, dtype=None, blocking=None):
+        import jax
+
+        from ..core.place import Place, set_device
+
+        for _, p in list(self.named_parameters()) + list(
+                self.named_buffers()):
+            data = p._data
+            if dtype is not None and dtype_mod.is_floating_point(p.dtype):
+                data = data.astype(dtype_mod.convert_dtype(dtype))
+            if device is not None:
+                place = device if isinstance(device, Place) else None
+                if place is None:
+                    from ..core.place import CPUPlace, TPUPlace
+
+                    nm, _, idx = str(device).partition(":")
+                    idx = int(idx) if idx else 0
+                    place = CPUPlace(idx) if nm == "cpu" else TPUPlace(idx)
+                data = jax.device_put(data, place.jax_device())
+            p._data = data
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # -- call --------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    def full_name(self):
+        return self._name
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = "\n  ".join(sub_repr)
+            lines.append(f"({name}): {sub_repr}")
+        main = self.__class__.__name__
+        if not lines:
+            return f"{main}({extra})"
+        body = "\n  ".join(lines)
+        return f"{main}(\n  {body}\n)"

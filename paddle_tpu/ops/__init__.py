@@ -1,0 +1,387 @@
+"""paddle_tpu.ops — the functional op surface (the `_C_ops` analog).
+
+Reference: ``python/paddle/_C_ops.py`` re-exporting generated per-op C
+functions (``eager_op_function.cc``).  Here the ops are jax-backed OpDefs
+(see registry.py); this module assembles the per-category modules and
+installs the Tensor operator/method surface exactly like the reference's
+monkey-patch layer (``python/paddle/base/dygraph/tensor_patch_methods.py``).
+"""
+from __future__ import annotations
+
+from . import registry
+from .registry import apply, get_op, register_op, all_ops  # noqa: F401
+
+from . import math as math_ops  # noqa: E402
+from . import reduction  # noqa: E402
+from . import manipulation  # noqa: E402
+from . import linalg  # noqa: E402
+from . import creation  # noqa: E402
+from . import random  # noqa: E402
+from . import activation as activation_ops  # noqa: E402
+from . import nn_ops  # noqa: E402
+
+# --- re-export the flat functional namespace ------------------------------
+from .math import (  # noqa: F401
+    add, subtract, multiply, divide, pow, maximum, minimum, remainder, mod,
+    floor_divide, floor_mod, fmax, fmin, logaddexp, atan2, gcd, lcm,
+    bitwise_and, bitwise_or, bitwise_xor, left_shift, right_shift,
+    exp, expm1, log, log2, log10, log1p, sqrt, rsqrt, square, abs, neg,
+    negative, sign, floor, ceil, round_, trunc, frac, reciprocal, sin, cos,
+    tan, asin, acos, atan, sinh, cosh, asinh, acosh, atanh, erf, erfinv,
+    lgamma, digamma, bitwise_not, isnan_, isinf_, isfinite_, logical_not,
+    logical_and, logical_or, logical_xor, equal, not_equal, greater_than,
+    greater_equal, less_than, less_equal, clip, scale, lerp, stanh,
+    nan_to_num, i0, rint,
+)
+from .reduction import (  # noqa: F401
+    sum, mean, max, min, amax, amin, prod, any, all, logsumexp, argmax,
+    argmin, cumsum, cumprod, cummax, cummin, var, std, numel, count_nonzero,
+    nanmean, nansum, median, quantile,
+)
+from .manipulation import (  # noqa: F401
+    cast, reshape, transpose, t, squeeze, unsqueeze, flatten, expand,
+    broadcast_to, expand_as, broadcast_shape, tile, concat, stack, split,
+    chunk, unstack, unbind, flip, roll, pad, gather, index_select,
+    take_along_axis, put_along_axis, scatter, scatter_nd_add, gather_nd,
+    where, nonzero, masked_select, masked_fill, topk, sort, argsort, unique,
+    unique_consecutive, assign, tril, triu, diag, diagonal,
+    repeat_interleave, one_hot, meshgrid, moveaxis, view, slice, getitem,
+    setitem,
+)
+from .linalg import (  # noqa: F401
+    matmul, mm, bmm, inner, dot, outer, addmm, einsum, norm, dist,
+    triangular_solve, cholesky, inverse, det, slogdet, solve, svd, qr, eigh,
+    matrix_power, pinv, matrix_rank, cross, histogram, bincount,
+)
+from .creation import (  # noqa: F401
+    zeros, ones, full, empty, zeros_like, ones_like, full_like, empty_like,
+    arange, linspace, logspace, eye, diag_embed, clone, to_tensor, complex,
+    as_complex, as_real,
+)
+from .random import (  # noqa: F401
+    seed, get_rng_state, set_rng_state, randn, standard_normal, normal,
+    gaussian, rand, uniform, randint, randint_like, randperm, bernoulli,
+    poisson, multinomial, normal_, uniform_, exponential_, Generator,
+    default_generator,
+)
+
+import builtins as _bi  # noqa: E402
+
+from ..core.tensor import Tensor  # noqa: E402
+
+
+# --- activations (functional) ---------------------------------------------
+
+def relu(x, name=None):
+    return apply(activation_ops.relu_op, x)
+
+
+def relu6(x, name=None):
+    return apply(activation_ops.relu6_op, x)
+
+
+def sigmoid(x, name=None):
+    return apply(activation_ops.sigmoid_op, x)
+
+
+def tanh(x, name=None):
+    return apply(activation_ops.tanh_op, x)
+
+
+def silu(x, name=None):
+    return apply(activation_ops.silu_op, x)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(activation_ops.gelu_op, x, approximate=bool(approximate))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(activation_ops.leaky_relu_op, x,
+                 negative_slope=float(negative_slope))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(activation_ops.elu_op, x, alpha=float(alpha))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(activation_ops.selu_op, x, scale=float(scale),
+                 alpha=float(alpha))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(activation_ops.celu_op, x, alpha=float(alpha))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(activation_ops.softplus_op, x, beta=float(beta),
+                 threshold=float(threshold))
+
+
+def softsign(x, name=None):
+    return apply(activation_ops.softsign_op, x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(activation_ops.hardtanh_op, x, min=float(min),
+                 max=float(max))
+
+
+def hardsigmoid(x, slope=1 / 6, offset=0.5, name=None):
+    return apply(activation_ops.hardsigmoid_op, x, slope=float(slope),
+                 offset=float(offset))
+
+
+def hardswish(x, name=None):
+    return apply(activation_ops.hardswish_op, x)
+
+
+def swish(x, name=None):
+    return apply(activation_ops.swish_op, x)
+
+
+def mish(x, name=None):
+    return apply(activation_ops.mish_op, x)
+
+
+def tanhshrink(x, name=None):
+    return apply(activation_ops.tanhshrink_op, x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(activation_ops.softshrink_op, x, threshold=float(threshold))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(activation_ops.hardshrink_op, x, threshold=float(threshold))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply(activation_ops.thresholded_relu_op, x,
+                 threshold=float(threshold), value=float(value))
+
+
+def log_sigmoid(x, name=None):
+    return apply(activation_ops.log_sigmoid_op, x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    return apply(activation_ops.prelu_op, x, weight, data_format=data_format)
+
+
+def glu(x, axis=-1, name=None):
+    return apply(activation_ops.glu_op, x, axis=int(axis))
+
+
+def swiglu(x, y=None, name=None):
+    if y is None:
+        return apply(activation_ops.swiglu_op, x)
+    return apply(activation_ops.swiglu_op, x, y)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = cast(x, dtype)
+    return apply(nn_ops.softmax_op, x, axis=int(axis))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = cast(x, dtype)
+    return apply(nn_ops.log_softmax_op, x, axis=int(axis))
+
+
+def isnan(x, name=None):
+    return isnan_(x)
+
+
+def isinf(x, name=None):
+    return isinf_(x)
+
+
+def isfinite(x, name=None):
+    return isfinite_(x)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    import numpy as np
+
+    return Tensor(np.allclose(x.numpy(), y.numpy(), rtol=rtol, atol=atol,
+                              equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    import jax.numpy as jnp
+
+    xd = x._data if isinstance(x, Tensor) else x
+    yd = y._data if isinstance(y, Tensor) else y
+    return Tensor(jnp.isclose(xd, yd, rtol=rtol, atol=atol,
+                              equal_nan=equal_nan))
+
+
+def equal_all(x, y, name=None):
+    import numpy as np
+
+    return Tensor(_bi.bool(np.array_equal(x.numpy(), y.numpy())))
+
+
+def increment(x, value=1.0, name=None):
+    out = add(x, to_tensor(value, dtype=str(x.dtype)))
+    x.set_value(out)
+    return x
+
+
+# --------------------------------------------------------------------------
+# Tensor method installation (tensor_patch_methods analog)
+# --------------------------------------------------------------------------
+
+def _swap(fn):
+    def rev(self, other):
+        return fn(other if isinstance(other, Tensor) else to_tensor(
+            other, dtype=str(self.dtype)), self)
+
+    return rev
+
+
+def _install_tensor_methods():
+    import numpy as np
+
+    T = Tensor
+
+    def _coerce(self, other):
+        if isinstance(other, Tensor):
+            return other
+        return other  # raw scalars handled by jnp broadcasting
+
+    T.__add__ = lambda s, o: add(s, _coerce(s, o))
+    T.__radd__ = lambda s, o: add(s, _coerce(s, o))
+    T.__sub__ = lambda s, o: subtract(s, _coerce(s, o))
+    T.__rsub__ = _swap(subtract)
+    T.__mul__ = lambda s, o: multiply(s, _coerce(s, o))
+    T.__rmul__ = lambda s, o: multiply(s, _coerce(s, o))
+    T.__truediv__ = lambda s, o: divide(s, _coerce(s, o))
+    T.__rtruediv__ = _swap(divide)
+    T.__floordiv__ = lambda s, o: floor_divide(s, _coerce(s, o))
+    T.__mod__ = lambda s, o: remainder(s, _coerce(s, o))
+    T.__pow__ = lambda s, o: pow(s, _coerce(s, o))
+    T.__rpow__ = _swap(pow)
+    T.__matmul__ = lambda s, o: matmul(s, o)
+    T.__rmatmul__ = _swap(matmul)
+    T.__neg__ = lambda s: neg(s)
+    T.__abs__ = lambda s: abs(s)
+    T.__invert__ = lambda s: logical_not(s) if s.dtype == np.bool_ \
+        else bitwise_not(s)
+    T.__eq__ = lambda s, o: equal(s, _coerce(s, o))
+    T.__ne__ = lambda s, o: not_equal(s, _coerce(s, o))
+    T.__lt__ = lambda s, o: less_than(s, _coerce(s, o))
+    T.__le__ = lambda s, o: less_equal(s, _coerce(s, o))
+    T.__gt__ = lambda s, o: greater_than(s, _coerce(s, o))
+    T.__ge__ = lambda s, o: greater_equal(s, _coerce(s, o))
+    T.__and__ = lambda s, o: logical_and(s, o) if s.dtype == np.bool_ \
+        else bitwise_and(s, o)
+    T.__or__ = lambda s, o: logical_or(s, o) if s.dtype == np.bool_ \
+        else bitwise_or(s, o)
+    T.__xor__ = lambda s, o: logical_xor(s, o) if s.dtype == np.bool_ \
+        else bitwise_xor(s, o)
+    T.__hash__ = object.__hash__
+    T.__getitem__ = getitem
+    T.__setitem__ = setitem
+
+    # Named methods.
+    methods = dict(
+        add=add, subtract=subtract, multiply=multiply, divide=divide,
+        pow=pow, matmul=matmul, mm=mm, bmm=bmm, dot=dot, maximum=maximum,
+        minimum=minimum, remainder=remainder, mod=mod,
+        floor_divide=floor_divide,
+        exp=exp, log=log, log2=log2, log10=log10, log1p=log1p, sqrt=sqrt,
+        rsqrt=rsqrt, square=square, abs=abs, sign=sign, floor=floor,
+        ceil=ceil, round=round_, trunc=trunc, reciprocal=reciprocal,
+        sin=sin, cos=cos, tan=tan, asin=asin, acos=acos, atan=atan,
+        sinh=sinh, cosh=cosh, tanh=tanh, erf=erf, lgamma=lgamma,
+        digamma=digamma, neg=neg, clip=clip, scale=scale, lerp=lerp,
+        isnan=isnan_, isinf=isinf_, isfinite=isfinite_,
+        logical_and=logical_and, logical_or=logical_or,
+        logical_not=logical_not, logical_xor=logical_xor,
+        equal=equal, not_equal=not_equal, greater_than=greater_than,
+        greater_equal=greater_equal, less_than=less_than,
+        less_equal=less_equal, equal_all=equal_all, allclose=allclose,
+        isclose=isclose,
+        sum=sum, mean=mean, max=max, min=min, amax=amax, amin=amin,
+        prod=prod, any=any, all=all, logsumexp=logsumexp, argmax=argmax,
+        argmin=argmin, cumsum=cumsum, cumprod=cumprod, var=var, std=std,
+        numel=numel, count_nonzero=count_nonzero, median=median,
+        cast=cast, astype=cast, reshape=reshape, reshape_=reshape,
+        transpose=transpose, t=t, squeeze=squeeze, squeeze_=squeeze,
+        unsqueeze=unsqueeze, unsqueeze_=unsqueeze, flatten=flatten,
+        expand=expand, expand_as=expand_as, broadcast_to=broadcast_to,
+        tile=tile, concat=concat, split=split, chunk=chunk, unbind=unbind,
+        flip=flip, roll=roll, gather=gather, index_select=index_select,
+        take_along_axis=take_along_axis, put_along_axis=put_along_axis,
+        scatter=scatter, scatter_nd_add=scatter_nd_add, gather_nd=gather_nd,
+        where=where, nonzero=nonzero, masked_select=masked_select,
+        masked_fill=masked_fill, topk=topk, sort=sort, argsort=argsort,
+        unique=unique, tril=tril, triu=triu, diag=diag, diagonal=diagonal,
+        repeat_interleave=repeat_interleave, moveaxis=moveaxis,
+        norm=norm, dist=dist, inverse=inverse, cholesky=cholesky,
+        multinomial=multinomial, normal_=normal_, uniform_=uniform_,
+        exponential_=exponential_, fill_=None, zero_=None,
+        softmax=softmax, sigmoid=sigmoid, relu=relu, gelu=gelu,
+        one_hot=one_hot, bincount=bincount, histogram=histogram,
+        nan_to_num=nan_to_num,
+    )
+    for name, fn in methods.items():
+        if fn is None:
+            continue
+        setattr(T, name, fn)
+
+    def fill_(self, value):
+        import jax.numpy as jnp
+
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        import jax.numpy as jnp
+
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    T.fill_ = fill_
+    T.zero_ = zero_
+
+    def _inplace_apply(self, fn, *args, **kw):
+        # Route through an autograd proxy so the new node's input edge
+        # keeps pointing at the OLD producer (no self-loop after rebind).
+        from .manipulation import _autograd_proxy
+
+        out = fn(_autograd_proxy(self), *args, **kw)
+        self._data = out._data
+        self._grad_node = out._grad_node
+        self._out_slot = out._out_slot
+        self.stop_gradient = out.stop_gradient and self.stop_gradient
+        return self
+
+    def add_(self, y):
+        return _inplace_apply(self, add, y)
+
+    def scale_(self, scale_v=1.0, bias=0.0, bias_after_scale=True):
+        return _inplace_apply(self, scale, scale_v, bias, bias_after_scale)
+
+    def subtract_(self, y):
+        return _inplace_apply(self, subtract, y)
+
+    def multiply_(self, y):
+        return _inplace_apply(self, multiply, y)
+
+    def clip_(self, min=None, max=None):
+        return _inplace_apply(self, clip, min, max)
+
+    T.add_ = add_
+    T.subtract_ = subtract_
+    T.multiply_ = multiply_
+    T.scale_ = scale_
+    T.clip_ = clip_
+
+
+_install_tensor_methods()

@@ -1,0 +1,140 @@
+"""Activation ops.
+
+Reference: ``paddle/phi/kernels/activation_kernel.h`` +
+``python/paddle/nn/functional/activation.py``.  All are single fusable
+elementwise jax expressions (XLA fuses them into the surrounding matmul
+epilogue on TPU), with hand-written grads for the hot ones.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import apply, register_op
+
+
+def _unary(name, fn, grad_fn=None, save_out=False, static_argnames=()):
+    if grad_fn is None:
+        op = register_op(name, fn, static_argnames=static_argnames)
+    else:
+        def fwd(x, **attrs):
+            out = fn(x, **attrs)
+            return out, (out if save_out else x)
+
+        def bwd(saved, g, **attrs):
+            return (grad_fn(saved, g, **attrs),)
+
+        op = register_op(name, fn, fwd=fwd, bwd=bwd,
+                         static_argnames=static_argnames)
+    return op
+
+
+relu_op = _unary("relu", jax.nn.relu,
+                 lambda out, g: g * (out > 0).astype(g.dtype), save_out=True)
+relu6_op = _unary("relu6", jax.nn.relu6,
+                  lambda x, g: g * ((x > 0) & (x < 6)).astype(g.dtype))
+sigmoid_op = _unary("sigmoid", jax.nn.sigmoid,
+                    lambda out, g: g * out * (1 - out), save_out=True)
+tanh_op = _unary("tanh", jnp.tanh,
+                 lambda out, g: g * (1 - out * out), save_out=True)
+silu_op = _unary(
+    "silu", jax.nn.silu,
+    lambda x, g: g * (jax.nn.sigmoid(x) * (1 + x * (1 - jax.nn.sigmoid(x)))))
+
+
+def _gelu_fn(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+gelu_op = register_op("gelu", _gelu_fn, static_argnames=("approximate",))
+
+leaky_relu_op = _unary(
+    "leaky_relu",
+    lambda x, negative_slope=0.01: jax.nn.leaky_relu(x, negative_slope),
+    lambda x, g, negative_slope=0.01: g * jnp.where(
+        x >= 0, jnp.ones_like(x), jnp.full_like(x, negative_slope)),
+    static_argnames=("negative_slope",))
+
+elu_op = register_op("elu", lambda x, alpha=1.0: jax.nn.elu(x, alpha),
+                     static_argnames=("alpha",))
+selu_op = register_op(
+    "selu",
+    lambda x, scale=1.0507009873554805, alpha=1.6732632423543772:
+    scale * jnp.where(x > 0, x, alpha * jnp.expm1(x)),
+    static_argnames=("scale", "alpha"))
+celu_op = register_op("celu", lambda x, alpha=1.0: jax.nn.celu(x, alpha),
+                      static_argnames=("alpha",))
+softplus_op = register_op(
+    "softplus",
+    lambda x, beta=1.0, threshold=20.0: jnp.where(
+        x * beta > threshold, x, jnp.logaddexp(x * beta, 0.0) / beta),
+    static_argnames=("beta", "threshold"))
+softsign_op = _unary("softsign", jax.nn.soft_sign,
+                     lambda x, g: g / jnp.square(1 + jnp.abs(x)))
+hardtanh_op = register_op(
+    "hardtanh", lambda x, min=-1.0, max=1.0: jnp.clip(x, min, max),
+    static_argnames=("min", "max"))
+hardsigmoid_op = register_op(
+    "hardsigmoid",
+    lambda x, slope=1 / 6, offset=0.5: jnp.clip(slope * x + offset, 0.0, 1.0),
+    static_argnames=("slope", "offset"))
+hardswish_op = _unary(
+    "hardswish", lambda x: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0,
+    lambda x, g: g * jnp.where(
+        x <= -3, jnp.zeros_like(x),
+        jnp.where(x >= 3, jnp.ones_like(x), (2 * x + 3) / 6)))
+swish_op = _unary("swish", jax.nn.silu,
+                  lambda x, g: g * (jax.nn.sigmoid(x)
+                                    * (1 + x * (1 - jax.nn.sigmoid(x)))))
+mish_op = register_op(
+    "mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+tanhshrink_op = register_op("tanhshrink", lambda x: x - jnp.tanh(x))
+softshrink_op = register_op(
+    "softshrink",
+    lambda x, threshold=0.5: jnp.where(
+        x > threshold, x - threshold,
+        jnp.where(x < -threshold, x + threshold, jnp.zeros_like(x))),
+    static_argnames=("threshold",))
+hardshrink_op = register_op(
+    "hardshrink",
+    lambda x, threshold=0.5: jnp.where(
+        jnp.abs(x) > threshold, x, jnp.zeros_like(x)),
+    static_argnames=("threshold",))
+thresholded_relu_op = register_op(
+    "thresholded_relu",
+    lambda x, threshold=1.0, value=0.0: jnp.where(
+        x > threshold, x, jnp.full_like(x, value)),
+    static_argnames=("threshold", "value"))
+log_sigmoid_op = register_op("log_sigmoid", jax.nn.log_sigmoid)
+
+
+def _prelu_plain(x, weight, data_format="NCHW"):
+    if weight.ndim == 1 and weight.shape[0] > 1:
+        shape = ((1, -1) + (1,) * (x.ndim - 2)) if data_format == "NCHW" \
+            else ((1,) * (x.ndim - 1) + (-1,))
+        w = weight.reshape(shape)
+    else:
+        w = weight
+    return jnp.where(x >= 0, x, w * x)
+
+
+prelu_op = register_op("prelu", _prelu_plain,
+                       static_argnames=("data_format",))
+
+
+def _glu_plain(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+glu_op = register_op("glu", _glu_plain, static_argnames=("axis",))
+
+
+def _swiglu_plain(x, y=None):
+    """Reference: phi fused swiglu (phi/kernels/fusion/); silu(x) * y."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+swiglu_op = register_op("swiglu", _swiglu_plain)

@@ -1,0 +1,160 @@
+"""Tensor creation ops.
+
+Reference: ``python/paddle/tensor/creation.py`` (zeros/ones/full/arange/
+eye/linspace/tril/triu/empty...).  Creation is cheap on TPU when it stays in
+XLA (iota/broadcast fuse into consumers), so everything here is jnp.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor, to_tensor  # noqa: F401
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy().tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default or dtype_mod.get_default_dtype()
+    return dtype_mod.convert_dtype(dtype)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = "bool"
+        elif isinstance(fill_value, int):
+            dtype = dtype_mod.get_default_dtype()
+        else:
+            dtype = dtype_mod.get_default_dtype()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(x._data if isinstance(x, Tensor) else x,
+                                 dtype=dtype_mod.convert_dtype(dtype) if dtype else None))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(x._data if isinstance(x, Tensor) else x,
+                                dtype=dtype_mod.convert_dtype(dtype) if dtype else None))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(x._data if isinstance(x, Tensor) else x,
+                                fill_value,
+                                dtype=dtype_mod.convert_dtype(dtype) if dtype else None))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = ("int64" if all(
+            isinstance(v, (int, np.integer)) for v in (start, end, step))
+            else dtype_mod.get_default_dtype())
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    return Tensor(jnp.logspace(_v(start), _v(stop), int(_v(num)),
+                               base=_v(base), dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns else None,
+                          dtype=_dt(dtype)))
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    arr = input._data if isinstance(input, Tensor) else jnp.asarray(input)
+    n = arr.shape[-1] + abs(offset)
+    out = jnp.zeros(arr.shape[:-1] + (n, n), arr.dtype)
+    idx = jnp.arange(arr.shape[-1])
+    if offset >= 0:
+        out = out.at[..., idx, idx + offset].set(arr)
+    else:
+        out = out.at[..., idx - offset, idx].set(arr)
+    if (dim1, dim2) not in ((-2, -1), (out.ndim - 2, out.ndim - 1)):
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return Tensor(out)
+
+
+def assign(x, output=None):
+    from .manipulation import assign as _assign
+
+    return _assign(x, output)
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def tril_(x, diagonal=0):
+    from .manipulation import tril
+
+    return tril(x, diagonal)
+
+
+def complex(real, imag, name=None):  # noqa: A001
+    r = real._data if isinstance(real, Tensor) else real
+    i = imag._data if isinstance(imag, Tensor) else imag
+    return Tensor(jax_complex(r, i))
+
+
+def jax_complex(r, i):
+    return r + 1j * i.astype(jnp.result_type(i, jnp.complex64))
+
+
+def as_complex(x, name=None):
+    d = x._data if isinstance(x, Tensor) else x
+    return Tensor(d[..., 0] + 1j * d[..., 1])
+
+
+def as_real(x, name=None):
+    d = x._data if isinstance(x, Tensor) else x
+    return Tensor(jnp.stack([jnp.real(d), jnp.imag(d)], axis=-1))

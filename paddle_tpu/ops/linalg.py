@@ -1,0 +1,312 @@
+"""Linear algebra ops.
+
+Reference: ``python/paddle/tensor/linalg.py`` (``matmul`` at :189 →
+``_C_ops.matmul``) with kernel pairing ``matmul``/``matmul_grad`` in
+ops.yaml; the matmul grad math mirrors ``phi/kernels/impl/
+matmul_grad_kernel_impl.h``.  matmul is THE MXU op — it stays a single
+``jnp.matmul`` so XLA tiles it onto the systolic array; transposes fold into
+``dot_general`` dimension numbers rather than materializing.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import apply, register_op
+from .math import unbroadcast
+
+
+def _mm(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+def _mm_fwd(x, y, transpose_x=False, transpose_y=False):
+    return _mm(x, y, transpose_x, transpose_y), (x, y)
+
+
+def _mm_bwd(saved, g, transpose_x=False, transpose_y=False):
+    x, y = saved
+    xshape, yshape = jnp.shape(x), jnp.shape(y)
+    # 1-D operand cases reduce to vector products.
+    if x.ndim == 1 and y.ndim == 1:
+        return (g * y).astype(x.dtype), (g * x).astype(y.dtype)
+    if x.ndim == 1:
+        # out = x @ Y (or Y^T): g shape [..., n]
+        yy = jnp.swapaxes(y, -1, -2) if transpose_y else y
+        gx = jnp.matmul(g[..., None, :],
+                        jnp.swapaxes(yy, -1, -2))[..., 0, :]
+        gy = jnp.matmul(x[:, None], g[..., None, :]) if not transpose_y \
+            else jnp.matmul(g[..., :, None], x[None, :])
+        return (unbroadcast(gx, xshape).astype(x.dtype),
+                unbroadcast(gy, yshape).astype(y.dtype))
+    if y.ndim == 1:
+        xx = jnp.swapaxes(x, -1, -2) if transpose_x else x
+        gx = jnp.matmul(g[..., :, None], y[None, :])
+        if transpose_x:
+            gx = jnp.swapaxes(gx, -1, -2)
+        gy = jnp.einsum("...mk,...m->k", xx, g)
+        return (unbroadcast(gx, xshape).astype(x.dtype),
+                unbroadcast(gy, yshape).astype(y.dtype))
+
+    if not transpose_x and not transpose_y:
+        gx = jnp.matmul(g, jnp.swapaxes(y, -1, -2))
+        gy = jnp.matmul(jnp.swapaxes(x, -1, -2), g)
+    elif transpose_x and not transpose_y:
+        gx = jnp.matmul(y, jnp.swapaxes(g, -1, -2))
+        gy = jnp.matmul(x, g)
+    elif not transpose_x and transpose_y:
+        gx = jnp.matmul(g, y)
+        gy = jnp.matmul(jnp.swapaxes(g, -1, -2), x)
+    else:
+        gx = jnp.matmul(jnp.swapaxes(y, -1, -2), jnp.swapaxes(g, -1, -2))
+        gy = jnp.matmul(jnp.swapaxes(g, -1, -2), jnp.swapaxes(x, -1, -2))
+    return (unbroadcast(gx, xshape).astype(x.dtype),
+            unbroadcast(gy, yshape).astype(y.dtype))
+
+
+matmul_op = register_op("matmul", _mm, fwd=_mm_fwd, bwd=_mm_bwd,
+                        static_argnames=("transpose_x", "transpose_y"))
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return apply(matmul_op, x, y, transpose_x=bool(transpose_x),
+                 transpose_y=bool(transpose_y))
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def inner(x, y, name=None):
+    return apply(_inner_op, x, y)
+
+
+_inner_op = register_op("inner", jnp.inner)
+
+dot_op = register_op(
+    "dot", lambda x, y: jnp.sum(x * y, axis=-1),
+    fwd=lambda x, y: (jnp.sum(x * y, axis=-1), (x, y)),
+    bwd=lambda saved, g: (g[..., None] * saved[1], g[..., None] * saved[0]))
+
+
+def dot(x, y, name=None):
+    return apply(dot_op, x, y)
+
+
+def outer(x, y, name=None):
+    return apply(_outer_op, x, y)
+
+
+_outer_op = register_op("outer", jnp.outer)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(_addmm_op, input, x, y, beta=float(beta), alpha=float(alpha))
+
+
+_addmm_op = register_op(
+    "addmm",
+    lambda inp, x, y, beta=1.0, alpha=1.0: beta * inp + alpha * jnp.matmul(x, y),
+    static_argnames=("beta", "alpha"))
+
+
+# -- einsum -----------------------------------------------------------------
+
+def einsum(equation, *operands):
+    from ..core.tensor import Tensor
+    from ..autograd import engine as _engine
+
+    datas = [o._data if isinstance(o, Tensor) else jnp.asarray(o)
+             for o in operands]
+    need_grad = _engine.is_grad_enabled() and any(
+        isinstance(o, Tensor) and not o.stop_gradient for o in operands)
+    if not need_grad:
+        return Tensor(jnp.einsum(equation, *datas))
+    out_data, vjp_fn = jax.vjp(lambda *ds: jnp.einsum(equation, *ds), *datas)
+    node = _engine.GradNode(_einsum_fakeop, vjp_fn, list(operands), {},
+                            vjp_fallback=True,
+                            diff_idx=list(range(len(operands))))
+    out = Tensor(out_data, stop_gradient=False)
+    node.bind_outputs([out])
+    return out
+
+
+class _EinsumOp:
+    name = "einsum"
+    jit_bwd = None
+
+
+_einsum_fakeop = _EinsumOp()
+
+
+# -- norms / decompositions -------------------------------------------------
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    from . import reduction, math as m
+
+    if p is None or p == "fro" or p == 2:
+        sq = m.multiply(x, x)
+        s = reduction.sum(sq, axis=axis, keepdim=keepdim)
+        return m.sqrt(s)
+    if p == 1:
+        return reduction.sum(m.abs(x), axis=axis, keepdim=keepdim)
+    if p == float("inf"):
+        return reduction.max(m.abs(x), axis=axis, keepdim=keepdim)
+    if p == float("-inf"):
+        return reduction.min(m.abs(x), axis=axis, keepdim=keepdim)
+    ax = m.abs(x)
+    powed = m.pow(ax, p)
+    s = reduction.sum(powed, axis=axis, keepdim=keepdim)
+    return m.pow(s, 1.0 / p)
+
+
+def dist(x, y, p=2, name=None):
+    from . import math as m
+
+    return norm(m.subtract(x, y), p=p)
+
+
+_tri_solve_op = register_op(
+    "triangular_solve",
+    lambda x, y, upper=True, transpose=False, unitriangular=False:
+    jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular),
+    static_argnames=("upper", "transpose", "unitriangular"))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return apply(_tri_solve_op, x, y, upper=bool(upper),
+                 transpose=bool(transpose), unitriangular=bool(unitriangular))
+
+
+_cholesky_op = register_op(
+    "cholesky",
+    lambda x, upper=False: (jnp.linalg.cholesky(x) if not upper
+                            else jnp.swapaxes(jnp.linalg.cholesky(x), -1, -2)),
+    static_argnames=("upper",))
+
+
+def cholesky(x, upper=False, name=None):
+    return apply(_cholesky_op, x, upper=bool(upper))
+
+
+_inv_op = register_op("inverse", jnp.linalg.inv)
+
+
+def inverse(x, name=None):
+    return apply(_inv_op, x)
+
+
+_det_op = register_op("det", jnp.linalg.det)
+
+
+def det(x, name=None):
+    return apply(_det_op, x)
+
+
+_slogdet_op = register_op(
+    "slogdet", lambda x: tuple(jnp.linalg.slogdet(x)), n_outputs=2)
+
+
+def slogdet(x, name=None):
+    return apply(_slogdet_op, x)
+
+
+_solve_op = register_op("solve", jnp.linalg.solve)
+
+
+def solve(x, y, name=None):
+    return apply(_solve_op, x, y)
+
+
+def svd(x, full_matrices=False, name=None):
+    from ..core.tensor import Tensor
+
+    u, s, vh = jnp.linalg.svd(x._data if isinstance(x, Tensor) else x,
+                              full_matrices=full_matrices)
+    return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2))
+
+
+def qr(x, mode="reduced", name=None):
+    from ..core.tensor import Tensor
+
+    q, r = jnp.linalg.qr(x._data if isinstance(x, Tensor) else x, mode=mode)
+    return Tensor(q), Tensor(r)
+
+
+def eigh(x, UPLO="L", name=None):
+    from ..core.tensor import Tensor
+
+    w, v = jnp.linalg.eigh(x._data if isinstance(x, Tensor) else x)
+    return Tensor(w), Tensor(v)
+
+
+def matrix_power(x, n, name=None):
+    return apply(_matrix_power_op, x, n=int(n))
+
+
+_matrix_power_op = register_op(
+    "matrix_power", lambda x, n: jnp.linalg.matrix_power(x, n),
+    static_argnames=("n",))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    from ..core.tensor import Tensor
+
+    return Tensor(jnp.linalg.pinv(x._data if isinstance(x, Tensor) else x,
+                                  rtol=rcond, hermitian=hermitian))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    from ..core.tensor import Tensor
+
+    return Tensor(jnp.linalg.matrix_rank(
+        x._data if isinstance(x, Tensor) else x))
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else None
+    from ..core.tensor import Tensor
+
+    xd = x._data if isinstance(x, Tensor) else x
+    yd = y._data if isinstance(y, Tensor) else y
+    if ax is None:
+        for i, s in enumerate(xd.shape):
+            if s == 3:
+                ax = i
+                break
+    return apply(_cross_op, x, y, axis=int(ax))
+
+
+_cross_op = register_op(
+    "cross", lambda x, y, axis: jnp.cross(x, y, axis=axis),
+    static_argnames=("axis",))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    from ..core.tensor import Tensor
+    import numpy as np
+
+    arr = np.asarray(input._data if isinstance(input, Tensor) else input)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
+    hist, _ = np.histogram(arr, bins=bins, range=(lo, hi))
+    return Tensor(jnp.asarray(hist, dtype=jnp.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    from ..core.tensor import Tensor
+    import numpy as np
+
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    w = np.asarray(weights._data) if isinstance(weights, Tensor) else weights
+    return Tensor(jnp.asarray(np.bincount(arr, w, minlength)))

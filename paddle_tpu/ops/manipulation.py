@@ -1,0 +1,904 @@
+"""Shape/layout manipulation + indexing ops.
+
+Reference: ``python/paddle/tensor/manipulation.py`` and the corresponding
+ops.yaml entries (reshape/transpose/concat/split/gather/...).  Grad pairings
+mirror backward.yaml (e.g. ``concat_grad`` splits the cotangent;
+``gather_grad`` scatter-adds).  All static attributes (shapes, axes) are jit
+static args, so XLA sees only static-shape programs — the tiling-friendly
+form for the MXU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from .registry import apply, register_op
+
+
+def _t(x):
+    return tuple(int(v) for v in x) if x is not None else None
+
+
+# -- cast -------------------------------------------------------------------
+
+cast_op = register_op(
+    "cast", lambda x, dtype: x.astype(dtype),
+    fwd=lambda x, dtype: (x.astype(dtype), x),
+    bwd=lambda x, g, dtype: (g.astype(x.dtype),),
+    static_argnames=("dtype",))
+
+
+def cast(x, dtype):
+    return apply(cast_op, x, dtype=dtype_mod.convert_dtype(dtype))
+
+
+# -- reshape family ---------------------------------------------------------
+
+reshape_op = register_op(
+    "reshape", lambda x, shape: jnp.reshape(x, shape),
+    fwd=lambda x, shape: (jnp.reshape(x, shape), x),
+    bwd=lambda x, g, shape: (jnp.reshape(g, jnp.shape(x)),),
+    static_argnames=("shape",))
+
+
+def reshape(x, shape, name=None):
+    from ..core.tensor import Tensor
+
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+    return apply(reshape_op, x, shape=tuple(shape))
+
+
+transpose_op = register_op(
+    "transpose", lambda x, perm: jnp.transpose(x, perm),
+    fwd=lambda x, perm: (jnp.transpose(x, perm), None),
+    bwd=lambda saved, g, perm: (jnp.transpose(g, _inv_perm(perm)),),
+    static_argnames=("perm",))
+
+
+def _inv_perm(perm):
+    inv = [0] * len(perm)
+    for i, p in enumerate(perm):
+        inv[p] = i
+    return tuple(inv)
+
+
+def transpose(x, perm, name=None):
+    return apply(transpose_op, x, perm=_t(perm))
+
+
+def t(x, name=None):
+    if x.ndim < 2:
+        return assign(x)
+    return transpose(x, list(range(x.ndim - 2)) + [x.ndim - 1, x.ndim - 2])
+
+
+squeeze_op = register_op(
+    "squeeze", lambda x, axis=None: jnp.squeeze(x, axis=axis),
+    fwd=lambda x, axis=None: (jnp.squeeze(x, axis=axis), x),
+    bwd=lambda x, g, axis=None: (jnp.reshape(g, jnp.shape(x)),),
+    static_argnames=("axis",))
+
+
+def squeeze(x, axis=None, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(a % x.ndim for a in axis)
+        axis = tuple(a for a in axis if x.shape[a] == 1)
+        if not axis:
+            return assign(x)
+    elif axis is not None:
+        axis = int(axis) % x.ndim
+        if x.shape[axis] != 1:
+            return assign(x)
+    return apply(squeeze_op, x, axis=axis)
+
+
+unsqueeze_op = register_op(
+    "unsqueeze", lambda x, axis: jnp.expand_dims(x, axis),
+    fwd=lambda x, axis: (jnp.expand_dims(x, axis), x),
+    bwd=lambda x, g, axis: (jnp.reshape(g, jnp.shape(x)),),
+    static_argnames=("axis",))
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    else:
+        axis = int(axis)
+    return apply(unsqueeze_op, x, axis=axis)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    if nd == 0:
+        return reshape(x, [1])
+    start = start_axis % nd
+    stop = stop_axis % nd
+    shape = x.shape
+    new_shape = (list(shape[:start])
+                 + [int(np.prod(shape[start:stop + 1]))]
+                 + list(shape[stop + 1:]))
+    return reshape(x, new_shape)
+
+
+expand_op = register_op(
+    "expand", lambda x, shape: jnp.broadcast_to(x, shape),
+    fwd=lambda x, shape: (jnp.broadcast_to(x, shape), x),
+    bwd=lambda x, g, shape: (_unbroadcast_to(g, jnp.shape(x)),),
+    static_argnames=("shape",))
+
+
+def _unbroadcast_to(g, shape):
+    from .math import unbroadcast
+
+    return unbroadcast(g, shape).reshape(shape)
+
+
+def expand(x, shape, name=None):
+    shape = [x.shape[i - (len(shape) - x.ndim)] if int(s) == -1 else int(s)
+             for i, s in enumerate(shape)]
+    return apply(expand_op, x, shape=tuple(shape))
+
+
+broadcast_to = expand
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+tile_op = register_op(
+    "tile", lambda x, repeat_times: jnp.tile(x, repeat_times),
+    static_argnames=("repeat_times",))
+
+
+def tile(x, repeat_times, name=None):
+    return apply(tile_op, x, repeat_times=_t(repeat_times))
+
+
+# -- concat / split / stack -------------------------------------------------
+
+def _concat_plain(*xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def _concat_fwd(*xs, axis=0):
+    return jnp.concatenate(xs, axis=axis), xs
+
+
+def _concat_bwd(xs, g, axis=0):
+    sizes = [jnp.shape(x)[axis] for x in xs]
+    splits = list(np.cumsum(sizes))[:-1]
+    return tuple(jnp.split(g, splits, axis=axis))
+
+
+concat_op = register_op("concat", _concat_plain, fwd=_concat_fwd,
+                        bwd=_concat_bwd, static_argnames=("axis",))
+
+
+def concat(x, axis=0, name=None):
+    from ..core.tensor import Tensor
+
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply(concat_op, *x, axis=int(axis))
+
+
+def _stack_plain(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+stack_op = register_op(
+    "stack", _stack_plain,
+    fwd=lambda *xs, axis=0: (jnp.stack(xs, axis=axis), len(xs)),
+    bwd=lambda n, g, axis=0: tuple(
+        jnp.squeeze(p, axis=axis)
+        for p in jnp.split(g, jnp.shape(g)[axis], axis=axis)),
+    static_argnames=("axis",))
+
+
+def stack(x, axis=0, name=None):
+    return apply(stack_op, *x, axis=int(axis))
+
+
+def _stack_bwd_fix():
+    pass
+
+
+split_op = register_op(
+    "split",
+    lambda x, indices=None, axis=0: tuple(jnp.split(x, indices, axis=axis)),
+    fwd=lambda x, indices=None, axis=0: (
+        tuple(jnp.split(x, indices, axis=axis)), None),
+    bwd=lambda saved, gs, axis=0, indices=None: (
+        jnp.concatenate(gs, axis=axis),),
+    static_argnames=("indices", "axis"), n_outputs=0)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    from ..core.tensor import Tensor
+
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    axis = int(axis) % x.ndim
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        indices = int(num_or_sections)
+        n_out = num_or_sections
+    else:
+        sections = [s if s != -1 else dim - sum(
+            v for v in num_or_sections if v != -1)
+            for s in num_or_sections]
+        indices = tuple(int(v) for v in np.cumsum(sections)[:-1])
+        n_out = len(sections)
+    split_op.n_outputs = n_out
+    outs = apply(split_op, x, indices=indices, axis=axis)
+    split_op.n_outputs = 0
+    return list(outs)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    axis = int(axis) % x.ndim
+    parts = split(x, x.shape[axis], axis)
+    return [squeeze(p, axis) for p in parts]
+
+
+def unbind(x, axis=0):
+    return unstack(x, axis)
+
+
+# -- flip / roll / pad ------------------------------------------------------
+
+flip_op = register_op(
+    "flip", lambda x, axis: jnp.flip(x, axis),
+    fwd=lambda x, axis: (jnp.flip(x, axis), None),
+    bwd=lambda s, g, axis: (jnp.flip(g, axis),),
+    static_argnames=("axis",))
+
+
+def flip(x, axis, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    else:
+        axis = int(axis)
+    return apply(flip_op, x, axis=axis)
+
+
+roll_op = register_op(
+    "roll", lambda x, shifts, axis=None: jnp.roll(x, shifts, axis),
+    fwd=lambda x, shifts, axis=None: (jnp.roll(x, shifts, axis), None),
+    bwd=lambda s, g, shifts, axis=None: (
+        jnp.roll(g, tuple(-v for v in shifts)
+                 if isinstance(shifts, tuple) else -shifts, axis),),
+    static_argnames=("shifts", "axis"))
+
+
+def roll(x, shifts, axis=None, name=None):
+    shifts = _t(shifts) if isinstance(shifts, (list, tuple)) else int(shifts)
+    axis = _t(axis) if isinstance(axis, (list, tuple)) else (
+        int(axis) if axis is not None else None)
+    return apply(roll_op, x, shifts=shifts, axis=axis)
+
+
+pad_op = register_op(
+    "pad", lambda x, pad_width, mode="constant", value=0.0: (
+        jnp.pad(x, pad_width, mode=mode, constant_values=value)
+        if mode == "constant" else jnp.pad(x, pad_width, mode=mode)),
+    static_argnames=("pad_width", "mode", "value"))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format=None, name=None):
+    """paddle.nn.functional.pad with int-list pad (last-dim-first pairs)."""
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        pairs = [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(nd)]
+        pad_width = tuple(pairs)
+    else:
+        # pad applies to trailing dims, paddle order: last dim first.
+        n = len(pad) // 2
+        pairs = [(0, 0)] * nd
+        for i in range(n):
+            dim = nd - 1 - i
+            pairs[dim] = (int(pad[2 * i]), int(pad[2 * i + 1]))
+        pad_width = tuple(pairs)
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    return apply(pad_op, x, pad_width=pad_width, mode=jmode,
+                 value=float(value))
+
+
+# -- gather / scatter / index ops ------------------------------------------
+
+def _gather_plain(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def _gather_fwd(x, index, axis=0):
+    return jnp.take(x, index, axis=axis), (x, index)
+
+
+def _gather_bwd(saved, g, axis=0):
+    x, index = saved
+    z = jnp.zeros(jnp.shape(x), g.dtype)
+    return (_index_add(z, index, g, axis).astype(x.dtype), None)
+
+
+def _index_add(z, index, g, axis):
+    idx = [slice(None)] * z.ndim
+    idx[axis] = index
+    return z.at[tuple(idx)].add(g)
+
+
+gather_op = register_op("gather", _gather_plain, fwd=_gather_fwd,
+                        bwd=_gather_bwd, static_argnames=("axis",),
+                        nondiff_argnums=(1,))
+
+
+def gather(x, index, axis=0, name=None):
+    from ..core.tensor import Tensor
+
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    if isinstance(index, Tensor) and index.ndim > 1:
+        index = reshape(index, [-1])
+    return apply(gather_op, x, index, axis=int(axis))
+
+
+index_select = gather
+
+
+def _take_along_fwd(x, index, axis=0):
+    return jnp.take_along_axis(x, index, axis=axis), (x, index)
+
+
+def _take_along_bwd(saved, g, axis=0):
+    x, index = saved
+    z = jnp.zeros(jnp.shape(x), g.dtype)
+    return (z.at[_along_axis_idx(index, axis, jnp.shape(x))].add(g)
+            .astype(x.dtype), None)
+
+
+def _along_axis_idx(index, axis, shape):
+    nd = len(shape)
+    axis = axis % nd
+    idxs = []
+    for d in range(nd):
+        if d == axis:
+            idxs.append(index)
+        else:
+            r = jnp.arange(index.shape[d])
+            r = r.reshape([-1 if i == d else 1 for i in range(nd)])
+            idxs.append(jnp.broadcast_to(r, index.shape))
+    return tuple(idxs)
+
+
+take_along_axis_op = register_op(
+    "take_along_axis",
+    lambda x, index, axis=0: jnp.take_along_axis(x, index, axis=axis),
+    fwd=_take_along_fwd, bwd=_take_along_bwd, static_argnames=("axis",),
+    nondiff_argnums=(1,))
+
+
+def take_along_axis(x, indices, axis, broadcast=True, name=None):
+    return apply(take_along_axis_op, x, indices, axis=int(axis))
+
+
+def _put_along_fwd(x, index, value, axis=0, reduce="assign"):
+    out = _put_along_plain(x, index, value, axis, reduce)
+    return out, (x, index, value)
+
+
+def _put_along_plain(x, index, value, axis=0, reduce="assign"):
+    ii = _along_axis_idx(index, axis, jnp.shape(x))
+    if reduce == "assign":
+        return x.at[ii].set(value)
+    if reduce == "add":
+        return x.at[ii].add(value)
+    if reduce == "multiply" or reduce == "mul":
+        return x.at[ii].multiply(value)
+    raise ValueError(f"unsupported reduce {reduce}")
+
+
+def _put_along_bwd(saved, g, axis=0, reduce="assign"):
+    x, index, value = saved
+    ii = _along_axis_idx(index, axis, jnp.shape(x))
+    gv = g[ii]
+    if reduce == "assign":
+        gx = g.at[ii].set(jnp.zeros_like(gv))
+    else:
+        gx = g
+    if jnp.ndim(value) == 0:
+        gv = jnp.sum(gv)
+    return gx, None, gv.astype(jnp.result_type(gv))
+
+
+put_along_axis_op = register_op(
+    "put_along_axis", _put_along_plain, fwd=_put_along_fwd,
+    bwd=_put_along_bwd, static_argnames=("axis", "reduce"),
+    nondiff_argnums=(1,))
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    return apply(put_along_axis_op, x, indices, values, axis=int(axis),
+                 reduce=reduce)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    """paddle.scatter: writes rows of ``updates`` at row ``index`` of x."""
+    op = scatter_op if overwrite else scatter_add_op
+    return apply(op, x, index, updates)
+
+
+def _scatter_fwd(x, index, updates):
+    return x.at[index].set(updates), (x, index)
+
+
+def _scatter_bwd(saved, g, **_):
+    x, index = saved
+    gu = g[index]
+    gx = g.at[index].set(jnp.zeros_like(gu))
+    return gx, None, gu
+
+
+scatter_op = register_op(
+    "scatter", lambda x, index, updates: x.at[index].set(updates),
+    fwd=_scatter_fwd, bwd=_scatter_bwd, nondiff_argnums=(1,))
+
+scatter_add_op = register_op(
+    "scatter_add", lambda x, index, updates: x.at[index].add(updates),
+    fwd=lambda x, index, updates: (x.at[index].add(updates), (x, index)),
+    bwd=lambda saved, g, **_: (g, None, g[saved[1]]),
+    nondiff_argnums=(1,))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return apply(scatter_nd_add_op, x, index, updates)
+
+
+def _snd_plain(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+scatter_nd_add_op = register_op(
+    "scatter_nd_add", _snd_plain,
+    fwd=lambda x, index, updates: (_snd_plain(x, index, updates), index),
+    bwd=lambda index, g, **_: (
+        g, None, g[tuple(jnp.moveaxis(index, -1, 0))]),
+    nondiff_argnums=(1,))
+
+
+def gather_nd(x, index, name=None):
+    return apply(gather_nd_op, x, index)
+
+
+def _gnd_plain(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+gather_nd_op = register_op(
+    "gather_nd", _gnd_plain,
+    fwd=lambda x, index: (_gnd_plain(x, index), (x, index)),
+    bwd=lambda saved, g, **_: (
+        jnp.zeros(jnp.shape(saved[0]), g.dtype).at[
+            tuple(jnp.moveaxis(saved[1], -1, 0))].add(g).astype(
+                saved[0].dtype), None),
+    nondiff_argnums=(1,))
+
+
+# -- where / masked ---------------------------------------------------------
+
+where_op = register_op(
+    "where", jnp.where,
+    fwd=lambda c, x, y: (jnp.where(c, x, y), (c, x, y)),
+    bwd=lambda saved, g: (
+        None,
+        _where_unbroadcast(saved[0], g, saved[1], True),
+        _where_unbroadcast(saved[0], g, saved[2], False)),
+    nondiff_argnums=(0,))
+
+
+def _where_unbroadcast(c, g, x, take_true):
+    from .math import unbroadcast
+
+    gx = jnp.where(c, g, jnp.zeros_like(g)) if take_true else \
+        jnp.where(c, jnp.zeros_like(g), g)
+    return unbroadcast(gx, jnp.shape(x))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply(where_op, condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    from ..core.tensor import Tensor
+
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(v[:, None], dtype=jnp.int64))
+                     for v in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1), dtype=jnp.int64))
+
+
+def masked_select(x, mask, name=None):
+    from ..core.tensor import Tensor
+
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    m = np.asarray(mask._data if isinstance(mask, Tensor) else mask)
+    return Tensor(jnp.asarray(arr[m.astype(bool)]))
+
+
+def masked_fill(x, mask, value, name=None):
+    from ..core.tensor import Tensor
+
+    if isinstance(value, Tensor):
+        value = value._data
+    return apply(masked_fill_op, x, mask, value)
+
+
+masked_fill_op = register_op(
+    "masked_fill", lambda x, mask, value: jnp.where(mask, value, x),
+    fwd=lambda x, mask, value: (jnp.where(mask, value, x), mask),
+    bwd=lambda mask, g, **_: (jnp.where(mask, jnp.zeros_like(g), g), None,
+                              None),
+    nondiff_argnums=(1, 2))
+
+
+# -- sort / topk / unique ---------------------------------------------------
+
+topk_op = register_op(
+    "topk", lambda x, k, axis=-1, largest=True: _topk(x, k, axis, largest),
+    static_argnames=("k", "axis", "largest"), n_outputs=2)
+
+
+def _topk(x, k, axis, largest):
+    if not largest:
+        vals, idx = jax.lax.top_k(jnp.moveaxis(-x, axis, -1), k)
+        vals = -vals
+    else:
+        vals, idx = jax.lax.top_k(jnp.moveaxis(x, axis, -1), k)
+    return (jnp.moveaxis(vals, -1, axis),
+            jnp.moveaxis(idx, -1, axis).astype(jnp.int64))
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    from ..core.tensor import Tensor
+
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    return apply(topk_op, x, k=int(k), axis=int(axis), largest=bool(largest))
+
+
+sort_op = register_op(
+    "sort", lambda x, axis=-1, descending=False: (
+        -jnp.sort(-x, axis=axis) if descending else jnp.sort(x, axis=axis)),
+    static_argnames=("axis", "descending"))
+argsort_op = register_op(
+    "argsort", lambda x, axis=-1, descending=False: (
+        jnp.argsort(-x, axis=axis) if descending
+        else jnp.argsort(x, axis=axis)).astype(jnp.int64),
+    static_argnames=("axis", "descending"))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    return apply(sort_op, x, axis=int(axis), descending=bool(descending))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    return apply(argsort_op, x, axis=int(axis), descending=bool(descending))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    from ..core.tensor import Tensor
+
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    res = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    from ..core.tensor import Tensor
+
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    if arr.ndim == 0 or arr.size == 0:
+        return Tensor(jnp.asarray(arr))
+    flat = arr.reshape(-1) if axis is None else arr
+    keep = np.concatenate([[True], flat[1:] != flat[:-1]]) \
+        if axis is None else None
+    out = flat[keep]
+    results = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        results.append(Tensor(jnp.asarray(inv, dtype=np.int64)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, flat.size))
+        results.append(Tensor(jnp.asarray(counts, dtype=np.int64)))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+# -- misc -------------------------------------------------------------------
+
+assign_op = register_op(
+    "assign", lambda x: jnp.asarray(x),
+    fwd=lambda x: (jnp.asarray(x), None),
+    bwd=lambda s, g: (g,))
+
+
+def assign(x, output=None):
+    from ..core.tensor import Tensor
+
+    if not isinstance(x, Tensor):
+        x = Tensor(jnp.asarray(x))
+    out = apply(assign_op, x)
+    if output is not None:
+        output.set_value(out)
+        return output
+    return out
+
+
+tril_op = register_op(
+    "tril", lambda x, diagonal=0: jnp.tril(x, diagonal),
+    fwd=lambda x, diagonal=0: (jnp.tril(x, diagonal), None),
+    bwd=lambda s, g, diagonal=0: (jnp.tril(g, diagonal),),
+    static_argnames=("diagonal",))
+triu_op = register_op(
+    "triu", lambda x, diagonal=0: jnp.triu(x, diagonal),
+    fwd=lambda x, diagonal=0: (jnp.triu(x, diagonal), None),
+    bwd=lambda s, g, diagonal=0: (jnp.triu(g, diagonal),),
+    static_argnames=("diagonal",))
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(tril_op, x, diagonal=int(diagonal))
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(triu_op, x, diagonal=int(diagonal))
+
+
+diag_op = register_op(
+    "diag", lambda x, offset=0: jnp.diag(x, offset),
+    static_argnames=("offset",))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    return apply(diag_op, x, offset=int(offset))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(diagonal_op, x, offset=int(offset), axis1=int(axis1),
+                 axis2=int(axis2))
+
+
+diagonal_op = register_op(
+    "diagonal",
+    lambda x, offset=0, axis1=0, axis2=1: jnp.diagonal(
+        x, offset, axis1, axis2),
+    static_argnames=("offset", "axis1", "axis2"))
+
+repeat_interleave_op = register_op(
+    "repeat_interleave",
+    lambda x, repeats, axis=None: jnp.repeat(x, repeats, axis=axis),
+    static_argnames=("repeats", "axis"))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    from ..core.tensor import Tensor
+
+    if isinstance(repeats, Tensor):
+        repeats = tuple(int(v) for v in repeats.numpy().tolist())
+    return apply(repeat_interleave_op, x, repeats=repeats,
+                 axis=int(axis) if axis is not None else None)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(one_hot_op, x, num_classes=int(num_classes))
+
+
+one_hot_op = register_op(
+    "one_hot",
+    lambda x, num_classes: jax.nn.one_hot(x, num_classes,
+                                          dtype=jnp.float32),
+    static_argnames=("num_classes",))
+
+
+def meshgrid(*args, **kwargs):
+    from ..core.tensor import Tensor
+
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    outs = jnp.meshgrid(*[a._data if isinstance(a, Tensor) else a
+                          for a in args], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(moveaxis_op, x,
+                 source=_t(source) if isinstance(source, (list, tuple))
+                 else int(source),
+                 destination=_t(destination)
+                 if isinstance(destination, (list, tuple))
+                 else int(destination))
+
+
+moveaxis_op = register_op(
+    "moveaxis",
+    lambda x, source, destination: jnp.moveaxis(x, source, destination),
+    static_argnames=("source", "destination"))
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    raise NotImplementedError("as_strided is not supported on TPU layouts")
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+import builtins  # noqa: E402
+
+builtins_slice = builtins.slice
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    from ..core.tensor import Tensor
+
+    idx = [builtins_slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        s = int(s.item()) if isinstance(s, Tensor) else int(s)
+        e = int(e.item()) if isinstance(e, Tensor) else int(e)
+        idx[ax] = builtins_slice(s, e)
+    return getitem(x, tuple(idx))
+
+
+# -- getitem / setitem ------------------------------------------------------
+
+
+def _normalize_index(x, idx):
+    """Convert Tensors inside an index to jax arrays."""
+    from ..core.tensor import Tensor
+
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    out = []
+    for it in idx:
+        if isinstance(it, Tensor):
+            d = it._data
+            out.append(d)
+        elif isinstance(it, (list, np.ndarray)):
+            arr = np.asarray(it)
+            out.append(jnp.asarray(arr))
+        else:
+            out.append(it)
+    return tuple(out)
+
+
+def getitem(x, idx):
+    from ..autograd import engine as _engine
+    from ..core.tensor import Tensor
+
+    jidx = _normalize_index(x, idx)
+
+    # Boolean-mask indexing yields data-dependent shapes: concretize.
+    has_bool = builtins.any(
+        hasattr(it, "dtype") and it.dtype == jnp.bool_ for it in jidx)
+    if has_bool:
+        arr = np.asarray(x._data)
+        npidx = tuple(np.asarray(it) if hasattr(it, "dtype") else it
+                      for it in jidx)
+        return Tensor(jnp.asarray(arr[npidx]))
+
+    need_grad = _engine.is_grad_enabled() and not x.stop_gradient
+    if not need_grad:
+        return Tensor(x._data[jidx])
+    out_data, vjp_fn = jax.vjp(lambda a: a[jidx], x._data)
+    node = _engine.GradNode(_getitem_opdef, vjp_fn, [x], {},
+                            vjp_fallback=True, diff_idx=[0])
+    out = Tensor(out_data, stop_gradient=False)
+    node.bind_outputs([out])
+    return out
+
+
+class _FakeOp:
+    name = "getitem"
+    jit_bwd = None
+
+
+_getitem_opdef = _FakeOp()
+
+
+def setitem(x, idx, value):
+    """In-place __setitem__ with autograd (functional under the hood)."""
+    from ..autograd import engine as _engine
+    from ..core.tensor import Tensor
+
+    jidx = _normalize_index(x, idx)
+    has_bool = builtins.any(
+        hasattr(it, "dtype") and it.dtype == jnp.bool_ for it in jidx)
+    if isinstance(value, Tensor):
+        vdata = value._data
+    else:
+        vdata = jnp.asarray(value, dtype=x.dtype)
+    if has_bool:
+        # where-based masked assignment (keeps shapes static).
+        if len(jidx) == 1:
+            mask = jidx[0]
+            new = jnp.where(
+                mask.reshape(mask.shape + (1,) * (x.ndim - mask.ndim)),
+                vdata, x._data)
+        else:
+            raise NotImplementedError("mixed bool advanced setitem")
+        x._data = new.astype(x.dtype)
+        x._grad_node = None
+        return x
+
+    need_grad = (_engine.is_grad_enabled()
+                 and (not x.stop_gradient
+                      or (isinstance(value, Tensor)
+                          and not value.stop_gradient)))
+    if not need_grad:
+        x._data = x._data.at[jidx].set(vdata)
+        return x
+
+    # Snapshot x's pre-mutation autograd identity into a proxy so the new
+    # node's input edge points at the OLD producer, not at x itself (which
+    # is about to be re-bound to the new node — a self-loop otherwise).
+    proxy = _autograd_proxy(x)
+    inputs = [proxy, value if isinstance(value, Tensor) else vdata]
+    out_data, vjp_fn = jax.vjp(
+        lambda a, v: a.at[jidx].set(v.astype(a.dtype)), x._data, vdata)
+    node = _engine.GradNode(_setitem_opdef, vjp_fn, inputs, {},
+                            vjp_fallback=True, diff_idx=[0, 1])
+    out = Tensor(out_data, stop_gradient=False)
+    node.bind_outputs([out])
+    # Paddle inplace semantics: x now refers to the new value/node.
+    x._data = out._data
+    x._grad_node = node
+    x._out_slot = 0
+    x.stop_gradient = False
+    return x
+
+
+def _autograd_proxy(t):
+    """Copy of t carrying its current autograd edge (for inplace ops)."""
+    from ..core.tensor import Tensor
+
+    p = Tensor(t._data, stop_gradient=t.stop_gradient)
+    p._grad_node = t._grad_node
+    p._out_slot = t._out_slot
+    p._hooks = t._hooks
+    return p
+
+
+class _FakeSetOp:
+    name = "setitem"
+    jit_bwd = None
+
+
+_setitem_opdef = _FakeSetOp()

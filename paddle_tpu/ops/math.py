@@ -1,0 +1,316 @@
+"""Elementwise / scalar math ops with hand-written backward pairings.
+
+Reference op surface: ``python/paddle/tensor/math.py`` + kernel pairings in
+``paddle/phi/ops/yaml/ops.yaml`` / ``backward.yaml`` (e.g. ``- op : add``
+paired with ``add_grad``).  Each hot op here registers an explicit
+(fwd, bwd) pair so eager dispatch stays on jitted, XLA-cached executables;
+broadcasting grads reduce over the broadcast axes exactly like the
+reference's ``ElementwiseGradKernel`` (phi/kernels/funcs/elementwise_base.h).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import apply, register_op
+
+
+def unbroadcast(g, shape):
+    """Sum ``g`` down to ``shape`` (reverse of numpy broadcasting)."""
+    shape = tuple(shape)
+    if g.shape == shape:
+        return g
+    # Sum leading extra dims.
+    extra = g.ndim - len(shape)
+    if extra > 0:
+        g = jnp.sum(g, axis=tuple(range(extra)))
+    # Sum dims that were 1 in the original shape.
+    axes = tuple(i for i, s in enumerate(shape) if s == 1 and g.shape[i] != 1)
+    if axes:
+        g = jnp.sum(g, axis=axes, keepdims=True)
+    return g.astype(jnp.result_type(g)) if g.shape == shape else jnp.reshape(g, shape)
+
+
+def _shape_of(x):
+    return jnp.shape(x)
+
+
+# -- binary ops -------------------------------------------------------------
+
+def _binary(name, fn, bwd):
+    def fwd(x, y):
+        return fn(x, y), (x, y)
+
+    op = register_op(name, fn, fwd=fwd, bwd=bwd)
+
+    def api(x, y, name=None):
+        return apply(op, x, y)
+
+    api.__name__ = name
+    return api, op
+
+
+def _add_bwd(saved, g):
+    x, y = saved
+    return unbroadcast(g, jnp.shape(x)), unbroadcast(g, jnp.shape(y))
+
+
+def _sub_bwd(saved, g):
+    x, y = saved
+    return unbroadcast(g, jnp.shape(x)), unbroadcast(-g, jnp.shape(y))
+
+
+def _mul_bwd(saved, g):
+    x, y = saved
+    return unbroadcast(g * y, jnp.shape(x)), unbroadcast(g * x, jnp.shape(y))
+
+
+def _div_bwd(saved, g):
+    x, y = saved
+    gx = unbroadcast(g / y, jnp.shape(x))
+    gy = unbroadcast(-g * x / (y * y), jnp.shape(y))
+    return gx, gy
+
+
+def _pow_bwd(saved, g):
+    x, y = saved
+    gx = unbroadcast(g * y * jnp.power(x, y - 1), jnp.shape(x))
+    safe_x = jnp.where(x > 0, x, jnp.ones_like(x))
+    gy = unbroadcast(g * jnp.power(x, y) * jnp.log(safe_x), jnp.shape(y))
+    return gx, gy
+
+
+def _max_bwd(saved, g):
+    x, y = saved
+    mask = (x >= y).astype(g.dtype)
+    return (unbroadcast(g * mask, jnp.shape(x)),
+            unbroadcast(g * (1 - mask), jnp.shape(y)))
+
+
+def _min_bwd(saved, g):
+    x, y = saved
+    mask = (x <= y).astype(g.dtype)
+    return (unbroadcast(g * mask, jnp.shape(x)),
+            unbroadcast(g * (1 - mask), jnp.shape(y)))
+
+
+add, add_op = _binary("add", jnp.add, _add_bwd)
+subtract, subtract_op = _binary("subtract", jnp.subtract, _sub_bwd)
+multiply, multiply_op = _binary("multiply", jnp.multiply, _mul_bwd)
+divide, divide_op = _binary("divide", jnp.true_divide, _div_bwd)
+pow_, pow_op = _binary("elementwise_pow", jnp.power, _pow_bwd)
+maximum, maximum_op = _binary("maximum", jnp.maximum, _max_bwd)
+minimum, minimum_op = _binary("minimum", jnp.minimum, _min_bwd)
+
+
+def pow(x, y, name=None):  # noqa: A001 - paddle API name
+    return apply(pow_op, x, y)
+
+
+def _nodiff_binary(name, fn):
+    op = register_op(name, fn)
+
+    def api(x, y, name=None):
+        return apply(op, x, y)
+
+    api.__name__ = name
+    return api
+
+
+remainder = _nodiff_binary("remainder", jnp.remainder)
+mod = remainder
+floor_divide = _nodiff_binary("floor_divide", jnp.floor_divide)
+floor_mod = remainder
+fmax = _nodiff_binary("fmax", jnp.fmax)
+fmin = _nodiff_binary("fmin", jnp.fmin)
+logaddexp = _nodiff_binary("logaddexp", jnp.logaddexp)
+atan2 = _nodiff_binary("atan2", jnp.arctan2)
+gcd = _nodiff_binary("gcd", jnp.gcd)
+lcm = _nodiff_binary("lcm", jnp.lcm)
+bitwise_and = _nodiff_binary("bitwise_and", jnp.bitwise_and)
+bitwise_or = _nodiff_binary("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _nodiff_binary("bitwise_xor", jnp.bitwise_xor)
+left_shift = _nodiff_binary("left_shift", jnp.left_shift)
+right_shift = _nodiff_binary("right_shift", jnp.right_shift)
+
+
+# -- unary ops --------------------------------------------------------------
+
+def _unary(name, fn, grad_fn=None, save_out=False):
+    """grad_fn(saved, g) where saved is input x (or output if save_out)."""
+    if grad_fn is None:
+        op = register_op(name, fn)
+    else:
+        def fwd(x):
+            out = fn(x)
+            return out, (out if save_out else x)
+
+        def bwd(saved, g):
+            return (grad_fn(saved, g),)
+
+        op = register_op(name, fn, fwd=fwd, bwd=bwd)
+
+    def api(x, name=None):
+        return apply(op, x)
+
+    api.__name__ = name
+    return api
+
+
+exp = _unary("exp", jnp.exp, lambda out, g: g * out, save_out=True)
+expm1 = _unary("expm1", jnp.expm1, lambda x, g: g * jnp.exp(x))
+log = _unary("log", jnp.log, lambda x, g: g / x)
+log2 = _unary("log2", jnp.log2, lambda x, g: g / (x * jnp.log(2.0).astype(x.dtype)))
+log10 = _unary("log10", jnp.log10,
+               lambda x, g: g / (x * jnp.log(10.0).astype(x.dtype)))
+log1p = _unary("log1p", jnp.log1p, lambda x, g: g / (1 + x))
+sqrt = _unary("sqrt", jnp.sqrt, lambda out, g: g * 0.5 / out, save_out=True)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt,
+               lambda x, g: g * (-0.5) * jax.lax.rsqrt(x) / x)
+square = _unary("square", jnp.square, lambda x, g: g * 2 * x)
+abs = _unary("abs", jnp.abs, lambda x, g: g * jnp.sign(x))  # noqa: A001
+neg = _unary("neg", jnp.negative, lambda x, g: -g)
+negative = neg
+sign = _unary("sign", jnp.sign)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round_ = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda x: x - jnp.trunc(x), lambda x, g: g)
+reciprocal = _unary("reciprocal", jnp.reciprocal,
+                    lambda x, g: -g / jnp.square(x))
+sin = _unary("sin", jnp.sin, lambda x, g: g * jnp.cos(x))
+cos = _unary("cos", jnp.cos, lambda x, g: -g * jnp.sin(x))
+tan = _unary("tan", jnp.tan, lambda x, g: g / jnp.square(jnp.cos(x)))
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh, lambda x, g: g * jnp.cosh(x))
+cosh = _unary("cosh", jnp.cosh, lambda x, g: g * jnp.sinh(x))
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+erf = _unary("erf", jax.scipy.special.erf,
+             lambda x, g: g * (2.0 / jnp.sqrt(jnp.pi)).astype(x.dtype)
+             * jnp.exp(-jnp.square(x)))
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+bitwise_not = _unary("bitwise_not", jnp.bitwise_not)
+isnan_ = _unary("isnan", jnp.isnan)
+isinf_ = _unary("isinf", jnp.isinf)
+isfinite_ = _unary("isfinite", jnp.isfinite)
+logical_not = _unary("logical_not", jnp.logical_not)
+i0 = _unary("i0", jax.scipy.special.i0)
+rint = _unary("rint", jnp.rint)
+
+
+def _logical_binary(name, fn):
+    op = register_op(name, fn)
+
+    def api(x, y, out=None, name=None):
+        return apply(op, x, y)
+
+    api.__name__ = name
+    return api
+
+
+logical_and = _logical_binary("logical_and", jnp.logical_and)
+logical_or = _logical_binary("logical_or", jnp.logical_or)
+logical_xor = _logical_binary("logical_xor", jnp.logical_xor)
+equal = _logical_binary("equal", lambda x, y: jnp.equal(x, y))
+not_equal = _logical_binary("not_equal", jnp.not_equal)
+greater_than = _logical_binary("greater_than", jnp.greater)
+greater_equal = _logical_binary("greater_equal", jnp.greater_equal)
+less_than = _logical_binary("less_than", jnp.less)
+less_equal = _logical_binary("less_equal", jnp.less_equal)
+
+
+# -- clip / scale / lerp ----------------------------------------------------
+
+def _clip_fwd(x, min=None, max=None):
+    return jnp.clip(x, min, max), x
+
+
+def _clip_bwd(x, g, min=None, max=None):
+    mask = jnp.ones_like(x, dtype=bool)
+    if min is not None:
+        mask &= x >= min
+    if max is not None:
+        mask &= x <= max
+    return (g * mask.astype(g.dtype),)
+
+
+clip_op = register_op("clip", lambda x, min=None, max=None: jnp.clip(x, min, max),
+                      fwd=_clip_fwd, bwd=_clip_bwd,
+                      static_argnames=("min", "max"))
+
+
+def clip(x, min=None, max=None, name=None):
+    min = float(min) if min is not None and not hasattr(min, "ndim") else min
+    max = float(max) if max is not None and not hasattr(max, "ndim") else max
+    from ..core.tensor import Tensor
+
+    if isinstance(min, Tensor):
+        min = float(min.item())
+    if isinstance(max, Tensor):
+        max = float(max.item())
+    return apply(clip_op, x, min=min, max=max)
+
+
+def _scale_fn(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+scale_op = register_op(
+    "scale", _scale_fn,
+    fwd=lambda x, scale=1.0, bias=0.0, bias_after_scale=True: (
+        _scale_fn(x, scale, bias, bias_after_scale), None),
+    bwd=lambda saved, g, scale=1.0, bias=0.0, bias_after_scale=True: (
+        g * scale,),
+    static_argnames=("scale", "bias", "bias_after_scale"))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    from ..core.tensor import Tensor
+
+    if isinstance(scale, Tensor):
+        scale = float(scale.item())
+    return apply(scale_op, x, scale=float(scale), bias=float(bias),
+                 bias_after_scale=bool(bias_after_scale))
+
+
+lerp_op = register_op(
+    "lerp", lambda x, y, w: x + w * (y - x),
+    fwd=lambda x, y, w: (x + w * (y - x), (x, y, w)),
+    bwd=lambda saved, g: (
+        unbroadcast(g * (1 - saved[2]), jnp.shape(saved[0])),
+        unbroadcast(g * saved[2], jnp.shape(saved[1])),
+        unbroadcast(g * (saved[1] - saved[0]), jnp.shape(saved[2]))))
+
+
+def lerp(x, y, weight, name=None):
+    return apply(lerp_op, x, y, weight)
+
+
+stanh_op = register_op(
+    "stanh",
+    lambda x, scale_a=0.67, scale_b=1.7159: scale_b * jnp.tanh(x * scale_a),
+    static_argnames=("scale_a", "scale_b"))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(stanh_op, x, scale_a=scale_a, scale_b=scale_b)
+
+
+_nan_to_num_op = register_op(
+    "nan_to_num",
+    lambda x, nan=0.0, posinf=None, neginf=None: jnp.nan_to_num(
+        x, nan=nan, posinf=posinf, neginf=neginf),
+    static_argnames=("nan", "posinf", "neginf"))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(_nan_to_num_op, x, nan=nan, posinf=posinf, neginf=neginf)

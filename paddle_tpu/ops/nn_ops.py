@@ -1,0 +1,536 @@
+"""Neural-network compute ops.
+
+Reference kernels: ``paddle/phi/kernels/`` conv/pool/norm/embedding/
+softmax/dropout (+ fused attention under ``phi/kernels/fusion/``), exposed
+via ``python/paddle/nn/functional/``.  TPU-native: convs and attention map
+to ``jax.lax`` convolutions / dot_general so XLA tiles them on the MXU;
+norms are written as fusable elementwise chains (XLA fuses the whole
+normalize+scale+shift into one kernel); dropout uses the counter-based PRNG.
+
+NHWC vs NCHW: the reference defaults to NCHW.  We accept both and keep the
+public default NCHW for API parity, transposing at the boundary — XLA's
+layout assignment makes this free inside a jit region.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .registry import apply, register_op
+from .random import default_generator
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        return tuple(int(v[0]) for _ in range(n))
+    return tuple(int(v) for _ in range(n))
+
+
+# -- convolution ------------------------------------------------------------
+
+def _conv2d_plain(x, w, stride=(1, 1), padding=(0, 0), dilation=(1, 1),
+                  groups=1, data_format="NCHW"):
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
+        else ("NHWC", "HWIO", "NHWC"))
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        pad = [(padding[0], padding[0]), (padding[1], padding[1])]
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=pad, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=None)
+
+
+conv2d_op = register_op(
+    "conv2d", _conv2d_plain,
+    static_argnames=("stride", "padding", "dilation", "groups",
+                     "data_format"))
+
+
+def conv2d_raw(x, weight, stride=1, padding=0, dilation=1, groups=1,
+               data_format="NCHW"):
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        pad = _pair(padding)
+    return apply(conv2d_op, x, weight, stride=_pair(stride), padding=pad,
+                 dilation=_pair(dilation), groups=int(groups),
+                 data_format=data_format)
+
+
+def _conv1d_plain(x, w, stride=1, padding=0, dilation=1, groups=1):
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NCH", "OIH", "NCH"))
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride,), padding=[(padding, padding)],
+        rhs_dilation=(dilation,), dimension_numbers=dn,
+        feature_group_count=groups)
+
+
+conv1d_op = register_op(
+    "conv1d", _conv1d_plain,
+    static_argnames=("stride", "padding", "dilation", "groups"))
+
+
+def _conv2d_transpose_plain(x, w, stride=(1, 1), padding=(0, 0),
+                            output_padding=(0, 0), dilation=(1, 1), groups=1,
+                            data_format="NCHW"):
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        ("NCHW", "IOHW", "NCHW") if data_format == "NCHW"
+        else ("NHWC", "HWIO", "NHWC"))
+    pad = [(dilation[0] * (w.shape[2] - 1) - padding[0],
+            dilation[0] * (w.shape[2] - 1) - padding[0] + output_padding[0]),
+           (dilation[1] * (w.shape[3] - 1) - padding[1],
+            dilation[1] * (w.shape[3] - 1) - padding[1] + output_padding[1])]
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pad,
+        lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+
+
+conv2d_transpose_op = register_op(
+    "conv2d_transpose", _conv2d_transpose_plain,
+    static_argnames=("stride", "padding", "output_padding", "dilation",
+                     "groups", "data_format"))
+
+
+# -- pooling ----------------------------------------------------------------
+
+def _max_pool2d_plain(x, kernel_size, stride, padding, ceil_mode=False,
+                      data_format="NCHW"):
+    if data_format == "NCHW":
+        window = (1, 1) + kernel_size
+        strides = (1, 1) + stride
+        pads = ((0, 0), (0, 0),
+                (padding[0], padding[0]), (padding[1], padding[1]))
+    else:
+        window = (1,) + kernel_size + (1,)
+        strides = (1,) + stride + (1,)
+        pads = ((0, 0), (padding[0], padding[0]),
+                (padding[1], padding[1]), (0, 0))
+    # -inf init is required for jax's reduce_window max transpose rule.
+    neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+           else jnp.iinfo(x.dtype).min)
+    return jax.lax.reduce_window(x, neg, jax.lax.max, window, strides, pads)
+
+
+max_pool2d_op = register_op(
+    "max_pool2d", _max_pool2d_plain,
+    static_argnames=("kernel_size", "stride", "padding", "ceil_mode",
+                     "data_format"))
+
+
+def _avg_pool2d_plain(x, kernel_size, stride, padding, exclusive=True,
+                      data_format="NCHW"):
+    if data_format == "NCHW":
+        window = (1, 1) + kernel_size
+        strides = (1, 1) + stride
+        pads = ((0, 0), (0, 0),
+                (padding[0], padding[0]), (padding[1], padding[1]))
+    else:
+        window = (1,) + kernel_size + (1,)
+        strides = (1,) + stride + (1,)
+        pads = ((0, 0), (padding[0], padding[0]),
+                (padding[1], padding[1]), (0, 0))
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+    if exclusive and (padding[0] or padding[1]):
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                       strides, pads)
+        return summed / counts
+    return summed / float(np.prod(kernel_size))
+
+
+avg_pool2d_op = register_op(
+    "avg_pool2d", _avg_pool2d_plain,
+    static_argnames=("kernel_size", "stride", "padding", "exclusive",
+                     "data_format"))
+
+
+def _adaptive_avg_pool2d_plain(x, output_size, data_format="NCHW"):
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    n, c, h, w = x.shape
+    oh, ow = output_size
+    # When evenly divisible this is an exact mean-pool reshape.
+    if h % oh == 0 and w % ow == 0:
+        out = x.reshape(n, c, oh, h // oh, ow, w // ow).mean(axis=(3, 5))
+    else:
+        # General case: interval averages.
+        hs = (np.arange(oh) * h // oh, ((np.arange(oh) + 1) * h + oh - 1) // oh)
+        ws = (np.arange(ow) * w // ow, ((np.arange(ow) + 1) * w + ow - 1) // ow)
+        rows = []
+        for i in range(oh):
+            cols = []
+            for j in range(ow):
+                cols.append(x[:, :, hs[0][i]:hs[1][i],
+                              ws[0][j]:ws[1][j]].mean(axis=(2, 3)))
+            rows.append(jnp.stack(cols, axis=-1))
+        out = jnp.stack(rows, axis=-2)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+adaptive_avg_pool2d_op = register_op(
+    "adaptive_avg_pool2d", _adaptive_avg_pool2d_plain,
+    static_argnames=("output_size", "data_format"))
+
+
+# -- normalization ----------------------------------------------------------
+
+def _layer_norm_plain(x, weight=None, bias=None, epsilon=1e-5,
+                      begin_norm_axis=-1):
+    axes = tuple(range(begin_norm_axis % x.ndim, x.ndim)) \
+        if begin_norm_axis != -1 else (x.ndim - 1,)
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+layer_norm_op = register_op(
+    "layer_norm", _layer_norm_plain,
+    static_argnames=("epsilon", "begin_norm_axis"))
+
+
+def _rms_norm_plain(x, weight=None, epsilon=1e-6):
+    # Reference: phi/kernels/fusion rms_norm; compute in fp32 for stability.
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + epsilon)
+    out = out.astype(dt)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+rms_norm_op = register_op("rms_norm", _rms_norm_plain,
+                          static_argnames=("epsilon",))
+
+
+def _batch_norm_infer(x, mean, var, weight=None, bias=None, epsilon=1e-5,
+                      data_format="NCHW"):
+    if data_format == "NCHW" and x.ndim == 4:
+        shape = (1, -1, 1, 1)
+    elif x.ndim == 2:
+        shape = (1, -1)
+    else:
+        shape = (1,) * (x.ndim - 1) + (-1,)
+    inv = jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    out = (x - mean.reshape(shape)) * inv
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+batch_norm_infer_op = register_op(
+    "batch_norm_infer", _batch_norm_infer,
+    static_argnames=("epsilon", "data_format"))
+
+
+def _batch_norm_stats(x, data_format="NCHW"):
+    axes = (0, 2, 3) if (data_format == "NCHW" and x.ndim == 4) else \
+        tuple(i for i in range(x.ndim) if i != x.ndim - 1) if x.ndim > 2 \
+        else (0,)
+    if data_format == "NCHW" and x.ndim == 4:
+        axes = (0, 2, 3)
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    return mean, var
+
+
+batch_norm_stats_op = register_op(
+    "batch_norm_stats", _batch_norm_stats, n_outputs=2,
+    static_argnames=("data_format",))
+
+
+def _group_norm_plain(x, weight=None, bias=None, epsilon=1e-5, groups=32,
+                      data_format="NCHW"):
+    if data_format != "NCHW":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    xg = x.reshape(n, groups, c // groups, *spatial)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xg - mean), axis=axes, keepdims=True)
+    out = ((xg - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x.shape)
+    shape = (1, c) + (1,) * len(spatial)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    if data_format != "NCHW":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+group_norm_op = register_op(
+    "group_norm", _group_norm_plain,
+    static_argnames=("epsilon", "groups", "data_format"))
+
+
+# -- embedding --------------------------------------------------------------
+
+def _embedding_plain(weight, ids, padding_idx=None):
+    out = jnp.take(weight, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+def _embedding_fwd(weight, ids, padding_idx=None):
+    return _embedding_plain(weight, ids, padding_idx), (weight, ids)
+
+
+def _embedding_bwd(saved, g, padding_idx=None):
+    weight, ids = saved
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None]
+        g = g * mask.astype(g.dtype)
+    gw = jnp.zeros(jnp.shape(weight), g.dtype)
+    gw = gw.at[ids].add(g)
+    return gw.astype(weight.dtype), None
+
+
+embedding_op = register_op("embedding", _embedding_plain,
+                           fwd=_embedding_fwd, bwd=_embedding_bwd,
+                           static_argnames=("padding_idx",),
+                           nondiff_argnums=(1,))
+
+
+# -- softmax + cross entropy ------------------------------------------------
+
+def _softmax_fwd(x, axis=-1):
+    out = jax.nn.softmax(x, axis=axis)
+    return out, out
+
+
+def _softmax_bwd(out, g, axis=-1):
+    inner = jnp.sum(out * g, axis=axis, keepdims=True)
+    return (out * (g - inner),)
+
+
+softmax_op = register_op("softmax",
+                         lambda x, axis=-1: jax.nn.softmax(x, axis=axis),
+                         fwd=_softmax_fwd, bwd=_softmax_bwd,
+                         static_argnames=("axis",))
+
+log_softmax_op = register_op(
+    "log_softmax", lambda x, axis=-1: jax.nn.log_softmax(x, axis=axis),
+    fwd=lambda x, axis=-1: (jax.nn.log_softmax(x, axis=axis), None),
+    bwd=None, static_argnames=("axis",))
+# log_softmax bwd needs the output; register with explicit pair:
+
+
+def _log_softmax_fwd(x, axis=-1):
+    out = jax.nn.log_softmax(x, axis=axis)
+    return out, out
+
+
+def _log_softmax_bwd(out, g, axis=-1):
+    return (g - jnp.exp(out) * jnp.sum(g, axis=axis, keepdims=True),)
+
+
+log_softmax_op = register_op("log_softmax",
+                             lambda x, axis=-1: jax.nn.log_softmax(
+                                 x, axis=axis),
+                             fwd=_log_softmax_fwd, bwd=_log_softmax_bwd,
+                             static_argnames=("axis",))
+
+
+def _softmax_ce_plain(logits, label, soft_label=False, ignore_index=-100,
+                      axis=-1):
+    lsm = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        return -jnp.sum(label * lsm, axis=axis, keepdims=True)
+    nll = -jnp.take_along_axis(lsm, label[..., None].astype(jnp.int32),
+                               axis=axis)
+    if ignore_index is not None:
+        mask = (label != ignore_index)[..., None]
+        nll = jnp.where(mask, nll, jnp.zeros_like(nll))
+    return nll
+
+
+def _softmax_ce_fwd(logits, label, soft_label=False, ignore_index=-100,
+                    axis=-1):
+    lsm = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * lsm, axis=axis, keepdims=True)
+    else:
+        nll = -jnp.take_along_axis(lsm, label[..., None].astype(jnp.int32),
+                                   axis=axis)
+        if ignore_index is not None:
+            mask = (label != ignore_index)[..., None]
+            nll = jnp.where(mask, nll, jnp.zeros_like(nll))
+        loss = nll
+    return loss, (lsm, label)
+
+
+def _softmax_ce_bwd(saved, g, soft_label=False, ignore_index=-100, axis=-1):
+    lsm, label = saved
+    sm = jnp.exp(lsm)
+    if soft_label:
+        glogits = g * (sm * jnp.sum(label, axis=axis, keepdims=True) - label)
+        return glogits, None
+    oh = jax.nn.one_hot(label, lsm.shape[axis], dtype=lsm.dtype, axis=axis)
+    if ignore_index is not None:
+        valid = (label != ignore_index)[..., None].astype(lsm.dtype)
+    else:
+        valid = 1.0
+    glogits = g * (sm - oh) * valid
+    return glogits, None
+
+
+softmax_with_cross_entropy_op = register_op(
+    "softmax_with_cross_entropy", _softmax_ce_plain,
+    fwd=_softmax_ce_fwd, bwd=_softmax_ce_bwd,
+    static_argnames=("soft_label", "ignore_index", "axis"),
+    nondiff_argnums=(1,))
+
+
+# -- dropout ----------------------------------------------------------------
+
+def _dropout_fwd_key(x, key, p=0.5, mode="upscale_in_train"):
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, jnp.shape(x))
+    if mode == "upscale_in_train":
+        out = jnp.where(mask, x / keep, jnp.zeros_like(x))
+    else:
+        out = jnp.where(mask, x, jnp.zeros_like(x))
+    return out, mask
+
+
+_dropout_jit = jax.jit(_dropout_fwd_key, static_argnames=("p", "mode"))
+
+
+def _dropout_bwd(mask, g, p=0.5, mode="upscale_in_train"):
+    keep = 1.0 - p
+    if mode == "upscale_in_train":
+        return (jnp.where(mask, g / keep, jnp.zeros_like(g)),)
+    return (jnp.where(mask, g, jnp.zeros_like(g)),)
+
+
+class _DropoutOp:
+    """Dropout needs a fresh key per call, so it bypasses register_op's
+    uniform jit wrapping and draws from the default generator."""
+
+    name = "dropout"
+    n_outputs = 1
+    jit_bwd = staticmethod(jax.jit(_dropout_bwd,
+                                   static_argnames=("p", "mode")))
+
+    @staticmethod
+    def fwd(x, p=0.5, mode="upscale_in_train"):
+        return _dropout_jit(x, default_generator.next_key(), p=p, mode=mode)
+
+
+dropout_op = _DropoutOp()
+
+
+def dropout_raw(x, p=0.5, training=True, mode="upscale_in_train"):
+    from ..autograd import engine as _engine
+    from ..core.tensor import Tensor
+
+    if not training:
+        if mode == "downscale_in_infer" and p > 0.0:
+            from . import math as _m
+
+            return _m.scale(x, scale=1.0 - p)
+        return x
+    if p == 0.0:
+        return x
+    need_grad = _engine.is_grad_enabled() and not x.stop_gradient
+    out_data, mask = dropout_op.fwd(x._data, p=float(p), mode=mode)
+    out = Tensor(out_data, stop_gradient=not need_grad)
+    if need_grad:
+        node = _engine.GradNode(dropout_op, mask, [x],
+                                {"p": float(p), "mode": mode})
+        node.bind_outputs([out])
+    return out
+
+
+# -- attention --------------------------------------------------------------
+
+def _sdpa_plain(q, k, v, mask=None, dropout=0.0, causal=False, scale=None):
+    """Scaled dot-product attention, [B, S, H, D] layout (paddle flash-attn
+    layout, nn/functional/flash_attention.py).  Computed in the MXU-friendly
+    [B, H, S, D] internally."""
+    B, Sq, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / np.sqrt(D)
+    qt = jnp.swapaxes(q, 1, 2)  # B H S D
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    if causal:
+        Sk = kt.shape[2]
+        causal_mask = jnp.tril(jnp.ones((Sq, Sk), dtype=bool), Sk - Sq)
+        logits = jnp.where(causal_mask, logits,
+                           jnp.finfo(logits.dtype).min)
+    if mask is not None:
+        logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1) \
+        .astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+sdpa_op = register_op(
+    "scaled_dot_product_attention", _sdpa_plain,
+    static_argnames=("dropout", "causal", "scale"))
+
+
+# -- rope -------------------------------------------------------------------
+
+def _rope_plain(q, k, cos, sin):
+    """Rotary embedding on [B, S, H, D]; cos/sin are [S, D] (interleaved
+    half-rotation, matching phi fused_rope semantics with use_neox=True)."""
+
+    def rot(x):
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        return jnp.concatenate([-x2, x1], axis=-1)
+
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return q * c + rot(q) * s, k * c + rot(k) * s
+
+
+fused_rope_op = register_op("fused_rotary_position_embedding", _rope_plain,
+                            n_outputs=2)
+
+
+# -- interpolate (nearest/bilinear) ----------------------------------------
+
+def _interp_plain(x, size, mode="nearest", align_corners=False,
+                  data_format="NCHW"):
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    method = {"nearest": "nearest", "bilinear": "linear",
+              "bicubic": "cubic"}[mode]
+    out = jax.image.resize(x, (x.shape[0], size[0], size[1], x.shape[3]),
+                           method=method)
+    if data_format == "NCHW":
+        out = jnp.transpose(out, (0, 3, 1, 2))
+    return out
+
+
+interpolate_op = register_op(
+    "interpolate", _interp_plain,
+    static_argnames=("size", "mode", "align_corners", "data_format"))

@@ -1,0 +1,195 @@
+"""Random ops + global generator.
+
+Reference: ``phi/core/generator.h`` (Generator with seed/offset state) and
+``python/paddle/tensor/random.py``.  TPU-native: a stateful facade over jax
+counter-based PRNG — ``paddle.seed`` resets the key; every sampling op
+splits the key, so eager sampling is reproducible, and the distributed RNG
+tracker (fleet/layers/mpu/random.py analog) can fork deterministic
+per-mesh-axis streams.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+
+
+class Generator:
+    """Stateful RNG facade over jax.random keys."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._key = jax.random.key(int(seed))
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    seed = initial_seed
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def get_state(self):
+        return jax.random.key_data(self._key)
+
+    def set_state(self, state):
+        self._key = jax.random.wrap_key_data(jnp.asarray(state))
+
+
+default_generator = Generator(0)
+
+
+def seed(value: int):
+    """paddle.seed"""
+    default_generator.manual_seed(int(value))
+    return default_generator
+
+
+def get_rng_state():
+    return [default_generator.get_state()]
+
+
+def set_rng_state(state):
+    default_generator.set_state(state[0] if isinstance(state, (list, tuple))
+                                else state)
+
+
+def _dt(dtype):
+    if dtype is None:
+        return dtype_mod.get_default_dtype()
+    return dtype_mod.convert_dtype(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy().tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+_jit_normal = jax.jit(jax.random.normal, static_argnames=("shape", "dtype"))
+_jit_uniform = jax.jit(jax.random.uniform,
+                       static_argnames=("shape", "dtype"))
+_jit_randint = jax.jit(jax.random.randint,
+                       static_argnames=("shape", "dtype"))
+_jit_bernoulli = jax.jit(lambda key, p: jax.random.bernoulli(key, p))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(_jit_normal(default_generator.next_key(), _shape(shape),
+                              _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        sh = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        z = _jit_normal(default_generator.next_key(), sh,
+                        dtype_mod.get_default_dtype())
+        return Tensor(m + s * z)
+    z = randn(shape if shape is not None else [1])
+    return Tensor(mean + std * z._data)
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    z = _jit_normal(default_generator.next_key(), _shape(shape), _dt(dtype))
+    return Tensor(mean + std * z)
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(_jit_uniform(default_generator.next_key(), _shape(shape),
+                               _dt(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    u = _jit_uniform(default_generator.next_key(), _shape(shape), _dt(dtype))
+    return Tensor(u * (max - min) + min)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = dtype_mod.convert_dtype(dtype) if dtype else jnp.dtype("int64")
+    return Tensor(_jit_randint(default_generator.next_key(), _shape(shape),
+                               int(low), int(high), d))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape, dtype or str(x.dtype))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(default_generator.next_key(),
+                                         int(n)).astype(_dt(dtype)))
+
+
+def shuffle(x, axis=0):
+    d = x._data if isinstance(x, Tensor) else x
+    return Tensor(jax.random.permutation(default_generator.next_key(), d,
+                                         axis=axis, independent=False))
+
+
+def bernoulli(x, name=None):
+    p = x._data if isinstance(x, Tensor) else x
+    return Tensor(_jit_bernoulli(default_generator.next_key(), p)
+                  .astype(p.dtype))
+
+
+def poisson(x, name=None):
+    lam = x._data if isinstance(x, Tensor) else x
+    return Tensor(jax.random.poisson(default_generator.next_key(), lam)
+                  .astype(lam.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    p = x._data if isinstance(x, Tensor) else x
+    key = default_generator.next_key()
+    if replacement:
+        idx = jax.random.categorical(
+            key, jnp.log(jnp.maximum(p, 1e-30)),
+            shape=p.shape[:-1] + (num_samples,))
+    else:
+        # Gumbel top-k trick for sampling without replacement.
+        g = jax.random.gumbel(key, p.shape)
+        scores = jnp.log(jnp.maximum(p, 1e-30)) + g
+        _, idx = jax.lax.top_k(scores, num_samples)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def exponential_(x, lam=1.0, name=None):
+    u = jax.random.exponential(default_generator.next_key(),
+                               jnp.shape(x._data)) / lam
+    x.set_value(u.astype(x.dtype))
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    z = _jit_normal(default_generator.next_key(), tuple(x.shape), x.dtype)
+    x.set_value(mean + std * z)
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    u = _jit_uniform(default_generator.next_key(), tuple(x.shape), x.dtype)
+    x.set_value(u * (max - min) + min)
+    return x

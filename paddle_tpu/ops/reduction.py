@@ -1,0 +1,379 @@
+"""Reduction ops.
+
+Reference: ``python/paddle/tensor/math.py`` (sum/mean/...) and stat ops,
+kernel pairing ``reduce_sum``/``reduce_sum_grad`` etc. in
+``paddle/phi/ops/yaml/ops.yaml``; grad semantics mirror
+``phi/kernels/funcs/reduce_function.h`` (broadcast the output cotangent back
+over the reduced axes).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import apply, register_op
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _restore_shape(g, x, axis, keepdim):
+    """Reshape/broadcast the reduced cotangent back to x's shape."""
+    if axis is None:
+        return jnp.broadcast_to(g, jnp.shape(x))
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    axes = tuple(a % x.ndim for a in axes)
+    if not keepdim:
+        g = jnp.expand_dims(g, axes)
+    return jnp.broadcast_to(g, jnp.shape(x))
+
+
+def _sum_fwd(x, axis=None, keepdim=False):
+    return jnp.sum(x, axis=axis, keepdims=keepdim), x
+
+
+def _sum_bwd(x, g, axis=None, keepdim=False):
+    return (_restore_shape(g, x, axis, keepdim).astype(x.dtype),)
+
+
+sum_op = register_op("reduce_sum",
+                     lambda x, axis=None, keepdim=False: jnp.sum(
+                         x, axis=axis, keepdims=keepdim),
+                     fwd=_sum_fwd, bwd=_sum_bwd,
+                     static_argnames=("axis", "keepdim"))
+
+
+def _mean_fwd(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim), x
+
+
+def _mean_bwd(x, g, axis=None, keepdim=False):
+    import numpy as np
+
+    shape = jnp.shape(x)
+    if axis is None:
+        n = int(np.prod(shape)) if shape else 1
+    else:
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        n = int(np.prod([shape[a % len(shape)] for a in axes]))
+    return ((_restore_shape(g, x, axis, keepdim) / n).astype(x.dtype),)
+
+
+mean_op = register_op("reduce_mean",
+                      lambda x, axis=None, keepdim=False: jnp.mean(
+                          x, axis=axis, keepdims=keepdim),
+                      fwd=_mean_fwd, bwd=_mean_bwd,
+                      static_argnames=("axis", "keepdim"))
+
+
+def _minmax_op(name, fn):
+    def plain(x, axis=None, keepdim=False):
+        return fn(x, axis=axis, keepdims=keepdim)
+
+    def fwd(x, axis=None, keepdim=False):
+        out = fn(x, axis=axis, keepdims=keepdim)
+        return out, (x, out)
+
+    def bwd(saved, g, axis=None, keepdim=False):
+        x, out = saved
+        full_out = _restore_shape(out, x, axis, keepdim)
+        full_g = _restore_shape(g, x, axis, keepdim)
+        mask = (x == full_out).astype(g.dtype)
+        # Split ties evenly, matching the reference's max_grad semantics of
+        # distributing gradient over all argmax positions equally is NOT what
+        # paddle does (paddle picks all). Keep all-positions like jnp.
+        denom = jnp.sum(mask, axis=axis, keepdims=True) if axis is not None \
+            else jnp.sum(mask)
+        denom = jnp.maximum(denom, 1).astype(g.dtype)
+        denom_full = _restore_shape(
+            denom if axis is not None and True else denom, x, axis, True) \
+            if axis is not None else denom
+        return ((full_g * mask / (denom_full if axis is not None else denom)
+                 ).astype(x.dtype),)
+
+    return register_op(name, plain, fwd=fwd, bwd=bwd,
+                       static_argnames=("axis", "keepdim"))
+
+
+max_op = _minmax_op("reduce_max", jnp.max)
+min_op = _minmax_op("reduce_min", jnp.min)
+
+prod_op = register_op("reduce_prod",
+                      lambda x, axis=None, keepdim=False: jnp.prod(
+                          x, axis=axis, keepdims=keepdim),
+                      static_argnames=("axis", "keepdim"))
+any_op = register_op("reduce_any",
+                     lambda x, axis=None, keepdim=False: jnp.any(
+                         x, axis=axis, keepdims=keepdim),
+                     static_argnames=("axis", "keepdim"))
+all_op = register_op("reduce_all",
+                     lambda x, axis=None, keepdim=False: jnp.all(
+                         x, axis=axis, keepdims=keepdim),
+                     static_argnames=("axis", "keepdim"))
+amax_op = register_op("amax",
+                      lambda x, axis=None, keepdim=False: jnp.amax(
+                          x, axis=axis, keepdims=keepdim),
+                      static_argnames=("axis", "keepdim"))
+amin_op = register_op("amin",
+                      lambda x, axis=None, keepdim=False: jnp.amin(
+                          x, axis=axis, keepdims=keepdim),
+                      static_argnames=("axis", "keepdim"))
+logsumexp_op = register_op(
+    "logsumexp",
+    lambda x, axis=None, keepdim=False: jax_logsumexp(x, axis, keepdim),
+    static_argnames=("axis", "keepdim"))
+
+
+def jax_logsumexp(x, axis, keepdim):
+    from jax.scipy.special import logsumexp as lse
+
+    return lse(x, axis=axis, keepdims=keepdim)
+
+
+argmax_op = register_op(
+    "argmax",
+    lambda x, axis=None, keepdim=False, dtype=jnp.int64: (
+        jnp.argmax(x, axis=axis, keepdims=keepdim).astype(dtype)
+        if axis is not None else jnp.argmax(x).astype(dtype)),
+    static_argnames=("axis", "keepdim", "dtype"))
+argmin_op = register_op(
+    "argmin",
+    lambda x, axis=None, keepdim=False, dtype=jnp.int64: (
+        jnp.argmin(x, axis=axis, keepdims=keepdim).astype(dtype)
+        if axis is not None else jnp.argmin(x).astype(dtype)),
+    static_argnames=("axis", "keepdim", "dtype"))
+
+cumsum_op = register_op(
+    "cumsum", lambda x, axis=None: (jnp.cumsum(x, axis=axis)
+                                    if axis is not None
+                                    else jnp.cumsum(x.reshape(-1))),
+    fwd=lambda x, axis=None: ((jnp.cumsum(x, axis=axis)
+                               if axis is not None
+                               else jnp.cumsum(x.reshape(-1))), x),
+    bwd=lambda x, g, axis=None: (
+        (jnp.flip(jnp.cumsum(jnp.flip(g, axis), axis=axis), axis)
+         if axis is not None
+         else jnp.reshape(jnp.flip(jnp.cumsum(jnp.flip(g, 0), axis=0), 0),
+                          jnp.shape(x))),),
+    static_argnames=("axis",))
+cumprod_op = register_op(
+    "cumprod", lambda x, dim=None: jnp.cumprod(x, axis=dim),
+    static_argnames=("dim",))
+cummax_op = register_op(
+    "cummax", lambda x, axis=None: jax_cummax(x, axis),
+    static_argnames=("axis",), n_outputs=2)
+cummin_op = register_op(
+    "cummin", lambda x, axis=None: jax_cummin(x, axis),
+    static_argnames=("axis",), n_outputs=2)
+
+
+def jax_cummax(x, axis):
+    import jax
+
+    vals = jax.lax.associative_scan(jnp.maximum, x, axis=axis)
+    # indices: positions of running max
+    n = x.shape[axis]
+    idx = jnp.arange(n).reshape([-1 if i == axis % x.ndim else 1
+                                 for i in range(x.ndim)])
+    idx = jnp.broadcast_to(idx, x.shape)
+    sel = jnp.where(x == vals, idx, 0)
+    inds = jax.lax.associative_scan(jnp.maximum, sel, axis=axis)
+    return vals, inds.astype(jnp.int64)
+
+
+def jax_cummin(x, axis):
+    vals, inds = jax_cummax(-x, axis)
+    return -vals, inds
+
+
+# -- Python-level APIs ------------------------------------------------------
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    out = apply(sum_op, x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+    if dtype is not None:
+        from . import manipulation
+
+        out = manipulation.cast(out, dtype)
+    return out
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply(mean_op, x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply(max_op, x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply(min_op, x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return apply(amax_op, x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return apply(amin_op, x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    out = apply(prod_op, x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+    if dtype is not None:
+        from . import manipulation
+
+        out = manipulation.cast(out, dtype)
+    return out
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply(any_op, x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply(all_op, x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(logsumexp_op, x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core import dtype as dt
+
+    return apply(argmax_op, x, axis=_norm_axis(axis), keepdim=bool(keepdim),
+                 dtype=dt.convert_dtype(dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core import dtype as dt
+
+    return apply(argmin_op, x, axis=_norm_axis(axis), keepdim=bool(keepdim),
+                 dtype=dt.convert_dtype(dtype))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    out = apply(cumsum_op, x, axis=_norm_axis(axis))
+    if dtype is not None:
+        from . import manipulation
+
+        out = manipulation.cast(out, dtype)
+    return out
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    out = apply(cumprod_op, x, dim=_norm_axis(dim))
+    if dtype is not None:
+        from . import manipulation
+
+        out = manipulation.cast(out, dtype)
+    return out
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    if axis is None:
+        from . import manipulation
+
+        x = manipulation.reshape(x, [-1])
+        axis = 0
+    return apply(cummax_op, x, axis=int(axis))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    if axis is None:
+        from . import manipulation
+
+        x = manipulation.reshape(x, [-1])
+        axis = 0
+    return apply(cummin_op, x, axis=int(axis))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    m = mean(x, axis=axis, keepdim=True)
+    sq = multiply_diff(x, m)
+    out = mean(sq, axis=axis, keepdim=keepdim)
+    if unbiased:
+        import numpy as np
+
+        shape = x.shape
+        if axis is None:
+            n = int(np.prod(shape)) if shape else 1
+        else:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            n = int(np.prod([shape[a % len(shape)] for a in axes]))
+        if n > 1:
+            from . import math as m_ops
+
+            out = m_ops.scale(out, scale=n / (n - 1))
+    return out
+
+
+def multiply_diff(x, m):
+    from . import math as m_ops
+
+    d = m_ops.subtract(x, m)
+    return m_ops.multiply(d, d)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    from . import math as m_ops
+
+    return m_ops.sqrt(var(x, axis=axis, unbiased=unbiased, keepdim=keepdim))
+
+
+def numel(x, name=None):
+    from ..core.tensor import Tensor
+
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    from . import math as m_ops
+    from . import manipulation
+
+    nz = manipulation.cast(m_ops.not_equal(x, 0), "int64")
+    return sum(nz, axis=axis, keepdim=keepdim)
+
+
+nanmean_op = register_op(
+    "nanmean", lambda x, axis=None, keepdim=False: jnp.nanmean(
+        x, axis=axis, keepdims=keepdim),
+    static_argnames=("axis", "keepdim"))
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply(nanmean_op, x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+nansum_op = register_op(
+    "nansum", lambda x, axis=None, keepdim=False: jnp.nansum(
+        x, axis=axis, keepdims=keepdim),
+    static_argnames=("axis", "keepdim"))
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return apply(nansum_op, x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+
+median_op = register_op(
+    "median", lambda x, axis=None, keepdim=False: jnp.median(
+        x, axis=axis, keepdims=keepdim),
+    static_argnames=("axis", "keepdim"))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply(median_op, x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+
+quantile_op = register_op(
+    "quantile", lambda x, q, axis=None, keepdim=False: jnp.quantile(
+        x, q, axis=axis, keepdims=keepdim),
+    static_argnames=("q", "axis", "keepdim"))
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return apply(quantile_op, x, q=float(q) if not isinstance(q, (list, tuple))
+                 else tuple(q), axis=_norm_axis(axis), keepdim=bool(keepdim))

@@ -1,0 +1,193 @@
+"""Op registry + eager dispatch.
+
+TPU-native re-design of the reference's kernel registry/dispatch stack:
+``phi::KernelFactory`` (``paddle/phi/core/kernel_factory.h:316``), the
+generated C++ op API (``paddle/phi/api/generator/api_gen.py:456``) and the
+generated dygraph ad_funcs (``paddle/fluid/eager/auto_code_generator/
+generator/eager_gen.py:321``).
+
+Design (SURVEY.md §7.2): on TPU, XLA *is* the kernel library.  An ``OpDef``
+binds a name to three jax-level callables:
+
+  * ``fn(*arrays, **attrs) -> array(s)``       plain forward
+  * ``fwd(*arrays, **attrs) -> (out, saved)``  forward returning residuals
+  * ``bwd(saved, grad_out, **attrs) -> grads`` VJP over the recorded inputs
+
+``fwd``/``bwd`` are hand-written for hot ops (mirroring the reference's
+ops.yaml/backward.yaml kernel pairing); ops registered with only ``fn`` get
+an automatic ``jax.vjp`` fallback.  Each callable is wrapped in ``jax.jit``
+once at registration, so the eager hot loop is an XLA executable-cache hit —
+the "dispatch" the reference does per-op in C++ becomes a jitted call here.
+
+The ``apply`` function is the analog of a generated ad_func: it decides
+whether gradients are required, runs the (jitted) forward, and hangs a
+``GradNode`` off the outputs for the tape-free backward engine
+(``paddle/fluid/eager/backward.cc:439`` analog in autograd/engine.py).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Sequence
+
+import jax
+
+from ..core import flags
+
+_OPS: dict[str, "OpDef"] = {}
+
+
+class OpDef:
+    __slots__ = (
+        "name", "fn", "fwd", "bwd", "n_outputs", "jit_fn", "jit_fwd",
+        "jit_bwd", "static_argnames", "nondiff_argnums",
+    )
+
+    def __init__(self, name, fn, fwd=None, bwd=None, n_outputs=1,
+                 static_argnames=(), nondiff_argnums=()):
+        self.name = name
+        self.fn = fn
+        self.fwd = fwd
+        self.bwd = bwd
+        self.n_outputs = n_outputs
+        self.static_argnames = tuple(static_argnames)
+        self.nondiff_argnums = frozenset(nondiff_argnums)
+        if flags.flag("FLAGS_eager_jit_ops"):
+            self.jit_fn = jax.jit(fn, static_argnames=self.static_argnames)
+            self.jit_fwd = (
+                jax.jit(fwd, static_argnames=self.static_argnames)
+                if fwd is not None else None)
+            self.jit_bwd = (
+                jax.jit(bwd, static_argnames=self.static_argnames)
+                if bwd is not None else None)
+        else:  # pragma: no cover - debug escape hatch
+            self.jit_fn, self.jit_fwd, self.jit_bwd = fn, fwd, bwd
+
+    def __repr__(self):
+        return f"OpDef({self.name})"
+
+
+def register_op(name, fn=None, *, fwd=None, bwd=None, n_outputs=1,
+                static_argnames=(), nondiff_argnums=()):
+    """Register an op. Usable as a decorator over the plain forward."""
+
+    def _register(f):
+        op = OpDef(name, f, fwd=fwd, bwd=bwd, n_outputs=n_outputs,
+                   static_argnames=static_argnames,
+                   nondiff_argnums=nondiff_argnums)
+        _OPS[name] = op
+        return op
+
+    if fn is not None:
+        return _register(fn)
+    return _register
+
+
+def get_op(name: str) -> OpDef:
+    return _OPS[name]
+
+
+def all_ops() -> dict:
+    return dict(_OPS)
+
+
+# ---------------------------------------------------------------------------
+# Eager dispatch (the ad_func analog).
+# ---------------------------------------------------------------------------
+
+def apply(op: OpDef, *tensor_args, attrs=None, **kw_attrs):
+    """Run ``op`` on Tensor arguments; returns Tensor(s).
+
+    Mirrors the generated ad_func control flow (eager_gen.py:321): collect
+    autograd metadata -> decide require_any_grad -> forward -> node creation
+    -> set edges/history.  AMP auto-cast hooks in via ops.amp_transform.
+    """
+    from ..core.tensor import Tensor
+    from ..autograd import engine as _engine
+    from ..amp import state as _amp_state
+
+    attrs = dict(attrs or {})
+    attrs.update(kw_attrs)
+
+    if _amp_state.amp_enabled():
+        tensor_args = _amp_state.amp_transform(op.name, tensor_args)
+
+    datas = []
+    need_grad = False
+    grad_on = _engine.is_grad_enabled()
+    for t in tensor_args:
+        if isinstance(t, Tensor):
+            datas.append(t._data)
+            if grad_on and not t.stop_gradient:
+                need_grad = True
+        else:
+            datas.append(t)
+
+    if need_grad and op.jit_fwd is not None:
+        out_data, saved = op.jit_fwd(*datas, **attrs)
+        node = _engine.GradNode(op, saved, tensor_args, attrs)
+    elif need_grad:
+        # jax.vjp fallback for ops without a hand-written backward pairing.
+        fun = functools.partial(op.fn, **attrs) if attrs else op.fn
+        diff_idx = [i for i in range(len(datas))
+                    if i not in op.nondiff_argnums]
+        closed = _close_over(fun, datas, diff_idx)
+        out_data, vjp_fn = jax.vjp(closed, *[datas[i] for i in diff_idx])
+        node = _engine.GradNode(op, vjp_fn, tensor_args, attrs,
+                                vjp_fallback=True, diff_idx=diff_idx)
+    else:
+        out_data = op.jit_fn(*datas, **attrs)
+        node = None
+
+    if flags.flag("FLAGS_check_nan_inf"):
+        _check_nan_inf(op.name, out_data)
+
+    # Ops whose outputs are all non-differentiable dtypes (bool/int —
+    # comparisons, argmax...) never get a grad node, matching the
+    # reference's IsDifferentiable check in ad_funcs.
+    if need_grad:
+        import jax.numpy as jnp
+
+        outs_flat = out_data if isinstance(out_data, (tuple, list)) \
+            else [out_data]
+        if not any(o is not None and jnp.issubdtype(o.dtype, jnp.inexact)
+                   for o in outs_flat):
+            need_grad = False
+            node = None
+
+    if op.n_outputs == 1 and not isinstance(out_data, (tuple, list)):
+        out = Tensor(out_data, stop_gradient=not need_grad)
+        if node is not None:
+            node.bind_outputs([out])
+        return out
+    outs = [Tensor(o, stop_gradient=not need_grad) if o is not None else None
+            for o in out_data]
+    if node is not None:
+        node.bind_outputs(outs)
+    return tuple(outs)
+
+
+def _close_over(fun, datas, diff_idx):
+    if len(diff_idx) == len(datas):
+        return fun
+
+    def closed(*diff_args):
+        full = list(datas)
+        for i, a in zip(diff_idx, diff_args):
+            full[i] = a
+        return fun(*full)
+
+    return closed
+
+
+def _check_nan_inf(name, out):
+    """Reference: fluid/eager/nan_inf_utils.h:38 CheckTensorHasNanOrInf."""
+    import jax.numpy as jnp
+    leaves = out if isinstance(out, (tuple, list)) else [out]
+    for leaf in leaves:
+        if leaf is None or not jnp.issubdtype(leaf.dtype, jnp.floating):
+            continue
+        if bool(jnp.any(~jnp.isfinite(leaf))):
+            msg = f"Operator {name} output contains NaN/Inf"
+            if flags.flag("FLAGS_check_nan_inf_level") == 0:
+                raise FloatingPointError(msg)
+            print(f"[check_nan_inf] {msg}")

@@ -1,0 +1,207 @@
+"""Optimizer base.
+
+Reference: ``python/paddle/optimizer/optimizer.py:125`` — parameter list /
+param-group handling, accumulator state, LR (float or LRScheduler),
+regularization, grad clip, ``step``/``clear_grad``/``state_dict``.
+
+TPU-native: each optimizer provides a pure jitted ``_update`` over (param,
+grad, *slots, lr) so the eager step is a cached XLA executable per shape;
+the same ``_update`` is reused by ``paddle_tpu.jit`` to build fully
+compiled train steps (the slots live in a pytree keyed like state_dict).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .lr import LRScheduler
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class Optimizer:
+    _accumulator_names: tuple = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._use_master_weights = multi_precision
+        self._accumulators: dict[int, dict] = {}
+        self._master_weights: dict[int, jnp.ndarray] = {}
+        self._global_step = 0
+
+        if weight_decay is None:
+            self._weight_decay = None
+        elif isinstance(weight_decay, (int, float)):
+            self._weight_decay = L2Decay(float(weight_decay))
+        else:
+            self._weight_decay = weight_decay
+
+        self._param_groups = []
+        if parameters is not None:
+            parameters = list(parameters)
+            if parameters and isinstance(parameters[0], dict):
+                for group in parameters:
+                    g = dict(group)
+                    g.setdefault("learning_rate", 1.0)
+                    g["params"] = list(g["params"])
+                    self._param_groups.append(g)
+            else:
+                self._param_groups.append({"params": parameters,
+                                           "learning_rate": 1.0})
+        self._parameters_provided = parameters is not None
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when the learning rate is a scheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- parameters ----------------------------------------------------------
+    def _parameter_list(self):
+        out = []
+        for g in self._param_groups:
+            out.extend(g["params"])
+        return out
+
+    @property
+    def _parameter_groups(self):
+        return self._param_groups
+
+    # -- state -------------------------------------------------------------
+    def _get_accumulator(self, p, name, init=None, dtype=None):
+        slots = self._accumulators.setdefault(id(p), {})
+        if name not in slots:
+            d = dtype or (jnp.float32 if self._use_master_weights
+                          else p.dtype)
+            slots[name] = jnp.zeros(tuple(p.shape), d) if init is None \
+                else init
+        return slots[name]
+
+    def _set_accumulator(self, p, name, value):
+        self._accumulators.setdefault(id(p), {})[name] = value
+
+    def _master_weight(self, p):
+        mw = self._master_weights.get(id(p))
+        if mw is None:
+            mw = p._data.astype(jnp.float32)
+            self._master_weights[id(p)] = mw
+        return mw
+
+    # -- step --------------------------------------------------------------
+    def step(self):
+        self._global_step += 1
+        for group in self._param_groups:
+            params_grads = [(p, p.grad) for p in group["params"]
+                            if p.grad is not None and p.trainable]
+            if not params_grads:
+                continue
+            if self._grad_clip is not None:
+                params_grads = self._grad_clip(params_grads)
+            group_lr = self.get_lr() * group.get("learning_rate", 1.0)
+            wd = group.get("weight_decay", self._weight_decay)
+            if isinstance(wd, (int, float)):
+                wd = L2Decay(float(wd))
+            for p, g in params_grads:
+                lr = group_lr * p.optimize_attr.get("learning_rate", 1.0) \
+                    if hasattr(p, "optimize_attr") else group_lr
+                self._apply_one(p, g, lr, wd)
+
+    @property
+    def _apply_weight_decay_in_grad(self):
+        return True
+
+    def _apply_one(self, p, g, lr, wd):
+        gd = g._data
+        use_master = (self._use_master_weights
+                      and p.dtype != jnp.float32)
+        pd = self._master_weight(p) if use_master else p._data
+        if gd.dtype != pd.dtype:
+            gd = gd.astype(pd.dtype)
+        if wd is not None and self._apply_weight_decay_in_grad \
+                and getattr(p, "regularizer", None) is None:
+            if isinstance(wd, L2Decay) and wd.coeff:
+                gd = gd + wd.coeff * pd
+            elif isinstance(wd, L1Decay) and wd.coeff:
+                gd = gd + wd.coeff * jnp.sign(pd)
+        new_p = self._update_param(p, pd, gd, lr, wd)
+        if use_master:
+            self._master_weights[id(p)] = new_p
+            p._data = new_p.astype(p.dtype)
+        else:
+            p._data = new_p
+
+    def _update_param(self, p, pd, gd, lr, wd):
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list():
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # -- serialization -----------------------------------------------------
+    def state_dict(self):
+        state = {"global_step": self._global_step}
+        accum = {}
+        for i, p in enumerate(self._parameter_list()):
+            slots = self._accumulators.get(id(p), {})
+            key = p.name or f"param_{i}"
+            for sname, val in slots.items():
+                accum[f"{key}.{sname}"] = np.asarray(val)
+            if id(p) in self._master_weights:
+                accum[f"{key}.master_weight"] = np.asarray(
+                    self._master_weights[id(p)])
+        state["accumulators"] = accum
+        if isinstance(self._learning_rate, LRScheduler):
+            state["LR_Scheduler"] = self._learning_rate.state_dict()
+        return state
+
+    def set_state_dict(self, state):
+        self._global_step = state.get("global_step", 0)
+        accum = state.get("accumulators", {})
+        for i, p in enumerate(self._parameter_list()):
+            key = p.name or f"param_{i}"
+            for full, val in accum.items():
+                if not full.startswith(key + "."):
+                    continue
+                sname = full[len(key) + 1:]
+                if sname == "master_weight":
+                    self._master_weights[id(p)] = jnp.asarray(val)
+                else:
+                    self._set_accumulator(p, sname, jnp.asarray(val))
+        if "LR_Scheduler" in state and isinstance(self._learning_rate,
+                                                  LRScheduler):
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+
+    load_state_dict = set_state_dict

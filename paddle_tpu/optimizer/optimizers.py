@@ -1,0 +1,238 @@
+"""Concrete optimizers: SGD, Momentum, Adagrad, RMSProp, Adam, AdamW, Lamb.
+
+Reference: ``python/paddle/optimizer/{sgd,momentum,adam,adamw,lamb}.py``
+with update math matching the phi kernels (``phi/kernels/
+{sgd,momentum,adam,adamw,lamb}_kernel...``).  Each update body is a
+module-level jitted function so eager steps hit the XLA executable cache.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .optimizer import L1Decay, L2Decay, Optimizer
+
+
+@jax.jit
+def _sgd_update(p, g, lr):
+    return p - lr * g
+
+
+@jax.jit
+def _momentum_update(p, g, vel, lr, mu, use_nesterov):
+    vel = mu * vel + g
+    new_p = jnp.where(use_nesterov, p - (g + mu * vel) * lr, p - lr * vel)
+    return new_p, vel
+
+
+@jax.jit
+def _adagrad_update(p, g, moment, lr, epsilon):
+    moment = moment + g * g
+    return p - lr * g / (jnp.sqrt(moment) + epsilon), moment
+
+
+@jax.jit
+def _rmsprop_update(p, g, mean_sq, mom, lr, rho, epsilon, momentum):
+    mean_sq = rho * mean_sq + (1 - rho) * g * g
+    mom = momentum * mom + lr * g / jnp.sqrt(mean_sq + epsilon)
+    return p - mom, mean_sq, mom
+
+
+@jax.jit
+def _adam_update(p, g, m, v, lr, beta1, beta2, epsilon, b1pow, b2pow):
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * g * g
+    mhat = m / (1 - b1pow)
+    vhat = v / (1 - b2pow)
+    return p - lr * mhat / (jnp.sqrt(vhat) + epsilon), m, v
+
+
+@jax.jit
+def _adamw_update(p, g, m, v, lr, beta1, beta2, epsilon, b1pow, b2pow,
+                  coeff):
+    # Decoupled weight decay (reference: phi/kernels/adamw_kernel).
+    p = p * (1.0 - lr * coeff)
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * g * g
+    mhat = m / (1 - b1pow)
+    vhat = v / (1 - b2pow)
+    return p - lr * mhat / (jnp.sqrt(vhat) + epsilon), m, v
+
+
+@jax.jit
+def _lamb_update(p, g, m, v, lr, beta1, beta2, epsilon, b1pow, b2pow,
+                 lamb_weight_decay):
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * g * g
+    mhat = m / (1 - b1pow)
+    vhat = v / (1 - b2pow)
+    r = mhat / (jnp.sqrt(vhat) + epsilon) + lamb_weight_decay * p
+    w_norm = jnp.sqrt(jnp.sum(p * p))
+    r_norm = jnp.sqrt(jnp.sum(r * r))
+    ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    return p - lr * ratio * r, m, v
+
+
+class SGD(Optimizer):
+    def _update_param(self, p, pd, gd, lr, wd):
+        return _sgd_update(pd, gd, lr)
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _update_param(self, p, pd, gd, lr, wd):
+        vel = self._get_accumulator(p, "velocity")
+        if vel.dtype != pd.dtype:
+            vel = vel.astype(pd.dtype)
+        new_p, vel = _momentum_update(pd, gd, vel, lr, self._momentum,
+                                      self._use_nesterov)
+        self._set_accumulator(p, "velocity", vel)
+        return new_p
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update_param(self, p, pd, gd, lr, wd):
+        mom = self._get_accumulator(
+            p, "moment",
+            init=jnp.full(tuple(p.shape), self._init_acc, pd.dtype))
+        new_p, mom = _adagrad_update(pd, gd, mom, lr, self._epsilon)
+        self._set_accumulator(p, "moment", mom)
+        return new_p
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+
+    def _update_param(self, p, pd, gd, lr, wd):
+        ms = self._get_accumulator(p, "mean_square", dtype=pd.dtype)
+        mom = self._get_accumulator(p, "momentum", dtype=pd.dtype)
+        new_p, ms, mom = _rmsprop_update(pd, gd, ms, mom, lr, self._rho,
+                                         self._epsilon, self._momentum)
+        self._set_accumulator(p, "mean_square", ms)
+        self._set_accumulator(p, "momentum", mom)
+        return new_p
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _step_count(self, p):
+        slots = self._accumulators.setdefault(id(p), {})
+        t = slots.get("_t", 0) + 1
+        slots["_t"] = t
+        return t
+
+    def _update_param(self, p, pd, gd, lr, wd):
+        m = self._get_accumulator(p, "moment1", dtype=jnp.float32)
+        v = self._get_accumulator(p, "moment2", dtype=jnp.float32)
+        t = self._step_count(p)
+        gd32 = gd.astype(jnp.float32)
+        pd32 = pd.astype(jnp.float32)
+        new_p, m, v = _adam_update(pd32, gd32, m, v, lr, self._beta1,
+                                   self._beta2, self._epsilon,
+                                   self._beta1 ** t, self._beta2 ** t)
+        self._set_accumulator(p, "moment1", m)
+        self._set_accumulator(p, "moment2", v)
+        return new_p.astype(pd.dtype)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         name=name)
+        self._coeff = float(weight_decay) if isinstance(
+            weight_decay, (int, float)) else weight_decay.coeff
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    @property
+    def _apply_weight_decay_in_grad(self):
+        return False
+
+    def _update_param(self, p, pd, gd, lr, wd):
+        coeff = self._coeff
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            coeff = 0.0
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        m = self._get_accumulator(p, "moment1", dtype=jnp.float32)
+        v = self._get_accumulator(p, "moment2", dtype=jnp.float32)
+        t = self._step_count(p)
+        new_p, m, v = _adamw_update(pd.astype(jnp.float32),
+                                    gd.astype(jnp.float32), m, v, lr,
+                                    self._beta1, self._beta2, self._epsilon,
+                                    self._beta1 ** t, self._beta2 ** t,
+                                    coeff)
+        self._set_accumulator(p, "moment1", m)
+        self._set_accumulator(p, "moment2", v)
+        return new_p.astype(pd.dtype)
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_param(self, p, pd, gd, lr, wd):
+        wd_coeff = self._lamb_wd
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd_coeff = 0.0
+        m = self._get_accumulator(p, "moment1", dtype=jnp.float32)
+        v = self._get_accumulator(p, "moment2", dtype=jnp.float32)
+        slots = self._accumulators.setdefault(id(p), {})
+        t = slots.get("_t", 0) + 1
+        slots["_t"] = t
+        new_p, m, v = _lamb_update(pd.astype(jnp.float32),
+                                   gd.astype(jnp.float32), m, v, lr,
+                                   self._beta1, self._beta2, self._epsilon,
+                                   self._beta1 ** t, self._beta2 ** t,
+                                   wd_coeff)
+        self._set_accumulator(p, "moment1", m)
+        self._set_accumulator(p, "moment2", v)
+        return new_p.astype(pd.dtype)

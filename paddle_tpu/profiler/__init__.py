@@ -1,0 +1,143 @@
+"""paddle.profiler analog over jax.profiler.
+
+Reference: ``python/paddle/profiler/profiler.py`` — Profiler with scheduler
+windows, RecordEvent, chrome-trace export; C++ side
+``fluid/platform/profiler/`` (HostTracer + CudaTracer/CUPTI).
+
+TPU-native: jax.profiler's XPlane traces (viewable in TensorBoard /
+Perfetto) replace CUPTI; RecordEvent maps to TraceAnnotation so host-side
+annotations appear on the device timeline.
+"""
+from __future__ import annotations
+
+import time
+from enum import Enum
+
+import jax
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    CUSTOM_DEVICE = 2
+    TPU = 3
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    def scheduler(step):
+        step = step - skip_first
+        if step < 0:
+            return ProfilerState.CLOSED
+        total = closed + ready + record
+        pos = step % total if repeat == 0 or step < repeat * total else -1
+        if pos < 0:
+            return ProfilerState.CLOSED
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == total - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        pass
+
+    handler._dir = dir_name
+    return handler
+
+
+class RecordEvent:
+    """Reference: profiler/utils.py RecordEvent -> jax TraceAnnotation."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ann = jax.profiler.TraceAnnotation(name)
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def begin(self):
+        self._ann.__enter__()
+
+    def end(self):
+        self._ann.__exit__(None, None, None)
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self._scheduler = scheduler
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._dir = getattr(on_trace_ready, "_dir", "./profiler_log")
+        self._step = 0
+        self._recording = False
+        self._step_times = []
+        self._last = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def start(self):
+        self._last = time.perf_counter()
+        if not self._timer_only:
+            try:
+                jax.profiler.start_trace(self._dir)
+                self._recording = True
+            except Exception:
+                self._recording = False
+
+    def stop(self):
+        if self._recording:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._recording = False
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._step_times.append(now - self._last)
+        self._last = now
+        self._step += 1
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return ""
+        avg = sum(self._step_times[-10:]) / len(self._step_times[-10:])
+        return f"avg_step_time: {avg * 1000:.2f} ms"
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        return self.step_info()
+
+    def export(self, path, format="json"):
+        pass
+
+
+def load_profiler_result(path):
+    return None

@@ -1,0 +1,4 @@
+"""paddle.vision analog — models/transforms/datasets (built out across
+milestones; reference: python/paddle/vision/)."""
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401

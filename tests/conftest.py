@@ -1,0 +1,23 @@
+"""Test config: run everything on an 8-device virtual CPU mesh.
+
+Mirrors the reference's device-free distributed testing strategy
+(SURVEY.md §4): multi-rank behavior is validated on one host —
+there via forked local trainers, here via XLA's forced host platform
+device count.  MUST run before jax is imported anywhere.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# A site hook may pin jax_platforms to the hardware plugin; tests must run
+# on the virtual 8-device CPU mesh, so override before backends initialize.
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", jax.default_backend()
+assert jax.device_count() == 8, jax.device_count()

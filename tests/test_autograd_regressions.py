@@ -1,0 +1,101 @@
+"""Regression tests for engine bugs found in review: setitem self-loop,
+None-grad starvation, paddle.grad .grad pollution, per-edge hooks, norm
+bias-without-weight, dropout downscale_in_infer."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def test_setitem_upstream_grad_flows():
+    x = paddle.to_tensor([1.0, 1.0, 1.0], stop_gradient=False)
+    y = x * 2
+    y[0] = 5.0
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])
+
+
+def test_where_masking_pattern_grads():
+    x = paddle.to_tensor([2.0, -3.0], stop_gradient=False)
+    h = x * 3
+    y = paddle.where(h > 0, h, paddle.zeros_like(h))
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 0.0])
+
+
+def test_comparison_output_has_no_grad_node():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    c = x > 0
+    assert c._grad_node is None
+    assert c.stop_gradient
+
+
+def test_paddle_grad_does_not_pollute_other_leaves():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    w = paddle.to_tensor(3.0, stop_gradient=False)
+    y = x * w
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), 3.0)
+    assert w.grad is None
+    assert x.grad is None
+
+
+def test_hook_fires_once_on_accumulated_grad():
+    h = paddle.to_tensor([1.0], stop_gradient=False)
+    calls = []
+
+    def hook(g):
+        calls.append(g.numpy().copy())
+        return g.clip(min=-1.5, max=1.5)
+
+    h.register_hook(hook)
+    y = h + h
+    y.sum().backward()
+    assert len(calls) == 1
+    np.testing.assert_allclose(calls[0], [2.0])
+    np.testing.assert_allclose(h.grad.numpy(), [1.5])
+
+
+def test_intermediate_hook_fires_once():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    h = x * 2
+    calls = []
+    h.register_hook(lambda g: calls.append(1))
+    y = h + h
+    y.sum().backward()
+    assert len(calls) == 1
+
+
+def test_batch_norm_bias_without_weight():
+    x = paddle.ones([2, 3, 4, 4])
+    rm = paddle.zeros([3])
+    rv = paddle.ones([3])
+    b = paddle.full([3], 5.0)
+    out = F.batch_norm(x, rm, rv, weight=None, bias=b, training=False)
+    expected = (1.0 / np.sqrt(1 + 1e-5)) + 5.0
+    np.testing.assert_allclose(out.numpy(), expected, rtol=1e-5)
+
+
+def test_group_norm_bias_without_weight():
+    x = paddle.randn([2, 4, 4, 4])
+    b = paddle.full([4], 2.0)
+    out = F.group_norm(x, 2, weight=None, bias=b)
+    ref = F.group_norm(x, 2, weight=paddle.ones([4]), bias=b)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+
+def test_dropout_downscale_in_infer():
+    x = paddle.ones([4])
+    out = F.dropout(x, p=0.5, training=False, mode="downscale_in_infer")
+    np.testing.assert_allclose(out.numpy(), [0.5] * 4)
+    out2 = F.dropout(x, p=0.5, training=False, mode="upscale_in_train")
+    np.testing.assert_allclose(out2.numpy(), [1.0] * 4)
+
+
+def test_inplace_add_keeps_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.add_(paddle.to_tensor([10.0]))
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])

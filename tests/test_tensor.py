@@ -1,0 +1,145 @@
+"""Tensor basics: creation, conversion, operators, indexing.
+
+Mirrors reference coverage in test/legacy_test (tensor creation/method
+tests) at smoke scale.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basics():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert str(t.dtype) == "float32"
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtype_conversion():
+    t = paddle.to_tensor([1, 2, 3])
+    assert str(t.dtype) == "int64"
+    f = t.astype("float32")
+    assert str(f.dtype) == "float32"
+    b = f.astype(paddle.bfloat16)
+    assert b.dtype == paddle.bfloat16
+
+
+def test_operators():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((x + y).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((x * y).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((y - x).numpy(), [3, 3, 3])
+    np.testing.assert_allclose((y / x).numpy(), [4, 2.5, 2], rtol=1e-6)
+    np.testing.assert_allclose((x ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((-x).numpy(), [-1, -2, -3])
+    np.testing.assert_allclose((2.0 * x).numpy(), [2, 4, 6])
+    np.testing.assert_allclose((1.0 - x).numpy(), [0, -1, -2])
+
+
+def test_comparison_ops():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([2.0, 2.0, 2.0])
+    np.testing.assert_array_equal((x < y).numpy(), [True, False, False])
+    np.testing.assert_array_equal((x == y).numpy(), [False, True, False])
+
+
+def test_matmul_operator():
+    a = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    c = a @ b
+    np.testing.assert_allclose(c.numpy(), a.numpy() @ b.numpy())
+
+
+def test_getitem_setitem():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(x[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(x[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(x[1:, 2:].numpy(), [[6, 7], [10, 11]])
+    x[0, 0] = 100.0
+    assert x.numpy()[0, 0] == 100.0
+    # boolean mask read
+    m = x > 50.0
+    assert (x[m].numpy() == [100.0]).all()
+
+
+def test_item_and_scalars():
+    t = paddle.to_tensor(3.5)
+    assert t.item() == pytest.approx(3.5)
+    assert float(t) == pytest.approx(3.5)
+    assert t.shape == []
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3]).numpy().sum() == 6
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    assert paddle.full([2], 7.0).numpy().tolist() == [7.0, 7.0]
+    e = paddle.eye(3)
+    np.testing.assert_allclose(e.numpy(), np.eye(3))
+    z = paddle.zeros_like(paddle.ones([4]))
+    assert z.numpy().tolist() == [0, 0, 0, 0]
+
+
+def test_manipulation():
+    x = paddle.arange(24, dtype="float32")
+    r = paddle.reshape(x, [2, 3, 4])
+    assert r.shape == [2, 3, 4]
+    t = paddle.transpose(r, [2, 0, 1])
+    assert t.shape == [4, 2, 3]
+    c = paddle.concat([r, r], axis=0)
+    assert c.shape == [4, 3, 4]
+    s = paddle.split(c, 2, axis=0)
+    assert len(s) == 2 and s[0].shape == [2, 3, 4]
+    st = paddle.stack([x, x])
+    assert st.shape == [2, 24]
+    sq = paddle.unsqueeze(x, 0)
+    assert sq.shape == [1, 24]
+    assert paddle.squeeze(sq, 0).shape == [24]
+    fl = paddle.flatten(r, 1, 2)
+    assert fl.shape == [2, 12]
+
+
+def test_reductions():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert x.sum().item() == 15
+    assert x.mean().item() == pytest.approx(2.5)
+    assert x.max().item() == 5
+    assert x.min().item() == 0
+    np.testing.assert_allclose(x.sum(axis=0).numpy(), [3, 5, 7])
+    np.testing.assert_allclose(x.sum(axis=1, keepdim=True).numpy(),
+                               [[3], [12]])
+    assert paddle.argmax(x).item() == 5
+    np.testing.assert_allclose(paddle.cumsum(x, axis=1).numpy(),
+                               np.cumsum(x.numpy(), axis=1))
+
+
+def test_where_gather_scatter():
+    x = paddle.to_tensor([1.0, 2.0, 3.0, 4.0])
+    cond = paddle.to_tensor([True, False, True, False])
+    out = paddle.where(cond, x, paddle.zeros_like(x))
+    np.testing.assert_allclose(out.numpy(), [1, 0, 3, 0])
+    idx = paddle.to_tensor([2, 0])
+    g = paddle.gather(x, idx)
+    np.testing.assert_allclose(g.numpy(), [3, 1])
+    tk = paddle.topk(x, 2)
+    np.testing.assert_allclose(tk[0].numpy(), [4, 3])
+
+
+def test_random_reproducibility():
+    paddle.seed(42)
+    a = paddle.randn([4, 4])
+    paddle.seed(42)
+    b = paddle.randn([4, 4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    c = paddle.randn([4, 4])
+    assert not np.allclose(b.numpy(), c.numpy())
+
+
+def test_einsum():
+    a = paddle.to_tensor(np.random.rand(2, 3).astype(np.float32))
+    b = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32))
+    out = paddle.einsum("ij,jk->ik", a, b)
+    np.testing.assert_allclose(out.numpy(), a.numpy() @ b.numpy(),
+                               rtol=1e-5)
