@@ -1,0 +1,110 @@
+"""Benchmark: Llama pretrain step throughput on the local chip(s).
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+Metric: tokens/sec/chip for a causal-LM train step (fwd+bwd+AdamW, bf16
+compute, remat) — the BASELINE.md headline metric shape.  vs_baseline is
+MFU / 0.45 (the north-star MFU target), since the reference publishes no
+absolute numbers (BASELINE.md).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _peak_flops_per_chip():
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    # bf16 peak per chip.
+    table = {
+        "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
+        "v5p": 459e12, "v4": 275e12, "v6": 918e12, "v6e": 918e12,
+        "cpu": 1e12,
+    }
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def main():
+    # Keep stdout clean: everything but the final JSON goes to stderr.
+    import jax
+
+    from paddle_tpu.models import (
+        CompiledTrainStep, LlamaConfig, LlamaForCausalLM, llama_shard_rules,
+    )
+    from paddle_tpu.distributed import ProcessMesh
+
+    on_cpu = jax.default_backend() == "cpu"
+    if on_cpu:
+        cfg = LlamaConfig(vocab_size=2048, hidden_size=256,
+                          intermediate_size=688, num_hidden_layers=4,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=512, recompute=True)
+        batch, seq, steps = 4, 256, 3
+    else:
+        # ~350M-param model: largest that trains comfortably on one
+        # 16G-HBM chip with fp32 master+moments.
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2752, num_hidden_layers=20,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=2048, recompute=True)
+        batch, seq, steps = 8, 2048, 10
+
+    print(f"building model (layers={cfg.num_hidden_layers}, "
+          f"hidden={cfg.hidden_size})...", file=sys.stderr)
+    model = LlamaForCausalLM(cfg)
+    n_devices = len(jax.devices())
+    mesh = None
+    rules = None
+    if n_devices > 1:
+        mesh = ProcessMesh(shape=[n_devices, 1], dim_names=["dp", "mp"])
+        rules = llama_shard_rules
+    step = CompiledTrainStep(model, lr=1e-4, mesh=mesh, shard_rules=rules,
+                             compute_dtype="bfloat16")
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+
+    print("compiling + warmup...", file=sys.stderr)
+    t0 = time.perf_counter()
+    loss = step.step(ids, ids)
+    jax.block_until_ready(loss)
+    print(f"first step (compile) {time.perf_counter() - t0:.1f}s, "
+          f"loss {float(loss):.3f}", file=sys.stderr)
+    loss = step.step(ids, ids)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step.step(ids, ids)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_step = batch * seq
+    tok_s = tokens_per_step / dt
+    tok_s_chip = tok_s / n_devices
+    # MFU convention: model FLOPs (6N + attn, fwd+bwd) / peak — remat's
+    # extra forward is hardware overhead, not counted as useful FLOPs.
+    flops_per_token = model.flops_per_token(seq)
+    mfu = tok_s_chip * flops_per_token / _peak_flops_per_chip()
+    print(f"step {dt * 1e3:.1f} ms, loss {float(loss):.3f}, "
+          f"tokens/s/chip {tok_s_chip:.0f}, MFU {mfu:.3f}",
+          file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "llama_pretrain_tokens_per_sec_per_chip",
+        "value": round(tok_s_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -27,6 +27,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = False
 
     def scale(self, var):
         if not self._enable:
@@ -50,12 +51,13 @@ class GradScaler:
 
             p.grad = Tensor(g, stop_gradient=True)
         self._found_inf = found
+        self._unscaled = True
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        if not getattr(self, "_unscaled", False):
+        if not self._unscaled:
             self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()

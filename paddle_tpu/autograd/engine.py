@@ -162,9 +162,10 @@ class GradNode:
 # Backward traversal (reference: eager/backward.cc:439 Backward())
 # --------------------------------------------------------------------------
 
-def _reachable_graph(root_nodes):
+def _reachable_graph(root_nodes, needed=None):
     """BFS over parent edges; returns {id: node} and consumer in-degree map.
-    Reference: getInDegreeMap (backward.cc:23)."""
+    When ``needed`` is given (GeneralGrad pruning), edges to nodes outside
+    it are ignored.  Reference: getInDegreeMap (backward.cc:23)."""
     nodes = {id(n): n for n in root_nodes}
     indeg = defaultdict(int)
     queue = deque(root_nodes)
@@ -173,11 +174,48 @@ def _reachable_graph(root_nodes):
         for kind, _i, parent, _slot in node.parent_edges():
             if kind != "node":
                 continue
+            if needed is not None and id(parent) not in needed:
+                continue
             indeg[id(parent)] += 1
             if id(parent) not in nodes:
                 nodes[id(parent)] = parent
                 queue.append(parent)
     return nodes, indeg
+
+
+def _mark_needed(root_nodes, slot_targets, leaf_target_ids):
+    """Subset of nodes that can reach a target (GeneralGrad's pruned
+    subgraph, eager/general_grad.h).  Iterative post-order DFS."""
+    needed: dict[int, bool] = {}
+
+    def compute(start):
+        stack = [(start, False)]
+        while stack:
+            node, expanded = stack.pop()
+            if id(node) in needed and not expanded:
+                continue
+            if not expanded:
+                needed.setdefault(id(node), False)
+                stack.append((node, True))
+                for kind, _i, obj, _slot in node.parent_edges():
+                    if kind == "node" and id(obj) not in needed:
+                        stack.append((obj, False))
+            else:
+                res = any((id(node), s) in slot_targets
+                          for s in range(node.n_outs))
+                if not res:
+                    for kind, _i, obj, _slot in node.parent_edges():
+                        if kind == "leaf" and id(obj) in leaf_target_ids:
+                            res = True
+                            break
+                        if kind == "node" and needed.get(id(obj), False):
+                            res = True
+                            break
+                needed[id(node)] = res
+
+    for n in root_nodes:
+        compute(n)
+    return {k for k, v in needed.items() if v}
 
 
 def run_backward(tensors, grad_tensors=None, retain_graph=False,
@@ -244,7 +282,14 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
         else:
             seed(t._grad_node, t._out_slot, g)
 
-    nodes, indeg = _reachable_graph(root_nodes)
+    # GeneralGrad pruning: for pure grad queries, restrict traversal to the
+    # subgraph between outputs and targets.
+    needed = None
+    if targets and not accumulate_into_grad:
+        needed = _mark_needed(root_nodes, slot_targets, set(leaf_targets))
+        root_nodes = [n for n in root_nodes if id(n) in needed]
+
+    nodes, indeg = _reachable_graph(root_nodes, needed)
     ready = deque(n for n in root_nodes if indeg[id(n)] == 0)
     processed = set()
 
@@ -282,6 +327,8 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
                     leaf_acc(obj, g)
             else:
                 parent = obj
+                if needed is not None and id(parent) not in needed:
+                    continue  # pruned branch
                 if g is not None:
                     if id(parent) not in node_grads:
                         node_grads[id(parent)] = [None] * parent.n_outs

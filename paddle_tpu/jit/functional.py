@@ -1,0 +1,55 @@
+"""Functional bridge: Layer -> pure function over a parameter pytree.
+
+This is what lets one model implementation serve both execution modes the
+reference maintains separately (dygraph vs static graph): the same eager
+Layer code is traced under jax with its parameters swapped for tracers.
+
+Reference analog: ``paddle/fluid/eager`` dygraph vs the jit/static path —
+here unified because eager ops are already jax calls.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..autograd import engine
+from ..core.tensor import Tensor
+
+
+def param_tree(layer, trainable_only=True):
+    """{name: jax array} for the layer's parameters."""
+    out = {}
+    for name, p in layer.named_parameters():
+        if trainable_only and not p.trainable:
+            continue
+        out[name] = p._data
+    return out
+
+
+def load_param_tree(layer, tree):
+    named = dict(layer.named_parameters())
+    for name, arr in tree.items():
+        named[name]._data = arr
+
+
+def functional_call(layer, params, *args, **kwargs):
+    """Call layer.forward with parameter values taken from ``params``
+    (a {name: array} tree), without mutating the layer afterwards.
+    Returns raw jax arrays (pytree). Grad recording is disabled — use
+    jax.grad over this function for derivatives."""
+    named = dict(layer.named_parameters())
+    saved = []
+    try:
+        for k, v in params.items():
+            t = named[k]
+            saved.append((t, t._data))
+            t._data = v
+        wrapped = [Tensor(a) if not isinstance(a, Tensor) and a is not None
+                   else a for a in args]
+        with engine.no_grad():
+            out = layer(*wrapped, **kwargs)
+        return jax.tree.map(
+            lambda o: o._data if isinstance(o, Tensor) else o, out,
+            is_leaf=lambda x: isinstance(x, Tensor))
+    finally:
+        for t, d in saved:
+            t._data = d

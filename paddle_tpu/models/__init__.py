@@ -1,0 +1,6 @@
+"""Model zoo: flagship Llama family + training harness; vision models live
+in paddle_tpu.vision.models, BERT in models/bert.py (as added)."""
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaForCausalLM, LlamaModel, llama_shard_rules,
+)
+from .training import CompiledTrainStep  # noqa: F401

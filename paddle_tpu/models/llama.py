@@ -1,0 +1,233 @@
+"""Llama model family — the flagship pretrain model (BASELINE config 3).
+
+Reference behavior target: the PaddleNLP Llama implementation driven through
+the reference's fleet stack; in-repo kernel parity points: fused rope
+(``/root/reference/paddle/phi/kernels/fusion/gpu/fused_rope_*``), rms_norm,
+flash attention (``phi/kernels/gpu/flash_attn_kernel.h``), swiglu.
+
+TPU-first design choices:
+- [B, S, H, D] attention layout (flash-attn layout) with MXU-friendly
+  einsums; causal SDPA is one fused XLA op chain (swap in the Pallas
+  flash-attention kernel via ``use_flash=True`` once registered).
+- GQA supported (num_key_value_heads < num_heads) — K/V heads repeat at
+  attention time, keeping the KV projection small.
+- RoPE precomputed as cos/sin tables (static shapes; XLA hoists them).
+- Everything traces into one program: works eagerly, under
+  ``paddle_tpu.jit``, and under the sharded train step (models/training.py).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .. import ops
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from .. import nn
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: str = "float32"
+    recompute: bool = False  # remat decoder layers in compiled steps
+    # (the reference's fleet recompute, fleet/recompute/recompute.py:109)
+
+    @staticmethod
+    def llama2_7b(**kw):
+        return LlamaConfig(**{**dict(
+            hidden_size=4096, intermediate_size=11008, num_hidden_layers=32,
+            num_attention_heads=32, num_key_value_heads=32), **kw})
+
+    @staticmethod
+    def llama2_13b(**kw):
+        return LlamaConfig(**{**dict(
+            hidden_size=5120, intermediate_size=13824, num_hidden_layers=40,
+            num_attention_heads=40, num_key_value_heads=40), **kw})
+
+    @staticmethod
+    def tiny(**kw):
+        return LlamaConfig(**{**dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128), **kw})
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def _rope_tables(config: LlamaConfig):
+    dim = config.head_dim
+    inv_freq = 1.0 / (config.rope_theta ** (
+        np.arange(0, dim, 2, dtype=np.float32) / dim))
+    t = np.arange(config.max_position_embeddings, dtype=np.float32)
+    freqs = np.outer(t, inv_freq)          # [S, D/2]
+    emb = np.concatenate([freqs, freqs], axis=-1)  # [S, D]
+    return np.cos(emb), np.sin(emb)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h, d = config.hidden_size, config.head_dim
+        kv = config.num_key_value_heads * d
+        self.q_proj = nn.Linear(h, h, bias_attr=False)
+        self.k_proj = nn.Linear(h, kv, bias_attr=False)
+        self.v_proj = nn.Linear(h, kv, bias_attr=False)
+        self.o_proj = nn.Linear(h, h, bias_attr=False)
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        cfg = self.config
+        B, S = x.shape[0], x.shape[1]
+        nh, nkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                      cfg.head_dim)
+        q = ops.reshape(self.q_proj(x), [B, S, nh, d])
+        k = ops.reshape(self.k_proj(x), [B, S, nkv, d])
+        v = ops.reshape(self.v_proj(x), [B, S, nkv, d])
+        q, k, _ = F.fused_rotary_position_embedding(q, k, None, sin=sin,
+                                                    cos=cos)
+        if nkv != nh:
+            rep = nh // nkv
+            k = ops.repeat_interleave(k, rep, axis=2)
+            v = ops.repeat_interleave(v, rep, axis=2)
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                             is_causal=True)
+        out = ops.reshape(out, [B, S, cfg.hidden_size])
+        return self.o_proj(out)
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, i = config.hidden_size, config.intermediate_size
+        self.gate_proj = nn.Linear(h, i, bias_attr=False)
+        self.up_proj = nn.Linear(h, i, bias_attr=False)
+        self.down_proj = nn.Linear(i, h, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(ops.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          epsilon=config.rms_norm_eps)
+        self.post_attention_layernorm = nn.RMSNorm(
+            config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        h = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
+        return h + self.mlp(self.post_attention_layernorm(h))
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(config.vocab_size,
+                                         config.hidden_size)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size,
+                               epsilon=config.rms_norm_eps)
+        cos, sin = _rope_tables(config)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+
+    def forward(self, input_ids, attn_mask=None):
+        import jax
+
+        S = input_ids.shape[1]
+        x = self.embed_tokens(input_ids)
+        cos = self.rope_cos[:S]
+        sin = self.rope_sin[:S]
+        remat = self.config.recompute and isinstance(x._data,
+                                                     jax.core.Tracer)
+        for layer in self.layers:
+            if remat:
+                # jax.checkpoint = recompute: activations of the layer are
+                # rematerialized in backward (HBM <- FLOPs trade).
+                def call(xd, lyr=layer, c=cos, s=sin, m=attn_mask):
+                    return lyr(Tensor(xd), c, s, m)._data
+
+                x = Tensor(jax.checkpoint(call)(x._data))
+            else:
+                x = layer(x, cos, sin, attn_mask)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        hidden = self.llama(input_ids, attn_mask)
+        if self.config.tie_word_embeddings:
+            logits = ops.matmul(hidden, self.llama.embed_tokens.weight,
+                                transpose_y=True)
+        else:
+            logits = self.lm_head(hidden)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            ops.reshape(logits, [-1, self.config.vocab_size]),
+            ops.reshape(labels, [-1]), reduction="mean")
+        return loss
+
+    def num_params(self):
+        return sum(int(np.prod(p.shape)) for p in self.parameters())
+
+    def flops_per_token(self, seq_len):
+        """Standard 6N + attention FLOPs estimate (for MFU)."""
+        n = self.num_params()
+        cfg = self.config
+        attn = (12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len)
+        return 6 * n + attn
+
+
+# -- TP/DP sharding rules (SURVEY.md §2.4 TP row: Megatron-style) -----------
+
+def llama_shard_rules(name: str, shape, mesh_axes=("dp", "mp")):
+    """Placement of each parameter over ('dp','mp')-style meshes; mirrors
+    fleet/layers/mpu/mp_layers.py: VocabParallelEmbedding shards vocab,
+    Column-parallel shards the output dim of q/k/v/gate/up, row-parallel
+    shards the input dim of o_proj/down_proj; norms replicate.
+
+    Returns a PartitionSpec-style tuple over tensor dims using axis NAMES.
+    """
+    mp = "mp" if "mp" in mesh_axes else None
+    if mp is None:
+        return (None,) * len(shape)
+    if "embed_tokens" in name or "lm_head" in name:
+        # [V, H] / [H, V]: shard the vocab dim.
+        if "embed_tokens" in name:
+            return ("mp", None)
+        return (None, "mp")
+    if any(k in name for k in ("q_proj", "k_proj", "v_proj", "gate_proj",
+                               "up_proj")):
+        return (None, "mp")   # column parallel: [in, out] shard out
+    if any(k in name for k in ("o_proj", "down_proj")):
+        return ("mp", None)   # row parallel: [in, out] shard in
+    return (None,) * len(shape)  # norms etc. replicated

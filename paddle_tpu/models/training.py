@@ -1,0 +1,220 @@
+"""Compiled (and sharded) train steps.
+
+The TPU-native answer to the reference's hybrid-parallel runtime
+(SURVEY.md §3.3): instead of per-op dispatch + stream collectives, the
+WHOLE train step (forward, backward, optimizer update, grad clip) is one
+XLA program.  Parallelism is declared as shardings:
+
+- dp: batch dim sharded over the 'dp' mesh axis; GSPMD turns the grad
+  reduction into fused all-reduces over ICI (the EagerReducer analog —
+  reference fluid/distributed/collective/reducer.cc).
+- tp (mp axis): parameters sharded per Megatron rules
+  (models/llama.py llama_shard_rules mirrors fleet/layers/mpu/mp_layers.py);
+  GSPMD inserts the row/column-parallel collectives.
+- ZeRO-ish sharding: optimizer moments additionally sharded over 'dp'
+  (the DygraphShardingOptimizer analog — optimizer states partitioned,
+  reference fleet/meta_optimizers/dygraph_optimizer/
+  dygraph_sharding_optimizer.py:44).
+- remat: jax.checkpoint over decoder layers = the reference's recompute
+  (fleet/recompute/recompute.py) without the PyLayer machinery.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..distributed.auto_parallel import ProcessMesh
+from ..jit.functional import functional_call, param_tree
+
+
+def _adamw_tree_update(params, grads, m, v, t, lr, beta1, beta2, eps,
+                       weight_decay, no_decay_fn, grad_clip_norm=None):
+    if grad_clip_norm is not None:
+        global_sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in jax.tree.leaves(grads))
+        gnorm = jnp.sqrt(global_sq)
+        scale = jnp.minimum(1.0, grad_clip_norm / jnp.maximum(gnorm, 1e-12))
+        grads = jax.tree.map(lambda g: g * scale.astype(g.dtype), grads)
+    b1p = beta1 ** t
+    b2p = beta2 ** t
+    new_params, new_m, new_v = {}, {}, {}
+    for k, p in params.items():
+        g = grads[k].astype(jnp.float32)
+        mk = beta1 * m[k] + (1 - beta1) * g
+        vk = beta2 * v[k] + (1 - beta2) * g * g
+        mhat = mk / (1 - b1p)
+        vhat = vk / (1 - b2p)
+        wd = 0.0 if no_decay_fn(k) else weight_decay
+        p32 = p.astype(jnp.float32)
+        p32 = p32 * (1.0 - lr * wd)
+        p32 = p32 - lr * mhat / (jnp.sqrt(vhat) + eps)
+        new_params[k] = p32.astype(p.dtype)
+        new_m[k] = mk
+        new_v[k] = vk
+    return new_params, new_m, new_v
+
+
+def _default_no_decay(name):
+    return "norm" in name or name.endswith(".bias") or "layernorm" in name
+
+
+class CompiledTrainStep:
+    """One-XLA-program AdamW train step over a Layer.
+
+    step(batch) -> loss; parameters/optimizer state live as jax arrays
+    (sharded when a mesh is given) and are written back to the Layer on
+    ``sync_to_model()``.
+    """
+
+    def __init__(self, model, lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
+                 weight_decay=0.01, grad_clip_norm=1.0, mesh: ProcessMesh
+                 = None, shard_rules=None, dp_axis="dp", zero_opt_states=True,
+                 compute_dtype=None, no_decay_fn=_default_no_decay,
+                 donate=True):
+        self.model = model
+        self.mesh = mesh
+        self.lr = lr
+        self._hyper = (beta1, beta2, eps, weight_decay)
+        self._t = 0
+
+        params = param_tree(model)
+        if compute_dtype is not None:
+            from ..core import dtype as dt
+
+            cd = dt.convert_dtype(compute_dtype)
+            params = {k: (v.astype(cd)
+                          if jnp.issubdtype(v.dtype, jnp.floating)
+                          and not no_decay_fn(k) else v)
+                      for k, v in params.items()}
+        # jnp.array (not astype): a no-op astype aliases the param buffer,
+        # which breaks double-donation in the jitted step.
+        self._master = {k: jnp.array(v, dtype=jnp.float32)
+                        for k, v in params.items()}
+        self._m = {k: jnp.zeros_like(v, dtype=jnp.float32)
+                   for k, v in params.items()}
+        self._v = {k: jnp.zeros_like(v, dtype=jnp.float32)
+                   for k, v in params.items()}
+        # Copy: self.params must not alias the Layer's live buffers, or
+        # donation would delete them out from under the eager model.
+        self.params = {k: jnp.array(v) for k, v in params.items()}
+        params = self.params
+
+        # -- shardings -----------------------------------------------------
+        if mesh is not None:
+            rules = shard_rules or (lambda name, shape: (None,) * len(shape))
+            self._param_sharding = {
+                k: NamedSharding(mesh.jax_mesh,
+                                 PartitionSpec(*rules(k, v.shape)))
+                for k, v in params.items()}
+            self._opt_sharding = {
+                k: self._zero_sharding(k, v, rules, dp_axis)
+                if zero_opt_states else self._param_sharding[k]
+                for k, v in params.items()}
+            self._batch_spec = NamedSharding(mesh.jax_mesh,
+                                            PartitionSpec(dp_axis))
+            # Place the state.
+            self.params = {k: jax.device_put(v, self._param_sharding[k])
+                           for k, v in params.items()}
+            self._m = {k: jax.device_put(v, self._opt_sharding[k])
+                       for k, v in self._m.items()}
+            self._v = {k: jax.device_put(v, self._opt_sharding[k])
+                       for k, v in self._v.items()}
+            self._master = {k: jax.device_put(v, self._opt_sharding[k])
+                            for k, v in self._master.items()}
+        else:
+            self._param_sharding = None
+
+        beta1_, beta2_, eps_, wd_ = self._hyper
+        model_ref = model
+        clip = grad_clip_norm
+
+        def loss_of(p, *batch):
+            out = functional_call(model_ref, p, *batch)
+            return jnp.asarray(out)
+
+        def step(params, master, m, v, t, lr_val, *batch):
+            loss, grads = jax.value_and_grad(loss_of)(params, *batch)
+            # AdamW on fp32 master weights (multi-precision semantics:
+            # reference phi/kernels adamw multi_precision path).
+            newp, new_m, new_v = _adamw_tree_update(
+                master, grads, m, v, t, lr_val, beta1_, beta2_, eps_, wd_,
+                no_decay_fn, grad_clip_norm=clip)
+            cast_back = {k: newp[k].astype(params[k].dtype)
+                         for k in params}
+            return cast_back, newp, new_m, new_v, loss
+
+        jit_kwargs = {}
+        if mesh is not None:
+            # Inputs carry their shardings (device_put above); pin outputs
+            # so updated state keeps the declared layout.
+            state_sh = (self._param_sharding, self._opt_sharding,
+                        self._opt_sharding, self._opt_sharding)
+            jit_kwargs["out_shardings"] = state_sh + (None,)
+            if donate:
+                jit_kwargs["donate_argnums"] = (0, 1, 2, 3)
+        elif donate:
+            jit_kwargs["donate_argnums"] = (0, 1, 2, 3)
+        self._step = jax.jit(step, **jit_kwargs)
+
+    def _zero_sharding(self, name, value, rules, dp_axis):
+        """Opt-state sharding: param's TP sharding + dp over the first
+        still-replicated dim that divides evenly (ZeRO partitioning)."""
+        spec = list(rules(name, value.shape))
+        dp = self.mesh.get_dim_size(dp_axis) \
+            if dp_axis in self.mesh.dim_names else 1
+        if dp > 1:
+            for i, s in enumerate(spec):
+                if s is None and value.shape[i] % dp == 0 and \
+                        value.shape[i] >= dp:
+                    spec[i] = dp_axis
+                    break
+        return NamedSharding(self.mesh.jax_mesh, PartitionSpec(*spec))
+
+    def _place_batch(self, arr):
+        arr = jnp.asarray(arr)
+        if self.mesh is not None:
+            ndim = arr.ndim
+            spec = [self._batch_spec.spec[0]] + [None] * (ndim - 1)
+            return jax.device_put(
+                arr, NamedSharding(self.mesh.jax_mesh,
+                                   PartitionSpec(*spec)))
+        return arr
+
+    def step(self, *batch):
+        from ..core.tensor import Tensor
+        from ..optimizer.lr import LRScheduler
+
+        self._t += 1
+        if isinstance(self.lr, LRScheduler):
+            lr_val = float(self.lr())
+            self.lr.step()
+        else:
+            lr_val = float(self.lr)
+        batch = [b._data if isinstance(b, Tensor) else b for b in batch]
+        batch = [self._place_batch(b) for b in batch]
+        (self.params, self._master, self._m, self._v, loss) = self._step(
+            self.params, self._master, self._m, self._v,
+            jnp.asarray(self._t, jnp.float32), lr_val, *batch)
+        return loss
+
+    def sync_to_model(self):
+        """Write current (possibly sharded) params back into the Layer."""
+        from ..jit.functional import load_param_tree
+
+        load_param_tree(self.model, self.params)
+
+    def state_dict(self):
+        return {"params": self.params, "master": self._master,
+                "m": self._m, "v": self._v, "t": self._t}
+
+    def set_state_dict(self, state):
+        self.params = state["params"]
+        self._master = state["master"]
+        self._m = state["m"]
+        self._v = state["v"]
+        self._t = state["t"]

@@ -329,35 +329,45 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     """[batch, seq, heads, head_dim] layout — reference:
     python/paddle/nn/functional/flash_attention.py
     scaled_dot_product_attention."""
-    if attn_mask is not None:
-        return registry.apply(nn_ops.sdpa_op, query, key, value, attn_mask,
-                              dropout=float(dropout_p),
-                              causal=bool(is_causal))
-    return registry.apply(nn_ops.sdpa_op, query, key, value,
-                          dropout=float(dropout_p), causal=bool(is_causal))
+    drop_key = None
+    if dropout_p > 0.0 and training:
+        from ...ops.random import default_generator
+
+        drop_key = default_generator.next_key()
+    return registry.apply(nn_ops.sdpa_op, query, key, value, attn_mask,
+                          drop_key, dropout=float(dropout_p),
+                          causal=bool(is_causal))
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
-                    return_softmax=False, fixed_seed_offset=None, name=None):
-    out = scaled_dot_product_attention(query, key, value,
-                                       dropout_p=dropout, is_causal=causal)
+                    return_softmax=False, fixed_seed_offset=None,
+                    training=True, name=None):
     if return_softmax:
-        return out, None
+        raise NotImplementedError(
+            "flash_attention(return_softmax=True) is not supported — the "
+            "fused path never materializes the softmax matrix")
+    out = scaled_dot_product_attention(query, key, value,
+                                       dropout_p=dropout, is_causal=causal,
+                                       training=training)
     return out, None
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
-                                    position_ids=None, use_neox_rotary_style=True):
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
     """Reference: phi fused_rope (ops/yaml/fused_ops.yaml)."""
     import jax.numpy as jnp
 
+    pos = position_ids._data if isinstance(position_ids, Tensor) \
+        else position_ids
     qk = registry.apply(nn_ops.fused_rope_op, q, k,
                         ops.cast(Tensor(cos._data if isinstance(cos, Tensor)
                                         else jnp.asarray(cos)),
                                  str(q.dtype)),
                         ops.cast(Tensor(sin._data if isinstance(sin, Tensor)
                                         else jnp.asarray(sin)),
-                                 str(q.dtype)))
+                                 str(q.dtype)),
+                        pos, neox=bool(use_neox_rotary_style))
     qo, ko = qk
     return qo, ko, v
 

@@ -333,13 +333,6 @@ softmax_op = register_op("softmax",
                          fwd=_softmax_fwd, bwd=_softmax_bwd,
                          static_argnames=("axis",))
 
-log_softmax_op = register_op(
-    "log_softmax", lambda x, axis=-1: jax.nn.log_softmax(x, axis=axis),
-    fwd=lambda x, axis=-1: (jax.nn.log_softmax(x, axis=axis), None),
-    bwd=None, static_argnames=("axis",))
-# log_softmax bwd needs the output; register with explicit pair:
-
-
 def _log_softmax_fwd(x, axis=-1):
     out = jax.nn.log_softmax(x, axis=axis)
     return out, out
@@ -469,10 +462,11 @@ def dropout_raw(x, p=0.5, training=True, mode="upscale_in_train"):
 
 # -- attention --------------------------------------------------------------
 
-def _sdpa_plain(q, k, v, mask=None, dropout=0.0, causal=False, scale=None):
+def _sdpa_plain(q, k, v, mask=None, key=None, dropout=0.0, causal=False,
+                scale=None):
     """Scaled dot-product attention, [B, S, H, D] layout (paddle flash-attn
     layout, nn/functional/flash_attention.py).  Computed in the MXU-friendly
-    [B, H, S, D] internally."""
+    [B, H, S, D] internally.  ``key`` enables attention dropout."""
     B, Sq, H, D = q.shape
     scale = scale if scale is not None else 1.0 / np.sqrt(D)
     qt = jnp.swapaxes(q, 1, 2)  # B H S D
@@ -488,32 +482,54 @@ def _sdpa_plain(q, k, v, mask=None, dropout=0.0, causal=False, scale=None):
         logits = logits + mask
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1) \
         .astype(q.dtype)
+    if key is not None and dropout > 0.0:
+        keep = jax.random.bernoulli(key, 1.0 - dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout),
+                          jnp.zeros_like(probs))
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
     return jnp.swapaxes(out, 1, 2)
 
 
 sdpa_op = register_op(
     "scaled_dot_product_attention", _sdpa_plain,
-    static_argnames=("dropout", "causal", "scale"))
+    static_argnames=("dropout", "causal", "scale"),
+    nondiff_argnums=(3, 4))
 
 
 # -- rope -------------------------------------------------------------------
 
-def _rope_plain(q, k, cos, sin):
-    """Rotary embedding on [B, S, H, D]; cos/sin are [S, D] (interleaved
-    half-rotation, matching phi fused_rope semantics with use_neox=True)."""
+def _rope_plain(q, k, cos, sin, position_ids=None, neox=True):
+    """Rotary embedding on [B, S, H, D]; cos/sin are [S_max, D] tables.
 
-    def rot(x):
-        x1, x2 = jnp.split(x, 2, axis=-1)
-        return jnp.concatenate([-x2, x1], axis=-1)
+    position_ids [B, S] selects table rows (left-padded / packed
+    sequences); neox=True rotates half-split pairs, neox=False rotates
+    interleaved even/odd pairs — matching the reference fused_rope's
+    use_neox_rotary_style (phi/kernels/fusion fused_rope).
+    """
+    if position_ids is not None:
+        c = cos[position_ids][:, :, None, :]   # [B, S, 1, D]
+        s = sin[position_ids][:, :, None, :]
+    else:
+        S = q.shape[1]
+        c = cos[None, :S, None, :]
+        s = sin[None, :S, None, :]
 
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
+    if neox:
+        def rot(x):
+            x1, x2 = jnp.split(x, 2, axis=-1)
+            return jnp.concatenate([-x2, x1], axis=-1)
+    else:
+        def rot(x):
+            x1 = x[..., 0::2]
+            x2 = x[..., 1::2]
+            return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+
     return q * c + rot(q) * s, k * c + rot(k) * s
 
 
 fused_rope_op = register_op("fused_rotary_position_embedding", _rope_plain,
-                            n_outputs=2)
+                            n_outputs=2, static_argnames=("neox",),
+                            nondiff_argnums=(4,))
 
 
 # -- interpolate (nearest/bilinear) ----------------------------------------

@@ -1,0 +1,14 @@
+"""paddle_tpu.parallel — convenience namespace over the distributed stack.
+
+The implementation lives in paddle_tpu.distributed (mesh/placements/
+collectives/fleet); this module re-exports the pieces used when writing
+parallel training code directly.
+"""
+from ..distributed import (  # noqa: F401
+    DataParallel, Partial, ProcessMesh, Replicate, Shard, all_gather,
+    all_reduce, alltoall, barrier, broadcast, get_rank, get_world_size,
+    init_parallel_env, new_group, reduce_scatter, reshard, shard_layer,
+    shard_tensor,
+)
+from ..distributed.spmd import constrain, shard_map_call  # noqa: F401
+from ..models.training import CompiledTrainStep  # noqa: F401

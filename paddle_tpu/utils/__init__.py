@@ -1,0 +1,61 @@
+"""Misc utilities (reference: python/paddle/utils/)."""
+from __future__ import annotations
+
+
+def try_import(name):
+    import importlib
+
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None
+
+
+def flatten(nested):
+    """Flatten nested lists/tuples/dicts to a leaf list (paddle.utils.flatten)."""
+    out = []
+
+    def rec(x):
+        if isinstance(x, dict):
+            for k in sorted(x):
+                rec(x[k])
+        elif isinstance(x, (list, tuple)):
+            for v in x:
+                rec(v)
+        else:
+            out.append(x)
+
+    rec(nested)
+    return out
+
+
+def map_structure(fn, structure):
+    if isinstance(structure, dict):
+        return {k: map_structure(fn, v) for k, v in structure.items()}
+    if isinstance(structure, (list, tuple)):
+        return type(structure)(map_structure(fn, v) for v in structure)
+    return fn(structure)
+
+
+def unique_name(prefix="tmp"):
+    global _name_counter
+    _name_counter += 1
+    return f"{prefix}_{_name_counter}"
+
+
+_name_counter = 0
+
+
+def run_check():
+    """paddle.utils.run_check analog: verify the device works."""
+    import jax
+
+    from .. import ops
+
+    x = ops.ones([2, 2])
+    y = ops.matmul(x, x)
+    assert float(y.numpy()[0, 0]) == 2.0
+    dev = jax.devices()[0]
+    print(f"paddle_tpu is installed and working on {dev.device_kind} "
+          f"({jax.device_count()} device(s)).")
+    return True

@@ -99,3 +99,66 @@ def test_inplace_add_keeps_graph():
     y.add_(paddle.to_tensor([10.0]))
     y.sum().backward()
     np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_paddle_grad_prunes_unrelated_branches():
+    """grad(y, x) must not execute backward of branches that cannot reach
+    x (GeneralGrad pruning)."""
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    w = paddle.to_tensor([2.0], stop_gradient=False)
+    calls = []
+    h = w * 3  # branch not reaching x
+    h.register_hook(lambda g: calls.append(1))
+    y = (x * 5).sum() + h.sum()
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [5.0])
+    assert calls == []  # pruned: hook on the w-branch never fired
+
+
+def test_grad_scaler_no_double_unscale():
+    p = paddle.EagerParamBase(np.zeros(2, np.float32))
+    model_params = [p]
+    scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=model_params)
+    p.grad = paddle.to_tensor(np.array([8.0, 8.0], np.float32))
+    scaler.unscale_(opt)
+    np.testing.assert_allclose(p.grad.numpy(), [1.0, 1.0])
+    scaler.step(opt)  # must NOT unscale again
+    np.testing.assert_allclose(p.numpy(), [-1.0, -1.0])
+    scaler.update()
+
+
+def test_sdpa_dropout_applied():
+    paddle.seed(0)
+    q = paddle.randn([1, 8, 2, 4])
+    out_nodrop = F.scaled_dot_product_attention(q, q, q, dropout_p=0.0)
+    out_drop = F.scaled_dot_product_attention(q, q, q, dropout_p=0.9,
+                                              training=True)
+    assert not np.allclose(out_nodrop.numpy(), out_drop.numpy())
+    out_eval = F.scaled_dot_product_attention(q, q, q, dropout_p=0.9,
+                                              training=False)
+    np.testing.assert_allclose(out_nodrop.numpy(), out_eval.numpy())
+
+
+def test_rope_position_ids_and_style():
+    S, D = 16, 8
+    inv = 1.0 / (10000.0 ** (np.arange(0, D, 2) / D))
+    t = np.arange(S, dtype=np.float32)
+    freqs = np.outer(t, inv)
+    cos = paddle.to_tensor(np.cos(np.concatenate([freqs, freqs], -1))
+                           .astype(np.float32))
+    sin = paddle.to_tensor(np.sin(np.concatenate([freqs, freqs], -1))
+                           .astype(np.float32))
+    q = paddle.randn([2, 4, 2, D])
+    k = paddle.randn([2, 4, 2, D])
+    # position_ids shifts which table rows are used
+    pos = paddle.to_tensor(np.array([[0, 1, 2, 3], [4, 5, 6, 7]]))
+    q1, k1, _ = F.fused_rotary_position_embedding(q, k, sin=sin, cos=cos,
+                                                  position_ids=pos)
+    q2, k2, _ = F.fused_rotary_position_embedding(q, k, sin=sin, cos=cos)
+    np.testing.assert_allclose(q1.numpy()[0], q2.numpy()[0], rtol=1e-5)
+    assert not np.allclose(q1.numpy()[1], q2.numpy()[1])
+    # interleaved style differs from neox style
+    q3, _, _ = F.fused_rotary_position_embedding(
+        q, k, sin=sin, cos=cos, use_neox_rotary_style=False)
+    assert not np.allclose(q3.numpy(), q2.numpy())

@@ -1,0 +1,124 @@
+"""Flagship model + compiled/sharded train-step tests.
+
+Mirrors the reference's hybrid-strategy tests (test/collective/fleet
+hybrid GPT tests) on the 8-device virtual CPU mesh.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import ProcessMesh
+from paddle_tpu.models import (
+    CompiledTrainStep, LlamaConfig, LlamaForCausalLM, llama_shard_rules,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return LlamaConfig.tiny()
+
+
+def _batch(cfg, bs=8, seq=32, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int64)
+    return ids[:, :], ids[:, :]  # LM: labels == inputs (shift inside loss
+    # is not modeled in this smoke test; loss value just needs to drop)
+
+
+def test_llama_forward_shapes(tiny_cfg):
+    model = LlamaForCausalLM(tiny_cfg)
+    ids = paddle.to_tensor(np.zeros((2, 16), np.int64))
+    logits = model(ids)
+    assert logits.shape == [2, 16, tiny_cfg.vocab_size]
+    loss = model(ids, labels=ids)
+    assert loss.shape == []
+    assert np.isfinite(loss.item())
+
+
+def test_llama_eager_backward(tiny_cfg):
+    model = LlamaForCausalLM(tiny_cfg)
+    ids = paddle.to_tensor(np.random.randint(0, 256, (2, 16)))
+    loss = model(ids, labels=ids)
+    loss.backward()
+    grads = [p.grad for p in model.parameters()]
+    assert all(g is not None for g in grads)
+    gnorm = sum(float((g.numpy().astype(np.float64) ** 2).sum())
+                for g in grads)
+    assert np.isfinite(gnorm) and gnorm > 0
+
+
+def test_compiled_step_single_device(tiny_cfg):
+    model = LlamaForCausalLM(tiny_cfg)
+    step = CompiledTrainStep(model, lr=1e-3, mesh=None)
+    x, y = _batch(tiny_cfg)
+    losses = [float(step.step(x, y)) for _ in range(10)]
+    assert losses[-1] < losses[0], losses
+    step.sync_to_model()
+
+
+def test_compiled_step_matches_eager_adamw(tiny_cfg):
+    """Compiled path and eager AdamW must implement the same math."""
+    paddle.seed(3)
+    model = LlamaForCausalLM(tiny_cfg)
+    sd = {k: v.numpy().copy() for k, v in model.state_dict().items()}
+    x, y = _batch(tiny_cfg, bs=4, seq=16)
+
+    step = CompiledTrainStep(model, lr=1e-2, weight_decay=0.0,
+                             grad_clip_norm=None, donate=False)
+    loss_compiled = float(step.step(x, y))
+
+    model2 = LlamaForCausalLM(tiny_cfg)
+    model2.set_state_dict({k: paddle.to_tensor(v) for k, v in sd.items()})
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2, weight_decay=0.0,
+                                 parameters=model2.parameters())
+    loss_eager = model2(paddle.to_tensor(x), labels=paddle.to_tensor(y))
+    loss_eager.backward()
+    opt.step()
+
+    np.testing.assert_allclose(loss_compiled, loss_eager.item(), rtol=1e-4)
+    step.sync_to_model()
+    for name, p in model2.named_parameters():
+        updated = dict(model.named_parameters())[name]
+        np.testing.assert_allclose(updated.numpy(), p.numpy(),
+                                   rtol=2e-3, atol=2e-5)
+
+
+def test_sharded_step_dp_mp(tiny_cfg):
+    """dp=4 x mp=2 over the 8-device CPU mesh; loss must match the
+    unsharded step (SPMD is numerically the same program)."""
+    paddle.seed(5)
+    model = LlamaForCausalLM(tiny_cfg)
+    sd = {k: v.numpy().copy() for k, v in model.state_dict().items()}
+    mesh = ProcessMesh(shape=[4, 2], dim_names=["dp", "mp"])
+    step = CompiledTrainStep(model, lr=1e-3, mesh=mesh,
+                             shard_rules=llama_shard_rules, donate=False)
+    x, y = _batch(tiny_cfg, bs=8, seq=32)
+    loss_sharded = float(step.step(x, y))
+
+    model2 = LlamaForCausalLM(tiny_cfg)
+    model2.set_state_dict({k: paddle.to_tensor(v) for k, v in sd.items()})
+    step2 = CompiledTrainStep(model2, lr=1e-3, mesh=None, donate=False)
+    loss_single = float(step2.step(x, y))
+    np.testing.assert_allclose(loss_sharded, loss_single, rtol=1e-4)
+
+    # params sharded as declared
+    qname = "llama.layers.0.self_attn.q_proj.weight"
+    sh = step.params[qname].sharding
+    assert sh.spec == (None, "mp"), sh.spec
+    # optimizer moment picked up a dp (zero) shard on a replicated dim
+    msh = step._m[qname].sharding
+    assert "dp" in str(msh.spec) or "mp" in str(msh.spec)
+
+    # multiple steps stay finite and decrease
+    losses = [loss_sharded] + [float(step.step(x, y)) for _ in range(5)]
+    assert losses[-1] < losses[0]
+
+
+def test_gqa_attention(tiny_cfg):
+    cfg = LlamaConfig.tiny(num_key_value_heads=2, num_attention_heads=4)
+    model = LlamaForCausalLM(cfg)
+    ids = paddle.to_tensor(np.zeros((1, 8), np.int64))
+    assert model(ids).shape == [1, 8, cfg.vocab_size]
+    kv = dict(model.named_parameters())[
+        "llama.layers.0.self_attn.k_proj.weight"]
+    assert kv.shape == [cfg.hidden_size, 2 * cfg.head_dim]
