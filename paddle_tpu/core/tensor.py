@@ -230,8 +230,10 @@ class EagerParamBase(Tensor):
     """Trainable parameter (reference: python/paddle/base/framework.py
     EagerParamBase; created by Layer.create_parameter)."""
 
+    # __dict__ slot: parameters accept arbitrary user attributes
+    # (is_sequence_parallel, is_firstly_shared, ... — paddle allows this).
     __slots__ = ("optimize_attr", "regularizer", "do_model_average",
-                 "need_clip", "is_distributed")
+                 "need_clip", "is_distributed", "__dict__")
 
     def __init__(self, data, name=None, trainable=True):
         super().__init__(data, stop_gradient=not trainable, name=name)

@@ -24,7 +24,11 @@ from .auto_parallel import (  # noqa: F401
 )
 from . import spmd  # noqa: F401
 from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
+from .ring_attention import ring_attention, ulysses_attention  # noqa: F401
+from .fleet.sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from .fleet import sharding  # noqa: F401  - paddle.distributed.sharding
 
 
 def get_backend():

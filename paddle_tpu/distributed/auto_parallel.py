@@ -212,9 +212,11 @@ class DistAttr:
                f"placements={self.placements})"
 
 
-def placements_to_spec(placements, ndim) -> PartitionSpec:
-    """[Shard(0), Replicate()] over mesh dims -> PartitionSpec per tensor
-    dim.  placements[i] says what mesh dim i does to the tensor."""
+def placements_to_spec(placements, ndim):
+    """[Shard(0), Replicate()] over mesh dims -> per-tensor-dim entry:
+    None | mesh_dim | tuple(mesh_dims).  placements[i] says what mesh dim i
+    does to the tensor.  (Plain list, NOT a PartitionSpec — PartitionSpec
+    is name-typed and mangles integer entries.)"""
     spec = [None] * ndim
     for mesh_dim, p in enumerate(placements):
         if isinstance(p, Shard):
@@ -222,9 +224,8 @@ def placements_to_spec(placements, ndim) -> PartitionSpec:
             if spec[d] is None:
                 spec[d] = []
             spec[d].append(mesh_dim)
-    return PartitionSpec(*[
-        tuple(s) if s and len(s) > 1 else (s[0] if s else None)
-        for s in spec])
+    return [tuple(s) if s and len(s) > 1 else (s[0] if s else None)
+            for s in spec]
 
 
 def to_named_sharding(mesh: ProcessMesh, placements, ndim):

@@ -12,6 +12,33 @@ from .topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup,
     get_hybrid_communicate_group, set_hybrid_communicate_group,
 )
+from . import mpu  # noqa: F401
+from . import sequence_parallel_utils  # noqa: F401
+from .pipeline_parallel import (  # noqa: F401
+    LayerDesc, PipelineLayer, PipelineParallel, SharedLayerDesc,
+    static_scheduler,
+)
+from .sharding import (  # noqa: F401
+    DygraphShardingOptimizer, GroupShardedOptimizerStage2,
+    GroupShardedStage2, GroupShardedStage3,
+)
+
+
+# fleet.meta_parallel exposes the reference layout's names; populate the
+# REAL module (not a shadowing class) so both attribute access and
+# `import paddle_tpu.distributed.fleet.meta_parallel` agree.
+from . import meta_parallel  # noqa: F401
+from . import mpu as _mpu  # noqa: F401
+
+meta_parallel.PipelineLayer = PipelineLayer
+meta_parallel.PipelineParallel = PipelineParallel
+meta_parallel.LayerDesc = LayerDesc
+meta_parallel.SharedLayerDesc = SharedLayerDesc
+meta_parallel.ColumnParallelLinear = _mpu.ColumnParallelLinear
+meta_parallel.RowParallelLinear = _mpu.RowParallelLinear
+meta_parallel.VocabParallelEmbedding = _mpu.VocabParallelEmbedding
+meta_parallel.ParallelCrossEntropy = _mpu.ParallelCrossEntropy
+meta_parallel.get_rng_state_tracker = None  # set by recompute milestone
 
 
 class DistributedStrategy:
@@ -88,7 +115,8 @@ class _Fleet:
         """Reference: fleet/model.py:32,139-170 — pick the wrapper by the
         dominant parallel mode."""
         from ..parallel import DataParallel
-        from .meta_parallel import PipelineParallel, TensorParallel
+        from .meta_parallel import TensorParallel
+        from .pipeline_parallel import PipelineParallel
 
         if self._hcg is None:
             self.init()

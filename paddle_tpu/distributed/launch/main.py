@@ -1,0 +1,152 @@
+"""python -m paddle_tpu.distributed.launch — the distributed job launcher.
+
+Reference: ``python/paddle/distributed/launch/main.py:23`` — builds a
+Context, a Collective controller, rendezvous via an HTTP/ETCD master, and
+spawns one worker process per device with PADDLE_* env; watches and
+restarts children (controllers/watcher.py), with elastic support.
+
+TPU-native process model: one SPMD *driver process per host* controls all
+local chips through PJRT (not one process per chip as on GPU) — so launch
+spawns ``nproc_per_node`` (default 1) processes per host, wires the jax
+coordination-service env (MASTER_ADDR/PORT -> jax.distributed.initialize
+in env.init_parallel_env), keeps the reference's PADDLE_* env names, and
+restarts failed workers up to --max_restart times.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch a distributed paddle_tpu job")
+    p.add_argument("--master", default=None,
+                   help="rendezvous endpoint ip:port")
+    p.add_argument("--nnodes", default="1",
+                   help="number of nodes, or min:max for elastic")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="worker processes per host (TPU SPMD: usually 1)")
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--run_mode", default="collective")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--devices", "--gpus", "--tpus", dest="devices",
+                   default=None)
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--elastic_level", type=int, default=-1)
+    p.add_argument("--elastic_timeout", type=int, default=30)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+class Container:
+    """One worker process (reference: launch/job/container.py)."""
+
+    def __init__(self, cmd, env, log_path):
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.proc = None
+        self.restarts = 0
+
+    def start(self):
+        os.makedirs(os.path.dirname(self.log_path) or ".", exist_ok=True)
+        self._log = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(self.cmd, env=self.env,
+                                     stdout=self._log, stderr=self._log)
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    @property
+    def returncode(self):
+        return self.proc.poll() if self.proc else None
+
+    def terminate(self):
+        if self.alive():
+            self.proc.terminate()
+            try:
+                self.proc.wait(10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+def launch(argv=None):
+    args = _parse_args(argv)
+    nnodes = int(str(args.nnodes).split(":")[0])
+    nproc = args.nproc_per_node
+    world = nnodes * nproc
+
+    master_ip, master_port = (args.master.split(":")
+                              if args.master else (None, None))
+
+    containers = []
+    for local_rank in range(nproc):
+        rank = args.node_rank * nproc + local_rank
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_NNODES": str(nnodes),
+            "PADDLE_JOB_ID": args.job_id,
+            "FLAGS_selected_tpus": str(local_rank),
+        })
+        if master_ip:
+            env["MASTER_ADDR"] = master_ip
+            env["MASTER_PORT"] = master_port
+            endpoints = [f"{master_ip}:{int(master_port) + i}"
+                         for i in range(world)]
+            env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
+            env["PADDLE_CURRENT_ENDPOINT"] = endpoints[rank]
+        cmd = [sys.executable, "-u", args.training_script,
+               *args.training_script_args]
+        log = os.path.join(args.log_dir,
+                           f"workerlog.{local_rank}")
+        containers.append(Container(cmd, env, log))
+
+    for c in containers:
+        c.start()
+
+    def _stop(signum, frame):
+        for c in containers:
+            c.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+
+    # Watcher loop (reference: controllers/watcher.py): restart failures
+    # up to max_restart, fail the job when exhausted.
+    while True:
+        states = [(c, c.returncode) for c in containers]
+        if all(rc == 0 for _, rc in states if rc is not None) and \
+                all(not c.alive() for c in containers):
+            return 0
+        for c, rc in states:
+            if rc is not None and rc != 0:
+                if c.restarts < args.max_restart:
+                    c.restarts += 1
+                    print(f"[launch] worker failed (rc={rc}); restart "
+                          f"{c.restarts}/{args.max_restart}",
+                          file=sys.stderr)
+                    c.start()
+                else:
+                    print(f"[launch] worker failed (rc={rc}); giving up",
+                          file=sys.stderr)
+                    for other in containers:
+                        other.terminate()
+                    return rc
+        time.sleep(1)
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

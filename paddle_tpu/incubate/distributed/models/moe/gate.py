@@ -1,0 +1,92 @@
+"""MoE gates.
+
+Reference: ``python/paddle/incubate/distributed/models/moe/gate/`` —
+``NaiveGate``, ``GShardGate`` (gshard_gate.py:31, top-2 + load-balance aux
+loss), ``SwitchGate`` (switch_gate.py:31, top-1).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..... import ops
+from .....core.tensor import Tensor
+from .....nn import initializer as I
+from .....nn.layers import Layer
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model, num_experts):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.wg = self.create_parameter(
+            shape=[d_model, num_experts],
+            default_initializer=I.XavierUniform())
+        self.loss = None
+
+    def logits(self, x):
+        return ops.matmul(x, self.wg)
+
+
+class NaiveGate(BaseGate):
+    def __init__(self, d_model, num_experts, topk=2):
+        super().__init__(d_model, num_experts)
+        self.topk = topk
+
+    def forward(self, x):
+        """x [T, H] -> (gate_probs [T, E], topk_idx [T, k], aux_loss)."""
+        logits = self.logits(x)
+        probs = ops.softmax(logits, axis=-1)
+        _, idx = ops.topk(probs, self.topk, axis=-1)
+        self.loss = Tensor(jnp.zeros([], jnp.float32))
+        return probs, idx, self.loss
+
+
+class GShardGate(BaseGate):
+    """Top-2 with the GShard load-balance loss: E * sum_e(me * ce) where
+    me = mean prob to expert e, ce = fraction of tokens routed to e."""
+
+    def __init__(self, d_model, num_experts, topk=2, capacity=(1.2, 2.4),
+                 group=None, random_routing=True):
+        super().__init__(d_model, num_experts)
+        if topk != 2:
+            # GShard is top-2 by construction (reference gshard_gate.py
+            # asserts the same); failing loudly beats silent re-routing.
+            raise ValueError(f"GShardGate requires topk=2, got {topk}")
+        self.topk = 2
+
+    def forward(self, x):
+        logits = self.logits(x)
+        probs = ops.softmax(logits, axis=-1)
+        p = probs._data
+        top1 = jnp.argmax(p, axis=-1)
+        me = jnp.mean(p, axis=0)
+        ce = jnp.mean(jax.nn.one_hot(top1, self.num_experts,
+                                     dtype=p.dtype), axis=0)
+        aux = jnp.sum(me * ce) * self.num_experts
+        _, idx = ops.topk(probs, self.topk, axis=-1)
+        self.loss = Tensor(aux)
+        return probs, idx, self.loss
+
+
+class SwitchGate(BaseGate):
+    """Top-1 (Switch Transformer) with its load-balance loss."""
+
+    def __init__(self, d_model, num_experts, topk=1, capacity=(1.2, 2.4),
+                 group=None):
+        super().__init__(d_model, num_experts)
+        self.topk = 1
+
+    def forward(self, x):
+        logits = self.logits(x)
+        probs = ops.softmax(logits, axis=-1)
+        p = probs._data
+        top1 = jnp.argmax(p, axis=-1)
+        me = jnp.mean(p, axis=0)
+        ce = jnp.mean(jax.nn.one_hot(top1, self.num_experts,
+                                     dtype=p.dtype), axis=0)
+        aux = jnp.sum(me * ce) * self.num_experts
+        _, idx = ops.topk(probs, 1, axis=-1)
+        self.loss = Tensor(aux)
+        return probs, idx, self.loss

@@ -1,0 +1,142 @@
+"""MoE layer with expert parallelism.
+
+Reference: ``python/paddle/incubate/distributed/models/moe/moe_layer.py:263``
+(MoELayer routing tokens to experts via ``global_scatter``/``global_gather``
+all-to-all — distributed/utils/moe_utils.py:20,153) + the fused MoE kernels
+(phi/kernels/fusion).
+
+TPU-native re-design (GShard construction): experts live as STACKED weights
+``[E, ...]`` sharded over the 'ep' mesh axis; routing is expressed as
+einsums with a one-hot dispatch mask [T, E, C] (capacity C per expert), so
+the token exchange lowers to XLA all-to-alls under GSPMD instead of
+imperative global_scatter calls.  Dense fallback (capacity covers all
+tokens) reproduces exact per-token FFN.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..... import ops
+from .....core.tensor import Tensor
+from .....nn import initializer as I
+from .....nn.layers import Layer
+from .gate import GShardGate, NaiveGate, SwitchGate
+
+
+class ExpertFFN(Layer):
+    """Stacked expert FFN: w1 [E, H, F], w2 [E, F, H]."""
+
+    def __init__(self, num_experts, d_model, d_hidden, activation="gelu"):
+        super().__init__()
+        self.w1 = self.create_parameter(
+            shape=[num_experts, d_model, d_hidden],
+            default_initializer=I.XavierUniform())
+        self.b1 = self.create_parameter(shape=[num_experts, 1, d_hidden],
+                                        is_bias=True)
+        self.w2 = self.create_parameter(
+            shape=[num_experts, d_hidden, d_model],
+            default_initializer=I.XavierUniform())
+        self.b2 = self.create_parameter(shape=[num_experts, 1, d_model],
+                                        is_bias=True)
+        self.activation = activation
+
+    def forward(self, x):
+        """x [E, C, H] -> [E, C, H]; one big batched MXU matmul pair."""
+        h = ops.add(ops.matmul(x, self.w1), self.b1)
+        h = getattr(ops, self.activation)(h)
+        return ops.add(ops.matmul(h, self.w2), self.b2)
+
+
+class MoELayer(Layer):
+    """Reference API: MoELayer(d_model, experts=..., gate=..., ...).
+
+    forward: [B, S, H] -> [B, S, H]; ``gate.loss`` carries the aux loss.
+    """
+
+    def __init__(self, d_model, d_hidden=None, num_experts=8, experts=None,
+                 gate=None, top_k=2, capacity_factor=1.25,
+                 moe_group=None, mp_group=None, activation="gelu",
+                 recompute_interval=0, mesh=None, ep_axis="ep"):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.mesh = mesh
+        self.ep_axis = ep_axis
+        if gate is None:
+            gate = "gshard"
+        if isinstance(gate, str):
+            gate = {"naive": NaiveGate, "gshard": GShardGate,
+                    "switch": SwitchGate}[gate](d_model, num_experts,
+                                                topk=top_k)
+        self.gate = gate
+        self.top_k = getattr(gate, "topk", top_k)
+        self.experts = experts or ExpertFFN(num_experts, d_model,
+                                            d_hidden or 4 * d_model,
+                                            activation)
+        if mesh is not None and ep_axis in mesh.dim_names:
+            from ....distributed.auto_parallel import (
+                Replicate, Shard, shard_tensor,
+            )
+
+            for pname, p in list(self.experts._parameters.items()):
+                placements = [Shard(0) if n == ep_axis else Replicate()
+                              for n in mesh.dim_names]
+                self.experts._parameters[pname] = shard_tensor(
+                    p, mesh, placements)
+
+    def forward(self, x):
+        B, S, H = x.shape
+        T = B * S
+        E = self.num_experts
+        tokens = ops.reshape(x, [T, H])
+        probs, topk_idx, aux = self.gate(tokens)
+        C = max(1, int(math.ceil(T * self.capacity_factor *
+                                 self.top_k / E)))
+        C = min(C, T)
+
+        # Routing decisions: integer/index work, no gradients (the gate
+        # trains through the combine weights + aux loss).
+        p = probs._data
+        idx = topk_idx._data  # [T, k]
+        k = idx.shape[-1]
+        assign = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [T, k, E]
+        # Position of each (token, slot) in its expert's capacity buffer.
+        assign_te = assign.reshape(T * k, E)
+        pos_in_e = jnp.cumsum(assign_te, axis=0) - 1.0
+        pos = jnp.sum(pos_in_e * assign_te, axis=-1).reshape(T, k)
+        keep = pos < C
+        pos = jnp.clip(pos, 0, C - 1).astype(jnp.int32)
+        cap_onehot = jax.nn.one_hot(pos, C, dtype=jnp.float32)  # [T, k, C]
+        assign_kept = assign * keep[..., None].astype(jnp.float32)
+        # dispatch [T, E, C] is a constant routing mask.
+        dispatch = Tensor(jnp.einsum("tke,tkc->tec", assign_kept,
+                                     cap_onehot).astype(p.dtype))
+        slot_mask = Tensor(jnp.einsum("tke,tkc->tkec", assign_kept,
+                                      cap_onehot).astype(p.dtype))
+
+        # Differentiable path: gate weights from probs, expert FFN, combine.
+        gate_w = ops.take_along_axis(probs, topk_idx, axis=-1)  # [T, k]
+        if k > 1:
+            denom = ops.clip(ops.sum(gate_w, axis=-1, keepdim=True),
+                             min=1e-9)
+            gate_w = ops.divide(gate_w, denom)
+        gate_w = ops.multiply(gate_w,
+                              Tensor(keep.astype(p.dtype)))
+
+        expert_in = ops.einsum("tec,th->ech", dispatch, tokens)  # [E,C,H]
+        if isinstance(self.experts, (list, tuple)):
+            outs = [self.experts[e](expert_in[e]) for e in range(E)]
+            expert_out = ops.stack(outs)
+        else:
+            expert_out = self.experts(expert_in)
+        slot_out = ops.einsum("ech,tkec->tkh",
+                              expert_out,
+                              ops.cast(slot_mask, str(expert_out.dtype)))
+        out = ops.einsum("tkh,tk->th", slot_out,
+                         ops.cast(gate_w, str(expert_out.dtype)))
+        return ops.reshape(out, [B, S, H])
