@@ -1,0 +1,88 @@
+"""Worker for __graft_entry__.dryrun_multichip.
+
+Runs in a subprocess whose env forces an n-device virtual CPU mesh
+(JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count) BEFORE jax
+is imported, mirroring the reference's device-free distributed testing
+strategy (test/legacy_test/test_dist_base.py:952 forks local trainers;
+here XLA's host-platform device count fakes the mesh).
+
+Asserts:
+  1. the sharded (dp x mp, ZeRO opt-state) compiled train step runs,
+  2. its loss numerically matches a single-device step (SPMD is the
+     same program),
+  3. params/opt-state actually carry the declared shardings,
+  4. a second step stays finite (state threading works).
+"""
+import os
+import sys
+
+
+def main(n_devices: int) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+    import numpy as np
+    import jax
+
+    # A site hook may pin jax_platforms to a hardware plugin; override
+    # before backends initialize.
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.default_backend() == "cpu", jax.default_backend()
+    assert jax.device_count() >= n_devices, (
+        f"forced {n_devices} CPU devices, got {jax.device_count()}")
+
+    from paddle_tpu.distributed import ProcessMesh
+    from paddle_tpu.models import (
+        CompiledTrainStep, LlamaConfig, LlamaForCausalLM, llama_shard_rules,
+    )
+    import paddle_tpu as paddle
+
+    mp = 2 if n_devices % 2 == 0 else 1
+    dp = n_devices // mp
+    mesh = ProcessMesh(shape=[dp, mp], dim_names=["dp", "mp"])
+
+    cfg = LlamaConfig(vocab_size=512, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64,
+                      recompute=True)
+    paddle.seed(7)
+    model = LlamaForCausalLM(cfg)
+    sd = {k: v.numpy().copy() for k, v in model.state_dict().items()}
+
+    step = CompiledTrainStep(model, lr=1e-3, mesh=mesh,
+                             shard_rules=llama_shard_rules,
+                             zero_opt_states=True, donate=False)
+    bs = max(dp * 2, 4)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (bs, 32)).astype(np.int32)
+    loss_sharded = float(step.step(ids, ids))
+    loss2 = float(step.step(ids, ids))
+    assert np.isfinite(loss_sharded) and np.isfinite(loss2)
+
+    # Numeric parity vs a single-device step on identical weights/batch.
+    model2 = LlamaForCausalLM(cfg)
+    model2.set_state_dict({k: paddle.to_tensor(v) for k, v in sd.items()})
+    step_single = CompiledTrainStep(model2, lr=1e-3, mesh=None, donate=False)
+    loss_single = float(step_single.step(ids, ids))
+    np.testing.assert_allclose(loss_sharded, loss_single, rtol=2e-4,
+                               err_msg="sharded vs single-device loss")
+
+    # Declared shardings actually applied.
+    q = step.params["llama.layers.0.self_attn.q_proj.weight"]
+    assert len(q.sharding.device_set) == n_devices, q.sharding
+    assert "mp" in str(q.sharding.spec), q.sharding.spec
+    m = step._m["llama.layers.0.self_attn.q_proj.weight"]
+    assert ("dp" in str(m.sharding.spec) or "mp" in str(m.sharding.spec)), \
+        m.sharding.spec
+
+    print(f"dryrun_multichip ok: mesh dp={dp} x mp={mp} on "
+          f"{n_devices} virtual CPU devices; sharded loss "
+          f"{loss_sharded:.6f} == single-device {loss_single:.6f}; "
+          f"step2 {loss2:.6f}")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]))
