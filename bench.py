@@ -46,15 +46,18 @@ def main():
         cfg = LlamaConfig(vocab_size=2048, hidden_size=256,
                           intermediate_size=688, num_hidden_layers=4,
                           num_attention_heads=8, num_key_value_heads=8,
-                          max_position_embeddings=512, recompute=True)
+                          max_position_embeddings=512, recompute=True,
+                          scan_layers=True)
         batch, seq, steps = 4, 256, 3
     else:
-        # ~350M-param model: largest that trains comfortably on one
-        # 16G-HBM chip with fp32 master+moments.
-        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
-                          intermediate_size=2752, num_hidden_layers=20,
+        # ~640M-param model (largest that fits 16G HBM with fp32 master +
+        # bf16 moments + full-layer remat): head_dim 128 keeps the MXU
+        # lanes full; scan_layers compiles one decoder body.
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=10,
                           num_attention_heads=16, num_key_value_heads=16,
-                          max_position_embeddings=2048, recompute=True)
+                          max_position_embeddings=2048, recompute=True,
+                          scan_layers=True)
         batch, seq, steps = 8, 2048, 10
 
     print(f"building model (layers={cfg.num_hidden_layers}, "
@@ -67,10 +70,11 @@ def main():
         mesh = ProcessMesh(shape=[n_devices, 1], dim_names=["dp", "mp"])
         rules = llama_shard_rules
     step = CompiledTrainStep(model, lr=1e-4, mesh=mesh, shard_rules=rules,
-                             compute_dtype="bfloat16")
+                             compute_dtype="bfloat16",
+                             moments_dtype="bfloat16")
 
     rng = np.random.RandomState(0)
-    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
 
     print("compiling + warmup...", file=sys.stderr)
     t0 = time.perf_counter()
@@ -103,6 +107,13 @@ def main():
         "value": round(tok_s_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.45, 4),
+        "model_params": model.num_params(),
+        "mfu": round(mfu, 4),
+        "batch": batch, "seq": seq,
+        "config": {"hidden": cfg.hidden_size,
+                   "layers": cfg.num_hidden_layers,
+                   "heads": cfg.num_attention_heads,
+                   "vocab": cfg.vocab_size},
     }))
 
 

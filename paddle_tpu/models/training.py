@@ -45,8 +45,8 @@ def _adamw_tree_update(params, grads, m, v, t, lr, beta1, beta2, eps,
     new_params, new_m, new_v = {}, {}, {}
     for k, p in params.items():
         g = grads[k].astype(jnp.float32)
-        mk = beta1 * m[k] + (1 - beta1) * g
-        vk = beta2 * v[k] + (1 - beta2) * g * g
+        mk = beta1 * m[k].astype(jnp.float32) + (1 - beta1) * g
+        vk = beta2 * v[k].astype(jnp.float32) + (1 - beta2) * g * g
         mhat = mk / (1 - b1p)
         vhat = vk / (1 - b2p)
         wd = 0.0 if no_decay_fn(k) else weight_decay
@@ -54,8 +54,8 @@ def _adamw_tree_update(params, grads, m, v, t, lr, beta1, beta2, eps,
         p32 = p32 * (1.0 - lr * wd)
         p32 = p32 - lr * mhat / (jnp.sqrt(vhat) + eps)
         new_params[k] = p32.astype(p.dtype)
-        new_m[k] = mk
-        new_v[k] = vk
+        new_m[k] = mk.astype(m[k].dtype)
+        new_v[k] = vk.astype(v[k].dtype)
     return new_params, new_m, new_v
 
 
@@ -75,7 +75,7 @@ class CompiledTrainStep:
                  weight_decay=0.01, grad_clip_norm=1.0, mesh: ProcessMesh
                  = None, shard_rules=None, dp_axis="dp", zero_opt_states=True,
                  compute_dtype=None, no_decay_fn=_default_no_decay,
-                 donate=True):
+                 donate=True, moments_dtype="float32"):
         self.model = model
         self.mesh = mesh
         self.lr = lr
@@ -93,11 +93,17 @@ class CompiledTrainStep:
                       for k, v in params.items()}
         # jnp.array (not astype): a no-op astype aliases the param buffer,
         # which breaks double-donation in the jitted step.
+        from ..core import dtype as _dt
+
+        mdt = _dt.convert_dtype(moments_dtype)
         self._master = {k: jnp.array(v, dtype=jnp.float32)
                         for k, v in params.items()}
-        self._m = {k: jnp.zeros_like(v, dtype=jnp.float32)
+        # moments_dtype="bfloat16" halves optimizer-state HBM (the
+        # reference's multi_precision=False adamw analog); the update math
+        # still runs in fp32 (_adamw_tree_update casts per step).
+        self._m = {k: jnp.zeros_like(v, dtype=mdt)
                    for k, v in params.items()}
-        self._v = {k: jnp.zeros_like(v, dtype=jnp.float32)
+        self._v = {k: jnp.zeros_like(v, dtype=mdt)
                    for k, v in params.items()}
         # Copy: self.params must not alias the Layer's live buffers, or
         # donation would delete them out from under the eager model.
@@ -196,10 +202,15 @@ class CompiledTrainStep:
         else:
             lr_val = float(self.lr)
         batch = [b._data if isinstance(b, Tensor) else b for b in batch]
-        batch = [self._place_batch(b) for b in batch]
-        (self.params, self._master, self._m, self._v, loss) = self._step(
-            self.params, self._master, self._m, self._v,
-            jnp.asarray(self._t, jnp.float32), lr_val, *batch)
+        # The train step needs no 64-bit types; tracing it with x64 off
+        # keeps weak-typed ints int32 (XLA-friendly) and lets the pallas
+        # flash-attention kernel lower (its mosaic pipeline chokes on the
+        # int64 indices that global x64 mode would introduce).
+        with jax.enable_x64(False):
+            batch = [self._place_batch(b) for b in batch]
+            (self.params, self._master, self._m, self._v, loss) = \
+                self._step(self.params, self._master, self._m, self._v,
+                           jnp.asarray(self._t, jnp.float32), lr_val, *batch)
         return loss
 
     def sync_to_model(self):

@@ -325,10 +325,13 @@ def log_loss(input, label, epsilon=1e-4, name=None):
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
-                                 training=True, name=None):
+                                 training=True, name=None, impl="auto"):
     """[batch, seq, heads, head_dim] layout — reference:
     python/paddle/nn/functional/flash_attention.py
-    scaled_dot_product_attention."""
+    scaled_dot_product_attention.  GQA (key/value heads < query heads) is
+    computed grouped, never materializing repeated K/V.  ``impl`` selects
+    the attention kernel: "einsum" (XLA fused), "flash" (Pallas TPU
+    flash kernel), or "auto"."""
     drop_key = None
     if dropout_p > 0.0 and training:
         from ...ops.random import default_generator
@@ -336,7 +339,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         drop_key = default_generator.next_key()
     return registry.apply(nn_ops.sdpa_op, query, key, value, attn_mask,
                           drop_key, dropout=float(dropout_p),
-                          causal=bool(is_causal))
+                          causal=bool(is_causal), impl=impl)
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,

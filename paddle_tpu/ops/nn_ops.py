@@ -194,10 +194,12 @@ def _layer_norm_plain(x, weight=None, bias=None, epsilon=1e-5,
     mean = jnp.mean(x, axis=axes, keepdims=True)
     var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
     out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    # Cast affine params to x's dtype — mixed-precision norms must not
+    # promote the activation stream (see _rms_norm_plain).
     if weight is not None:
-        out = out * weight
+        out = out * weight.astype(out.dtype)
     if bias is not None:
-        out = out + bias
+        out = out + bias.astype(out.dtype)
     return out
 
 
@@ -208,13 +210,16 @@ layer_norm_op = register_op(
 
 def _rms_norm_plain(x, weight=None, epsilon=1e-6):
     # Reference: phi/kernels/fusion rms_norm; compute in fp32 for stability.
+    # The affine weight is cast to x's dtype: a fp32 master weight must NOT
+    # promote a bf16 activation stream to fp32 (that silently turns every
+    # downstream matmul into a slow fp32 MXU op).
     dt = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
     out = xf * jax.lax.rsqrt(var + epsilon)
     out = out.astype(dt)
     if weight is not None:
-        out = out * weight
+        out = out * weight.astype(dt)
     return out
 
 
@@ -351,7 +356,9 @@ log_softmax_op = register_op("log_softmax",
 
 def _softmax_ce_plain(logits, label, soft_label=False, ignore_index=-100,
                       axis=-1):
-    lsm = jax.nn.log_softmax(logits, axis=axis)
+    # log_softmax in fp32: bf16 logits over a large vocab lose the loss
+    # signal (reference softmax_with_cross_entropy also accumulates fp32).
+    lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
     if soft_label:
         return -jnp.sum(label * lsm, axis=axis, keepdims=True)
     nll = -jnp.take_along_axis(lsm, label[..., None].astype(jnp.int32),
@@ -364,7 +371,7 @@ def _softmax_ce_plain(logits, label, soft_label=False, ignore_index=-100,
 
 def _softmax_ce_fwd(logits, label, soft_label=False, ignore_index=-100,
                     axis=-1):
-    lsm = jax.nn.log_softmax(logits, axis=axis)
+    lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
     if soft_label:
         loss = -jnp.sum(label * lsm, axis=axis, keepdims=True)
     else:
@@ -462,37 +469,102 @@ def dropout_raw(x, p=0.5, training=True, mode="upscale_in_train"):
 
 # -- attention --------------------------------------------------------------
 
+def _flash_attention_tpu(qt, kt, vt, causal, scale):
+    """Pallas TPU flash attention ([B, H, S, D] layout), fwd+bwd via the
+    kernel's custom_vjp.  Reference parity: phi/kernels/gpu/
+    flash_attn_kernel.h — the O(S) -memory attention path."""
+    from jax.experimental.pallas.ops.tpu import flash_attention as _fa_mod
+
+    # x64 off while tracing the kernel: global x64 (core/dtype.py) would
+    # make the kernel's weak-typed ints (grid index maps, iotas) int64,
+    # which trips upstream lax.select dtype checks and the mosaic lowering.
+    # The context re-enters on every (re)trace since it wraps the traced
+    # Python.
+    with jax.enable_x64(False):
+        return _fa_mod.flash_attention(qt, kt, vt, causal=causal,
+                                       sm_scale=float(scale))
+
+
 def _sdpa_plain(q, k, v, mask=None, key=None, dropout=0.0, causal=False,
-                scale=None):
+                scale=None, impl="auto"):
     """Scaled dot-product attention, [B, S, H, D] layout (paddle flash-attn
     layout, nn/functional/flash_attention.py).  Computed in the MXU-friendly
-    [B, H, S, D] internally.  ``key`` enables attention dropout."""
+    [B, H, S, D] internally.  ``key`` enables attention dropout.
+
+    GQA (k/v heads < q heads) is computed by grouped einsum — K/V are
+    NEVER materialized at q-head count (the reference flash kernel gets
+    this from its head-broadcast support; repeat_interleave would burn
+    HBM bandwidth).
+
+    impl: "einsum" = XLA fused softmax-attention; "flash" = Pallas TPU
+    flash kernel (requires TPU, no mask/dropout, Sq==Sk, D%128==0);
+    "auto" = einsum, with flash reserved for long sequences where the
+    O(S^2) logits no longer fit the einsum path's HBM budget.
+    """
     B, Sq, H, D = q.shape
+    Hkv, Sk = k.shape[2], k.shape[1]
     scale = scale if scale is not None else 1.0 / np.sqrt(D)
     qt = jnp.swapaxes(q, 1, 2)  # B H S D
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+
+    flash_ok = (mask is None and key is None and Sq == Sk
+                and D % 128 == 0 and Sq % 512 == 0
+                and jax.devices()[0].platform == "tpu")
+    if impl == "flash" and not flash_ok:
+        raise ValueError(
+            "impl='flash' requires: TPU backend, no attn_mask, no dropout, "
+            f"Sq == Sk, head_dim % 128 == 0, seq % 512 == 0; got "
+            f"Sq={Sq} Sk={Sk} D={D} mask={mask is not None} "
+            f"dropout={key is not None} "
+            f"platform={jax.devices()[0].platform}")
+    # auto: XLA's fused attention wins up to moderate S on-chip; the Pallas
+    # kernel's block skipping pays off once causal S^2 dominates (measured
+    # crossover on v5e ~4k).
+    use_flash = impl == "flash" or (impl == "auto" and flash_ok
+                                    and Sq >= 4096)
+    if use_flash:
+        if Hkv != H:
+            kt = jnp.repeat(kt, H // Hkv, axis=1)
+            vt = jnp.repeat(vt, H // Hkv, axis=1)
+        out = _flash_attention_tpu(qt, kt, vt, causal, scale)
+        return jnp.swapaxes(out, 1, 2)
+
+    grouped = Hkv != H
+    if grouped:
+        g = H // Hkv
+        qt = qt.reshape(B, Hkv, g, Sq, D)
+        logits = jnp.einsum("bngqd,bnkd->bngqk", qt, kt) * scale
+    else:
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
     if causal:
-        Sk = kt.shape[2]
         causal_mask = jnp.tril(jnp.ones((Sq, Sk), dtype=bool), Sk - Sq)
         logits = jnp.where(causal_mask, logits,
                            jnp.finfo(logits.dtype).min)
     if mask is not None:
-        logits = logits + mask
+        if grouped and mask.ndim == 4:
+            m = (mask.reshape(B, Hkv, H // Hkv, Sq, Sk)
+                 if mask.shape[1] == H else mask[:, :, None])
+            logits = logits + m
+        else:
+            logits = logits + mask
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1) \
         .astype(q.dtype)
     if key is not None and dropout > 0.0:
         keep = jax.random.bernoulli(key, 1.0 - dropout, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout),
                           jnp.zeros_like(probs))
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    if grouped:
+        out = jnp.einsum("bngqk,bnkd->bngqd", probs, vt)
+        out = out.reshape(B, H, Sq, D)
+    else:
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
     return jnp.swapaxes(out, 1, 2)
 
 
 sdpa_op = register_op(
     "scaled_dot_product_attention", _sdpa_plain,
-    static_argnames=("dropout", "causal", "scale"),
+    static_argnames=("dropout", "causal", "scale", "impl"),
     nondiff_argnums=(3, 4))
 
 
