@@ -158,19 +158,21 @@ class Tensor:
         return ops.assign(self)
 
     # -- device movement --------------------------------------------------
-    def to(self, *args, **kwargs):
-        place, dtype = None, None
-        for a in list(args) + list(kwargs.values()):
+    def to(self, *args, device=None, dtype=None, blocking=None, place=None):
+        """Reference signature: Tensor.to(device=None, dtype=None,
+        blocking=None) — positional args are classified; bools/None are
+        ``blocking`` and never mistaken for a dtype."""
+        for a in list(args) + [device]:
+            if a is None or isinstance(a, bool):
+                continue  # blocking flag or absent
             if isinstance(a, Place):
                 place = a
             elif isinstance(a, str) and a.split(":")[0] in (
                     "cpu", "tpu", "gpu", "xpu", "cuda"):
-                from .place import set_device  # parse only
-
                 name, _, idx = a.partition(":")
                 idx = int(idx) if idx else 0
                 place = CPUPlace(idx) if name == "cpu" else TPUPlace(idx)
-            else:
+            elif dtype is None:
                 dtype = a
         data = self._data
         if dtype is not None:

@@ -76,13 +76,55 @@ class StaticFunction:
 
         leaves, _ = jax.tree.flatten((args, kwargs),
                                      is_leaf=lambda x: isinstance(x, Tensor))
-        tensor_datas = [x._data for x in leaves if isinstance(x, Tensor)]
-        out_datas, new_state = jitted([t._data for t in state],
-                                      tensor_datas)
+        tensor_leaves = [x for x in leaves if isinstance(x, Tensor)]
+        sdatas = [t._data for t in state]
+        idatas = [t._data for t in tensor_leaves]
+
+        # Training path: build the autograd graph THROUGH the jitted call
+        # (reference to_static fully supports training — jit/api.py:197);
+        # a grad-recording forward uses jax.vjp over the compiled function
+        # and hangs a vjp-fallback GradNode off the outputs.
+        all_inputs = list(state) + tensor_leaves
+        diff_idx = [i for i, t in enumerate(all_inputs)
+                    if not t.stop_gradient]
+        need_grad = engine.is_grad_enabled() and bool(diff_idx)
+
+        if not need_grad:
+            out_datas, new_state = jitted(sdatas, idatas)
+            for t, d in zip(state, new_state):
+                t._data = d
+            return jax.tree.map(
+                lambda d: Tensor(d) if d is not None else None, out_datas)
+
+        # vjp only over the grad-requiring leaves (non-diff ones are closed
+        # over, registry._close_over style) — no wasted backward compute
+        # for frozen parameters.
+        all_datas = sdatas + idatas
+        n_state = len(sdatas)
+
+        def f(*diff_datas):
+            full = list(all_datas)
+            for i, d in zip(diff_idx, diff_datas):
+                full[i] = d
+            return jitted(full[:n_state], full[n_state:])
+
+        out_datas, vjp_fn, new_state = jax.vjp(
+            f, *[all_datas[i] for i in diff_idx], has_aux=True)
         for t, d in zip(state, new_state):
             t._data = d
-        return jax.tree.map(
-            lambda d: Tensor(d) if d is not None else None, out_datas)
+
+        out_flat, out_tree = jax.tree.flatten(out_datas)
+
+        def vjp_saved(cotangent):
+            cots = (list(cotangent) if isinstance(cotangent, tuple)
+                    else [cotangent])
+            return list(vjp_fn(jax.tree.unflatten(out_tree, cots)))
+
+        node = engine.GradNode(None, vjp_saved, all_inputs, {},
+                               vjp_fallback=True, diff_idx=diff_idx)
+        outs = [Tensor(d, stop_gradient=False) for d in out_flat]
+        node.bind_outputs(outs)
+        return jax.tree.unflatten(out_tree, outs)
 
     def _compile(self, args, kwargs, state):
         fn = self._fn
@@ -186,8 +228,11 @@ def save(layer, path, input_spec=None, **configs):
 
             lowered = jax.jit(pure).lower(*datas)
             payload["stablehlo"] = lowered.as_text()
-        except Exception as e:  # serialize params regardless
-            payload["stablehlo_error"] = str(e)
+        except Exception as e:
+            # Do not silently ship a checkpoint without the program the
+            # caller asked for (input_spec given == lowering requested).
+            raise RuntimeError(
+                f"jit.save: lowering to StableHLO failed: {e}") from e
     with open(path + ".pdparams", "wb") as f:
         pickle.dump(payload, f)
 

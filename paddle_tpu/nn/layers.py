@@ -250,11 +250,13 @@ class Layer:
         for name, p in self.named_parameters():
             dest[structured_name_prefix + name] = p
         for name, b in self.named_buffers():
+            # Check persistability against the OWNING sublayer — a nested
+            # non-persistable buffer must not leak into checkpoints.
             short = name.rsplit(".", 1)[-1]
             owner = self
-            if "." in name:
-                pass
-            if short in self._non_persistable_buffer_names:
+            for part in name.split(".")[:-1]:
+                owner = owner._sub_layers[part]
+            if short in owner._non_persistable_buffer_names:
                 continue
             dest[structured_name_prefix + name] = b
         return dest

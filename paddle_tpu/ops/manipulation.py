@@ -417,7 +417,13 @@ def _put_along_bwd(saved, g, axis=0, reduce="assign"):
     gv = g[ii]
     if reduce == "assign":
         gx = g.at[ii].set(jnp.zeros_like(gv))
-    else:
+    elif reduce in ("multiply", "mul"):
+        # y = x * value at the written positions: dx there scales by value,
+        # dvalue = g * x (assumes unique indices, as the forward does).
+        gx = g.at[ii].multiply(jnp.broadcast_to(value, gv.shape)
+                               .astype(g.dtype))
+        gv = gv * x[ii].astype(gv.dtype)
+    else:  # add
         gx = g
     if jnp.ndim(value) == 0:
         gv = jnp.sum(gv)
@@ -538,11 +544,14 @@ def nonzero(x, as_tuple=False):
 
 
 def masked_select(x, mask, name=None):
+    """Differentiable bool-mask selection (concrete mask; grads flow back
+    to x via getitem's vjp — scatter-add at the selected positions)."""
     from ..core.tensor import Tensor
 
-    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    if not isinstance(x, Tensor):
+        x = Tensor(jnp.asarray(x))
     m = np.asarray(mask._data if isinstance(mask, Tensor) else mask)
-    return Tensor(jnp.asarray(arr[m.astype(bool)]))
+    return getitem(x, Tensor(jnp.asarray(m.astype(bool))))
 
 
 def masked_fill(x, mask, value, name=None):
@@ -805,14 +814,15 @@ def getitem(x, idx):
 
     jidx = _normalize_index(x, idx)
 
-    # Boolean-mask indexing yields data-dependent shapes: concretize.
+    # Boolean-mask indexing yields data-dependent shapes: the MASK must be
+    # concrete (numpy), but the gather itself stays a jax op so gradients
+    # flow (scatter-add backward via vjp) — the reference differentiates
+    # through bool-mask selection too.
     has_bool = builtins.any(
         hasattr(it, "dtype") and it.dtype == jnp.bool_ for it in jidx)
     if has_bool:
-        arr = np.asarray(x._data)
-        npidx = tuple(np.asarray(it) if hasattr(it, "dtype") else it
-                      for it in jidx)
-        return Tensor(jnp.asarray(arr[npidx]))
+        jidx = tuple(np.asarray(it) if hasattr(it, "dtype")
+                     and it.dtype == jnp.bool_ else it for it in jidx)
 
     need_grad = _engine.is_grad_enabled() and not x.stop_gradient
     if not need_grad:

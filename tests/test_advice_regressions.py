@@ -1,0 +1,104 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_to_static_training_builds_grads():
+    """ADVICE high: a to_static-wrapped Layer must train, not silently
+    no-op (reference paddle.jit.to_static supports training)."""
+    paddle.seed(0)
+    layer = nn.Linear(4, 3)
+    layer = paddle.jit.to_static(layer)
+    x = paddle.to_tensor(np.random.randn(5, 4).astype(np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=layer.parameters())
+    w0 = layer.weight.numpy().copy()
+    losses = []
+    for _ in range(5):
+        out = layer(x)
+        loss = (out * out).mean()
+        loss.backward()
+        assert layer.weight.grad is not None, \
+            "to_static forward dropped the autograd graph"
+        opt.step()
+        opt.clear_grad()
+        losses.append(loss.item())
+    assert losses[-1] < losses[0]
+    assert not np.allclose(layer.weight.numpy(), w0)
+
+
+def test_to_static_matches_eager_grads():
+    paddle.seed(1)
+    lin = nn.Linear(4, 3)
+    x = paddle.to_tensor(np.random.randn(2, 4).astype(np.float32))
+
+    loss = (lin(x) ** 2).sum()
+    loss.backward()
+    eager_gw = lin.weight.grad.numpy().copy()
+    lin.clear_gradients()
+
+    slin = paddle.jit.to_static(lin)
+    loss2 = (slin(x) ** 2).sum()
+    loss2.backward()
+    np.testing.assert_allclose(lin.weight.grad.numpy(), eager_gw,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(loss2.item(), loss.item(), rtol=1e-6)
+
+
+def test_bool_mask_getitem_grad():
+    """ADVICE medium: x[mask] must be differentiable."""
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32), stop_gradient=False)
+    mask = paddle.to_tensor(np.array([True, False, True, True, False, False]))
+    y = x[mask]
+    assert y.shape == [3]
+    y.sum().backward()
+    assert x.grad is not None
+    np.testing.assert_allclose(x.grad.numpy(),
+                               [1, 0, 1, 1, 0, 0])
+
+
+def test_masked_select_grad():
+    x = paddle.to_tensor(np.array([[1., 2.], [3., 4.]], np.float32),
+                         stop_gradient=False)
+    mask = paddle.to_tensor(np.array([[True, False], [False, True]]))
+    y = paddle.masked_select(x, mask)
+    np.testing.assert_allclose(y.numpy(), [1., 4.])
+    (y * paddle.to_tensor(np.array([2., 3.], np.float32))).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[2., 0.], [0., 3.]])
+
+
+def test_put_along_axis_multiply_grad():
+    """ADVICE medium: reduce='mul' grads were computed as 'add'."""
+    x = paddle.to_tensor(np.array([1., 5., 1.], np.float32),
+                         stop_gradient=False)
+    v = paddle.to_tensor(np.array([2.], np.float32), stop_gradient=False)
+    idx = paddle.to_tensor(np.array([1], np.int64))
+    out = paddle.put_along_axis(x, idx, v, axis=0, reduce="mul")
+    np.testing.assert_allclose(out.numpy(), [1., 10., 1.])
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1., 2., 1.])
+    np.testing.assert_allclose(v.grad.numpy(), [5.])
+
+
+def test_tensor_to_blocking_kwarg():
+    """ADVICE low: t.to('cpu', blocking=True) must not treat True as a
+    dtype."""
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    out = t.to("cpu", blocking=True)
+    assert str(out.dtype).endswith("float32")
+    out2 = t.to("float64")
+    assert str(out2.dtype).endswith("float64")
+
+
+def test_nested_non_persistable_buffers_excluded():
+    """ADVICE low: nested non-persistable buffers must not leak into
+    state_dict."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    sd = model.state_dict()
+    assert not any("rope_cos" in k or "rope_sin" in k for k in sd), \
+        [k for k in sd if "rope" in k]
