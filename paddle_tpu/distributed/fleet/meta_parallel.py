@@ -22,9 +22,14 @@ class MetaParallelBase(Layer):
         self._layers = layers
         self._hcg = hcg
         self._strategy = strategy
-        self.add_sublayer("_layers", layers)
+        if layers is not None:  # None = compiled-engine-only wrapper
+            self.add_sublayer("_layers", layers)
 
     def forward(self, *inputs, **kwargs):
+        if self._layers is None:
+            raise RuntimeError(
+                "this wrapper was built engine-only (layers=None); only "
+                "train_batch via the compiled SPMD engine is available")
         return self._layers(*inputs, **kwargs)
 
     def state_dict(self, *args, **kwargs):

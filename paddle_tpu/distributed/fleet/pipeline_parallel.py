@@ -140,10 +140,12 @@ class PipelineLayer(Layer):
 
 
 def static_scheduler(num_stages, num_micro_batches, stage_id,
-                     schedule="1F1B"):
+                     schedule="1F1B", num_virtual=None):
     """Emit the micro-step order string for one stage —
     the reference's testable schedule form (pipeline_parallel.py:560-590):
-    'f0;f1;b0;f2;b1;...'"""
+    'f0;f1;b0;f2;b1;...'.  schedule="VPP" emits the interleaved
+    virtual-pipeline order (PipelineParallelWithInterleave,
+    pipeline_parallel.py:1136) with entries 'f{micro}.{chunk}'."""
     M, P, i = num_micro_batches, num_stages, stage_id
     steps = []
     if schedule in ("1F1B", "1f1b"):
@@ -162,6 +164,24 @@ def static_scheduler(num_stages, num_micro_batches, stage_id,
             b += 1
     elif schedule in ("FThenB", "F-then-B", "fthenb"):
         steps = [f"f{m}" for m in range(M)] + [f"b{m}" for m in range(M)]
+    elif schedule in ("VPP", "vpp", "interleave"):
+        V = num_virtual or 1
+        fwd, bwd = [], []
+        for g in range(0, M, P):
+            grp = list(range(g, min(g + P, M)))
+            for v in range(V):
+                fwd += [f"f{m}.{v}" for m in grp]
+            for v in reversed(range(V)):
+                bwd += [f"b{m}.{v}" for m in grp]
+        warmup = min((P - 1 - i) + (V - 1) * P, len(fwd))
+        steps = fwd[:warmup]
+        fi, bi = warmup, 0
+        while fi < len(fwd):
+            steps.append(fwd[fi])
+            fi += 1
+            steps.append(bwd[bi])
+            bi += 1
+        steps += bwd[bi:]
     else:
         raise ValueError(f"unknown schedule {schedule}")
     return ";".join(steps)
@@ -170,7 +190,7 @@ def static_scheduler(num_stages, num_micro_batches, stage_id,
 class PipelineParallel(MetaParallelBase):
     """Reference: meta_parallel/pipeline_parallel.py PipelineParallel."""
 
-    def __init__(self, layers, hcg, strategy):
+    def __init__(self, layers, hcg, strategy, spmd_step=None):
         super().__init__(layers, hcg, strategy)
         cfg = strategy.pipeline_configs if strategy is not None else {}
         self.micro_batch_size = cfg.get("micro_batch_size", 1)
@@ -179,6 +199,10 @@ class PipelineParallel(MetaParallelBase):
                            if hcg is not None else 1)
         self.stage_id = hcg.get_stage_id() if hcg is not None else 0
         self._schedule_mode = cfg.get("schedule_mode", "1F1B")
+        # Optional compiled SPMD engine (distributed/pipeline.py
+        # PipelineTrainStep): stages placed over the 'pp' mesh axis with
+        # ppermute transfer; train_batch delegates to it when present.
+        self._spmd_step = spmd_step
 
     def schedule_string(self, micro_batches=None):
         return static_scheduler(self.num_stages,
@@ -227,6 +251,21 @@ class PipelineParallel(MetaParallelBase):
         return total
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        if self._spmd_step is not None:
+            # Compiled multi-device path: fwd+bwd+update is one XLA
+            # program; the optimizer lives inside the engine.
+            if scaler is not None:
+                raise ValueError(
+                    "GradScaler is not supported on the SPMD pipeline "
+                    "path (bf16 training needs no loss scaling)")
+            xs, ys = data
+            if lr_scheduler is not None:
+                # Propagate the scheduled lr into the engine's update.
+                self._spmd_step.lr = float(lr_scheduler())
+            loss = self._spmd_step.step(xs, ys)
+            if lr_scheduler is not None:
+                lr_scheduler.step()
+            return loss
         loss = self.forward_backward_pipeline(data, scaler)
         if scaler is not None:
             scaler.step(optimizer)

@@ -1,0 +1,210 @@
+"""SPMD pipeline parallelism over a 'pp' mesh axis.
+
+Reference behavior target: fleet/meta_parallel/pipeline_parallel.py:545
+(1F1B ``forward_backward_pipeline``) + p2p_communication.py (stage-to-stage
+isend/irecv).  TPU-native re-design: there are no per-stage processes or
+P2P calls — the pipeline is ONE SPMD program under ``shard_map``:
+
+- per-stage parameters are stacked on a leading dim and sharded over the
+  'pp' mesh axis, so each device holds exactly its stage's weights;
+- microbatches rotate stage-to-stage via ``lax.ppermute`` (XLA
+  collective-permute riding ICI — the p2p_communication analog);
+- the loop is a ``lax.scan`` over T = M + P - 1 ticks: at tick t, stage s
+  processes microbatch t - s (the classic skewed schedule; every stage is
+  busy in steady state, bubble = (P-1)/T as in the reference's 1F1B);
+- the last stage applies the head + loss, masked to valid ticks, and the
+  scalar loss is ``psum``'d over 'pp' (and ``pmean``'d over 'dp' if the
+  mesh has one);
+- backward is ``jax.grad`` through the whole thing: the transpose of
+  ppermute is the reverse permute, so gradients flow stage-to-stage in
+  reverse order — exactly the reference's backward micro-step schedule,
+  but compiler-generated.
+
+Memory note: with ``remat=True`` each stage rematerializes its microbatch
+activations in backward, so live state is the O(T) stage-boundary
+activations — the 1F1B memory story, without the hand-written schedule.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from jax import shard_map
+
+
+def stack_stage_params(per_stage_trees):
+    """[{name: leaf} per stage] -> {name: stacked [P, ...]} (leading dim =
+    stage; shard it over 'pp')."""
+    keys = list(per_stage_trees[0].keys())
+    for t in per_stage_trees[1:]:
+        if list(t.keys()) != keys:
+            raise ValueError("pipeline stages must be homogeneous: "
+                             f"{keys} vs {list(t.keys())}")
+    return {k: jnp.stack([t[k] for t in per_stage_trees])
+            for k in keys}
+
+
+def stage_sharding(mesh, stacked_params, axis="pp"):
+    """NamedShardings placing dim 0 of every stacked leaf on ``axis``."""
+    return {
+        k: NamedSharding(mesh, PartitionSpec(axis,
+                                             *([None] * (v.ndim - 1))))
+        for k, v in stacked_params.items()}
+
+
+def spmd_pipeline(mesh, stage_fn, last_fn, axis="pp", dp_axis=None,
+                  remat=True):
+    """Build ``fn(stage_params, last_params, xs, ys, extra) -> loss``.
+
+    - ``stage_fn(stage_tree, x, extra) -> x``: one pipeline stage (a block
+      of layers).  ``stage_tree`` leaves have NO stage dim (already local).
+    - ``last_fn(last_params, x, y, extra) -> scalar loss`` for one
+      microbatch (head + loss; computed on the last stage).
+    - ``stage_params``: {name: [P, ...]} stacked tree (stack_stage_params),
+      sharded over ``axis``.
+    - ``xs``: [M, mb, ...] stage-0 inputs (already embedded);
+      ``ys``: [M, mb, ...] labels.  ``extra``: replicated aux pytree
+      (rope tables...).
+
+    The returned fn is pure/differentiable — call under jax.jit /
+    value_and_grad.
+    """
+    P = mesh.shape[axis]
+    axes = (axis,) if dp_axis is None else (axis, dp_axis)
+    body = jax.checkpoint(stage_fn, prevent_cse=False) if remat else stage_fn
+
+    def local(stage_params, last_params, xs, ys, extra):
+        sp = jax.tree.map(lambda a: a[0], stage_params)  # drop stage dim
+        p = jax.lax.axis_index(axis)
+        M = xs.shape[0]
+        T = M + P - 1
+        pad = jnp.zeros((P - 1,) + xs.shape[1:], xs.dtype)
+        xs_pad = jnp.concatenate([xs, pad], axis=0)
+
+        def step(recv, t):
+            x_t = jax.lax.dynamic_index_in_dim(xs_pad, t, 0, keepdims=False)
+            inp = jnp.where(p == 0, x_t, recv)
+            out = body(sp, inp, extra)
+            m = t - (P - 1)
+            y_m = jax.lax.dynamic_index_in_dim(
+                ys, jnp.clip(m, 0, M - 1), 0, keepdims=False)
+            valid = jnp.logical_and(p == P - 1, m >= 0)
+            contrib = jnp.where(
+                valid, last_fn(last_params, out, y_m, extra), 0.0)
+            nxt = jax.lax.ppermute(
+                out, axis, [(i, (i + 1) % P) for i in range(P)]) \
+                if P > 1 else out
+            return nxt, contrib
+
+        recv0 = jnp.zeros(xs.shape[1:], xs.dtype)
+        _, contribs = jax.lax.scan(step, recv0, jnp.arange(T))
+        loss = jnp.sum(contribs)
+        if P > 1:
+            loss = jax.lax.psum(loss, axis)
+        loss = loss / M
+        if dp_axis is not None:
+            loss = jax.lax.pmean(loss, dp_axis)
+        return loss
+
+    stage_spec = PartitionSpec(axis)
+    data_spec = (PartitionSpec(None, dp_axis)
+                 if dp_axis is not None else PartitionSpec())
+
+    def fn(stage_params, last_params, xs, ys, extra=()):
+        in_specs = (
+            jax.tree.map(lambda _: stage_spec, stage_params),
+            jax.tree.map(lambda _: PartitionSpec(), last_params),
+            data_spec, data_spec,
+            jax.tree.map(lambda _: PartitionSpec(), extra),
+        )
+        return shard_map(
+            local, mesh=mesh, in_specs=in_specs,
+            out_specs=PartitionSpec(),
+            check_vma=False)(stage_params, last_params, xs, ys, extra)
+
+    return fn
+
+
+class PipelineTrainStep:
+    """Compiled AdamW train step over an embed -> P homogeneous stages ->
+    head model, pipelined over the 'pp' mesh axis (optionally x 'dp').
+
+    The functional analog of the reference's
+    ``PipelineParallel.train_batch`` (1F1B) for the flagship decoder
+    models; reference: pipeline_parallel.py:790.
+    """
+
+    def __init__(self, mesh, embed_fn, stage_fn, last_fn, embed_params,
+                 stage_params_stacked, last_params, extra=(), axis="pp",
+                 dp_axis=None, lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
+                 weight_decay=0.0, remat=True, donate=True):
+        self.mesh = mesh
+        self.lr = lr
+        self._t = 0
+        pipe = spmd_pipeline(mesh, stage_fn, last_fn, axis=axis,
+                             dp_axis=dp_axis, remat=remat)
+        self._extra = extra
+
+        def loss_of(params, xs, ys):
+            ep, sp, lp = params
+            xs_h = embed_fn(ep, xs, extra)
+            return pipe(sp, lp, xs_h, ys, extra)
+
+        st_sh = stage_sharding(mesh, stage_params_stacked, axis)
+        repl = NamedSharding(mesh, PartitionSpec())
+        self._shardings = (
+            jax.tree.map(lambda _: repl, embed_params),
+            st_sh,
+            jax.tree.map(lambda _: repl, last_params),
+        )
+        place = lambda tree, sh: jax.tree.map(jax.device_put, tree, sh)
+        self.params = (place(embed_params, self._shardings[0]),
+                       {k: jax.device_put(v, st_sh[k])
+                        for k, v in stage_params_stacked.items()},
+                       place(last_params, self._shardings[2]))
+        zeros32 = lambda tree: jax.tree.map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), tree)
+        self._m = jax.tree.map(jax.device_put, zeros32(self.params),
+                               self._shardings)
+        self._v = jax.tree.map(jax.device_put, zeros32(self.params),
+                               self._shardings)
+
+        def step(params, m, v, t, lr_val, xs, ys):
+            loss, grads = jax.value_and_grad(loss_of)(params, xs, ys)
+            b1p, b2p = beta1 ** t, beta2 ** t
+
+            def upd(p, g, mk, vk):
+                g = g.astype(jnp.float32)
+                mk = beta1 * mk + (1 - beta1) * g
+                vk = beta2 * vk + (1 - beta2) * g * g
+                p32 = p.astype(jnp.float32) * (1.0 - lr_val * weight_decay)
+                p32 = p32 - lr_val * (mk / (1 - b1p)) / (
+                    jnp.sqrt(vk / (1 - b2p)) + eps)
+                return p32.astype(p.dtype), mk, vk
+
+            pl, treedef = jax.tree.flatten(params)
+            gl = jax.tree.leaves(grads)
+            ml = jax.tree.leaves(m)
+            vl = jax.tree.leaves(v)
+            triples = [upd(*t4) for t4 in zip(pl, gl, ml, vl)]
+            newp = jax.tree.unflatten(treedef, [t3[0] for t3 in triples])
+            newm = jax.tree.unflatten(treedef, [t3[1] for t3 in triples])
+            newv = jax.tree.unflatten(treedef, [t3[2] for t3 in triples])
+            return newp, newm, newv, loss
+
+        kw = {"donate_argnums": (0, 1, 2)} if donate else {}
+        self._step = jax.jit(step, **kw)
+
+    def step(self, xs, ys):
+        self._t += 1
+        with jax.enable_x64(False):
+            self.params, self._m, self._v, loss = self._step(
+                self.params, self._m, self._v,
+                jnp.asarray(self._t, jnp.float32), float(self.lr),
+                jnp.asarray(xs), jnp.asarray(ys))
+        return loss

@@ -21,6 +21,7 @@ import pickle
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 from ..autograd import engine
 from ..core.tensor import Tensor
@@ -118,11 +119,17 @@ class StaticFunction:
         def vjp_saved(cotangent):
             cots = (list(cotangent) if isinstance(cotangent, tuple)
                     else [cotangent])
+            # Integer/bool outputs take float0 cotangents (jax.vjp
+            # contract), not the engine's dtype-matched zeros.
+            cots = [np.zeros(np.shape(p), jax.dtypes.float0)
+                    if not jnp.issubdtype(p.dtype, jnp.inexact) else c
+                    for c, p in zip(cots, out_flat)]
             return list(vjp_fn(jax.tree.unflatten(out_tree, cots)))
 
         node = engine.GradNode(None, vjp_saved, all_inputs, {},
                                vjp_fallback=True, diff_idx=diff_idx)
-        outs = [Tensor(d, stop_gradient=False) for d in out_flat]
+        outs = [Tensor(d, stop_gradient=not jnp.issubdtype(
+            d.dtype, jnp.inexact)) for d in out_flat]
         node.bind_outputs(outs)
         return jax.tree.unflatten(out_tree, outs)
 

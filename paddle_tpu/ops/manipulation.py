@@ -418,11 +418,17 @@ def _put_along_bwd(saved, g, axis=0, reduce="assign"):
     if reduce == "assign":
         gx = g.at[ii].set(jnp.zeros_like(gv))
     elif reduce in ("multiply", "mul"):
-        # y = x * value at the written positions: dx there scales by value,
-        # dvalue = g * x (assumes unique indices, as the forward does).
-        gx = g.at[ii].multiply(jnp.broadcast_to(value, gv.shape)
-                               .astype(g.dtype))
-        gv = gv * x[ii].astype(gv.dtype)
+        # y = x * prod(values written to the cell): dx scales by the full
+        # product (g.at[ii].multiply applies every factor, duplicate
+        # indices included); dvalue_j = g * out/value_j (product of x and
+        # the OTHER factors).  value_j == 0 falls back to g*x — exact when
+        # indices are unique, best-effort for duplicated zero writes.
+        vb = jnp.broadcast_to(value, gv.shape).astype(g.dtype)
+        gx = g.at[ii].multiply(vb)
+        out = _put_along_plain(x, index, value, axis, reduce)
+        gv = gv * jnp.where(vb == 0, x[ii].astype(gv.dtype),
+                            out[ii].astype(gv.dtype) / jnp.where(
+                                vb == 0, jnp.ones_like(vb), vb))
     else:  # add
         gx = g
     if jnp.ndim(value) == 0:
