@@ -26,17 +26,21 @@ class MetaParallelBase(Layer):
             self.add_sublayer("_layers", layers)
 
     def forward(self, *inputs, **kwargs):
+        return self._require_layers()(*inputs, **kwargs)
+
+    def _require_layers(self):
         if self._layers is None:
             raise RuntimeError(
                 "this wrapper was built engine-only (layers=None); only "
                 "train_batch via the compiled SPMD engine is available")
-        return self._layers(*inputs, **kwargs)
+        return self._layers
 
     def state_dict(self, *args, **kwargs):
-        return self._layers.state_dict(*args, **kwargs)
+        return self._require_layers().state_dict(*args, **kwargs)
 
     def set_state_dict(self, state_dict, *args, **kwargs):
-        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+        return self._require_layers().set_state_dict(state_dict, *args,
+                                                     **kwargs)
 
 
 class TensorParallel(MetaParallelBase):

@@ -16,9 +16,68 @@ so fleet-style training scripts run unchanged.
 """
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 
 from ...nn.layers import Layer
+
+_degrade_warned: set = set()
+
+
+def _resolve_mesh_axis(mesh=None, axis=None):
+    """(jax Mesh, axis name) for ZeRO partitioning — explicit args win,
+    else the fleet HCG's 'sharding' (or 'dp') axis."""
+    if mesh is not None:
+        jm = getattr(mesh, "jax_mesh", mesh)
+        axis = axis or "sharding"
+        if axis not in jm.shape:
+            raise ValueError(
+                f"mesh has axes {tuple(jm.shape)}; ZeRO axis {axis!r} not "
+                "among them (pass axis=... to pick one)")
+        return jm, axis
+    from .topology import get_hybrid_communicate_group
+
+    hcg = get_hybrid_communicate_group()
+    if hcg is None or hcg.mesh is None:
+        return None, None
+    if hcg.get_sharding_parallel_world_size() > 1:
+        return hcg.mesh.jax_mesh, "sharding"
+    if hcg.get_data_parallel_world_size() > 1:
+        return hcg.mesh.jax_mesh, "dp"
+    return None, None
+
+
+def _zero_dim(n, shape, axis="sharding", name=None):
+    """The single placement rule for ZeRO layouts: first dim evenly
+    divisible by n (None + one-time warning when nothing divides)."""
+    for i, s in enumerate(shape):
+        if s % n == 0 and s >= n:
+            return i
+    if shape and name not in _degrade_warned:
+        _degrade_warned.add(name)
+        warnings.warn(
+            f"ZeRO sharding: no dim of {name or 'param'} {tuple(shape)} "
+            f"divides {axis}={n}; state stays replicated")
+    return None
+
+
+def _zero_sharding(jax_mesh, axis, shape, name=None):
+    """NamedSharding putting ``axis`` on the first evenly divisible dim;
+    replicated (with a one-time warning) when nothing divides."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec = [None] * len(shape)
+    dim = _zero_dim(jax_mesh.shape[axis], shape, axis, name)
+    if dim is not None:
+        spec[dim] = axis
+    return NamedSharding(jax_mesh, PartitionSpec(*spec))
+
+
+def _replicated(jax_mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(jax_mesh, PartitionSpec())
 
 
 class DygraphShardingOptimizer:
@@ -69,24 +128,95 @@ class DygraphShardingOptimizer:
 
 
 class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
-    """Stage 2 optimizer facade (group_sharded_optimizer_stage2.py)."""
+    """Stage 2 optimizer (group_sharded_optimizer_stage2.py semantics,
+    SPMD form): every step, gradients are resharded onto the ZeRO layout
+    (the reduce-scatter — each device keeps 1/n of every grad), the inner
+    update runs on the sharded grads/moments/master-weights, and the
+    parameters are re-replicated (the reference's post-update param
+    broadcast).  Optimizer state lives sharded: per-device state bytes
+    are 1/n of the replicated size (asserted by tests)."""
 
     def __init__(self, params, optim, group=None, offload=False,
-                 device="tpu", **kwargs):
+                 device="tpu", mesh=None, axis=None, reshard_params=False,
+                 **kwargs):
         super().__init__(optim, None)
         self.offload = offload
+        self._jax_mesh, self._axis = _resolve_mesh_axis(mesh, axis)
+        self._reshard_params = reshard_params  # True = stage 3
+
+    def _zero_put(self, arr, name=None):
+        import jax
+
+        sh = _zero_sharding(self._jax_mesh, self._axis, arr.shape, name)
+        return jax.device_put(arr, sh)
+
+    def step(self):
+        if self._jax_mesh is None:
+            return self._inner_opt.step()
+        import jax
+
+        opt = self._inner_opt
+        params = [p for p in opt._parameter_list() if p.trainable]
+        # 1. reduce-scatter analog: grads onto the ZeRO layout.
+        for p in params:
+            if p.grad is not None:
+                p.grad._data = self._zero_put(p.grad._data, p.name)
+        opt.step()
+        # 2. optimizer state (lazily created by the inner step) sharded;
+        # scalar slots (beta_pow etc.) stay replicated.
+        for p in params:
+            slots = opt._accumulators.get(id(p), {})
+            for k, v in list(slots.items()):
+                if hasattr(v, "shape") and tuple(v.shape) == tuple(p.shape):
+                    slots[k] = self._zero_put(v, f"{p.name}.{k}")
+            mw = opt._master_weights.get(id(p))
+            if mw is not None:
+                opt._master_weights[id(p)] = self._zero_put(
+                    mw, f"{p.name}.master")
+        # 3. parameters: replicated again (stage 2) or sharded at rest
+        # (stage 3 — the allgather-on-use happens inside XLA).
+        for p in params:
+            if self._reshard_params:
+                p._data = self._zero_put(p._data, p.name)
+            else:
+                p._data = jax.device_put(p._data,
+                                         _replicated(self._jax_mesh))
 
 
 class GroupShardedStage2(Layer):
-    """Stage 2 model wrapper (group_sharded_stage2.py:715-LoC analog)."""
+    """Stage 2 model wrapper (group_sharded_stage2.py:715-LoC analog):
+    registers gradient hooks that reshard each parameter's accumulated
+    grad onto the ZeRO layout as backward produces it — the EagerReducer-
+    style overlapped reduce-scatter (reference reduce hooks)."""
 
     def __init__(self, layer, sharding_optimizer, group=None,
-                 sync_buffers=False, buffer_max_size=2 ** 23, **kwargs):
+                 sync_buffers=False, buffer_max_size=2 ** 23, mesh=None,
+                 axis=None, **kwargs):
         super().__init__()
         self._layers = layer
         self.add_sublayer("_layers", layer)
         self._sharding_optimizers = [sharding_optimizer] if not isinstance(
             sharding_optimizer, list) else sharding_optimizer
+        self._jax_mesh, self._axis = _resolve_mesh_axis(mesh, axis)
+        if self._jax_mesh is not None:
+            self._register_grad_hooks()
+
+    def _register_grad_hooks(self):
+        import jax
+
+        from ...core.tensor import Tensor
+
+        for p in self._layers.parameters():
+            if not p.trainable:
+                continue
+
+            def hook(g, _name=p.name):
+                sh = _zero_sharding(self._jax_mesh, self._axis,
+                                    g._data.shape, _name)
+                return Tensor(jax.device_put(g._data, sh),
+                              stop_gradient=True)
+
+            p.register_hook(hook)
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
@@ -131,8 +261,7 @@ class GroupShardedStage3(GroupShardedStage2):
             for pname, p in list(sub._parameters.items()):
                 if p is None:
                     continue
-                dim = next((i for i, s in enumerate(p.shape)
-                            if s % n == 0 and s >= n), None)
+                dim = _zero_dim(n, p.shape, axis, p.name)
                 if dim is None:
                     continue
                 placements = [Shard(dim) if name == axis else Replicate()
@@ -158,7 +287,8 @@ def group_sharded_parallel(model, optimizer, level, scaler=None,
         raise ValueError(
             f"level must be one of 'os', 'os_g', 'p_g_os', got {level!r}")
     opt = GroupShardedOptimizerStage2([], optimizer, group=group,
-                                      offload=offload)
+                                      offload=offload,
+                                      reshard_params=(level == "p_g_os"))
     if level == "os":
         return model, opt, scaler
     if level == "os_g":

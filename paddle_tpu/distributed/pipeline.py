@@ -75,7 +75,6 @@ def spmd_pipeline(mesh, stage_fn, last_fn, axis="pp", dp_axis=None,
     value_and_grad.
     """
     P = mesh.shape[axis]
-    axes = (axis,) if dp_axis is None else (axis, dp_axis)
     body = jax.checkpoint(stage_fn, prevent_cse=False) if remat else stage_fn
 
     def local(stage_params, last_params, xs, ys, extra):

@@ -397,7 +397,10 @@ def take_along_axis(x, indices, axis, broadcast=True, name=None):
 
 def _put_along_fwd(x, index, value, axis=0, reduce="assign"):
     out = _put_along_plain(x, index, value, axis, reduce)
-    return out, (x, index, value)
+    # multiply's backward needs the forward output; other reduces don't —
+    # don't hold the extra residual for them.
+    keep = out if reduce in ("multiply", "mul") else None
+    return out, (x, index, value, keep)
 
 
 def _put_along_plain(x, index, value, axis=0, reduce="assign"):
@@ -412,7 +415,7 @@ def _put_along_plain(x, index, value, axis=0, reduce="assign"):
 
 
 def _put_along_bwd(saved, g, axis=0, reduce="assign"):
-    x, index, value = saved
+    x, index, value, out = saved
     ii = _along_axis_idx(index, axis, jnp.shape(x))
     gv = g[ii]
     if reduce == "assign":
@@ -425,7 +428,6 @@ def _put_along_bwd(saved, g, axis=0, reduce="assign"):
         # indices are unique, best-effort for duplicated zero writes.
         vb = jnp.broadcast_to(value, gv.shape).astype(g.dtype)
         gx = g.at[ii].multiply(vb)
-        out = _put_along_plain(x, index, value, axis, reduce)
         gv = gv * jnp.where(vb == 0, x[ii].astype(gv.dtype),
                             out[ii].astype(gv.dtype) / jnp.where(
                                 vb == 0, jnp.ones_like(vb), vb))

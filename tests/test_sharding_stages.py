@@ -1,0 +1,146 @@
+"""ZeRO stage 2/3 semantics tests (VERDICT r1 item 4).
+
+Mirrors the reference's group-sharded tests
+(test/collective/fleet/dygraph_group_sharded_stage2.py etc.): numeric
+parity vs unsharded training PLUS memory assertions — per-device state
+shard bytes must be 1/n of the replicated size.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.sharding import (
+    GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3,
+    group_sharded_parallel,
+)
+
+N_DEV = 8
+
+
+@pytest.fixture()
+def hcg_sharding8():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": N_DEV}
+    fleet.init(is_collective=True, strategy=strategy)
+    return fleet.fleet.get_hybrid_communicate_group()
+
+
+def _mlp(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 16))
+
+
+def _data(seed=1):
+    rng = np.random.RandomState(seed)
+    x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    return x, y
+
+
+def _train(model, opt, x, y, steps=3):
+    losses = []
+    for _ in range(steps):
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(loss.item())
+    return losses
+
+
+def _shard_bytes(arr):
+    return arr.addressable_shards[0].data.nbytes
+
+
+def test_stage2_parity_and_state_sharding(hcg_sharding8):
+    model = _mlp()
+    sd = {k: v.numpy().copy() for k, v in model.state_dict().items()}
+    x, y = _data()
+
+    inner = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                   parameters=model.parameters())
+    opt = GroupShardedOptimizerStage2(model.parameters(), inner)
+    wrapped = GroupShardedStage2(model, opt)
+    losses = _train(wrapped, opt, x, y)
+
+    # Parity vs plain unsharded training.
+    ref = _mlp()
+    ref.set_state_dict({k: paddle.to_tensor(v) for k, v in sd.items()})
+    ref_opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=ref.parameters())
+    ref_losses = _train(ref, ref_opt, x, y)
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5)
+    for (n1, p1), (n2, p2) in zip(model.named_parameters(),
+                                  ref.named_parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-4,
+                                   atol=1e-6)
+
+    # Optimizer moments sharded: per-device bytes == total/8.
+    w = dict(model.named_parameters())["0.weight"]
+    slots = inner._accumulators[id(w)]
+    checked = 0
+    for k, v in slots.items():
+        if hasattr(v, "shape") and tuple(v.shape) == tuple(w.shape):
+            assert len(v.sharding.device_set) == N_DEV, (k, v.sharding)
+            assert _shard_bytes(v) * N_DEV == v.nbytes, k
+            checked += 1
+    assert checked >= 2  # moment1 + moment2
+    # Parameters stay replicated in stage 2.
+    assert _shard_bytes(w._data) == w._data.nbytes
+
+
+def test_stage2_grad_hook_reduce_scatter(hcg_sharding8):
+    model = _mlp(seed=2)
+    inner = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                   parameters=model.parameters())
+    opt = GroupShardedOptimizerStage2(model.parameters(), inner)
+    wrapped = GroupShardedStage2(model, opt)
+    x, y = _data(seed=3)
+    loss = ((wrapped(x) - y) ** 2).mean()
+    loss.backward()
+    g = dict(model.named_parameters())["0.weight"].grad
+    # Grad landed in the ZeRO layout at backward time (hook), before any
+    # optimizer step: per-device shard is 1/8 of the bytes.
+    assert _shard_bytes(g._data) * N_DEV == g._data.nbytes, g._data.sharding
+
+
+def test_stage3_params_sharded_at_rest(hcg_sharding8):
+    model = _mlp(seed=4)
+    sd = {k: v.numpy().copy() for k, v in model.state_dict().items()}
+    x, y = _data(seed=5)
+
+    inner = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                   parameters=model.parameters())
+    model2, opt, _ = group_sharded_parallel(model, inner, "p_g_os")
+    assert isinstance(model2, GroupShardedStage3)
+    w = dict(model.named_parameters())["0.weight"]
+    assert _shard_bytes(w._data) * N_DEV == w._data.nbytes, \
+        w._data.sharding
+
+    losses = _train(model2, opt, x, y)
+    assert all(np.isfinite(v) for v in losses)
+    # still sharded after updates
+    assert _shard_bytes(w._data) * N_DEV == w._data.nbytes
+
+    ref = _mlp(seed=4)
+    ref.set_state_dict({k: paddle.to_tensor(v) for k, v in sd.items()})
+    ref_opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=ref.parameters())
+    ref_losses = _train(ref, ref_opt, x, y)
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5)
+
+
+def test_group_sharded_parallel_levels(hcg_sharding8):
+    for level in ("os", "os_g", "p_g_os"):
+        model = _mlp(seed=6)
+        inner = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                       parameters=model.parameters())
+        m2, opt, _ = group_sharded_parallel(model, inner, level)
+        x, y = _data(seed=7)
+        losses = _train(m2, opt, x, y, steps=2)
+        assert losses[-1] < losses[0], (level, losses)
