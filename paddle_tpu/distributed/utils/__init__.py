@@ -1,0 +1,10 @@
+"""paddle.distributed.utils parity: MoE token-exchange primitives.
+
+Reference: ``python/paddle/distributed/utils/moe_utils.py``.
+"""
+from .moe_utils import (  # noqa: F401
+    dispatch_masks,
+    ep_moe_local,
+    global_gather,
+    global_scatter,
+)

@@ -1,0 +1,134 @@
+"""Expert-parallel MoE token exchange.
+
+Reference: ``python/paddle/distributed/utils/moe_utils.py:20`` (global_scatter)
+and ``:153`` (global_gather) — imperative NCCL all-to-alls moving a ragged,
+count-described token buffer between expert-parallel ranks; used by
+``incubate/distributed/models/moe/moe_layer.py:263``.
+
+TPU-native re-design: ragged count-based exchange is hostile to XLA (dynamic
+shapes defeat MXU tiling), so the exchange is expressed over *fixed-capacity*
+buffers.  Each source device builds ``[E, C, H]`` — its contribution to every
+expert, C slots per (expert, source) — and one ``lax.all_to_all`` over the
+'ep' mesh axis delivers ``[E_local, n*C, H]`` to each expert owner.  The
+inverse all-to-all returns expert outputs to token owners.  Capacity C plays
+the role of the reference's local_count/global_count bookkeeping; overflow
+tokens are dropped exactly as the reference's capacity-clipped gates do.
+
+These helpers are jax-level and must run inside a ``shard_map`` region whose
+mesh binds ``axis_name`` (see ``MoELayer(dispatch_mode='alltoall')``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def global_scatter(expert_in, axis_name, n):
+    """Send per-expert token buffers to the experts' owner devices.
+
+    expert_in: [E, C, H] — this device's contribution to every global expert
+    (expert e lives on device ``e // (E//n)``).  Returns [E_local, n*C, H]:
+    the local experts' inputs, slots grouped by source device.
+    """
+    E, C, H = expert_in.shape
+    e_local = E // n
+    x = expert_in.reshape(n, e_local, C, H)
+    # After the exchange, leading axis indexes the *source* device.
+    y = jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                           tiled=True)
+    return y.transpose(1, 0, 2, 3).reshape(e_local, n * C, H)
+
+
+def global_gather(expert_out, axis_name, n):
+    """Inverse of :func:`global_scatter`.
+
+    expert_out: [E_local, n*C, H] (local experts' outputs, slots grouped by
+    source device).  Returns [E, C, H]: this device's slots filled with the
+    outputs of every global expert.
+    """
+    e_local, nC, H = expert_out.shape
+    C = nC // n
+    x = expert_out.reshape(e_local, n, C, H).transpose(1, 0, 2, 3)
+    y = jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                           tiled=True)
+    return y.reshape(n * e_local, C, H)
+
+
+def dispatch_masks(probs, idx, num_experts, capacity):
+    """Capacity-clipped routing masks from gate decisions.
+
+    probs: [T, E] gate probabilities; idx: [T, k] top-k expert ids.
+    Returns (dispatch [T, E, C], slot_mask [T, k, E, C], keep [T, k]) —
+    constant (stop-gradient) routing masks; gradients train the gate through
+    the combine weights and the aux loss, as in the reference gates.
+    """
+    T, E = probs.shape
+    k = idx.shape[-1]
+    C = capacity
+    assign = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [T, k, E]
+    assign_te = assign.reshape(T * k, E)
+    pos_in_e = jnp.cumsum(assign_te, axis=0) - 1.0
+    pos = jnp.sum(pos_in_e * assign_te, axis=-1).reshape(T, k)
+    keep = pos < C
+    pos = jnp.clip(pos, 0, C - 1).astype(jnp.int32)
+    cap_onehot = jax.nn.one_hot(pos, C, dtype=jnp.float32)  # [T, k, C]
+    assign_kept = assign * keep[..., None].astype(jnp.float32)
+    dispatch = jnp.einsum("tke,tkc->tec", assign_kept, cap_onehot)
+    slot_mask = jnp.einsum("tke,tkc->tkec", assign_kept, cap_onehot)
+    dispatch = jax.lax.stop_gradient(dispatch)
+    slot_mask = jax.lax.stop_gradient(slot_mask)
+    return dispatch, slot_mask, jax.lax.stop_gradient(keep)
+
+
+def _aux_loss(probs, idx, num_experts, kind, axis_name=None):
+    """GShard/Switch load-balance loss: E * sum_e(me * ce)."""
+    if kind == "naive":
+        return jnp.zeros([], jnp.float32)
+    p32 = probs.astype(jnp.float32)
+    top1 = idx[:, 0]
+    me = jnp.mean(p32, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(top1, num_experts, dtype=jnp.float32),
+                  axis=0)
+    if axis_name is not None:
+        me = jax.lax.pmean(me, axis_name)
+        ce = jax.lax.pmean(ce, axis_name)
+    return jnp.sum(me * ce) * num_experts
+
+
+def ep_moe_local(tokens, wg, w1, b1, w2, b2, *, axis_name, n, num_experts,
+                 top_k, capacity, activation, gate_kind):
+    """Per-device EP MoE body (runs inside shard_map over ``axis_name``).
+
+    tokens: [T_local, H]; wg: [H, E] replicated gate; w1/b1/w2/b2: this
+    device's expert slice ([E_local, H, F] etc).  Returns (out [T_local, H],
+    aux_loss scalar).
+    """
+    E = num_experts
+    logits = tokens.astype(jnp.float32) @ wg.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    _, idx = jax.lax.top_k(probs, top_k)
+    aux = _aux_loss(probs, idx, E, gate_kind, axis_name)
+
+    dispatch, slot_mask, keep = dispatch_masks(probs, idx, E, capacity)
+
+    gate_w = jnp.take_along_axis(probs, idx, axis=-1)  # [T, k]
+    if top_k > 1:
+        denom = jnp.clip(jnp.sum(gate_w, axis=-1, keepdims=True), 1e-9)
+        gate_w = gate_w / denom
+    gate_w = gate_w * keep.astype(gate_w.dtype)
+
+    cdt = tokens.dtype
+    expert_in = jnp.einsum("tec,th->ech", dispatch.astype(cdt), tokens)
+    xin = global_scatter(expert_in, axis_name, n)  # [E_local, n*C, H]
+    if activation == "gelu":
+        # Match ops.gelu (exact erf form), not jax.nn.gelu's tanh default.
+        def act(v):
+            return jax.nn.gelu(v, approximate=False)
+    else:
+        act = getattr(jax.nn, activation)
+    h = act(jnp.einsum("ech,ehf->ecf", xin, w1) + b1)
+    y_local = jnp.einsum("ecf,efh->ech", h, w2) + b2
+    y = global_gather(y_local, axis_name, n)  # [E, C, H]
+    slot_out = jnp.einsum("ech,tkec->tkh", y, slot_mask.astype(cdt))
+    out = jnp.einsum("tkh,tk->th", slot_out, gate_w.astype(cdt))
+    return out, aux

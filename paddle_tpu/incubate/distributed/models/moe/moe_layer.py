@@ -59,7 +59,12 @@ class MoELayer(Layer):
     def __init__(self, d_model, d_hidden=None, num_experts=8, experts=None,
                  gate=None, top_k=2, capacity_factor=1.25,
                  moe_group=None, mp_group=None, activation="gelu",
-                 recompute_interval=0, mesh=None, ep_axis="ep"):
+                 recompute_interval=0, mesh=None, ep_axis="ep",
+                 dispatch_mode="gspmd"):
+        """dispatch_mode: 'gspmd' routes via sharded einsums (GSPMD inserts
+        the collectives); 'alltoall' runs the explicit expert-parallel
+        exchange (global_scatter/global_gather all-to-alls under shard_map,
+        matching the reference's moe_utils.py:20,153 semantics)."""
         super().__init__()
         self.d_model = d_model
         self.num_experts = num_experts
@@ -67,6 +72,21 @@ class MoELayer(Layer):
         self.capacity_factor = capacity_factor
         self.mesh = mesh
         self.ep_axis = ep_axis
+        self.dispatch_mode = dispatch_mode
+        self._ep_op = None
+        if dispatch_mode == "alltoall":
+            if mesh is None or ep_axis not in mesh.dim_names:
+                raise ValueError(
+                    "dispatch_mode='alltoall' needs a mesh with an "
+                    f"'{ep_axis}' axis; got mesh={mesh}")
+            if isinstance(experts, (list, tuple)):
+                raise ValueError(
+                    "dispatch_mode='alltoall' needs stacked experts "
+                    "(ExpertFFN), not a per-expert layer list")
+            if num_experts % mesh.get_dim_size(ep_axis) != 0:
+                raise ValueError(
+                    f"num_experts={num_experts} must divide over the "
+                    f"'{ep_axis}' axis size {mesh.get_dim_size(ep_axis)}")
         if gate is None:
             gate = "gshard"
         if isinstance(gate, str):
@@ -79,7 +99,7 @@ class MoELayer(Layer):
                                             d_hidden or 4 * d_model,
                                             activation)
         if mesh is not None and ep_axis in mesh.dim_names:
-            from ....distributed.auto_parallel import (
+            from .....distributed.auto_parallel import (
                 Replicate, Shard, shard_tensor,
             )
 
@@ -89,7 +109,76 @@ class MoELayer(Layer):
                 self.experts._parameters[pname] = shard_tensor(
                     p, mesh, placements)
 
+    def _gate_kind(self):
+        # isinstance so gate subclasses keep their load-balance loss.
+        if isinstance(self.gate, SwitchGate):
+            return "switch"
+        if isinstance(self.gate, GShardGate):
+            return "gshard"
+        return "naive"
+
+    def _ep_opdef(self):
+        """Single OpDef running the shard_map EP exchange; capacity is
+        derived from the (trace-time static) token count, so jit's own
+        per-shape cache handles varying batch/sequence sizes."""
+        if self._ep_op is not None:
+            return self._ep_op
+        import functools
+
+        from jax.sharding import PartitionSpec as P
+
+        from .....distributed.utils import moe_utils
+        from .....ops.registry import OpDef
+
+        mesh = self.mesh
+        ep = self.ep_axis
+        n = mesh.get_dim_size(ep)
+        E, k = self.num_experts, self.top_k
+        cf = self.capacity_factor
+        activation = self.experts.activation
+        gate_kind = self._gate_kind()
+        tok_spec = P(ep) if n > 1 else P()
+        espec = P(ep) if n > 1 else P()
+
+        def fn(tokens, wg, w1, b1, w2, b2):
+            T_local = tokens.shape[0] // n
+            C = max(1, int(math.ceil(T_local * cf * k / E)))
+            C = min(C, T_local)
+            body = functools.partial(
+                moe_utils.ep_moe_local, axis_name=ep, n=n, num_experts=E,
+                top_k=k, capacity=C, activation=activation,
+                gate_kind=gate_kind)
+            mapped = jax.shard_map(
+                body, mesh=mesh.jax_mesh,
+                in_specs=(tok_spec, P(), espec, espec, espec, espec),
+                out_specs=(tok_spec, P()))
+            return mapped(tokens, wg, w1, b1, w2, b2)
+
+        self._ep_op = OpDef("moe_ep_alltoall", fn, n_outputs=2)
+        return self._ep_op
+
+    def _forward_alltoall(self, x):
+        """Explicit expert-parallel forward (all-to-all token exchange)."""
+        from .....ops import registry
+
+        B, S, H = x.shape
+        T = B * S
+        tokens = ops.reshape(x, [T, H])
+        e = self.experts
+        if T % self.mesh.get_dim_size(self.ep_axis) != 0:
+            raise ValueError(
+                f"token count {T} must divide over the '{self.ep_axis}' "
+                f"axis size {self.mesh.get_dim_size(self.ep_axis)}")
+        out, aux = registry.apply(self._ep_opdef(), tokens, self.gate.wg,
+                                  e.w1, e.b1, e.w2, e.b2)
+        self.gate.loss = aux
+        return ops.reshape(out, [B, S, H])
+
     def forward(self, x):
+        if self.dispatch_mode == "alltoall":
+            return self._forward_alltoall(x)
+        from .....distributed.utils import moe_utils as _mu
+
         B, S, H = x.shape
         T = B * S
         E = self.num_experts
@@ -104,20 +193,9 @@ class MoELayer(Layer):
         p = probs._data
         idx = topk_idx._data  # [T, k]
         k = idx.shape[-1]
-        assign = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [T, k, E]
-        # Position of each (token, slot) in its expert's capacity buffer.
-        assign_te = assign.reshape(T * k, E)
-        pos_in_e = jnp.cumsum(assign_te, axis=0) - 1.0
-        pos = jnp.sum(pos_in_e * assign_te, axis=-1).reshape(T, k)
-        keep = pos < C
-        pos = jnp.clip(pos, 0, C - 1).astype(jnp.int32)
-        cap_onehot = jax.nn.one_hot(pos, C, dtype=jnp.float32)  # [T, k, C]
-        assign_kept = assign * keep[..., None].astype(jnp.float32)
-        # dispatch [T, E, C] is a constant routing mask.
-        dispatch = Tensor(jnp.einsum("tke,tkc->tec", assign_kept,
-                                     cap_onehot).astype(p.dtype))
-        slot_mask = Tensor(jnp.einsum("tke,tkc->tkec", assign_kept,
-                                      cap_onehot).astype(p.dtype))
+        dispatch_d, slot_mask_d, keep = _mu.dispatch_masks(p, idx, E, C)
+        dispatch = Tensor(dispatch_d.astype(p.dtype))
+        slot_mask = Tensor(slot_mask_d.astype(p.dtype))
 
         # Differentiable path: gate weights from probs, expert FFN, combine.
         gate_w = ops.take_along_axis(probs, topk_idx, axis=-1)  # [T, k]
