@@ -15,11 +15,21 @@ _cache: dict = {}
 def cached_cast(t, target):
     from ..ops.manipulation import cast
 
+    from ..autograd import engine as _engine
+
     key = (id(t), str(target))
     hit = _cache.get(key)
     if hit is not None:
         src_ref, out = hit
-        if src_ref() is t:
+        node = getattr(out, "_grad_node", None)
+        # Reuse only within a step: once backward released the cast node's
+        # residuals, a second backward through it would fail.  And a cast
+        # recorded under no_grad (node is None) must not serve a
+        # grad-enabled step — it would silently cut the source's gradient.
+        need_node = (_engine.is_grad_enabled()
+                     and not getattr(t, "stop_gradient", True))
+        if (src_ref() is t and not getattr(node, "released", False)
+                and not (need_node and node is None)):
             return out
     out = cast(t, target)
     try:

@@ -63,6 +63,7 @@ def dispatch_masks(probs, idx, num_experts, capacity):
     the combine weights and the aux loss, as in the reference gates.
     """
     T, E = probs.shape
+    assert E == num_experts, (E, num_experts)
     k = idx.shape[-1]
     C = capacity
     assign = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [T, k, E]

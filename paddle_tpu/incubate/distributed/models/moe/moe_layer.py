@@ -94,6 +94,15 @@ class MoELayer(Layer):
                     "switch": SwitchGate}[gate](d_model, num_experts,
                                                 topk=top_k)
         self.gate = gate
+        if (dispatch_mode == "alltoall"
+                and type(gate) not in (NaiveGate, GShardGate, SwitchGate)):
+            # The EP path re-expresses the gate inside shard_map (it cannot
+            # call an arbitrary gate.forward); a custom gate would silently
+            # route differently from the gspmd path.
+            raise ValueError(
+                "dispatch_mode='alltoall' supports the built-in "
+                "Naive/GShard/Switch gates only; use "
+                "dispatch_mode='gspmd' for custom gates")
         self.top_k = getattr(gate, "topk", top_k)
         self.experts = experts or ExpertFFN(num_experts, d_model,
                                             d_hidden or 4 * d_model,

@@ -102,3 +102,27 @@ def test_nested_non_persistable_buffers_excluded():
     sd = model.state_dict()
     assert not any("rope_cos" in k or "rope_sin" in k for k in sd), \
         [k for k in sd if "rope" in k]
+
+
+def test_amp_cast_cache_survives_backward_and_no_grad():
+    """Review regressions: (a) a second AMP step must not backward through
+    a released cast node; (b) a cast cached under no_grad must not serve a
+    grad-enabled step (it would silently cut the parameter's gradient)."""
+    from paddle_tpu import amp, nn
+
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+
+    # (b) eval pass under no_grad first populates the cache gradless.
+    with paddle.no_grad():
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            lin(x)
+    for _ in range(2):  # (a) two consecutive training steps
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = lin(x)
+        out.sum().backward()
+        assert lin.weight.grad is not None
+        assert float(np.abs(lin.weight.grad.numpy()).sum()) > 0
+        lin.weight.clear_grad()
+        lin.bias.clear_grad()

@@ -1,0 +1,156 @@
+"""hapi Model.fit/evaluate/predict (reference python/paddle/hapi/model.py:1082)
+including the BASELINE config-1 slice: a vision ResNet trained on fake data
+through Model.fit with DataLoader + metrics + AMP.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.hapi import Model
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+
+
+class FakeClassifyData(Dataset):
+    def __init__(self, n=32, shape=(8,), classes=4, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, *shape).astype(np.float32)
+        self.y = rng.randint(0, classes, size=(n, 1)).astype(np.int64)
+        # make it learnable: class encoded in the first feature dims
+        for i in range(n):
+            self.x[i, self.y[i, 0] % shape[0]] += 3.0
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _mlp(in_dim=8, classes=4):
+    return nn.Sequential(
+        nn.Linear(in_dim, 32), nn.ReLU(), nn.Linear(32, classes))
+
+
+def test_fit_decreases_loss_and_tracks_accuracy():
+    paddle.seed(0)
+    net = _mlp()
+    model = Model(net)
+    model.prepare(paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    data = FakeClassifyData(64)
+    first = model.fit(data, batch_size=16, epochs=1, verbose=0)
+    last = model.fit(data, batch_size=16, epochs=3, verbose=0)
+    assert last["loss"] < first["loss"]
+    assert last["accuracy"] > 0.5
+
+
+def test_evaluate_and_predict():
+    paddle.seed(1)
+    net = _mlp()
+    model = Model(net)
+    model.prepare(paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    data = FakeClassifyData(48)
+    model.fit(data, batch_size=16, epochs=4, verbose=0)
+    logs = model.evaluate(data, batch_size=16, verbose=0)
+    assert "loss" in logs and "accuracy" in logs
+    assert logs["accuracy"] > 0.5
+    preds = model.predict(data, batch_size=16, stack_outputs=True,
+                          verbose=0)
+    assert preds.shape == (48, 4)
+    top = preds.argmax(-1)
+    acc = (top.reshape(-1, 1) == data.y).mean()
+    assert abs(acc - logs["accuracy"]) < 0.2
+
+
+def test_save_load_roundtrip():
+    paddle.seed(2)
+    net = _mlp()
+    model = Model(net)
+    model.prepare(paddle.optimizer.SGD(learning_rate=0.01,
+                                       parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    data = FakeClassifyData(32)
+    model.fit(data, batch_size=16, epochs=1, verbose=0)
+    ref = model.predict(data, batch_size=16, stack_outputs=True)
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "ckpt", "final")
+        model.save(prefix)
+        assert os.path.exists(prefix + ".pdparams")
+        assert os.path.exists(prefix + ".pdopt")
+
+        paddle.seed(99)
+        net2 = _mlp()
+        model2 = Model(net2)
+        model2.prepare(paddle.optimizer.SGD(learning_rate=0.01,
+                                            parameters=net2.parameters()),
+                       nn.CrossEntropyLoss())
+        model2.load(prefix)
+        got = model2.predict(data, batch_size=16, stack_outputs=True)
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_callbacks_early_stopping_and_lr():
+    from paddle_tpu.hapi.callbacks import EarlyStopping
+
+    paddle.seed(3)
+    net = _mlp()
+    model = Model(net)
+    model.prepare(paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    data = FakeClassifyData(32)
+    es = EarlyStopping(monitor="loss", patience=0, baseline=-1.0)
+    model.fit(data, eval_data=data, batch_size=16, epochs=5, verbose=0,
+              callbacks=[es])
+    assert model.stop_training  # baseline=-1 is unbeatable -> stop at once
+
+
+def test_amp_o1_fit():
+    paddle.seed(4)
+    net = _mlp()
+    model = Model(net)
+    model.prepare(paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy(),
+                  amp_configs={"level": "O1", "dtype": "bfloat16"})
+    data = FakeClassifyData(32)
+    logs = model.fit(data, batch_size=16, epochs=3, verbose=0)
+    assert np.isfinite(logs["loss"])
+
+
+def test_vision_resnet_config1_slice():
+    """BASELINE config 1: vision model through Model.fit on fake images."""
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(5)
+    net = resnet18(num_classes=4)
+
+    class FakeImages(Dataset):
+        def __init__(self, n=8):
+            rng = np.random.RandomState(0)
+            self.x = rng.randn(n, 3, 32, 32).astype(np.float32)
+            self.y = rng.randint(0, 4, size=(n, 1)).astype(np.int64)
+
+        def __len__(self):
+            return len(self.x)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    model = Model(net)
+    model.prepare(paddle.optimizer.Momentum(learning_rate=0.01,
+                                            parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    logs = model.fit(FakeImages(), batch_size=4, epochs=1, verbose=0)
+    assert np.isfinite(logs["loss"])
+    info = model.summary()
+    assert info["total_params"] > 1e5
