@@ -1,0 +1,267 @@
+"""Auto-parallel Engine: compile a whole sharded train program from an
+annotated dygraph model.
+
+Reference: ``python/paddle/distributed/auto_parallel/static/engine.py:92``
+(Engine) — there: trace to a static program, run completion (sharding
+propagation), Partitioner (per-rank program split), Reshard (comm
+insertion), then a pass pipeline and the executor.  TPU-native: the author
+places ``shard_tensor`` annotations (directly or via the mpu layers);
+``rules_from_annotations`` collects them; GSPMD is the
+completion+partitioner+reshard, and jit is the executor.  One Engine works
+for ANY Layer + loss + optimizer — nothing is model-specific.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..models.training import (
+    CompiledTrainStep,
+    _adamw_tree_update,
+    rules_from_annotations,
+)
+from .auto_parallel import ProcessMesh
+
+
+def _l2_coeff(opt):
+    """Coupled (L2Decay) coefficient the eager base class folds into the
+    gradient (optimizer.py _apply_one)."""
+    from ..optimizer.optimizer import L2Decay
+
+    wd = getattr(opt, "_weight_decay", None)
+    if wd is None:
+        return 0.0
+    if isinstance(wd, L2Decay):
+        return wd.coeff
+    raise NotImplementedError(
+        f"Engine supports L2Decay regularization only, got {type(wd)}")
+
+
+def _with_l2(grads, master, coeff):
+    if not coeff:
+        return grads
+    return {k: grads[k] + coeff * master[k].astype(grads[k].dtype)
+            for k in grads}
+
+
+def _update_fn_from_optimizer(opt):
+    """Map an eager Optimizer instance onto a pure tree-update function
+    (master, grads, m, v, t, lr) -> (new_master, new_m, new_v) with the
+    same semantics its per-tensor ``step`` applies."""
+    from ..optimizer.optimizers import SGD, Adam, AdamW, Momentum
+
+    if isinstance(opt, AdamW):
+        beta1, beta2, eps = opt._beta1, opt._beta2, opt._epsilon
+        wd = opt._coeff
+        if getattr(opt, "_lr_ratio", None) is not None:
+            raise NotImplementedError("Engine does not support AdamW "
+                                      "lr_ratio")
+        decay_fn = opt._apply_decay_param_fun
+        # Keyed by the structured parameter name (named_parameters), the
+        # Engine analog of the eager path's tensor name.
+        no_decay = ((lambda k: not decay_fn(k)) if decay_fn is not None
+                    else (lambda k: False))
+
+        def fn(master, grads, m, v, t, lr):
+            return _adamw_tree_update(master, grads, m, v, t, lr, beta1,
+                                      beta2, eps, wd, no_decay)
+
+        return fn
+    if isinstance(opt, Adam):
+        beta1, beta2, eps = opt._beta1, opt._beta2, opt._epsilon
+        l2 = _l2_coeff(opt)
+
+        def fn(master, grads, m, v, t, lr):
+            grads = _with_l2(grads, master, l2)
+            return _adamw_tree_update(master, grads, m, v, t, lr, beta1,
+                                      beta2, eps, 0.0, lambda k: True)
+
+        return fn
+    if isinstance(opt, Momentum):
+        mu, nesterov = opt._momentum, opt._use_nesterov
+        l2 = _l2_coeff(opt)
+
+        def fn(master, grads, m, v, t, lr):
+            grads = _with_l2(grads, master, l2)
+            newp, newm = {}, {}
+            for k, p in master.items():
+                g = grads[k].astype(jnp.float32)
+                vel = mu * m[k].astype(jnp.float32) + g
+                step = (g + mu * vel) if nesterov else vel
+                newp[k] = (p.astype(jnp.float32) - lr * step).astype(p.dtype)
+                newm[k] = vel.astype(m[k].dtype)
+            return newp, newm, v
+
+        return fn
+    if isinstance(opt, SGD):
+        l2 = _l2_coeff(opt)
+
+        def fn(master, grads, m, v, t, lr):
+            grads = _with_l2(grads, master, l2)
+            newp = {k: (p.astype(jnp.float32)
+                        - lr * grads[k].astype(jnp.float32)).astype(p.dtype)
+                    for k, p in master.items()}
+            return newp, m, v
+
+        return fn
+    raise NotImplementedError(
+        f"Engine cannot compile optimizer {type(opt).__name__}; supported: "
+        "SGD, Momentum, Adam, AdamW")
+
+
+class Engine:
+    """paddle.distributed.auto_parallel Engine analog.
+
+    engine = Engine(model, loss=nn.CrossEntropyLoss(),
+                    optimizer=paddle.optimizer.AdamW(...), mesh=mesh)
+    engine.prepare()                       # compile the sharded step
+    loss = engine.step(x, y)               # one optimizer step
+    engine.fit(dataset, epochs=2, batch_size=32)
+    """
+
+    def __init__(self, model, loss=None, optimizer=None, metrics=None,
+                 strategy=None, mesh: ProcessMesh = None, dp_axis="dp",
+                 n_labels=1, compute_dtype=None, zero_opt_states=True,
+                 grad_clip_norm=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics or []
+        self.strategy = strategy
+        self.mesh = mesh
+        self.dp_axis = dp_axis
+        self.n_labels = n_labels if loss is not None else 0
+        self._compute_dtype = compute_dtype
+        self._zero = zero_opt_states
+        self._clip = grad_clip_norm
+        self._step = None
+        self._eval_fn = None
+        self._pred_fn = None
+
+    # -- build --------------------------------------------------------------
+
+    def prepare(self):
+        """Compile the train step: collect shard annotations, place state,
+        jit forward+backward+update as one XLA program."""
+        if self._step is not None:
+            return self
+        from ..optimizer.lr import LRScheduler
+
+        opt = self.optimizer
+        lr = 1e-3
+        update_fn = None
+        if opt is not None:
+            update_fn = _update_fn_from_optimizer(opt)
+            lr = opt._learning_rate
+            if not isinstance(lr, LRScheduler):
+                lr = float(lr)
+            clip = getattr(opt, "_grad_clip", None)
+            if self._clip is None and clip is not None:
+                from ..nn.clip import ClipGradByGlobalNorm
+
+                if not isinstance(clip, ClipGradByGlobalNorm):
+                    raise NotImplementedError(
+                        "Engine compiles global-norm clipping only "
+                        f"(ClipGradByGlobalNorm), got {type(clip).__name__}")
+                self._clip = float(clip.clip_norm)
+        self._step = CompiledTrainStep(
+            self.model, lr=lr, mesh=self.mesh,
+            shard_rules="auto" if self.mesh is not None else None,
+            dp_axis=self.dp_axis, zero_opt_states=self._zero,
+            compute_dtype=self._compute_dtype, update_fn=update_fn,
+            loss_fn=self.loss, n_labels=self.n_labels,
+            grad_clip_norm=self._clip)
+        return self
+
+    # -- stepping -----------------------------------------------------------
+
+    def step(self, *batch):
+        """One train step (forward + backward + update), compiled+sharded."""
+        self.prepare()
+        return self._step.step(*batch)
+
+    def fit(self, train_data, epochs=1, batch_size=32, shuffle=True,
+            num_workers=0, drop_last=True, verbose=1, log_freq=10):
+        from ..io import DataLoader, Dataset
+
+        self.prepare()
+        if isinstance(train_data, DataLoader):
+            loader = train_data
+        elif isinstance(train_data, Dataset):
+            loader = DataLoader(train_data, batch_size=batch_size,
+                                shuffle=shuffle, num_workers=num_workers,
+                                drop_last=drop_last)
+        else:
+            raise TypeError(f"expected Dataset/DataLoader, got "
+                            f"{type(train_data)}")
+        history = []
+        for epoch in range(epochs):
+            losses = []
+            for i, batch in enumerate(loader):
+                loss = self.step(*batch)
+                losses.append(float(np.asarray(loss)))
+                if verbose and i % log_freq == 0:
+                    print(f"epoch {epoch} step {i}: loss {losses[-1]:.4f}")
+            history.append(float(np.mean(losses)) if losses else None)
+            if verbose and history[-1] is not None:
+                print(f"epoch {epoch}: mean loss {history[-1]:.4f}")
+        return history
+
+    # -- inference ----------------------------------------------------------
+
+    def _forward_fn(self):
+        import jax
+
+        from ..jit.functional import functional_call
+
+        model = self.model
+
+        def fwd(params, *inputs):
+            return functional_call(model, params, *inputs)
+
+        return jax.jit(fwd)
+
+    def predict_batch(self, *inputs):
+        self.prepare()
+        if self._pred_fn is None:
+            self._pred_fn = self._forward_fn()
+        from ..core.tensor import Tensor
+
+        ins = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+               for i in inputs]
+        return self._pred_fn(self._step.params, *ins)
+
+    def evaluate_batch(self, *batch):
+        """Loss on one batch without an update (shares the train step's
+        pure loss function)."""
+        self.prepare()
+        if self._eval_fn is None:
+            import jax
+
+            self._eval_fn = jax.jit(self._step.loss_of)
+        from ..core.tensor import Tensor
+
+        b = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+             for x in batch]
+        return float(np.asarray(self._eval_fn(self._step.params, *b)))
+
+    # -- state --------------------------------------------------------------
+
+    def sync_to_model(self):
+        self._step.sync_to_model()
+
+    def state_dict(self):
+        self.prepare()
+        return self._step.state_dict()
+
+    def set_state_dict(self, state):
+        self.prepare()
+        self._step.set_state_dict(state)
+
+    @property
+    def shard_rules(self):
+        """The derived annotation-based rules (for inspection/tests)."""
+        if self.mesh is None:
+            return None
+        return rules_from_annotations(self.model, self.mesh)

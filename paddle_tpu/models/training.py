@@ -32,14 +32,18 @@ from ..distributed.auto_parallel import ProcessMesh
 from ..jit.functional import functional_call, param_tree
 
 
+def _clip_by_global_norm(grads, grad_clip_norm):
+    global_sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree.leaves(grads))
+    gnorm = jnp.sqrt(global_sq)
+    scale = jnp.minimum(1.0, grad_clip_norm / jnp.maximum(gnorm, 1e-12))
+    return jax.tree.map(lambda g: g * scale.astype(g.dtype), grads)
+
+
 def _adamw_tree_update(params, grads, m, v, t, lr, beta1, beta2, eps,
                        weight_decay, no_decay_fn, grad_clip_norm=None):
     if grad_clip_norm is not None:
-        global_sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                        for g in jax.tree.leaves(grads))
-        gnorm = jnp.sqrt(global_sq)
-        scale = jnp.minimum(1.0, grad_clip_norm / jnp.maximum(gnorm, 1e-12))
-        grads = jax.tree.map(lambda g: g * scale.astype(g.dtype), grads)
+        grads = _clip_by_global_norm(grads, grad_clip_norm)
     b1p = beta1 ** t
     b2p = beta2 ** t
     new_params, new_m, new_v = {}, {}, {}
@@ -63,6 +67,33 @@ def _default_no_decay(name):
     return "norm" in name or name.endswith(".bias") or "layernorm" in name
 
 
+def rules_from_annotations(model, mesh: ProcessMesh):
+    """Derive per-param shard rules from the placements already on the
+    model's parameters (as stamped by ``shard_tensor`` — e.g. the mpu
+    Column/Row/VocabParallel layers), replacing hand-written rule tables.
+
+    The reference's completion pass propagates dist_attrs over the whole
+    graph (``auto_parallel/static/completion.py``); on TPU that propagation
+    is GSPMD's job — reading the author-placed annotations here is the
+    analog of collecting the user's ``shard_tensor`` marks before it runs.
+    """
+    from jax.sharding import NamedSharding as _NS
+
+    specs = {}
+    for name, p in model.named_parameters():
+        sh = getattr(p._data, "sharding", None)
+        if isinstance(sh, _NS) and sh.mesh == mesh.jax_mesh:
+            spec = tuple(sh.spec) + (None,) * (p._data.ndim - len(sh.spec))
+            specs[name] = spec
+        else:
+            specs[name] = (None,) * p._data.ndim
+
+    def rules(name, shape):
+        return specs.get(name, (None,) * len(shape))
+
+    return rules
+
+
 class CompiledTrainStep:
     """One-XLA-program AdamW train step over a Layer.
 
@@ -75,7 +106,13 @@ class CompiledTrainStep:
                  weight_decay=0.01, grad_clip_norm=1.0, mesh: ProcessMesh
                  = None, shard_rules=None, dp_axis="dp", zero_opt_states=True,
                  compute_dtype=None, no_decay_fn=_default_no_decay,
-                 donate=True, moments_dtype="float32"):
+                 donate=True, moments_dtype="float32", update_fn=None,
+                 loss_fn=None, n_labels=1):
+        """update_fn(master, grads, m, v, t, lr) -> (new_master, m, v)
+        overrides the default AdamW update (grads arrive already clipped).
+        loss_fn, when given, makes the step treat the last ``n_labels``
+        batch elements as labels: loss = loss_fn(model(*inputs), *labels);
+        without it the model itself must return the loss."""
         self.model = model
         self.mesh = mesh
         self.lr = lr
@@ -112,6 +149,8 @@ class CompiledTrainStep:
 
         # -- shardings -----------------------------------------------------
         if mesh is not None:
+            if shard_rules == "auto":
+                shard_rules = rules_from_annotations(model, mesh)
             rules = shard_rules or (lambda name, shape: (None,) * len(shape))
             self._param_sharding = {
                 k: NamedSharding(mesh.jax_mesh,
@@ -139,17 +178,43 @@ class CompiledTrainStep:
         model_ref = model
         clip = grad_clip_norm
 
-        def loss_of(p, *batch):
-            out = functional_call(model_ref, p, *batch)
-            return jnp.asarray(out)
+        if loss_fn is not None:
+            def loss_of(p, *batch):
+                if n_labels:
+                    ins, labs = batch[:-n_labels], batch[-n_labels:]
+                else:
+                    ins, labs = batch, ()
+                out = functional_call(model_ref, p, *ins)
+                from ..autograd import engine as _engine
+                from ..core.tensor import Tensor as _T
+
+                wrapped = [_T(o) for o in (out if isinstance(
+                    out, (tuple, list)) else [out])]
+                lab_t = [_T(l) for l in labs]
+                with _engine.no_grad():  # jax.grad differentiates, not the tape
+                    res = loss_fn(*(wrapped + lab_t))
+                return jnp.asarray(res._data
+                                   if isinstance(res, _T) else res)
+        else:
+            def loss_of(p, *batch):
+                out = functional_call(model_ref, p, *batch)
+                return jnp.asarray(out)
+
+        self.loss_of = loss_of  # pure (params, *batch) -> scalar loss
 
         def step(params, master, m, v, t, lr_val, *batch):
             loss, grads = jax.value_and_grad(loss_of)(params, *batch)
-            # AdamW on fp32 master weights (multi-precision semantics:
-            # reference phi/kernels adamw multi_precision path).
-            newp, new_m, new_v = _adamw_tree_update(
-                master, grads, m, v, t, lr_val, beta1_, beta2_, eps_, wd_,
-                no_decay_fn, grad_clip_norm=clip)
+            if update_fn is not None:
+                if clip is not None:
+                    grads = _clip_by_global_norm(grads, clip)
+                newp, new_m, new_v = update_fn(master, grads, m, v, t,
+                                               lr_val)
+            else:
+                # AdamW on fp32 master weights (multi-precision semantics:
+                # reference phi/kernels adamw multi_precision path).
+                newp, new_m, new_v = _adamw_tree_update(
+                    master, grads, m, v, t, lr_val, beta1_, beta2_, eps_,
+                    wd_, no_decay_fn, grad_clip_norm=clip)
             cast_back = {k: newp[k].astype(params[k].dtype)
                          for k in params}
             return cast_back, newp, new_m, new_v, loss
