@@ -45,10 +45,11 @@ def _with_l2(grads, master, coeff):
             for k in grads}
 
 
-def _update_fn_from_optimizer(opt):
+def _update_fn_from_optimizer(opt, name_map=None):
     """Map an eager Optimizer instance onto a pure tree-update function
     (master, grads, m, v, t, lr) -> (new_master, new_m, new_v) with the
-    same semantics its per-tensor ``step`` applies."""
+    same semantics its per-tensor ``step`` applies.  name_map translates
+    tree keys (structured names) to ``p.name`` for name-keyed options."""
     from ..optimizer.optimizers import SGD, Adam, AdamW, Momentum
 
     if isinstance(opt, AdamW):
@@ -58,16 +59,23 @@ def _update_fn_from_optimizer(opt):
             raise NotImplementedError("Engine does not support AdamW "
                                       "lr_ratio")
         decay_fn = opt._apply_decay_param_fun
-        # Keyed by the structured parameter name (named_parameters), the
-        # Engine analog of the eager path's tensor name.
-        no_decay = ((lambda k: not decay_fn(k)) if decay_fn is not None
-                    else (lambda k: False))
+        if decay_fn is not None and name_map is not None:
+            # Eager AdamW keys the fn by p.name — translate the tree key
+            # (structured name) to it so both paths decay the same set.
+            def no_decay(k):
+                return not decay_fn(name_map.get(k, k))
+        elif decay_fn is not None:
+            def no_decay(k):
+                return not decay_fn(k)
+        else:
+            def no_decay(k):
+                return False
 
         def fn(master, grads, m, v, t, lr):
             return _adamw_tree_update(master, grads, m, v, t, lr, beta1,
                                       beta2, eps, wd, no_decay)
 
-        return fn
+        return fn, "mv"
     if isinstance(opt, Adam):
         beta1, beta2, eps = opt._beta1, opt._beta2, opt._epsilon
         l2 = _l2_coeff(opt)
@@ -77,7 +85,7 @@ def _update_fn_from_optimizer(opt):
             return _adamw_tree_update(master, grads, m, v, t, lr, beta1,
                                       beta2, eps, 0.0, lambda k: True)
 
-        return fn
+        return fn, "mv"
     if isinstance(opt, Momentum):
         mu, nesterov = opt._momentum, opt._use_nesterov
         l2 = _l2_coeff(opt)
@@ -93,7 +101,7 @@ def _update_fn_from_optimizer(opt):
                 newm[k] = vel.astype(m[k].dtype)
             return newp, newm, v
 
-        return fn
+        return fn, "m"
     if isinstance(opt, SGD):
         l2 = _l2_coeff(opt)
 
@@ -104,7 +112,7 @@ def _update_fn_from_optimizer(opt):
                     for k, p in master.items()}
             return newp, m, v
 
-        return fn
+        return fn, "none"
     raise NotImplementedError(
         f"Engine cannot compile optimizer {type(opt).__name__}; supported: "
         "SGD, Momentum, Adam, AdamW")
@@ -150,9 +158,11 @@ class Engine:
 
         opt = self.optimizer
         lr = 1e-3
-        update_fn = None
+        update_fn, moments = None, "mv"
         if opt is not None:
-            update_fn = _update_fn_from_optimizer(opt)
+            name_map = {k: p.name for k, p in
+                        self.model.named_parameters()}
+            update_fn, moments = _update_fn_from_optimizer(opt, name_map)
             lr = opt._learning_rate
             if not isinstance(lr, LRScheduler):
                 lr = float(lr)
@@ -171,7 +181,7 @@ class Engine:
             dp_axis=self.dp_axis, zero_opt_states=self._zero,
             compute_dtype=self._compute_dtype, update_fn=update_fn,
             loss_fn=self.loss, n_labels=self.n_labels,
-            grad_clip_norm=self._clip)
+            grad_clip_norm=self._clip, moments=moments)
         return self
 
     # -- stepping -----------------------------------------------------------
@@ -197,13 +207,15 @@ class Engine:
                             f"{type(train_data)}")
         history = []
         for epoch in range(epochs):
-            losses = []
+            losses = []  # device arrays: don't force a host sync per step
             for i, batch in enumerate(loader):
-                loss = self.step(*batch)
-                losses.append(float(np.asarray(loss)))
+                losses.append(self.step(*batch))
                 if verbose and i % log_freq == 0:
-                    print(f"epoch {epoch} step {i}: loss {losses[-1]:.4f}")
-            history.append(float(np.mean(losses)) if losses else None)
+                    print(f"epoch {epoch} step {i}: loss "
+                          f"{float(np.asarray(losses[-1])):.4f}")
+            history.append(
+                float(np.mean([np.asarray(l) for l in losses]))
+                if losses else None)
             if verbose and history[-1] is not None:
                 print(f"epoch {epoch}: mean loss {history[-1]:.4f}")
         return history

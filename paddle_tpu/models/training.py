@@ -107,7 +107,7 @@ class CompiledTrainStep:
                  = None, shard_rules=None, dp_axis="dp", zero_opt_states=True,
                  compute_dtype=None, no_decay_fn=_default_no_decay,
                  donate=True, moments_dtype="float32", update_fn=None,
-                 loss_fn=None, n_labels=1):
+                 loss_fn=None, n_labels=1, moments="mv"):
         """update_fn(master, grads, m, v, t, lr) -> (new_master, m, v)
         overrides the default AdamW update (grads arrive already clipped).
         loss_fn, when given, makes the step treat the last ``n_labels``
@@ -138,10 +138,14 @@ class CompiledTrainStep:
         # moments_dtype="bfloat16" halves optimizer-state HBM (the
         # reference's multi_precision=False adamw analog); the update math
         # still runs in fp32 (_adamw_tree_update casts per step).
-        self._m = {k: jnp.zeros_like(v, dtype=mdt)
-                   for k, v in params.items()}
-        self._v = {k: jnp.zeros_like(v, dtype=mdt)
-                   for k, v in params.items()}
+        # Allocate only the moment trees the update rule reads ("mv" for
+        # adam-family, "m" for momentum, "none" for sgd) — dead fp32
+        # moments on a large model are real HBM.
+        self._m = ({k: jnp.zeros_like(v, dtype=mdt)
+                    for k, v in params.items()} if moments in ("mv", "m")
+                   else {})
+        self._v = ({k: jnp.zeros_like(v, dtype=mdt)
+                    for k, v in params.items()} if moments == "mv" else {})
         # Copy: self.params must not alias the Layer's live buffers, or
         # donation would delete them out from under the eager model.
         self.params = {k: jnp.array(v) for k, v in params.items()}
@@ -285,12 +289,26 @@ class CompiledTrainStep:
         load_param_tree(self.model, self.params)
 
     def state_dict(self):
-        return {"params": self.params, "master": self._master,
-                "m": self._m, "v": self._v, "t": self._t}
+        # Copy (sharding-preserving): the live arrays are donated to the
+        # next jitted step, which would delete a checkpoint that merely
+        # aliased them.
+        cp = lambda tree: {k: v.copy() for k, v in tree.items()}  # noqa: E731
+        state = {"params": cp(self.params), "master": cp(self._master),
+                 "m": cp(self._m), "v": cp(self._v), "t": self._t}
+        from ..optimizer.lr import LRScheduler
+
+        if isinstance(self.lr, LRScheduler):
+            state["lr_scheduler"] = self.lr.state_dict()
+        return state
 
     def set_state_dict(self, state):
-        self.params = state["params"]
-        self._master = state["master"]
-        self._m = state["m"]
-        self._v = state["v"]
+        cp = lambda tree: {k: v.copy() for k, v in tree.items()}  # noqa: E731
+        self.params = cp(state["params"])
+        self._master = cp(state["master"])
+        self._m = cp(state["m"])
+        self._v = cp(state["v"])
         self._t = state["t"]
+        from ..optimizer.lr import LRScheduler
+
+        if "lr_scheduler" in state and isinstance(self.lr, LRScheduler):
+            self.lr.set_state_dict(state["lr_scheduler"])

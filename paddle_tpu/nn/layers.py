@@ -31,6 +31,9 @@ class HookRemoveHelper:
         self._hooks.pop(self._hook_id, None)
 
 
+_unique_ids = {"n": 0}
+
+
 class Layer:
     def __init__(self, name_scope=None, dtype="float32"):
         self.training = True
@@ -67,6 +70,12 @@ class Layer:
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
         data = init(shape, dtype_mod.convert_dtype(dtype))
+        if name is None:
+            # Reference-style auto names ("linear_0.w_0"): unique, and what
+            # apply_decay_param_fun / state-keyed APIs receive as p.name.
+            name = (f"{type(self).__name__.lower()}_{_unique_ids['n']}."
+                    f"{'b' if is_bias else 'w'}_0")
+            _unique_ids["n"] += 1
         p = EagerParamBase(data, name=name, trainable=trainable)
         p.optimize_attr["learning_rate"] = lr
         return p

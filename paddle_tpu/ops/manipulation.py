@@ -336,7 +336,10 @@ def _gather_bwd(saved, g, axis=0):
 
 
 def _index_add(z, index, g, axis):
-    idx = [slice(None)] * z.ndim
+    import builtins
+
+    # The module-level ``slice`` op (paddle API parity) shadows the builtin.
+    idx = [builtins.slice(None)] * z.ndim
     idx[axis] = index
     return z.at[tuple(idx)].add(g)
 

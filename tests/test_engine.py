@@ -146,6 +146,9 @@ def test_engine_fit_and_state_roundtrip():
 
     state = eng.state_dict()
     ev = eng.evaluate_batch(Data().x[:16], Data().y[:16])
+    # Stepping the source engine after checkpointing must not invalidate
+    # the saved arrays (donation would, if state_dict aliased them).
+    eng.step(Data().x[:16], Data().y[:16])
     eng2 = Engine(model, loss=nn.CrossEntropyLoss(),
                   optimizer=paddle.optimizer.Adam(
                       learning_rate=0.01, parameters=model.parameters()))
@@ -153,6 +156,7 @@ def test_engine_fit_and_state_roundtrip():
     eng2.set_state_dict(state)
     ev2 = eng2.evaluate_batch(Data().x[:16], Data().y[:16])
     np.testing.assert_allclose(ev2, ev, rtol=1e-5)
+    eng2.step(Data().x[:16], Data().y[:16])  # restored state is steppable
 
 
 def test_engine_weight_decay_parity():

@@ -1,0 +1,600 @@
+"""Table-driven op suite: every registered op gets a NumPy-golden forward
+check, a bf16 sweep, and a finite-difference gradient check (op_harness).
+
+Reference: ``test/legacy_test/op_test.py`` + the 1,076 per-op test files it
+powers; here one table covers the whole registry with a coverage gate so a
+newly registered op fails the suite until it gets a row (or a justified
+SKIP entry).
+"""
+import numpy as np
+import pytest
+import scipy.special as sp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.ops as ops
+from paddle_tpu.ops.registry import all_ops
+
+from op_harness import OpSpec
+
+R = np.random.RandomState(42)
+
+
+def fa(*s):
+    return R.randn(*s).astype(np.float32)
+
+
+def fpos(*s):
+    return (np.abs(R.randn(*s)) + 0.5).astype(np.float32)
+
+
+def funit(*s, lo=-0.9, hi=0.9):
+    return R.uniform(lo, hi, s).astype(np.float32)
+
+
+def ints(*s, lo=0, hi=5):
+    return R.randint(lo, hi, size=s).astype(np.int32)
+
+
+def bools(*s):
+    return R.rand(*s) > 0.5
+
+
+def away(x, points, margin=0.05):
+    """Nudge values within ``margin`` of any kink point away from it (keeps
+    finite differences honest)."""
+    x = np.array(x, copy=True)
+    for p in points:
+        near = np.abs(x - p) < margin
+        x[near] = p + margin * np.where(x[near] >= p, 1.0, -1.0) * 2
+    return x
+
+
+def spd(n):
+    a = R.randn(n, n).astype(np.float32)
+    return a @ a.T + n * np.eye(n, dtype=np.float32)
+
+
+SPECS = {}
+
+
+def op(key, fn, inputs, golden=None, **kw):
+    SPECS[key] = OpSpec(key, fn, inputs, golden, **kw)
+
+
+# --- unary elementwise (smooth) --------------------------------------------
+for name, gold, inp in [
+    ("abs", np.abs, [away(fa(3, 4), [0.0])]),
+    ("exp", np.exp, [fa(3, 4)]),
+    ("expm1", np.expm1, [fa(3, 4)]),
+    ("log", np.log, [fpos(3, 4)]),
+    ("log2", np.log2, [fpos(3, 4)]),
+    ("log10", np.log10, [fpos(3, 4)]),
+    ("log1p", np.log1p, [fpos(3, 4)]),
+    ("sqrt", np.sqrt, [fpos(3, 4)]),
+    ("rsqrt", lambda x: 1 / np.sqrt(x), [fpos(3, 4)]),
+    ("square", np.square, [fa(3, 4)]),
+    ("reciprocal", lambda x: 1 / x, [fpos(3, 4)]),
+    ("sin", np.sin, [fa(3, 4)]),
+    ("cos", np.cos, [fa(3, 4)]),
+    ("tan", np.tan, [funit(3, 4)]),
+    ("sinh", np.sinh, [fa(3, 4)]),
+    ("cosh", np.cosh, [fa(3, 4)]),
+    ("tanh", np.tanh, [fa(3, 4)]),
+    ("asin", np.arcsin, [funit(3, 4)]),
+    ("acos", np.arccos, [funit(3, 4)]),
+    ("atan", np.arctan, [fa(3, 4)]),
+    ("asinh", np.arcsinh, [fa(3, 4)]),
+    ("acosh", np.arccosh, [fpos(3, 4) + 1.0]),
+    ("atanh", np.arctanh, [funit(3, 4)]),
+    ("erf", sp.erf, [fa(3, 4)]),
+    ("erfinv", sp.erfinv, [funit(3, 4)]),
+    ("digamma", sp.digamma, [fpos(3, 4)]),
+    ("lgamma", sp.gammaln, [fpos(3, 4)]),
+    ("i0", sp.i0, [fa(3, 4)]),
+    ("neg", np.negative, [fa(3, 4)]),
+    ("sigmoid", sp.expit, [fa(3, 4)]),
+    ("log_sigmoid", lambda x: np.log(sp.expit(x)), [fa(3, 4)]),
+    ("silu", lambda x: x * sp.expit(x), [fa(3, 4)]),
+    ("swish", lambda x: x * sp.expit(x), [fa(3, 4)]),
+    ("mish", lambda x: x * np.tanh(np.log1p(np.exp(x))), [fa(3, 4)]),
+    ("softsign", lambda x: x / (1 + np.abs(x)), [fa(3, 4)]),
+    ("tanhshrink", lambda x: x - np.tanh(x), [fa(3, 4)]),
+    ("softplus", lambda x: np.log1p(np.exp(x)), [fa(3, 4)]),
+    ("gelu", lambda x: 0.5 * x * (1 + sp.erf(x / np.sqrt(2))),
+     [fa(3, 4)]),
+]:
+    op(name, getattr(ops, name), inp, gold)
+
+# --- unary elementwise (kinked / integer-valued results) -------------------
+op("ceil", ops.ceil, [away(fa(3, 4), [-1, 0, 1])], np.ceil, grad=False)
+op("floor", ops.floor, [away(fa(3, 4), [-1, 0, 1])], np.floor, grad=False)
+op("round", ops.round_, [fa(3, 4)], np.round, grad=False)
+op("rint", ops.rint, [fa(3, 4)], np.rint, grad=False)
+op("trunc", ops.trunc, [fa(3, 4)], np.trunc, grad=False)
+op("sign", ops.sign, [away(fa(3, 4), [0.0])], np.sign, grad=False)
+op("frac", ops.frac, [away(fa(3, 4), [-1, 0, 1])],
+   lambda x: x - np.trunc(x))
+op("relu", ops.relu, [away(fa(3, 4), [0.0])], lambda x: np.maximum(x, 0))
+op("relu6", ops.relu6, [away(fa(3, 4) * 4, [0.0, 6.0])],
+   lambda x: np.clip(x, 0, 6))
+op("leaky_relu", lambda x: ops.leaky_relu(x, 0.1),
+   [away(fa(3, 4), [0.0])], lambda x: np.where(x > 0, x, 0.1 * x))
+op("elu", lambda x: ops.elu(x, 1.0), [away(fa(3, 4), [0.0])],
+   lambda x: np.where(x > 0, x, np.expm1(x)))
+op("celu", lambda x: ops.celu(x, 1.2), [away(fa(3, 4), [0.0])],
+   lambda x: np.maximum(x, 0) + np.minimum(0, 1.2 * np.expm1(x / 1.2)))
+_selu_s, _selu_a = 1.0507009873554805, 1.6732632423543772
+op("selu", ops.selu, [away(fa(3, 4), [0.0])],
+   lambda x: _selu_s * np.where(x > 0, x, _selu_a * np.expm1(x)))
+op("hardtanh", ops.hardtanh, [away(fa(3, 4) * 2, [-1.0, 1.0])],
+   lambda x: np.clip(x, -1, 1))
+op("hardsigmoid", ops.hardsigmoid, [away(fa(3, 4) * 4, [-3.0, 3.0])],
+   lambda x: np.clip(x / 6 + 0.5, 0, 1))
+op("hardswish", ops.hardswish, [away(fa(3, 4) * 4, [-3.0, 3.0])],
+   lambda x: x * np.clip(x + 3, 0, 6) / 6)
+op("hardshrink", ops.hardshrink, [away(fa(3, 4), [-0.5, 0.5])],
+   lambda x: np.where(np.abs(x) > 0.5, x, 0))
+op("softshrink", ops.softshrink, [away(fa(3, 4), [-0.5, 0.5])],
+   lambda x: np.sign(x) * np.maximum(np.abs(x) - 0.5, 0))
+op("thresholded_relu", ops.thresholded_relu,
+   [away(fa(3, 4) * 2, [1.0])], lambda x: np.where(x > 1.0, x, 0))
+op("stanh", lambda x: ops.stanh(x, 0.67, 1.7159), [fa(3, 4)],
+   lambda x: 1.7159 * np.tanh(0.67 * x))
+op("prelu", lambda x, w: ops.prelu(x, w),
+   [away(fa(2, 3, 4, 4), [0.0]), fpos(3)],
+   lambda x, w: np.where(x > 0, x, w.reshape(1, 3, 1, 1) * x))
+op("glu", lambda x: ops.glu(x, -1), [fa(3, 6)],
+   lambda x: x[:, :3] * sp.expit(x[:, 3:]))
+op("swiglu", lambda x, y: ops.swiglu(x, y), [fa(3, 4), fa(3, 4)],
+   lambda x, y: x * sp.expit(x) * y)
+op("clip", lambda x: ops.clip(x, -1.0, 1.0),
+   [away(fa(3, 4) * 2, [-1.0, 1.0])], lambda x: np.clip(x, -1, 1))
+op("scale", lambda x: ops.scale(x, scale=2.5, bias=0.5), [fa(3, 4)],
+   lambda x: 2.5 * x + 0.5)
+op("nan_to_num", ops.nan_to_num,
+   [np.array([[1.0, np.nan], [np.inf, -np.inf]], np.float32)],
+   np.nan_to_num, grad=False)
+
+# --- binary elementwise ----------------------------------------------------
+op("add", ops.add, [fa(3, 4), fa(3, 4)], np.add)
+op("subtract", ops.subtract, [fa(3, 4), fa(3, 4)], np.subtract)
+op("multiply", ops.multiply, [fa(3, 4), fa(3, 4)], np.multiply)
+op("divide", ops.divide, [fa(3, 4), fpos(3, 4)], np.divide)
+op("elementwise_pow", lambda x, y: ops.pow(x, y),
+   [fpos(3, 4), fa(3, 4)], np.power, covers=("elementwise_pow",))
+op("floor_divide", ops.floor_divide, [fa(3, 4) * 4, fpos(3, 4)],
+   np.floor_divide, grad=False)
+op("remainder", ops.remainder, [fa(3, 4) * 4, fpos(3, 4)], np.mod,
+   grad=False)
+op("maximum", ops.maximum, [fa(3, 4), fa(3, 4)], np.maximum)
+op("minimum", ops.minimum, [fa(3, 4), fa(3, 4)], np.minimum)
+op("fmax", ops.fmax, [fa(3, 4), fa(3, 4)], np.fmax)
+op("fmin", ops.fmin, [fa(3, 4), fa(3, 4)], np.fmin)
+op("atan2", ops.atan2, [fpos(3, 4), fpos(3, 4)], np.arctan2)
+op("logaddexp", ops.logaddexp, [fa(3, 4), fa(3, 4)], np.logaddexp)
+op("lerp", lambda x, y, w: ops.lerp(x, y, w),
+   [fa(3, 4), fa(3, 4), funit(3, 4, lo=0.1, hi=0.9)],
+   lambda x, y, w: x + w * (y - x))
+
+# --- comparisons / logical / bitwise (no grads, no bf16) -------------------
+for name, gold in [("equal", np.equal), ("not_equal", np.not_equal),
+                   ("greater_equal", np.greater_equal),
+                   ("greater_than", np.greater),
+                   ("less_equal", np.less_equal), ("less_than", np.less)]:
+    op(name, getattr(ops, name), [ints(3, 4), ints(3, 4)], gold,
+       grad=False, bf16=False)
+for name, gold in [("logical_and", np.logical_and),
+                   ("logical_or", np.logical_or),
+                   ("logical_xor", np.logical_xor)]:
+    op(name, getattr(ops, name), [bools(3, 4), bools(3, 4)], gold,
+       grad=False, bf16=False)
+op("logical_not", ops.logical_not, [bools(3, 4)], np.logical_not,
+   grad=False, bf16=False)
+for name, gold in [("bitwise_and", np.bitwise_and),
+                   ("bitwise_or", np.bitwise_or),
+                   ("bitwise_xor", np.bitwise_xor)]:
+    op(name, getattr(ops, name), [ints(3, 4, hi=16), ints(3, 4, hi=16)],
+       gold, grad=False, bf16=False)
+op("bitwise_not", ops.bitwise_not, [ints(3, 4, hi=16)], np.bitwise_not,
+   grad=False, bf16=False)
+op("left_shift", ops.left_shift, [ints(3, 4, hi=8), ints(3, 4, hi=4)],
+   np.left_shift, grad=False, bf16=False)
+op("right_shift", ops.right_shift, [ints(3, 4, lo=8, hi=64),
+                                    ints(3, 4, hi=4)],
+   np.right_shift, grad=False, bf16=False)
+op("gcd", ops.gcd, [ints(3, 4, lo=1, hi=30), ints(3, 4, lo=1, hi=30)],
+   np.gcd, grad=False, bf16=False)
+op("lcm", ops.lcm, [ints(3, 4, lo=1, hi=12), ints(3, 4, lo=1, hi=12)],
+   np.lcm, grad=False, bf16=False)
+_nastyf = np.array([[1.0, np.nan, np.inf], [-np.inf, 0.0, 2.0]],
+                   np.float32)
+op("isnan", ops.isnan, [_nastyf], np.isnan, grad=False, bf16=False)
+op("isinf", ops.isinf, [_nastyf], np.isinf, grad=False, bf16=False)
+op("isfinite", ops.isfinite, [_nastyf], np.isfinite, grad=False,
+   bf16=False)
+
+# --- reductions ------------------------------------------------------------
+op("reduce_sum", lambda x: ops.sum(x, axis=1), [fa(3, 4)],
+   lambda x: np.sum(x, 1))
+op("reduce_mean", lambda x: ops.mean(x, axis=-1), [fa(3, 4)],
+   lambda x: np.mean(x, -1))
+op("reduce_max", lambda x: ops.max(x, axis=0), [fa(3, 4)],
+   lambda x: np.max(x, 0))
+op("reduce_min", lambda x: ops.min(x, axis=0), [fa(3, 4)],
+   lambda x: np.min(x, 0))
+op("reduce_prod", lambda x: ops.prod(x, axis=1), [fpos(3, 4)],
+   lambda x: np.prod(x, 1))
+op("amax", lambda x: ops.amax(x, axis=1), [fa(3, 4)],
+   lambda x: np.amax(x, 1))
+op("amin", lambda x: ops.amin(x, axis=1), [fa(3, 4)],
+   lambda x: np.amin(x, 1))
+op("reduce_all", lambda x: ops.all(x, axis=1), [bools(3, 4)],
+   lambda x: np.all(x, 1), grad=False, bf16=False)
+op("reduce_any", lambda x: ops.any(x, axis=1), [bools(3, 4)],
+   lambda x: np.any(x, 1), grad=False, bf16=False)
+op("logsumexp", lambda x: ops.logsumexp(x, axis=1), [fa(3, 4)],
+   lambda x: sp.logsumexp(x, 1))
+_nan_in = np.where(R.rand(3, 4) > 0.7, np.nan,
+                   R.randn(3, 4)).astype(np.float32)
+op("nansum", lambda x: ops.nansum(x, axis=1), [_nan_in],
+   lambda x: np.nansum(x, 1), grad=False)
+op("nanmean", lambda x: ops.nanmean(x, axis=1), [_nan_in],
+   lambda x: np.nanmean(x, 1), grad=False)
+op("median", lambda x: ops.median(x, axis=1), [fa(3, 5)],
+   lambda x: np.median(x, 1))
+op("quantile", lambda x: ops.quantile(x, 0.5, axis=1), [fa(3, 5)],
+   lambda x: np.quantile(x, 0.5, axis=1))
+op("cumsum", lambda x: ops.cumsum(x, axis=1), [fa(3, 4)],
+   lambda x: np.cumsum(x, 1))
+op("cumprod", lambda x: ops.cumprod(x, dim=1), [fpos(3, 4)],
+   lambda x: np.cumprod(x, 1))
+op("cummax", lambda x: ops.cummax(x, axis=1), [fa(3, 4)],
+   lambda x: np.maximum.accumulate(x, 1), out_index=0)
+op("cummin", lambda x: ops.cummin(x, axis=1), [fa(3, 4)],
+   lambda x: np.minimum.accumulate(x, 1), out_index=0)
+op("argmax", lambda x: ops.argmax(x, axis=1), [fa(3, 4)],
+   lambda x: np.argmax(x, 1), grad=False, bf16=False)
+op("argmin", lambda x: ops.argmin(x, axis=1), [fa(3, 4)],
+   lambda x: np.argmin(x, 1), grad=False, bf16=False)
+op("argsort", lambda x: ops.argsort(x, axis=1), [fa(3, 4)],
+   lambda x: np.argsort(x, 1), grad=False, bf16=False)
+op("sort", lambda x: ops.sort(x, axis=1), [fa(3, 4)],
+   lambda x: np.sort(x, 1))
+op("topk", lambda x: ops.topk(x, 2, axis=1), [fa(3, 5)],
+   lambda x: -np.sort(-x, 1)[:, :2], out_index=0)
+
+# --- linalg ----------------------------------------------------------------
+op("matmul", ops.matmul, [fa(3, 4), fa(4, 5)], np.matmul)
+op("addmm", lambda b, x, y: ops.addmm(b, x, y),
+   [fa(3, 5), fa(3, 4), fa(4, 5)],
+   lambda b, x, y: b + x @ y)
+op("dot", ops.dot, [fa(5), fa(5)], np.dot)
+op("inner", ops.inner, [fa(3, 4), fa(5, 4)], np.inner)
+op("outer", ops.outer, [fa(3), fa(4)], np.outer)
+op("cross", lambda x, y: ops.cross(x, y, axis=-1), [fa(4, 3), fa(4, 3)],
+   lambda x, y: np.cross(x, y))
+_spd4 = spd(4)
+op("cholesky", ops.cholesky, [_spd4], np.linalg.cholesky, gtol=5e-2,
+   bf16=False)
+op("det", ops.det, [_spd4], np.linalg.det, bf16=False, gtol=5e-2)
+op("slogdet", lambda x: ops.slogdet(x), [_spd4],
+   lambda x: np.linalg.slogdet(x)[1], out_index=1, bf16=False, gtol=5e-2)
+op("inverse", ops.inverse, [_spd4], np.linalg.inv, bf16=False, gtol=5e-2)
+op("matrix_power", lambda x: ops.matrix_power(x, 3), [_spd4 / 4],
+   lambda x: np.linalg.matrix_power(x, 3), bf16=False, gtol=5e-2)
+_b4 = fa(4, 2)
+op("solve", ops.solve, [_spd4, _b4],
+   lambda a, b: np.linalg.solve(a, b), bf16=False, gtol=5e-2)
+_tril4 = np.tril(spd(4)).astype(np.float32)
+op("triangular_solve",
+   lambda a, b: ops.triangular_solve(a, b, upper=False),
+   [_tril4, _b4],
+   lambda a, b: np.linalg.solve(a, b), bf16=False, gtol=5e-2)
+op("diag", ops.diag, [fa(4)], np.diag)
+op("diagonal", lambda x: ops.diagonal(x), [fa(4, 4)],
+   lambda x: np.diagonal(x))
+op("tril", ops.tril, [fa(4, 4)], np.tril)
+op("triu", ops.triu, [fa(4, 4)], np.triu)
+
+# --- manipulation ----------------------------------------------------------
+op("reshape", lambda x: ops.reshape(x, [4, 3]), [fa(3, 4)],
+   lambda x: x.reshape(4, 3))
+op("transpose", lambda x: ops.transpose(x, [1, 0]), [fa(3, 4)],
+   lambda x: x.T)
+op("moveaxis", lambda x: ops.moveaxis(x, 0, 2), [fa(2, 3, 4)],
+   lambda x: np.moveaxis(x, 0, 2))
+op("squeeze", lambda x: ops.squeeze(x, 1), [fa(3, 1, 4)],
+   lambda x: x.squeeze(1))
+op("unsqueeze", lambda x: ops.unsqueeze(x, 1), [fa(3, 4)],
+   lambda x: x[:, None])
+op("stack", lambda x, y: ops.stack([x, y], axis=1),
+   [fa(3, 4), fa(3, 4)], lambda x, y: np.stack([x, y], 1))
+op("concat", lambda x, y: ops.concat([x, y], axis=1),
+   [fa(3, 4), fa(3, 2)], lambda x, y: np.concatenate([x, y], 1))
+op("split", lambda x: ops.split(x, 2, axis=1), [fa(3, 4)],
+   lambda x: np.split(x, 2, 1)[0], out_index=0)
+op("tile", lambda x: ops.tile(x, [2, 3]), [fa(3, 4)],
+   lambda x: np.tile(x, (2, 3)))
+op("expand", lambda x: ops.expand(x, [3, 4]), [fa(1, 4)],
+   lambda x: np.broadcast_to(x, (3, 4)))
+op("flip", lambda x: ops.flip(x, axis=1), [fa(3, 4)],
+   lambda x: np.flip(x, 1))
+op("roll", lambda x: ops.roll(x, 2, axis=1), [fa(3, 4)],
+   lambda x: np.roll(x, 2, 1))
+op("pad", lambda x: ops.pad(x, [1, 2], value=0.5), [fa(3, 4)],
+   lambda x: np.pad(x, ((0, 0), (1, 2)), constant_values=0.5))
+_gidx = np.array([2, 0, 1, 2], np.int32)
+op("gather", lambda x, i: ops.gather(x, i, axis=0),
+   [fa(3, 4), _gidx], lambda x, i: x[i], grad_inputs=[0])
+_gnd_idx = np.array([[0, 1], [2, 3]], np.int32)
+op("gather_nd", lambda x, i: ops.gather_nd(x, i),
+   [fa(3, 4), _gnd_idx], lambda x, i: x[i[:, 0], i[:, 1]],
+   grad_inputs=[0])
+_tal_idx = ints(3, 2, hi=4)
+op("take_along_axis", lambda x, i: ops.take_along_axis(x, i, axis=1),
+   [fa(3, 4), _tal_idx],
+   lambda x, i: np.take_along_axis(x, i.astype(np.int64), 1),
+   grad_inputs=[0])
+_pal_idx = np.array([[0], [2], [1]], np.int32)
+
+
+def _pal_gold(x, i, v):
+    out = np.array(x, copy=True)
+    np.put_along_axis(out, i.astype(np.int64), v, 1)
+    return out
+
+
+op("put_along_axis",
+   lambda x, i, v: ops.put_along_axis(x, i, v, axis=1),
+   [fa(3, 4), _pal_idx, fa(3, 1)], _pal_gold, grad_inputs=[0, 2])
+_sc_idx = np.array([0, 2], np.int32)
+
+
+def _scatter_gold(x, i, u):
+    out = np.array(x, copy=True)
+    out[i] = u
+    return out
+
+
+def _scatter_add_gold(x, i, u):
+    out = np.array(x, copy=True)
+    np.add.at(out, i, u)
+    return out
+
+
+op("scatter", lambda x, i, u: ops.scatter(x, i, u),
+   [fa(4, 3), _sc_idx, fa(2, 3)], _scatter_gold, grad_inputs=[0, 2])
+op("scatter_add",
+   lambda x, i, u: ops.scatter(x, i, u, overwrite=False),
+   [fa(4, 3), _sc_idx, fa(2, 3)], _scatter_add_gold,
+   covers=("scatter_add",), grad_inputs=[0, 2])
+
+
+def _snd_gold(x, i, u):
+    out = np.array(x, copy=True)
+    for r in range(i.shape[0]):
+        out[tuple(i[r])] += u[r]
+    return out
+
+
+op("scatter_nd_add", lambda x, i, u: ops.scatter_nd_add(x, i, u),
+   [fa(4, 3), np.array([[0, 1], [2, 2]], np.int32), fa(2)],
+   _snd_gold, grad_inputs=[0, 2])
+op("repeat_interleave",
+   lambda x: ops.repeat_interleave(x, 2, axis=1), [fa(3, 4)],
+   lambda x: np.repeat(x, 2, 1))
+_mask34 = bools(3, 4)
+op("masked_fill", lambda x, m: ops.masked_fill(x, m, 2.5),
+   [fa(3, 4), _mask34],
+   lambda x, m: np.where(m, 2.5, x), grad_inputs=[0])
+op("where", lambda c, x, y: ops.where(c, x, y),
+   [_mask34, fa(3, 4), fa(3, 4)],
+   lambda c, x, y: np.where(c, x, y), grad_inputs=[1, 2])
+op("one_hot", lambda x: ops.one_hot(x, 5), [ints(6, hi=5)],
+   lambda x: np.eye(5, dtype=np.float32)[x], grad=False, bf16=False)
+op("cast", lambda x: ops.cast(x, "float64"), [fa(3, 4)],
+   lambda x: x.astype(np.float64), bf16=False)
+op("assign", ops.assign, [fa(3, 4)], lambda x: x)
+op("embedding", lambda ids, w: F.embedding(ids, w),
+   [ints(5, hi=7), fa(7, 4)], lambda i, w: w[i], grad_inputs=[1])
+
+# --- nn --------------------------------------------------------------------
+op("softmax", lambda x: ops.softmax(x, axis=-1), [fa(3, 4)],
+   lambda x: sp.softmax(x, -1))
+op("log_softmax", lambda x: ops.log_softmax(x, axis=-1), [fa(3, 4)],
+   lambda x: sp.log_softmax(x, -1))
+
+
+def _ln_gold(x, w, b):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + 1e-5) * w + b
+
+
+op("layer_norm", lambda x, w, b: F.layer_norm(x, [4], w, b),
+   [fa(3, 4), fpos(4), fa(4)], _ln_gold)
+
+
+def _rms_gold(x, w):
+    ms = np.mean(x * x, -1, keepdims=True)
+    return x / np.sqrt(ms + 1e-6) * w
+
+
+op("rms_norm", lambda x, w: F.rms_norm(x, w), [fa(3, 4), fpos(4)],
+   _rms_gold)
+
+
+def _gn_gold(x, w, b):
+    n, c, h, wd = x.shape
+    g = 2
+    xr = x.reshape(n, g, c // g, h, wd)
+    mu = xr.mean((2, 3, 4), keepdims=True)
+    var = xr.var((2, 3, 4), keepdims=True)
+    xn = ((xr - mu) / np.sqrt(var + 1e-5)).reshape(n, c, h, wd)
+    return xn * w.reshape(1, c, 1, 1) + b.reshape(1, c, 1, 1)
+
+
+op("group_norm", lambda x, w, b: F.group_norm(x, 2, weight=w, bias=b),
+   [fa(2, 4, 3, 3), fpos(4), fa(4)], _gn_gold, gtol=5e-2)
+
+
+def _bn_infer_gold(x, m, v, w, b):
+    xn = (x - m.reshape(1, -1, 1, 1)) / np.sqrt(
+        v.reshape(1, -1, 1, 1) + 1e-5)
+    return xn * w.reshape(1, -1, 1, 1) + b.reshape(1, -1, 1, 1)
+
+
+from paddle_tpu.ops.registry import apply as _apply, get_op as _get_op
+
+op("batch_norm_infer",
+   lambda x, m, v, w, b: _apply(_get_op("batch_norm_infer"), x, m, v, w,
+                                b),
+   [fa(2, 3, 4, 4), fa(3), fpos(3), fpos(3), fa(3)], _bn_infer_gold,
+   grad_inputs=[0, 3, 4])
+op("batch_norm_stats",
+   lambda x: _apply(_get_op("batch_norm_stats"), x),
+   [fa(2, 3, 4, 4)], lambda x: x.mean((0, 2, 3)), out_index=0,
+   grad=False)
+
+
+def _conv2d_gold(x, w):
+    n, cin, hh, ww = x.shape
+    co, _, kh, kw = w.shape
+    oh, ow = hh - kh + 1, ww - kw + 1
+    out = np.zeros((n, co, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, :, i:i + kh, j:j + kw]
+            out[:, :, i, j] = np.einsum("ncij,ocij->no", patch, w)
+    return out
+
+
+op("conv2d", lambda x, w: F.conv2d(x, w), [fa(1, 2, 5, 5), fa(3, 2, 3, 3)],
+   _conv2d_gold, gtol=5e-2)
+
+
+def _conv1d_gold(x, w):
+    n, cin, ll = x.shape
+    co, _, k = w.shape
+    ol = ll - k + 1
+    out = np.zeros((n, co, ol), np.float32)
+    for i in range(ol):
+        out[:, :, i] = np.einsum("nci,oci->no", x[:, :, i:i + k], w)
+    return out
+
+
+op("conv1d", lambda x, w: F.conv1d(x, w), [fa(1, 2, 6), fa(3, 2, 3)],
+   _conv1d_gold, gtol=5e-2)
+op("conv2d_transpose", lambda x, w: F.conv2d_transpose(x, w),
+   [fa(1, 3, 4, 4), fa(3, 2, 3, 3)], None, gtol=5e-2)
+
+
+def _maxpool_gold(x):
+    n, c, h, w = x.shape
+    out = np.zeros((n, c, h // 2, w // 2), np.float32)
+    for i in range(h // 2):
+        for j in range(w // 2):
+            out[:, :, i, j] = x[:, :, 2 * i:2 * i + 2,
+                                2 * j:2 * j + 2].max((2, 3))
+    return out
+
+
+op("max_pool2d", lambda x: F.max_pool2d(x, 2, 2), [fa(1, 2, 6, 6)],
+   _maxpool_gold, gtol=5e-2)
+
+
+def _avgpool_gold(x):
+    n, c, h, w = x.shape
+    out = np.zeros((n, c, h // 2, w // 2), np.float32)
+    for i in range(h // 2):
+        for j in range(w // 2):
+            out[:, :, i, j] = x[:, :, 2 * i:2 * i + 2,
+                                2 * j:2 * j + 2].mean((2, 3))
+    return out
+
+
+op("avg_pool2d", lambda x: F.avg_pool2d(x, 2, 2), [fa(1, 2, 6, 6)],
+   _avgpool_gold)
+op("adaptive_avg_pool2d", lambda x: F.adaptive_avg_pool2d(x, 1),
+   [fa(1, 2, 6, 6)], lambda x: x.mean((2, 3), keepdims=True))
+op("interpolate",
+   lambda x: F.interpolate(x, scale_factor=2, mode="nearest"),
+   [fa(1, 2, 3, 3)], lambda x: x.repeat(2, 2).repeat(2, 3))
+
+
+def _sce_gold(logits, label):
+    ls = sp.log_softmax(logits, -1)
+    return -np.take_along_axis(ls, label[:, None].astype(np.int64),
+                               1)
+def _sce(logits, label):
+    return F.softmax_with_cross_entropy(logits, label)
+
+
+op("softmax_with_cross_entropy", _sce, [fa(5, 4), ints(5, hi=4)],
+   _sce_gold, grad_inputs=[0])
+
+
+def _sdpa_gold(q, k, v):
+    # [B, S, H, D] layout
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    s = np.einsum("bhqd,bhkd->bhqk", qt, kt) / np.sqrt(q.shape[-1])
+    p = sp.softmax(s, -1)
+    return np.einsum("bhqk,bhkd->bhqd", p, vt).transpose(0, 2, 1, 3)
+
+
+op("scaled_dot_product_attention",
+   lambda q, k, v: F.scaled_dot_product_attention(q, k, v),
+   [fa(2, 4, 2, 8), fa(2, 4, 2, 8), fa(2, 4, 2, 8)], _sdpa_gold,
+   gtol=5e-2)
+
+
+def _rope_inputs():
+    q = fa(2, 4, 2, 8)
+    k = fa(2, 4, 2, 8)
+    pos = np.arange(4, dtype=np.float32)
+    inv = 1.0 / (10000 ** (np.arange(0, 8, 2, np.float32) / 8))
+    ang = np.outer(pos, inv)
+    emb = np.concatenate([ang, ang], -1)
+    return [q, k, np.cos(emb).astype(np.float32)[None, :, None, :],
+            np.sin(emb).astype(np.float32)[None, :, None, :]]
+
+
+op("fused_rotary_position_embedding",
+   lambda q, k, c, s: F.fused_rotary_position_embedding(q, k, cos=c,
+                                                        sin=s),
+   _rope_inputs(), None, out_index=0, grad_inputs=[0, 1])
+
+# ---------------------------------------------------------------------------
+
+SKIP = {
+    # exercised by dedicated suites instead of the table
+}
+
+
+def test_coverage_complete():
+    """Every registered op must be covered by a table row (or an explicit,
+    justified SKIP)."""
+    registered = set(all_ops())
+    covered = set()
+    for s in SPECS.values():
+        covered.update(s.covers)
+    missing = registered - covered - set(SKIP)
+    assert not missing, f"ops with no OpTest row: {sorted(missing)}"
+
+
+@pytest.mark.parametrize("key", sorted(SPECS))
+def test_forward_fp32(key):
+    SPECS[key].check_forward_fp32()
+
+
+@pytest.mark.parametrize("key", sorted(SPECS))
+def test_forward_bf16(key):
+    SPECS[key].check_forward_bf16()
+
+
+@pytest.mark.parametrize("key", sorted(SPECS))
+def test_grad_finite_difference(key):
+    SPECS[key].check_grad_fd()
