@@ -72,34 +72,70 @@ def _ring_attention_local(q, k, v, axis_name, n_blocks, scale, causal):
 
 
 def ring_attention(q, k, v, mesh: ProcessMesh, axis="sp", causal=True,
-                   scale=None):
+                   scale=None, batch_axis=None):
     """Distributed causal attention; q/k/v [B, S, H, D] with S sharded
-    over ``axis``.  Returns [B, S, H, D] sharded the same way."""
+    over ``axis``.  Returns [B, S, H, D] sharded the same way.
+    ``batch_axis``: mesh axis the batch dim is sharded over (e.g. 'dp' in
+    a hybrid mesh) so the shard_map doesn't force-replicate it."""
     qd = q._data if isinstance(q, Tensor) else q
     kd = k._data if isinstance(k, Tensor) else k
     vd = v._data if isinstance(v, Tensor) else v
     n = mesh.get_dim_size(axis)
     D = qd.shape[-1]
+    default_scale = scale is None
     scale = scale if scale is not None else 1.0 / np.sqrt(D)
     if n == 1:
         from ..ops import nn_ops
 
-        out = nn_ops._sdpa_plain(qd, kd, vd, causal=causal, scale=scale)
-        return Tensor(out) if isinstance(q, Tensor) else out
+        if isinstance(q, Tensor):
+            if default_scale:
+                from ..nn import functional as NF
 
-    spec = PartitionSpec(None, axis, None, None)
+                return NF.scaled_dot_product_attention(q, k, v,
+                                                       is_causal=causal)
+            import functools
+
+            fn = functools.partial(nn_ops._sdpa_plain, causal=causal,
+                                   scale=scale)
+            return _dist_attn_apply("sdpa_local", fn,
+                                    (causal, scale), q, k, v)
+        return nn_ops._sdpa_plain(qd, kd, vd, causal=causal, scale=scale)
+
+    spec = PartitionSpec(batch_axis, axis, None, None)
 
     def local(q_, k_, v_):
         return _ring_attention_local(q_, k_, v_, axis, n, scale, causal)
 
     mapped = jax.shard_map(local, mesh=mesh.jax_mesh,
                            in_specs=(spec, spec, spec), out_specs=spec)
-    out = mapped(qd, kd, vd)
-    return Tensor(out) if isinstance(q, Tensor) else out
+    if isinstance(q, Tensor):
+        # Through the op registry so the eager tape differentiates it
+        # (a bare Tensor(mapped(...)) would silently cut gradients).
+        return _dist_attn_apply("ring_attention", mapped,
+                                (mesh, axis, causal, scale, batch_axis),
+                                q, k, v)
+    return mapped(qd, kd, vd)
+
+
+_DIST_ATTN_OPS: dict = {}
+
+
+def _dist_attn_apply(kind, mapped, cache_key, q, k, v):
+    from ..ops.registry import OpDef, apply
+
+    # Key by the jax Mesh itself (content-hashed), never id(): a GC'd
+    # ProcessMesh's address can be reused and would alias a stale entry.
+    key = (kind,) + tuple(x.jax_mesh if isinstance(x, ProcessMesh) else x
+                          for x in cache_key)
+    op = _DIST_ATTN_OPS.get(key)
+    if op is None:
+        op = OpDef(kind, mapped)
+        _DIST_ATTN_OPS[key] = op
+    return apply(op, q, k, v)
 
 
 def ulysses_attention(q, k, v, mesh: ProcessMesh, axis="sp", causal=True,
-                      scale=None):
+                      scale=None, batch_axis=None):
     """All-to-all head-parallel attention (Ulysses): reshard seq-sharded
     activations to head-sharded, attend fully, reshard back."""
     qd = q._data if isinstance(q, Tensor) else q
@@ -108,16 +144,28 @@ def ulysses_attention(q, k, v, mesh: ProcessMesh, axis="sp", causal=True,
     n = mesh.get_dim_size(axis)
     D = qd.shape[-1]
     H = qd.shape[2]
+    default_scale = scale is None
     scale = scale if scale is not None else 1.0 / np.sqrt(D)
     if n == 1:
         from ..ops import nn_ops
 
-        out = nn_ops._sdpa_plain(qd, kd, vd, causal=causal, scale=scale)
-        return Tensor(out) if isinstance(q, Tensor) else out
+        if isinstance(q, Tensor):
+            if default_scale:
+                from ..nn import functional as NF
+
+                return NF.scaled_dot_product_attention(q, k, v,
+                                                       is_causal=causal)
+            import functools
+
+            fn = functools.partial(nn_ops._sdpa_plain, causal=causal,
+                                   scale=scale)
+            return _dist_attn_apply("sdpa_local", fn,
+                                    (causal, scale), q, k, v)
+        return nn_ops._sdpa_plain(qd, kd, vd, causal=causal, scale=scale)
     if H % n != 0:
         raise ValueError(f"num_heads {H} must divide the {axis} degree {n}")
 
-    spec = PartitionSpec(None, axis, None, None)
+    spec = PartitionSpec(batch_axis, axis, None, None)
 
     def local(q_, k_, v_):
         # [B, S/n, H, D] -> all_to_all -> [B, S, H/n, D]
@@ -137,5 +185,8 @@ def ulysses_attention(q, k, v, mesh: ProcessMesh, axis="sp", causal=True,
 
     mapped = jax.shard_map(local, mesh=mesh.jax_mesh,
                            in_specs=(spec, spec, spec), out_specs=spec)
-    out = mapped(qd, kd, vd)
-    return Tensor(out) if isinstance(q, Tensor) else out
+    if isinstance(q, Tensor):
+        return _dist_attn_apply("ulysses_attention", mapped,
+                                (mesh, axis, causal, scale, batch_axis),
+                                q, k, v)
+    return mapped(qd, kd, vd)

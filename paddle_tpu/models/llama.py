@@ -52,6 +52,11 @@ class LlamaConfig:
     # O(1) in depth; the XLA-native analog of the reference's static
     # pipeline program cloning)
     attention_impl: str = "auto"  # "auto" | "einsum" | "flash" (Pallas)
+    context_parallel: str = "none"  # "none" | "ring" | "ulysses":
+    # distributed attention over the hybrid topology's 'sep' axis
+    # (SURVEY §5.7 — the reference has the sep axis but no kernel; here
+    # ring = ppermute K/V rotation, ulysses = all-to-all head parallel)
+    sep_axis: str = "sep"
 
     @staticmethod
     def llama2_7b(**kw):
@@ -108,13 +113,58 @@ class LlamaAttention(nn.Layer):
         v = ops.reshape(self.v_proj(x), [B, S, nkv, d])
         q, k, _ = F.fused_rotary_position_embedding(q, k, None, sin=sin,
                                                     cos=cos)
-        # GQA: K/V stay at nkv heads; grouped attention avoids the
-        # repeat_interleave HBM blowup (VERDICT r1 weak #1).
-        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
-                                             is_causal=True,
-                                             impl=cfg.attention_impl)
+        cp_out = self._context_parallel_attention(q, k, v, attn_mask)
+        if cp_out is not None:
+            out = cp_out
+        else:
+            # GQA: K/V stay at nkv heads; grouped attention avoids the
+            # repeat_interleave HBM blowup (VERDICT r1 weak #1).
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, is_causal=True,
+                impl=cfg.attention_impl)
         out = ops.reshape(out, [B, S, cfg.hidden_size])
         return self.o_proj(out)
+
+    def _context_parallel_attention(self, q, k, v, attn_mask=None):
+        """Sequence/context parallelism over the hybrid topology's sep
+        axis: ring attention (K/V rotate via ppermute) or Ulysses
+        (all-to-all head parallel).  Returns None when not active so the
+        caller falls through to single-device attention.
+
+        Plumbing mirrors the reference's sep-degree path (sep axis in
+        fleet/base/topology.py:188 + segment_parallel wrapper) which ships
+        no distributed-attention kernel — this supplies it (SURVEY §5.7)."""
+        cfg = self.config
+        if cfg.context_parallel not in ("ring", "ulysses"):
+            return None
+        if attn_mask is not None:
+            # Ring/Ulysses are causal-only; an explicit mask (e.g. padding)
+            # must go through single-device attention, not be dropped.
+            return None
+        from ..distributed.fleet.topology import (
+            get_hybrid_communicate_group,
+        )
+        from ..distributed.ring_attention import (
+            ring_attention,
+            ulysses_attention,
+        )
+
+        hcg = get_hybrid_communicate_group()
+        mesh = getattr(hcg, "mesh", None) if hcg is not None else None
+        if mesh is None or cfg.sep_axis not in mesh.dim_names or \
+                mesh.get_dim_size(cfg.sep_axis) <= 1:
+            return None
+        if cfg.num_key_value_heads != cfg.num_attention_heads:
+            # Ring/Ulysses bodies run per-head; expand GQA K/V groups.
+            rep = cfg.num_attention_heads // cfg.num_key_value_heads
+            k = ops.repeat_interleave(k, rep, axis=2)
+            v = ops.repeat_interleave(v, rep, axis=2)
+        fn = ring_attention if cfg.context_parallel == "ring" \
+            else ulysses_attention
+        batch_axis = "dp" if "dp" in mesh.dim_names and \
+            mesh.get_dim_size("dp") > 1 else None
+        return fn(q, k, v, mesh, axis=cfg.sep_axis, causal=True,
+                  batch_axis=batch_axis)
 
 
 class LlamaMLP(nn.Layer):

@@ -73,9 +73,17 @@ class Layer:
         if name is None:
             # Reference-style auto names ("linear_0.w_0"): unique, and what
             # apply_decay_param_fun / state-keyed APIs receive as p.name.
-            name = (f"{type(self).__name__.lower()}_{_unique_ids['n']}."
-                    f"{'b' if is_bias else 'w'}_0")
-            _unique_ids["n"] += 1
+            # One layer index per *instance*, one w/b index per parameter.
+            prefix = self.__dict__.get("_auto_name_prefix")
+            if prefix is None:
+                prefix = f"{type(self).__name__.lower()}_{_unique_ids['n']}"
+                _unique_ids["n"] += 1
+                self.__dict__["_auto_name_prefix"] = prefix
+                self.__dict__["_auto_name_counts"] = {"w": 0, "b": 0}
+            counts = self.__dict__["_auto_name_counts"]
+            kind = "b" if is_bias else "w"
+            name = f"{prefix}.{kind}_{counts[kind]}"
+            counts[kind] += 1
         p = EagerParamBase(data, name=name, trainable=trainable)
         p.optimize_attr["learning_rate"] = lr
         return p

@@ -336,10 +336,9 @@ def _gather_bwd(saved, g, axis=0):
 
 
 def _index_add(z, index, g, axis):
-    import builtins
-
-    # The module-level ``slice`` op (paddle API parity) shadows the builtin.
-    idx = [builtins.slice(None)] * z.ndim
+    # The module-level ``slice`` op (paddle API parity) shadows the builtin;
+    # ``builtins_slice`` is this module's alias for it.
+    idx = [builtins_slice(None)] * z.ndim
     idx[axis] = index
     return z.at[tuple(idx)].add(g)
 

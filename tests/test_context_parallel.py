@@ -1,0 +1,97 @@
+"""Context parallelism in the model path: LlamaAttention routes through
+ring/Ulysses attention over the hybrid topology's 'sep' axis.
+
+The reference ships the sep axis (fleet/base/topology.py:188,
+distributed_strategy.proto:107) but no distributed-attention kernel
+(SURVEY §5.7); here the kernel exists and is wired into the flagship
+model, parity-tested against single-device attention on the 8-device
+CPU mesh.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet import DistributedStrategy, fleet
+from paddle_tpu.models import (
+    CompiledTrainStep, LlamaConfig, LlamaForCausalLM, llama_shard_rules,
+)
+
+
+def _init_sep(dp=2, sep=4):
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "sep_degree": sep}
+    fleet.init(is_collective=True, strategy=strategy)
+    return fleet.get_hybrid_communicate_group()
+
+
+def _reset_fleet():
+    fleet.init(is_collective=True, strategy=DistributedStrategy())
+
+
+def _losses(cfg, mesh, x, y, steps=3, seed=21):
+    paddle.seed(seed)
+    model = LlamaForCausalLM(cfg)
+    step = CompiledTrainStep(model, lr=1e-3, mesh=mesh,
+                             shard_rules=llama_shard_rules if mesh else None,
+                             donate=False)
+    return [float(step.step(x, y)) for _ in range(steps)]
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_context_parallel_train_parity(impl):
+    """sep=4 x dp=2 long-seq train steps == single-device numerics."""
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 256, (2, 64)).astype(np.int64)
+
+    hcg = _init_sep(dp=2, sep=4)
+    cfg = LlamaConfig.tiny(context_parallel=impl)
+    sharded = _losses(cfg, hcg.mesh, x, x)
+
+    _reset_fleet()
+    single = _losses(LlamaConfig.tiny(), None, x, x)
+    np.testing.assert_allclose(sharded, single, rtol=2e-4, atol=1e-5)
+    assert sharded[-1] < sharded[0]
+
+
+def test_context_parallel_gqa():
+    """GQA (kv heads < q heads) under ring context parallelism."""
+    rng = np.random.RandomState(1)
+    x = rng.randint(0, 256, (2, 32)).astype(np.int64)
+
+    hcg = _init_sep(dp=1, sep=4)
+    cfg = LlamaConfig.tiny(context_parallel="ring")
+    assert cfg.num_key_value_heads < cfg.num_attention_heads
+    sharded = _losses(cfg, hcg.mesh, x, x, steps=2)
+
+    _reset_fleet()
+    single = _losses(LlamaConfig.tiny(), None, x, x, steps=2)
+    np.testing.assert_allclose(sharded, single, rtol=2e-4, atol=1e-5)
+
+
+def test_context_parallel_eager_grads_flow():
+    """Eager training through the distributed-attention op must reach the
+    projection weights (regression: Tensor(out) used to cut the tape)."""
+    _init_sep(dp=1, sep=4)
+    try:
+        paddle.seed(5)
+        model = LlamaForCausalLM(LlamaConfig.tiny(context_parallel="ring"))
+        ids = paddle.to_tensor(
+            np.random.randint(0, 256, (1, 16)).astype(np.int64))
+        loss = model(ids, labels=ids)
+        loss.backward()
+        qw = dict(model.named_parameters())[
+            "llama.layers.0.self_attn.q_proj.weight"]
+        assert qw.grad is not None
+        assert float(np.abs(qw.grad.numpy()).sum()) > 0
+    finally:
+        _reset_fleet()
+
+
+def test_context_parallel_inactive_without_sep():
+    """With no sep axis in the topology the config degrades gracefully to
+    single-device attention."""
+    _reset_fleet()
+    model = LlamaForCausalLM(LlamaConfig.tiny(context_parallel="ring"))
+    ids = paddle.to_tensor(np.zeros((1, 8), np.int64))
+    out = model(ids)
+    assert out.shape == [1, 8, 256]
