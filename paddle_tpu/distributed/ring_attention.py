@@ -71,6 +71,26 @@ def _ring_attention_local(q, k, v, axis_name, n_blocks, scale, causal):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
+
+def _local_sdpa_fallback(q, k, v, qd, kd, vd, causal, scale,
+                         default_scale):
+    """Single-device attention for axis size 1 (shared by ring/ulysses)."""
+    from ..ops import nn_ops
+
+    if isinstance(q, Tensor):
+        if default_scale:
+            from ..nn import functional as NF
+
+            return NF.scaled_dot_product_attention(q, k, v,
+                                                   is_causal=causal)
+        import functools
+
+        fn = functools.partial(nn_ops._sdpa_plain, causal=causal,
+                               scale=scale)
+        return _dist_attn_apply("sdpa_local", fn, (causal, scale), q, k, v)
+    return nn_ops._sdpa_plain(qd, kd, vd, causal=causal, scale=scale)
+
+
 def ring_attention(q, k, v, mesh: ProcessMesh, axis="sp", causal=True,
                    scale=None, batch_axis=None):
     """Distributed causal attention; q/k/v [B, S, H, D] with S sharded
@@ -85,21 +105,8 @@ def ring_attention(q, k, v, mesh: ProcessMesh, axis="sp", causal=True,
     default_scale = scale is None
     scale = scale if scale is not None else 1.0 / np.sqrt(D)
     if n == 1:
-        from ..ops import nn_ops
-
-        if isinstance(q, Tensor):
-            if default_scale:
-                from ..nn import functional as NF
-
-                return NF.scaled_dot_product_attention(q, k, v,
-                                                       is_causal=causal)
-            import functools
-
-            fn = functools.partial(nn_ops._sdpa_plain, causal=causal,
-                                   scale=scale)
-            return _dist_attn_apply("sdpa_local", fn,
-                                    (causal, scale), q, k, v)
-        return nn_ops._sdpa_plain(qd, kd, vd, causal=causal, scale=scale)
+        return _local_sdpa_fallback(q, k, v, qd, kd, vd, causal, scale,
+                                    default_scale)
 
     spec = PartitionSpec(batch_axis, axis, None, None)
 
@@ -129,6 +136,10 @@ def _dist_attn_apply(kind, mapped, cache_key, q, k, v):
                           for x in cache_key)
     op = _DIST_ATTN_OPS.get(key)
     if op is None:
+        if len(_DIST_ATTN_OPS) >= 16:
+            # Bounded: topology sweeps (tests, notebooks) must not pin
+            # meshes + compiled executables forever.
+            _DIST_ATTN_OPS.clear()
         op = OpDef(kind, mapped)
         _DIST_ATTN_OPS[key] = op
     return apply(op, q, k, v)
@@ -147,21 +158,8 @@ def ulysses_attention(q, k, v, mesh: ProcessMesh, axis="sp", causal=True,
     default_scale = scale is None
     scale = scale if scale is not None else 1.0 / np.sqrt(D)
     if n == 1:
-        from ..ops import nn_ops
-
-        if isinstance(q, Tensor):
-            if default_scale:
-                from ..nn import functional as NF
-
-                return NF.scaled_dot_product_attention(q, k, v,
-                                                       is_causal=causal)
-            import functools
-
-            fn = functools.partial(nn_ops._sdpa_plain, causal=causal,
-                                   scale=scale)
-            return _dist_attn_apply("sdpa_local", fn,
-                                    (causal, scale), q, k, v)
-        return nn_ops._sdpa_plain(qd, kd, vd, causal=causal, scale=scale)
+        return _local_sdpa_fallback(q, k, v, qd, kd, vd, causal, scale,
+                                    default_scale)
     if H % n != 0:
         raise ValueError(f"num_heads {H} must divide the {axis} degree {n}")
 

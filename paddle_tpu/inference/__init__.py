@@ -1,0 +1,107 @@
+"""Inference predictor.
+
+Reference: ``paddle/fluid/inference/api/paddle_inference_api.h:81``
+(Predictor), ``analysis_predictor.h:105`` (AnalysisPredictor: load program,
+run IR pass pipeline, execute with zero-copy handles), Python surface
+``paddle.inference.Config`` / ``create_predictor``.
+
+TPU-native: the "analysis + executor" pipeline is XLA — a Predictor wraps
+either a live Layer or a ``paddle_tpu.jit.save``d program prefix, compiles
+the forward once with ``jax.jit`` over the parameter pytree, and serves
+``run()`` as an executable-cache hit.  Zero-copy handles are jax device
+arrays.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Config:
+    """Reference: paddle.inference.Config(prog_file, params_file)."""
+
+    def __init__(self, model_path=None, params_path=None):
+        self.model_path = model_path
+        self.params_path = params_path
+        if params_path is not None and params_path != model_path:
+            # jit.save writes program + weights into one <prefix>.pdparams;
+            # a separate params file would be silently ignored otherwise.
+            raise NotImplementedError(
+                "paddle_tpu saves program and weights in a single "
+                f"'<prefix>.pdparams' file; pass that prefix as model_path "
+                f"(got params_path={params_path!r})")
+        self._device = None
+
+    def enable_use_gpu(self, *a, **k):  # compat no-op: device is jax's
+        pass
+
+    def disable_gpu(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class Predictor:
+    """predictor = create_predictor(config)  # or Predictor(layer)
+    out = predictor.run([np_array, ...])  -> [np_array, ...]
+    """
+
+    def __init__(self, source, model_builder=None):
+        from ..nn.layers import Layer
+
+        if isinstance(source, Config):
+            if model_builder is None:
+                raise ValueError(
+                    "Predictor(Config) needs model_builder: a callable "
+                    "returning the Layer to load the saved weights into "
+                    "(StableHLO-only programs carry no python forward)")
+            layer = model_builder()
+            from .. import jit as pjit
+
+            translated = pjit.load(source.model_path)
+            layer.set_state_dict(translated.state_dict())
+            self.layer = layer
+        elif isinstance(source, Layer):
+            self.layer = source
+        else:
+            raise TypeError(f"Predictor expects Config or Layer, got "
+                            f"{type(source)}")
+        self.layer.eval()
+        self._jitted = None
+
+    def _build(self):
+        import jax
+
+        from ..jit.functional import functional_call, param_tree
+
+        layer = self.layer
+        self._params = param_tree(layer, trainable_only=False)
+
+        def fwd(params, *inputs):
+            return functional_call(layer, params, *inputs)
+
+        self._jitted = jax.jit(fwd)
+
+    def get_input_names(self):
+        import inspect
+
+        sig = inspect.signature(self.layer.forward)
+        return [p for p in sig.parameters if p != "self"]
+
+    def run(self, inputs):
+        """inputs: list of np arrays / Tensors -> list of np arrays."""
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        if self._jitted is None:
+            self._build()
+        ins = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+               for i in inputs]
+        out = self._jitted(self._params, *ins)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        return [np.asarray(o) for o in outs]
+
+
+def create_predictor(config, model_builder=None):
+    return Predictor(config, model_builder=model_builder)

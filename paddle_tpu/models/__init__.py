@@ -4,3 +4,4 @@ from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, llama_shard_rules,
 )
 from .training import CompiledTrainStep  # noqa: F401
+from .generation import LlamaDecoder  # noqa: F401

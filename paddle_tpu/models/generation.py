@@ -1,0 +1,177 @@
+"""Incremental decoding with a static KV cache.
+
+Reference: the inference decode path — ``paddle/phi/kernels/fusion/gpu/
+block_multi_head_attention_kernel.cu`` (paged/block KV cache) and
+``masked_multihead_attention`` (single-token decode attention), driven by
+``AnalysisPredictor`` (``fluid/inference/api/analysis_predictor.h:105``).
+
+TPU-native re-design: the cache is a STATIC-shape ring of
+``[n_layers, B, max_len, n_kv, d]`` arrays updated with
+``lax.dynamic_update_slice`` (no paging — XLA wants fixed shapes; max_len
+plays the role of the reference's block table capacity), the decode loop is
+ONE compiled ``lax.scan`` (no host round-trip per token), and layer weights
+are stacked on a leading layer axis so the whole network is a scan over one
+compiled layer body.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.nn_ops import _rms_norm_plain, _rope_plain
+
+
+def _stack_layer_params(state, n_layers, prefix="llama.layers"):
+    """{name: [L, ...] array} for the per-layer weights."""
+    names = ["self_attn.q_proj.weight", "self_attn.k_proj.weight",
+             "self_attn.v_proj.weight", "self_attn.o_proj.weight",
+             "mlp.gate_proj.weight", "mlp.up_proj.weight",
+             "mlp.down_proj.weight", "input_layernorm.weight",
+             "post_attention_layernorm.weight"]
+    out = {}
+    for n in names:
+        out[n] = jnp.stack([jnp.asarray(state[f"{prefix}.{i}.{n}"])
+                            for i in range(n_layers)])
+    return out
+
+
+class LlamaDecoder:
+    """Greedy incremental decoder over a LlamaForCausalLM's weights.
+
+    decoder = LlamaDecoder(model)
+    out_ids = decoder.generate(input_ids, max_new_tokens=32)  # [B, new]
+    """
+
+    def __init__(self, model):
+        from .llama import _rope_tables
+
+        cfg = model.config
+        self.config = cfg
+        state = {k: v._data for k, v in model.state_dict().items()}
+        self.layers = _stack_layer_params(state, cfg.num_hidden_layers)
+        self.embed = jnp.asarray(state["llama.embed_tokens.weight"])
+        self.norm_w = jnp.asarray(state["llama.norm.weight"])
+        if cfg.tie_word_embeddings:
+            self.head_w = self.embed.T
+        else:
+            self.head_w = jnp.asarray(state["lm_head.weight"])
+        cos, sin = _rope_tables(cfg)
+        self.cos, self.sin = jnp.asarray(cos), jnp.asarray(sin)
+        self._gen_cache = {}
+
+    # -- one forward over [B, S] tokens against the cache -------------------
+
+    def _forward(self, params, ids, kc, vc, pos_start):
+        """params = (layers, embed, norm_w, head_w, cos, sin) as traced
+        args (NOT closure constants — weights must stay jit inputs, not be
+        baked into the executable).  ids [B, S]; kc/vc
+        [L, B, max_len, n_kv, d]; pos_start: scalar position of ids[:, 0].
+        Returns (last-token logits, new caches)."""
+        layers, embed, norm_w, head_w, cos_tab, sin_tab = params
+        cfg = self.config
+        nh, nkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                      cfg.head_dim)
+        eps = cfg.rms_norm_eps
+        B, S = ids.shape
+        Lc = kc.shape[2]
+        x = embed[ids]  # [B, S, h]
+        positions = pos_start + jnp.arange(S)
+        pos_ids = jnp.broadcast_to(positions[None], (B, S))
+        scale = 1.0 / np.sqrt(d)
+        key_pos = jnp.arange(Lc)
+
+        def block(x, lp_kv):
+            lp, k_cache, v_cache = lp_kv
+            h = _rms_norm_plain(x, lp["input_layernorm.weight"],
+                                epsilon=eps)
+            q = (h @ lp["self_attn.q_proj.weight"]).reshape(B, S, nh, d)
+            k = (h @ lp["self_attn.k_proj.weight"]).reshape(B, S, nkv, d)
+            v = (h @ lp["self_attn.v_proj.weight"]).reshape(B, S, nkv, d)
+            q, k = _rope_plain(q, k, cos_tab, sin_tab,
+                               position_ids=pos_ids)
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype), (0, pos_start, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype), (0, pos_start, 0, 0))
+            # Grouped GQA attention against the padded cache, causal via
+            # key_pos <= pos_start + q_idx (masked_multihead_attention
+            # semantics on a fixed-capacity buffer).
+            g = nh // nkv
+            qt = jnp.swapaxes(q, 1, 2).reshape(B, nkv, g, S, d)
+            kt = jnp.swapaxes(k_cache, 1, 2)  # [B, nkv, Lc, d]
+            vt = jnp.swapaxes(v_cache, 1, 2)
+            logits = jnp.einsum("bngqd,bnkd->bngqk", qt, kt) * scale
+            mask = key_pos[None, :] <= (pos_start + jnp.arange(S))[:, None]
+            logits = jnp.where(mask[None, None, None], logits,
+                               jnp.finfo(logits.dtype).min)
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1) \
+                .astype(x.dtype)
+            o = jnp.einsum("bngqk,bnkd->bngqd", probs, vt)
+            o = jnp.swapaxes(o.reshape(B, nh, S, d), 1, 2) \
+                .reshape(B, S, nh * d)
+            x = x + o @ lp["self_attn.o_proj.weight"]
+            h2 = _rms_norm_plain(x, lp["post_attention_layernorm.weight"],
+                                 epsilon=eps)
+            gate = h2 @ lp["mlp.gate_proj.weight"]
+            up = h2 @ lp["mlp.up_proj.weight"]
+            x = x + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
+            return x, (k_cache, v_cache)
+
+        x, (new_kc, new_vc) = jax.lax.scan(block, x, (layers, kc, vc))
+        x = _rms_norm_plain(x, norm_w, epsilon=eps)
+        logits = x[:, -1] @ head_w  # [B, V]
+        return logits, new_kc, new_vc
+
+    # -- compiled greedy generation -----------------------------------------
+
+    def _build_generate(self, B, S, max_new_tokens):
+        cfg = self.config
+        nkv, d = cfg.num_key_value_heads, cfg.head_dim
+        L = cfg.num_hidden_layers
+        max_len = S + max_new_tokens
+        dt = self.embed.dtype
+
+        def gen(params, ids):
+            kc = jnp.zeros((L, B, max_len, nkv, d), dt)
+            vc = jnp.zeros((L, B, max_len, nkv, d), dt)
+            logits, kc, vc = self._forward(params, ids, kc, vc, 0)
+            tok = jnp.argmax(logits, axis=-1).astype(ids.dtype)  # [B]
+
+            def step(carry, _):
+                tok, kc, vc, pos = carry
+                logits, kc, vc = self._forward(params, tok[:, None], kc,
+                                               vc, pos)
+                nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
+                return (nxt, kc, vc, pos + 1), tok
+
+            (last, _, _, _), toks = jax.lax.scan(
+                step, (tok, kc, vc, jnp.asarray(S)), None,
+                length=max_new_tokens - 1)
+            return jnp.concatenate([jnp.swapaxes(toks, 0, 1),
+                                    last[:, None]], axis=1)
+
+        return jax.jit(gen)
+
+    def generate(self, input_ids, max_new_tokens=16):
+        """Greedy decode: returns [B, max_new_tokens] generated ids."""
+        from ..core.tensor import Tensor
+
+        ids = input_ids._data if isinstance(input_ids, Tensor) \
+            else jnp.asarray(np.asarray(input_ids))
+        B, S = ids.shape
+        if S + max_new_tokens > self.config.max_position_embeddings:
+            raise ValueError(
+                f"prompt {S} + max_new_tokens {max_new_tokens} exceeds "
+                f"max_position_embeddings "
+                f"{self.config.max_position_embeddings}")
+        key = (B, S, max_new_tokens)
+        if key not in self._gen_cache:
+            self._gen_cache[key] = self._build_generate(B, S,
+                                                        max_new_tokens)
+        params = (self.layers, self.embed, self.norm_w, self.head_w,
+                  self.cos, self.sin)
+        out = self._gen_cache[key](params, ids)
+        return Tensor(out) if isinstance(input_ids, Tensor) else \
+            np.asarray(out)

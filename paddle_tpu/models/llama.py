@@ -321,6 +321,20 @@ class LlamaForCausalLM(nn.Layer):
         lab = labels._data if isinstance(labels, Tensor) else labels
         return Tensor(jax.checkpoint(loss_fn)(hidden._data, w._data, lab))
 
+    def generate(self, input_ids, max_new_tokens=16):
+        """Greedy KV-cache decode (see models/generation.py). The decoder
+        snapshots weights at build; it is rebuilt automatically whenever
+        the live parameter buffers have changed since."""
+        from .generation import LlamaDecoder
+
+        sig = tuple(id(p._data) for _, p in self.named_parameters())
+        if getattr(self, "_decoder", None) is None or \
+                self._decoder_sig != sig:
+            self._decoder = LlamaDecoder(self)
+            self._decoder_sig = sig
+        return self._decoder.generate(input_ids,
+                                      max_new_tokens=max_new_tokens)
+
     def num_params(self):
         return sum(int(np.prod(p.shape)) for p in self.parameters())
 
