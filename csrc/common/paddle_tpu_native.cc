@@ -1,0 +1,188 @@
+// paddle_tpu native core: L0 common (flags / DDim / enforce) + host-side
+// data-pipeline kernels.
+//
+// Reference parity: paddle/common/ (DDim ddim.h, flags.cc registry,
+// enforce.h) and the C++ half of the io stack (fluid/framework/data_feed.cc,
+// io worker collation).  On TPU the device math belongs to XLA; what stays
+// native is the HOST hot path: epoch shuffling, variable-length document
+// packing into fixed windows (XLA wants static shapes), and batch collation
+// (row gather) feeding the async dispatch queue.
+//
+// Exposed as a C ABI consumed via ctypes (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+int64_t ptn_version() { return 2; }
+
+// ---------------------------------------------------------------------------
+// Flags registry (PD_DEFINE_* / PHI_DEFINE_EXPORTED_* analog).
+// ---------------------------------------------------------------------------
+
+namespace {
+std::map<std::string, double> g_flags;
+std::mutex g_flags_mu;
+}  // namespace
+
+void ptn_flag_set(const char* key, double value) {
+  std::lock_guard<std::mutex> lk(g_flags_mu);
+  g_flags[key] = value;
+}
+
+double ptn_flag_get(const char* key, int* found) {
+  std::lock_guard<std::mutex> lk(g_flags_mu);
+  auto it = g_flags.find(key);
+  if (it == g_flags.end()) {
+    *found = 0;
+    return 0.0;
+  }
+  *found = 1;
+  return it->second;
+}
+
+int64_t ptn_flag_count() {
+  std::lock_guard<std::mutex> lk(g_flags_mu);
+  return static_cast<int64_t>(g_flags.size());
+}
+
+// ---------------------------------------------------------------------------
+// DDim (paddle/common/ddim.h analog): bounded-rank shape math.
+// ---------------------------------------------------------------------------
+
+int64_t ptn_ddim_product(const int64_t* dims, int64_t rank) {
+  int64_t p = 1;
+  for (int64_t i = 0; i < rank; ++i) p *= dims[i];
+  return p;
+}
+
+// Row-major contiguous strides; returns 0 on success, -1 on bad rank.
+int64_t ptn_ddim_strides(const int64_t* dims, int64_t rank,
+                         int64_t* strides) {
+  if (rank < 0 || rank > 9) return -1;  // DDim::kMaxRank == 9
+  int64_t s = 1;
+  for (int64_t i = rank - 1; i >= 0; --i) {
+    strides[i] = s;
+    s *= dims[i];
+  }
+  return 0;
+}
+
+// slice_ddim(dims, begin, end) -> out; returns new rank or -1.
+int64_t ptn_ddim_slice(const int64_t* dims, int64_t rank, int64_t begin,
+                       int64_t end, int64_t* out) {
+  if (begin < 0 || end > rank || begin > end) return -1;
+  for (int64_t i = begin; i < end; ++i) out[i - begin] = dims[i];
+  return end - begin;
+}
+
+// ---------------------------------------------------------------------------
+// Data pipeline kernels.
+// ---------------------------------------------------------------------------
+
+// Fisher-Yates shuffle with splitmix64 — the epoch-shuffle hot loop.
+static inline uint64_t splitmix64(uint64_t* state) {
+  uint64_t z = (*state += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+void ptn_shuffle(int64_t* idx, int64_t n, uint64_t seed) {
+  uint64_t st = seed ? seed : 0x853c49e6748fea9bULL;
+  for (int64_t i = n - 1; i > 0; --i) {
+    int64_t j = static_cast<int64_t>(splitmix64(&st) %
+                                     static_cast<uint64_t>(i + 1));
+    int64_t t = idx[i];
+    idx[i] = idx[j];
+    idx[j] = t;
+  }
+}
+
+// Greedy sequential packing of variable-length docs into fixed-capacity
+// windows (static shapes for XLA).  bin_ids[i] = window of doc i;
+// returns the number of windows.  Docs longer than capacity get their own
+// window (caller truncates).
+int64_t ptn_pack_greedy(const int64_t* lens, int64_t n, int64_t capacity,
+                        int64_t* bin_ids) {
+  if (capacity <= 0) return -1;
+  int64_t bin = 0, used = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t l = lens[i] < capacity ? lens[i] : capacity;
+    if (used > 0 && used + l > capacity) {
+      ++bin;
+      used = 0;
+    }
+    bin_ids[i] = bin;
+    used += l;
+  }
+  return n > 0 ? bin + 1 : 0;
+}
+
+// First-fit-decreasing packing: better occupancy, needs sorted input.
+// order[] must hold doc indices sorted by decreasing length.
+int64_t ptn_pack_ffd(const int64_t* lens, const int64_t* order, int64_t n,
+                     int64_t capacity, int64_t* bin_ids) {
+  if (capacity <= 0) return -1;
+  std::vector<int64_t> space;
+  for (int64_t oi = 0; oi < n; ++oi) {
+    int64_t i = order[oi];
+    int64_t l = lens[i] < capacity ? lens[i] : capacity;
+    int64_t placed = -1;
+    for (size_t b = 0; b < space.size(); ++b) {
+      if (space[b] >= l) {
+        placed = static_cast<int64_t>(b);
+        break;
+      }
+    }
+    if (placed < 0) {
+      space.push_back(capacity);
+      placed = static_cast<int64_t>(space.size()) - 1;
+    }
+    space[placed] -= l;
+    bin_ids[i] = placed;
+  }
+  return static_cast<int64_t>(space.size());
+}
+
+// Row-gather collation: out[r] = src[idx[r]] for fixed-size rows.  The
+// DataLoader batch-assembly hot loop (one memcpy per sample).
+void ptn_gather_rows(const char* src, int64_t row_bytes, const int64_t* idx,
+                     int64_t n, char* out) {
+  for (int64_t r = 0; r < n; ++r) {
+    std::memcpy(out + r * row_bytes, src + idx[r] * row_bytes,
+                static_cast<size_t>(row_bytes));
+  }
+}
+
+// Flatten packed documents into [n_bins, capacity] token windows with
+// padding: tokens = concatenated docs, offsets[i] = start of doc i
+// (offsets[n] = total).  Returns 0, or -1 on overflow (should not happen
+// with bins from ptn_pack_*).
+int64_t ptn_fill_windows(const int64_t* tokens, const int64_t* offsets,
+                         const int64_t* bin_ids, int64_t n, int64_t n_bins,
+                         int64_t capacity, int64_t pad, int64_t* out,
+                         int64_t* out_used) {
+  for (int64_t b = 0; b < n_bins; ++b) {
+    out_used[b] = 0;
+    for (int64_t c = 0; c < capacity; ++c) out[b * capacity + c] = pad;
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t b = bin_ids[i];
+    if (b < 0 || b >= n_bins) return -1;
+    int64_t len = offsets[i + 1] - offsets[i];
+    if (len > capacity) len = capacity;  // truncate over-long docs
+    if (out_used[b] + len > capacity) return -1;
+    std::memcpy(out + b * capacity + out_used[b], tokens + offsets[i],
+                static_cast<size_t>(len) * sizeof(int64_t));
+    out_used[b] += len;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -354,3 +354,38 @@ class DataLoader:
 
 def get_worker_info():
     return None
+
+
+def pack_sequences(docs, seq_len, pad=0, strategy="ffd"):
+    """Pack variable-length token documents into fixed [n, seq_len] windows.
+
+    The LM-pretrain data-prep hot loop: XLA needs static shapes, so ragged
+    documents are binned into fixed windows (reference analog: the C++ data
+    feed, fluid/framework/data_feed.cc).  Runs on the native core
+    (csrc/common/paddle_tpu_native.cc) when built, numpy otherwise.
+
+    strategy: "ffd" (first-fit-decreasing, best occupancy) or "greedy"
+    (order-preserving sequential fill).
+    Returns (windows [n_bins, seq_len] int64, used [n_bins]).
+    """
+    import numpy as _np
+
+    from ..core import native as _native
+
+    docs = [
+        _np.ascontiguousarray(_np.asarray(d).ravel(), _np.int64)
+        for d in docs
+    ]
+    lens = _np.array([len(d) for d in docs], _np.int64)
+    if strategy == "ffd":
+        bins, n_bins = _native.pack_ffd(lens, seq_len)
+    elif strategy == "greedy":
+        bins, n_bins = _native.pack_greedy(lens, seq_len)
+    else:
+        raise ValueError(f"unknown packing strategy {strategy!r}")
+    tokens = (_np.concatenate(docs) if docs
+              else _np.zeros(0, _np.int64))
+    offsets = _np.zeros(len(docs) + 1, _np.int64)
+    _np.cumsum(lens, out=offsets[1:])
+    return _native.fill_windows(tokens, offsets, bins, n_bins, seq_len,
+                                pad=pad)

@@ -161,6 +161,9 @@ class LlamaDecoder:
         ids = input_ids._data if isinstance(input_ids, Tensor) \
             else jnp.asarray(np.asarray(input_ids))
         B, S = ids.shape
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens}")
         if S + max_new_tokens > self.config.max_position_embeddings:
             raise ValueError(
                 f"prompt {S} + max_new_tokens {max_new_tokens} exceeds "
@@ -168,6 +171,10 @@ class LlamaDecoder:
                 f"{self.config.max_position_embeddings}")
         key = (B, S, max_new_tokens)
         if key not in self._gen_cache:
+            if len(self._gen_cache) >= 8:
+                # Bounded: variable-length serving must not pin one
+                # compiled decode program per distinct prompt shape.
+                self._gen_cache.clear()
             self._gen_cache[key] = self._build_generate(B, S,
                                                         max_new_tokens)
         params = (self.layers, self.embed, self.norm_w, self.head_w,

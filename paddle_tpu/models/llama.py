@@ -325,13 +325,19 @@ class LlamaForCausalLM(nn.Layer):
         """Greedy KV-cache decode (see models/generation.py). The decoder
         snapshots weights at build; it is rebuilt automatically whenever
         the live parameter buffers have changed since."""
+        import weakref
+
         from .generation import LlamaDecoder
 
-        sig = tuple(id(p._data) for _, p in self.named_parameters())
-        if getattr(self, "_decoder", None) is None or \
-                self._decoder_sig != sig:
+        # Weakrefs, not id(): a recycled id after GC would fake-match and
+        # serve stale weights.  A dead ref never compares `is` equal.
+        refs = getattr(self, "_decoder_refs", None)
+        live = [p._data for _, p in self.named_parameters()]
+        fresh = (refs is not None and len(refs) == len(live)
+                 and all(r() is d for r, d in zip(refs, live)))
+        if getattr(self, "_decoder", None) is None or not fresh:
             self._decoder = LlamaDecoder(self)
-            self._decoder_sig = sig
+            self._decoder_refs = [weakref.ref(d) for d in live]
         return self._decoder.generate(input_ids,
                                       max_new_tokens=max_new_tokens)
 
