@@ -35,11 +35,16 @@ def _build_and_load():
     if not os.path.exists(so) or \
             os.path.getmtime(so) < os.path.getmtime(src):
         os.makedirs(out_dir, exist_ok=True)
+        # Compile to a temp path + atomic rename: an interrupted or
+        # concurrent build must never leave a corrupt .so at the final
+        # path (the mtime check would then trust it forever).
+        tmp = so + f".tmp.{os.getpid()}"
         cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-Wall",
-               src, "-o", so]
+               src, "-o", tmp]
         try:
             subprocess.run(cmd, check=True, capture_output=True,
                            timeout=120)
+            os.replace(tmp, so)
         except FileNotFoundError:
             return None  # no toolchain: silent fallback is the contract
         except subprocess.CalledProcessError as e:
@@ -55,6 +60,12 @@ def _build_and_load():
 
             warnings.warn(f"paddle_tpu native build failed: {e}")
             return None
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
     try:
         lib = ctypes.CDLL(so)
     except OSError:

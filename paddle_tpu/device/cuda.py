@@ -1,0 +1,24 @@
+"""paddle.device.cuda compat namespace — the reference exposes memory
+stats here (``python/paddle/device/cuda/__init__.py``); on TPU they are
+the same PJRT stats as ``paddle.device.memory``."""
+from .memory import (  # noqa: F401
+    empty_cache,
+    get_device_properties,
+    max_memory_allocated,
+    max_memory_reserved,
+    memory_allocated,
+    memory_reserved,
+    reset_max_memory_allocated,
+)
+
+
+def device_count():
+    import jax
+
+    return len(jax.devices())
+
+
+def synchronize(device=None):
+    from . import synchronize as _sync
+
+    _sync(device)

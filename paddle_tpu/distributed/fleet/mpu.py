@@ -120,18 +120,83 @@ class RowParallelLinear(Layer):
         return F.linear(x, self.weight, self.bias)
 
 
+def _vocab_parallel_ce_local(logits, label, *, axis_name, ignore_index):
+    """Per-device body: logits [T, V_local] (this rank's vocab shard),
+    label [T] global ids.  CE without ever materializing gathered logits —
+    max/sum-exp/target-logit are psum'd scalars per token, the memory win
+    of the reference's ParallelCrossEntropy (mp_layers.py:742)."""
+    import jax
+    import jax.numpy as jnp
+
+    T, v_local = logits.shape
+    rank = jax.lax.axis_index(axis_name)
+    lo = rank * v_local
+    lf = logits.astype(jnp.float32)
+    # Stable softmax pieces with cross-shard reductions.
+    local_max = jnp.max(lf, axis=-1)
+    # The global max is only a log-sum-exp stability shift (its gradient
+    # contributions cancel), so stop_gradient is exact — and pmax has no
+    # differentiation rule anyway.
+    gmax = jax.lax.stop_gradient(
+        jax.lax.pmax(jax.lax.stop_gradient(local_max), axis_name))
+    sumexp = jnp.sum(jnp.exp(lf - gmax[:, None]), axis=-1)
+    gsum = jax.lax.psum(sumexp, axis_name)
+    # The target logit lives on exactly one shard: masked local gather.
+    local_idx = jnp.clip(label - lo, 0, v_local - 1)
+    mine = (label >= lo) & (label < lo + v_local)
+    picked = jnp.take_along_axis(lf, local_idx[:, None], axis=-1)[:, 0]
+    target = jax.lax.psum(jnp.where(mine, picked, 0.0), axis_name)
+    loss = jnp.log(gsum) + gmax - target
+    return jnp.where(label == ignore_index, 0.0, loss)
+
+
 class ParallelCrossEntropy(Layer):
-    """Vocab-parallel softmax CE (mp_layers.py:742).  With logits sharded
-    on the class dim, GSPMD computes the softmax reductions with
-    allreduces over mp; the math here is the plain CE."""
+    """Vocab-parallel softmax CE (mp_layers.py:742): logits sharded on the
+    class dim over 'mp', loss computed shard-locally with psum'd scalar
+    reductions — the gathered [T, V] logits are never materialized."""
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
         super().__init__()
         self.ignore_index = ignore_index
+        self._ops = {}
+
+    def _mp_op(self, mesh, n):
+        import functools
+
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from ...ops.registry import OpDef
+
+        key = (mesh.jax_mesh, n)
+        if key not in self._ops:
+            body = functools.partial(_vocab_parallel_ce_local,
+                                     axis_name="mp",
+                                     ignore_index=self.ignore_index)
+
+            def fn(logits, label):
+                mapped = jax.shard_map(
+                    body, mesh=mesh.jax_mesh,
+                    in_specs=(P(None, "mp"), P()), out_specs=P())
+                return mapped(logits, label)
+
+            self._ops[key] = OpDef("vocab_parallel_cross_entropy", fn,
+                                   nondiff_argnums=(1,))
+        return self._ops[key]
 
     def forward(self, input, label):
-        return F.cross_entropy(input, label, reduction="none",
-                               ignore_index=self.ignore_index)
+        mesh, mp = _mp_mesh()
+        if mesh is None or mp <= 1:
+            return F.cross_entropy(input, label, reduction="none",
+                                   ignore_index=self.ignore_index)
+        from ...ops import registry
+        from ... import ops as _ops
+
+        shape = input.shape
+        flat = _ops.reshape(input, [-1, shape[-1]])
+        lab = _ops.reshape(label, [-1])
+        loss = registry.apply(self._mp_op(mesh, mp), flat, lab)
+        return _ops.reshape(loss, list(shape[:-1]))
 
 
 def _is_traced(t):

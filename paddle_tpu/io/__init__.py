@@ -183,19 +183,49 @@ class BatchSampler(Sampler):
         return (n + self.batch_size - 1) // self.batch_size
 
 
+def _default_shard_info():
+    """Per-host feeding defaults for multi-process SPMD: when jax runs
+    multi-process, each process loads its own data shard keyed by
+    ``jax.process_index()`` (SURVEY §7 step 4: per-host sharded feeding);
+    single-process falls back to the launcher env (PADDLE_TRAINER_*)."""
+    import jax
+
+    try:
+        if jax.process_count() > 1:
+            return jax.process_count(), jax.process_index()
+    except Exception:
+        pass
+    from ..distributed import get_rank, get_world_size
+
+    return get_world_size(), get_rank()
+
+
 class DistributedBatchSampler(BatchSampler):
     """Reference: python/paddle/io/dataloader/batch_sampler.py
-    DistributedBatchSampler — shards indices per rank."""
+    DistributedBatchSampler — shards indices per rank (rank defaulting to
+    the jax process for multi-host SPMD feeding)."""
 
     def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
                  shuffle=False, drop_last=False):
-        from ..distributed import get_rank, get_world_size
-
         self.dataset = dataset
         self.batch_size = batch_size
-        self.nranks = num_replicas if num_replicas is not None \
-            else get_world_size()
-        self.local_rank = rank if rank is not None else get_rank()
+        if num_replicas is None and rank is None:
+            num_replicas, rank = _default_shard_info()
+        elif num_replicas is None or rank is None:
+            # Half-specified would silently pair values from different
+            # sources (user vs jax process) -> wrong shard; fall back to
+            # the launcher env for the missing one, the pre-jax behavior.
+            from ..distributed import get_rank, get_world_size
+
+            num_replicas = num_replicas if num_replicas is not None \
+                else get_world_size()
+            rank = rank if rank is not None else get_rank()
+        if not (0 <= rank < num_replicas):
+            raise ValueError(
+                f"rank {rank} out of range for num_replicas "
+                f"{num_replicas}")
+        self.nranks = num_replicas
+        self.local_rank = rank
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.epoch = 0

@@ -57,9 +57,11 @@ class LlamaDecoder:
             self.head_w = self.embed.T
         else:
             self.head_w = jnp.asarray(state["lm_head.weight"])
+        import collections
+
         cos, sin = _rope_tables(cfg)
         self.cos, self.sin = jnp.asarray(cos), jnp.asarray(sin)
-        self._gen_cache = {}
+        self._gen_cache = collections.OrderedDict()
 
     # -- one forward over [B, S] tokens against the cache -------------------
 
@@ -170,11 +172,14 @@ class LlamaDecoder:
                 f"max_position_embeddings "
                 f"{self.config.max_position_embeddings}")
         key = (B, S, max_new_tokens)
-        if key not in self._gen_cache:
+        if key in self._gen_cache:
+            self._gen_cache.move_to_end(key)  # LRU touch
+        else:
             if len(self._gen_cache) >= 8:
-                # Bounded: variable-length serving must not pin one
-                # compiled decode program per distinct prompt shape.
-                self._gen_cache.clear()
+                # Bounded LRU: variable-length serving must not pin one
+                # compiled decode program per distinct prompt shape, and
+                # evicting only the coldest entry avoids recompile thrash.
+                self._gen_cache.popitem(last=False)
             self._gen_cache[key] = self._build_generate(B, S,
                                                         max_new_tokens)
         params = (self.layers, self.embed, self.norm_w, self.head_w,

@@ -33,3 +33,7 @@ def layer_norm_types():
     from .norm import _BatchNormBase
 
     return (_BatchNormBase, LayerNorm, GroupNorm, RMSNorm)
+from .transformer import (  # noqa: F401
+    MultiHeadAttention, Transformer, TransformerDecoder,
+    TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
+)

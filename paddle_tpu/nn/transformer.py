@@ -1,0 +1,294 @@
+"""Prebuilt transformer layers.
+
+Reference: ``python/paddle/nn/layer/transformer.py`` — MultiHeadAttention
+(:117), TransformerEncoderLayer (:498), TransformerEncoder (:701),
+TransformerDecoderLayer (:813), TransformerDecoder (:1026), Transformer
+(:1144).  Attention rides ``F.scaled_dot_product_attention`` ([B, S, H, D]
+flash-attn layout) so the MXU path and the Pallas flash kernel apply to
+these layers too.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops
+from . import functional as F
+from .common import Dropout, LayerList, Linear
+from .layers import Layer
+from .norm import LayerNorm
+
+
+def _convert_attn_mask(mask, dtype):
+    """bool mask (True = keep) -> additive; pass additive through."""
+    if mask is None:
+        return None
+    if "bool" in str(mask.dtype):
+        big = float(np.finfo(np.float32).min)
+        return ops.scale(ops.cast(ops.logical_not(mask), "float32"),
+                         scale=big)
+    return mask
+
+
+class MultiHeadAttention(Layer):
+    """Reference transformer.py:117; q/k/v projections + SDPA + out proj.
+    Supports self- and cross-attention and an incremental (decode) cache.
+    """
+
+    class Cache:
+        def __init__(self, k, v):
+            self.k, self.v = k, v
+
+    class StaticCache:
+        def __init__(self, k, v):
+            self.k, self.v = k, v
+
+    def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None,
+                 vdim=None, need_weights=False, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        if embed_dim % num_heads != 0:
+            raise ValueError(f"embed_dim {embed_dim} must divide "
+                             f"num_heads {num_heads}")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout = dropout
+        self.need_weights = need_weights
+        kdim = kdim or embed_dim
+        vdim = vdim or embed_dim
+        self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        self.k_proj = Linear(kdim, embed_dim, weight_attr, bias_attr)
+        self.v_proj = Linear(vdim, embed_dim, weight_attr, bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, weight_attr,
+                               bias_attr)
+
+    def gen_cache(self, key, value=None, type=None):
+        if type is MultiHeadAttention.StaticCache or value is not None:
+            value = value if value is not None else key
+            B, S = key.shape[0], key.shape[1]
+            k = ops.reshape(self.k_proj(key),
+                            [B, S, self.num_heads, self.head_dim])
+            v = ops.reshape(self.v_proj(value),
+                            [B, S, self.num_heads, self.head_dim])
+            return MultiHeadAttention.StaticCache(k, v)
+        B = key.shape[0]
+        from ..core.tensor import Tensor
+        import jax.numpy as jnp
+
+        k = Tensor(jnp.zeros((B, 0, self.num_heads, self.head_dim),
+                             jnp.float32))
+        v = Tensor(jnp.zeros((B, 0, self.num_heads, self.head_dim),
+                             jnp.float32))
+        return MultiHeadAttention.Cache(k, v)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        key = query if key is None else key
+        value = key if value is None else value
+        B, Sq = query.shape[0], query.shape[1]
+        q = ops.reshape(self.q_proj(query),
+                        [B, Sq, self.num_heads, self.head_dim])
+        if isinstance(cache, MultiHeadAttention.StaticCache):
+            k, v = cache.k, cache.v
+        else:
+            Sk = key.shape[1]
+            k = ops.reshape(self.k_proj(key),
+                            [B, Sk, self.num_heads, self.head_dim])
+            v = ops.reshape(self.v_proj(value),
+                            [B, Sk, self.num_heads, self.head_dim])
+            if isinstance(cache, MultiHeadAttention.Cache):
+                k = ops.concat([cache.k, k], axis=1)
+                v = ops.concat([cache.v, v], axis=1)
+                cache = MultiHeadAttention.Cache(k, v)
+        mask = _convert_attn_mask(attn_mask, q.dtype)
+        if mask is not None and mask.ndim == 3:
+            mask = ops.unsqueeze(mask, 1)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=mask, dropout_p=self.dropout,
+            training=self.training)
+        out = self.out_proj(ops.reshape(out, [B, Sq, self.embed_dim]))
+        if cache is not None:
+            return out, cache
+        return out
+
+
+class TransformerEncoderLayer(Layer):
+    """Reference transformer.py:498 (post-norm default, normalize_before
+    for pre-norm)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(
+            d_model, nhead, dropout=attn_dropout if attn_dropout
+            is not None else dropout)
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.dropout = Dropout(dropout)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(act_dropout if act_dropout is not None
+                                else dropout)
+        self.activation = getattr(ops, activation)
+
+    def forward(self, src, src_mask=None, cache=None):
+        residual = src
+        x = self.norm1(src) if self.normalize_before else src
+        if cache is not None:
+            x, cache = self.self_attn(x, attn_mask=src_mask, cache=cache)
+        else:
+            x = self.self_attn(x, attn_mask=src_mask)
+        x = residual + self.dropout1(x)
+        if not self.normalize_before:
+            x = self.norm1(x)
+        residual = x
+        y = self.norm2(x) if self.normalize_before else x
+        y = self.linear2(self.dropout2(self.activation(self.linear1(y))))
+        y = residual + self.dropout(y)
+        if not self.normalize_before:
+            y = self.norm2(y)
+        return (y, cache) if cache is not None else y
+
+    def gen_cache(self, src):
+        return self.self_attn.gen_cache(src)
+
+
+class TransformerEncoder(Layer):
+    """Reference transformer.py:701."""
+
+    def __init__(self, encoder_layer, num_layers, norm=None):
+        super().__init__()
+        import copy
+
+        self.layers = LayerList(
+            [encoder_layer] + [copy.deepcopy(encoder_layer)
+                               for _ in range(num_layers - 1)])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, src, src_mask=None):
+        out = src
+        for layer in self.layers:
+            out = layer(out, src_mask=src_mask)
+        if self.norm is not None:
+            out = self.norm(out)
+        return out
+
+
+class TransformerDecoderLayer(Layer):
+    """Reference transformer.py:813 — self-attn (causal) + cross-attn +
+    FFN."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        ad = attn_dropout if attn_dropout is not None else dropout
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=ad)
+        self.cross_attn = MultiHeadAttention(d_model, nhead, dropout=ad)
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.norm3 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.dropout3 = Dropout(act_dropout if act_dropout is not None
+                                else dropout)
+        self.activation = getattr(ops, activation)
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
+        residual = tgt
+        x = self.norm1(tgt) if self.normalize_before else tgt
+        x = self.self_attn(x, attn_mask=tgt_mask)
+        x = residual + self.dropout1(x)
+        if not self.normalize_before:
+            x = self.norm1(x)
+        residual = x
+        y = self.norm2(x) if self.normalize_before else x
+        y = self.cross_attn(y, memory, memory, attn_mask=memory_mask)
+        y = residual + self.dropout2(y)
+        if not self.normalize_before:
+            y = self.norm2(y)
+        residual = y
+        z = self.norm3(y) if self.normalize_before else y
+        z = self.linear2(self.dropout3(self.activation(self.linear1(z))))
+        z = residual + z
+        if not self.normalize_before:
+            z = self.norm3(z)
+        return z
+
+
+class TransformerDecoder(Layer):
+    """Reference transformer.py:1026."""
+
+    def __init__(self, decoder_layer, num_layers, norm=None):
+        super().__init__()
+        import copy
+
+        self.layers = LayerList(
+            [decoder_layer] + [copy.deepcopy(decoder_layer)
+                               for _ in range(num_layers - 1)])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None):
+        out = tgt
+        for layer in self.layers:
+            out = layer(out, memory, tgt_mask=tgt_mask,
+                        memory_mask=memory_mask)
+        if self.norm is not None:
+            out = self.norm(out)
+        return out
+
+
+class Transformer(Layer):
+    """Reference transformer.py:1144 — full encoder-decoder."""
+
+    def __init__(self, d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 custom_encoder=None, custom_decoder=None):
+        super().__init__()
+        self.d_model = d_model
+        self.nhead = nhead
+        if custom_encoder is not None:
+            self.encoder = custom_encoder
+        else:
+            enc_layer = TransformerEncoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before)
+            norm = LayerNorm(d_model) if normalize_before else None
+            self.encoder = TransformerEncoder(enc_layer,
+                                              num_encoder_layers, norm)
+        if custom_decoder is not None:
+            self.decoder = custom_decoder
+        else:
+            dec_layer = TransformerDecoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before)
+            norm = LayerNorm(d_model) if normalize_before else None
+            self.decoder = TransformerDecoder(dec_layer,
+                                              num_decoder_layers, norm)
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None,
+                memory_mask=None):
+        memory = self.encoder(src, src_mask=src_mask)
+        return self.decoder(tgt, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask)
+
+    @staticmethod
+    def generate_square_subsequent_mask(length):
+        """Additive causal mask [length, length] (reference :1310)."""
+        from ..core.tensor import Tensor
+        import jax.numpy as jnp
+
+        m = jnp.where(jnp.tril(jnp.ones((length, length), bool)), 0.0,
+                      np.finfo(np.float32).min)
+        return Tensor(m.astype(jnp.float32))

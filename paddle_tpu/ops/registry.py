@@ -35,6 +35,12 @@ from ..core import flags
 
 _OPS: dict[str, "OpDef"] = {}
 
+# Hooks set by paddle_tpu.amp.debugging (kept here to avoid import cycles):
+# _OP_STATS: {(op_name, dtype): count} when operator-stats collection is on.
+# _CHECKER_CFG: TensorCheckerConfig scoping the NaN/Inf check per op.
+_OP_STATS = None
+_CHECKER_CFG = None
+
 
 class OpDef:
     __slots__ = (
@@ -138,8 +144,16 @@ def apply(op: OpDef, *tensor_args, attrs=None, **kw_attrs):
         out_data = op.jit_fn(*datas, **attrs)
         node = None
 
-    if flags.flag("FLAGS_check_nan_inf"):
+    if flags.flag("FLAGS_check_nan_inf") and (
+            _CHECKER_CFG is None or _CHECKER_CFG._applies_to(op.name)):
         _check_nan_inf(op.name, out_data)
+    if _OP_STATS is not None:
+        outs = out_data if isinstance(out_data, (tuple, list)) \
+            else [out_data]
+        for o in outs:
+            if o is not None:
+                k = (op.name, str(o.dtype))
+                _OP_STATS[k] = _OP_STATS.get(k, 0) + 1
 
     # Ops whose outputs are all non-differentiable dtypes (bool/int —
     # comparisons, argmax...) never get a grad node, matching the

@@ -58,12 +58,18 @@ def export_chrome_tracing(dir_name, worker_name=None):
     return handler
 
 
+_EVENT_STATS = None  # {name: [count, total_s, min_s, max_s]} when active
+
+
 class RecordEvent:
-    """Reference: profiler/utils.py RecordEvent -> jax TraceAnnotation."""
+    """Reference: profiler/utils.py RecordEvent -> jax TraceAnnotation.
+    While a Profiler is active, host-side durations also feed the
+    statistics table (reference profiler_statistic.py)."""
 
     def __init__(self, name, event_type=None):
         self.name = name
         self._ann = jax.profiler.TraceAnnotation(name)
+        self._t0 = None
 
     def __enter__(self):
         self.begin()
@@ -75,9 +81,18 @@ class RecordEvent:
 
     def begin(self):
         self._ann.__enter__()
+        self._t0 = time.perf_counter()
 
     def end(self):
         self._ann.__exit__(None, None, None)
+        if _EVENT_STATS is not None and self._t0 is not None:
+            dt = time.perf_counter() - self._t0
+            rec = _EVENT_STATS.setdefault(self.name,
+                                          [0, 0.0, float("inf"), 0.0])
+            rec[0] += 1
+            rec[1] += dt
+            rec[2] = min(rec[2], dt)
+            rec[3] = max(rec[3], dt)
 
 
 class Profiler:
@@ -102,6 +117,10 @@ class Profiler:
         return False
 
     def start(self):
+        global _EVENT_STATS
+        _EVENT_STATS = {}
+        self._event_stats = None  # a restarted session must not show the
+        self._step_times = []     # previous run's table/timings
         self._last = time.perf_counter()
         if not self._timer_only:
             try:
@@ -111,12 +130,27 @@ class Profiler:
                 self._recording = False
 
     def stop(self):
+        global _EVENT_STATS
+        self._event_stats = _EVENT_STATS or {}
+        _EVENT_STATS = None
         if self._recording:
             try:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
             self._recording = False
+        if self._on_trace_ready is not None and \
+                callable(self._on_trace_ready):
+            import inspect
+
+            try:
+                n_params = len(inspect.signature(
+                    self._on_trace_ready).parameters)
+            except (TypeError, ValueError):
+                n_params = 1
+            # Only an arity mismatch is forgiven; handler bugs propagate.
+            if n_params >= 1:
+                self._on_trace_ready(self)
 
     def step(self, num_samples=None):
         now = time.perf_counter()
@@ -133,7 +167,29 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
-        return self.step_info()
+        """Statistics table over RecordEvent spans (reference
+        profiler_statistic.py event summary) + step timing."""
+        stats = getattr(self, "_event_stats", None) or _EVENT_STATS or {}
+        lines = []
+        if self._step_times:
+            tot = sum(self._step_times)
+            avg = tot / len(self._step_times)
+            lines.append(f"steps: {len(self._step_times)}  "
+                         f"total: {tot * 1e3:.2f} ms  "
+                         f"avg: {avg * 1e3:.2f} ms")
+        if stats:
+            w = max(len(n) for n in stats) + 2
+            lines.append(f"{'Name':<{w}}{'Calls':>8}{'Total(ms)':>12}"
+                         f"{'Avg(ms)':>12}{'Min(ms)':>12}{'Max(ms)':>12}")
+            order = sorted(stats.items(), key=lambda kv: -kv[1][1])
+            for name, (cnt, tot, mn, mx) in order:
+                lines.append(
+                    f"{name:<{w}}{cnt:>8}{tot * 1e3:>12.3f}"
+                    f"{tot / cnt * 1e3:>12.3f}{mn * 1e3:>12.3f}"
+                    f"{mx * 1e3:>12.3f}")
+        out = "\n".join(lines) if lines else self.step_info()
+        print(out)
+        return out
 
     def export(self, path, format="json"):
         pass

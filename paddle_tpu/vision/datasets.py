@@ -1,0 +1,157 @@
+"""Vision datasets.
+
+Reference: ``python/paddle/vision/datasets/`` (MNIST mnist.py, Cifar
+cifar.py, FashionMNIST).  Same file formats and __getitem__ contracts;
+`download=True` is unsupported in this environment (no egress) — point
+``image_path``/``data_file`` at local copies, or use FakeImageDataset for
+pipeline work without data on disk.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class FakeImageDataset(Dataset):
+    """Deterministic random images + labels; stands in for real datasets in
+    tests/benchmarks (the reference tests use fake readers the same way)."""
+
+    def __init__(self, num_samples=128, image_shape=(3, 32, 32),
+                 num_classes=10, seed=0, transform=None):
+        rng = np.random.RandomState(seed)
+        self.images = rng.rand(num_samples, *image_shape) \
+            .astype(np.float32)
+        self.labels = rng.randint(0, num_classes,
+                                  size=(num_samples, 1)).astype(np.int64)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, i):
+        img = self.images[i]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[i]
+
+
+def _read_idx_images(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        data = f.read()
+    magic = int.from_bytes(data[0:4], "big")
+    if magic != 2051:
+        raise ValueError(f"{path}: not an IDX image file (magic {magic})")
+    n = int.from_bytes(data[4:8], "big")
+    rows = int.from_bytes(data[8:12], "big")
+    cols = int.from_bytes(data[12:16], "big")
+    arr = np.frombuffer(data, np.uint8, offset=16)
+    return arr.reshape(n, rows, cols)
+
+
+def _read_idx_labels(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        data = f.read()
+    magic = int.from_bytes(data[0:4], "big")
+    if magic != 2049:
+        raise ValueError(f"{path}: not an IDX label file (magic {magic})")
+    return np.frombuffer(data, np.uint8, offset=8)
+
+
+class MNIST(Dataset):
+    """Reference mnist.py: idx-format images/labels; mode train|test.
+    Files must exist locally (image_path/label_path) — no download here."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if download:
+            raise RuntimeError(
+                f"{self.NAME}: download is unavailable in this environment "
+                "(no network egress); pass local image_path/label_path")
+        if image_path is None or label_path is None:
+            raise ValueError(
+                f"{self.NAME}: image_path and label_path are required "
+                "(auto-download is unsupported without egress)")
+        for p in (image_path, label_path):
+            if not os.path.exists(p):
+                raise FileNotFoundError(p)
+        self.images = _read_idx_images(image_path)
+        self.labels = _read_idx_labels(label_path)
+        if len(self.images) != len(self.labels):
+            raise ValueError("image/label count mismatch")
+        self.mode = mode
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, i):
+        img = self.images[i].astype(np.float32)[None]  # [1, 28, 28]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array([self.labels[i]], np.int64)
+
+
+class FashionMNIST(MNIST):
+    """Reference fashion-mnist (same idx format)."""
+
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """Reference cifar.py: the python-pickle batches inside the official
+    tar.gz; mode train|test."""
+
+    _train_members = [f"data_batch_{i}" for i in range(1, 6)]
+    _test_members = ["test_batch"]
+    _label_key = b"labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if download:
+            raise RuntimeError(
+                "Cifar: download is unavailable in this environment "
+                "(no network egress); pass a local data_file tar.gz")
+        if data_file is None:
+            raise ValueError("Cifar: data_file (the official tar.gz) is "
+                             "required")
+        wanted = self._train_members if mode == "train" \
+            else self._test_members
+        images, labels = [], []
+        with tarfile.open(data_file, "r:*") as tar:
+            for m in tar.getmembers():
+                base = os.path.basename(m.name)
+                if base in wanted:
+                    d = pickle.load(tar.extractfile(m), encoding="bytes")
+                    images.append(np.asarray(d[b"data"], np.uint8))
+                    labels.append(np.asarray(d[self._label_key],
+                                             np.int64))
+        if not images:
+            raise ValueError(f"no {mode} batches found in {data_file}")
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.concatenate(labels)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, i):
+        img = self.images[i].astype(np.float32)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array([self.labels[i]], np.int64)
+
+
+class Cifar100(Cifar10):
+    _train_members = ["train"]
+    _test_members = ["test"]
+    _label_key = b"fine_labels"
