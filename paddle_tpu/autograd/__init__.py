@@ -8,3 +8,4 @@ from .engine import (  # noqa: F401
     backward, enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled,
 )
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .functional import hessian, jacobian, jvp, vjp  # noqa: F401

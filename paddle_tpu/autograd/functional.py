@@ -1,0 +1,87 @@
+"""Functional higher-order autograd: jacobian / hessian / vjp / jvp.
+
+Reference: ``python/paddle/incubate/autograd/functional.py`` (jacobian,
+hessian, vjp, jvp) and the prim/composite higher-order machinery
+(``paddle/fluid/prim``).  TPU-native: higher-order differentiation is what
+jax's functional transforms are built for — the Layer/Tensor function is
+lifted to a pure jax function and jax.jacobian/jax.hessian/jax.vjp/jax.jvp
+do the rest, composing to any order.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor
+
+
+def _lift(func):
+    """Wrap a Tensor-function as a pure jax function."""
+
+    def pure(*arrays):
+        from . import engine
+
+        with engine.no_grad():
+            out = func(*[Tensor(a) for a in arrays])
+        return jax.tree.map(
+            lambda o: o._data if isinstance(o, Tensor) else o, out,
+            is_leaf=lambda x: isinstance(x, Tensor))
+
+    return pure
+
+
+def _datas(xs):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    return [x._data if isinstance(x, Tensor) else x for x in xs]
+
+
+def _wrap(tree):
+    return jax.tree.map(Tensor, tree)
+
+
+def vjp(func, xs, v=None):
+    """(outputs, vjp_result): reverse-mode products.  Reference
+    incubate/autograd/functional.py vjp."""
+    datas = _datas(xs)
+    out, vjp_fn = jax.vjp(_lift(func), *datas)
+    if v is None:
+        v = jax.tree.map(lambda o: jax.numpy.ones_like(o), out)
+    else:
+        v = jax.tree.map(
+            lambda t: t._data if isinstance(t, Tensor) else t, v,
+            is_leaf=lambda x: isinstance(x, Tensor))
+    grads = vjp_fn(v)
+    grads = grads[0] if len(datas) == 1 else list(grads)
+    return _wrap(out), _wrap(grads)
+
+
+def jvp(func, xs, v=None):
+    """(outputs, jvp_result): forward-mode products."""
+    datas = _datas(xs)
+    if v is None:
+        tangents = [jax.numpy.ones_like(d) for d in datas]
+    else:
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        tangents = [t._data if isinstance(t, Tensor) else t for t in vs]
+    out, tangent_out = jax.jvp(_lift(func), tuple(datas), tuple(tangents))
+    return _wrap(out), _wrap(tangent_out)
+
+
+def jacobian(func, xs, create_graph=False):
+    """Full Jacobian (reverse-mode).  For func: R^n -> R^m over a single
+    input, returns [*out_shape, *in_shape]; multiple inputs return a
+    tuple."""
+    datas = _datas(xs)
+    jac = jax.jacrev(_lift(func), argnums=tuple(range(len(datas))))(*datas)
+    if len(datas) == 1:
+        jac = jac[0] if isinstance(jac, tuple) else jac
+    return _wrap(jac)
+
+
+def hessian(func, xs, create_graph=False):
+    """Hessian of a scalar-output function (forward-over-reverse)."""
+    datas = _datas(xs)
+    hes = jax.hessian(_lift(func), argnums=tuple(range(len(datas))))(
+        *datas)
+    if len(datas) == 1:
+        hes = hes[0][0] if isinstance(hes, tuple) else hes
+    return _wrap(hes)

@@ -1,0 +1,104 @@
+"""Elastic training manager: membership, scale detection, restart signal.
+
+Reference: ``python/paddle/distributed/fleet/elastic/manager.py:124``
+(ElasticManager) — registers nodes in etcd, watches membership, scales the
+world within ``--nnodes=min:max`` and triggers coordinated restarts.  Here
+membership lives in the launch HTTP master's KV store (no etcd in-image);
+each node heartbeats a lease key and the manager diffs the alive set.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..launch.master import KVClient
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """elastic = ElasticManager(master, job_id, np='2:4', host=...)
+    elastic.register(); ... status = elastic.watch()"""
+
+    def __init__(self, master_endpoint, job_id, np, host, rank,
+                 heartbeat_interval=2.0, lease_ttl=6.0,
+                 elastic_timeout=30.0):
+        self.kv = KVClient(master_endpoint)
+        self.job_id = job_id
+        parts = str(np).split(":")
+        self.min_np = int(parts[0])
+        self.max_np = int(parts[-1])
+        self.host = host
+        self.rank = rank
+        self.scope = f"/elastic/{job_id}"
+        self.hb_interval = heartbeat_interval
+        self.lease_ttl = lease_ttl
+        self.elastic_timeout = elastic_timeout
+        self.enable = self.max_np > self.min_np
+        self._stop = threading.Event()
+        self._hb_thread = None
+        self._known = None
+
+    # -- membership ----------------------------------------------------------
+
+    def _lease_key(self):
+        return f"{self.scope}/{self.rank}"
+
+    def _beat(self):
+        while not self._stop.is_set():
+            self.kv.put(self._lease_key(),
+                        f"{self.host}:{time.time()}")
+            self._stop.wait(self.hb_interval)
+
+    def register(self):
+        """Announce this node and start the heartbeat lease."""
+        self.kv.put(self._lease_key(), f"{self.host}:{time.time()}")
+        self._hb_thread = threading.Thread(target=self._beat, daemon=True)
+        self._hb_thread.start()
+
+    def exit(self, completed=True):
+        self._stop.set()
+        self.kv.delete(self._lease_key())
+
+    def alive_nodes(self):
+        """Ranks whose lease was renewed within the TTL."""
+        now = time.time()
+        out = {}
+        for key, val in self.kv.get_prefix(self.scope).items():
+            rank = key.rsplit("/", 1)[1]
+            host, ts = val.rsplit(":", 1)
+            if now - float(ts) <= self.lease_ttl:
+                out[int(rank)] = host
+        return out
+
+    # -- scale decisions -------------------------------------------------------
+
+    def watch(self):
+        """One membership observation -> ElasticStatus.
+
+        RESTART when the alive set changed but still satisfies min_np
+        (reference: coordinated restart at the new world size); HOLD while
+        below min_np (wait for rejoin within elastic_timeout, then ERROR).
+        """
+        alive = set(self.alive_nodes())
+        if self._known is None:
+            self._known = alive
+            self._below_since = None
+            return ElasticStatus.HOLD
+        if len(alive) < self.min_np:
+            if self._below_since is None:
+                self._below_since = time.time()
+            if time.time() - self._below_since > self.elastic_timeout:
+                return ElasticStatus.ERROR
+            return ElasticStatus.HOLD
+        self._below_since = None
+        if alive != self._known:
+            self._known = alive
+            return ElasticStatus.RESTART
+        return ElasticStatus.HOLD

@@ -40,6 +40,12 @@ def _parse_args(argv=None):
     p.add_argument("--devices", "--gpus", "--tpus", dest="devices",
                    default=None)
     p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--rendezvous", default="env", choices=["env", "http"],
+                   help="env: derive endpoints from --master arithmetic; "
+                        "http: rank-0 hosts an HTTP KV master and nodes "
+                        "register (reference HTTPMaster)")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="this node's address advertised at rendezvous")
     p.add_argument("--elastic_level", type=int, default=-1)
     p.add_argument("--elastic_timeout", type=int, default=30)
     p.add_argument("training_script")
@@ -88,6 +94,18 @@ def launch(argv=None):
     master_ip, master_port = (args.master.split(":")
                               if args.master else (None, None))
 
+    http_master = None
+    node_endpoints = None
+    if args.rendezvous == "http" and args.master:
+        from .master import HTTPMaster, rendezvous
+
+        if args.node_rank == 0:
+            http_master = HTTPMaster(args.master).start()
+        node_endpoints = rendezvous(
+            args.master, args.job_id, args.node_rank,
+            f"{args.host}:{int(master_port) + 1 + args.node_rank}",
+            nnodes, timeout=args.elastic_timeout * 10)
+
     containers = []
     for local_rank in range(nproc):
         rank = args.node_rank * nproc + local_rank
@@ -103,10 +121,17 @@ def launch(argv=None):
         if master_ip:
             env["MASTER_ADDR"] = master_ip
             env["MASTER_PORT"] = master_port
-            endpoints = [f"{master_ip}:{int(master_port) + i}"
-                         for i in range(world)]
-            env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
-            env["PADDLE_CURRENT_ENDPOINT"] = endpoints[rank]
+            if node_endpoints is not None:
+                # HTTP-rendezvous'd per-node endpoints (reference
+                # collective controller sync_peers).
+                env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(node_endpoints)
+                env["PADDLE_CURRENT_ENDPOINT"] = \
+                    node_endpoints[args.node_rank]
+            else:
+                endpoints = [f"{master_ip}:{int(master_port) + i}"
+                             for i in range(world)]
+                env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
+                env["PADDLE_CURRENT_ENDPOINT"] = endpoints[rank]
         cmd = [sys.executable, "-u", args.training_script,
                *args.training_script_args]
         log = os.path.join(args.log_dir,
@@ -126,26 +151,30 @@ def launch(argv=None):
 
     # Watcher loop (reference: controllers/watcher.py): restart failures
     # up to max_restart, fail the job when exhausted.
-    while True:
-        states = [(c, c.returncode) for c in containers]
-        if all(rc == 0 for _, rc in states if rc is not None) and \
-                all(not c.alive() for c in containers):
-            return 0
-        for c, rc in states:
-            if rc is not None and rc != 0:
-                if c.restarts < args.max_restart:
-                    c.restarts += 1
-                    print(f"[launch] worker failed (rc={rc}); restart "
-                          f"{c.restarts}/{args.max_restart}",
-                          file=sys.stderr)
-                    c.start()
-                else:
-                    print(f"[launch] worker failed (rc={rc}); giving up",
-                          file=sys.stderr)
-                    for other in containers:
-                        other.terminate()
-                    return rc
-        time.sleep(1)
+    try:
+        while True:
+            states = [(c, c.returncode) for c in containers]
+            if all(rc == 0 for _, rc in states if rc is not None) and \
+                    all(not c.alive() for c in containers):
+                return 0
+            for c, rc in states:
+                if rc is not None and rc != 0:
+                    if c.restarts < args.max_restart:
+                        c.restarts += 1
+                        print(f"[launch] worker failed (rc={rc}); restart "
+                              f"{c.restarts}/{args.max_restart}",
+                              file=sys.stderr)
+                        c.start()
+                    else:
+                        print(f"[launch] worker failed (rc={rc}); "
+                              "giving up", file=sys.stderr)
+                        for other in containers:
+                            other.terminate()
+                        return rc
+            time.sleep(1)
+    finally:
+        if http_master is not None:
+            http_master.stop()
 
 
 if __name__ == "__main__":

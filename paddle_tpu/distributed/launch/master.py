@@ -1,0 +1,154 @@
+"""Rendezvous master: HTTP KV store + node registration.
+
+Reference: ``python/paddle/distributed/launch/controllers/master.py`` —
+``HTTPMaster`` (:73) serving a KV store on the rank-0 node and
+``ETCDMaster`` (:186) for external etcd.  Here the HTTP master is a
+threaded stdlib server (no etcd in the image); the wire protocol is
+GET/PUT on /kv/<scope>/<key>, which is all the reference's collective
+controller needs: each node PUTs its endpoint under the job scope and
+polls the scope until the expected peer count shows up.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _store(self):
+        return self.server._kv
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length).decode()
+        with self.server._mu:
+            self._store()[self.path] = value
+        self.send_response(200)
+        self.end_headers()
+
+    def do_DELETE(self):
+        with self.server._mu:
+            self._store().pop(self.path, None)
+        self.send_response(200)
+        self.end_headers()
+
+    def do_GET(self):
+        with self.server._mu:
+            if self.path.endswith("/"):
+                # scope listing: every key under the prefix
+                items = {k: v for k, v in self._store().items()
+                         if k.startswith(self.path)}
+                body = json.dumps(items).encode()
+            elif self.path in self._store():
+                body = self._store()[self.path].encode()
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class HTTPMaster:
+    """In-process rendezvous server (run on the rank-0 node)."""
+
+    def __init__(self, endpoint):
+        host, port = endpoint.split(":")
+        self._server = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._server._kv = {}
+        self._server._mu = threading.Lock()
+        self._thread = None
+        self.endpoint = f"{host}:{self._server.server_address[1]}"
+
+    def start(self):
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class KVClient:
+    """Client half (reference launch/utils/kv_client.py)."""
+
+    def __init__(self, endpoint):
+        self.base = f"http://{endpoint}"
+
+    def _req(self, method, path, data=None, timeout=5):
+        req = urllib.request.Request(self.base + path, data=data,
+                                     method=method)
+        return urllib.request.urlopen(req, timeout=timeout)
+
+    def put(self, key, value):
+        try:
+            self._req("PUT", key, value.encode()).read()
+            return True
+        except (urllib.error.URLError, OSError):
+            return False
+
+    def get(self, key):
+        try:
+            return self._req("GET", key).read().decode()
+        except urllib.error.HTTPError:
+            return None
+        except (urllib.error.URLError, OSError):
+            return None
+
+    def delete(self, key):
+        try:
+            self._req("DELETE", key).read()
+            return True
+        except (urllib.error.URLError, OSError):
+            return False
+
+    def get_prefix(self, scope):
+        """{key: value} under a '/scope/' prefix."""
+        try:
+            raw = self._req("GET", scope if scope.endswith("/")
+                            else scope + "/").read()
+            return json.loads(raw)
+        except (urllib.error.URLError, OSError, ValueError):
+            return {}
+
+    def wait(self, key, timeout=60, interval=0.2):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            v = self.get(key)
+            if v is not None:
+                return v
+            time.sleep(interval)
+        return None
+
+
+def rendezvous(master_endpoint, job_id, rank, endpoint, nnodes,
+               timeout=120):
+    """Register this node and wait for the full peer set.
+
+    Returns the rank-sorted endpoint list once ``nnodes`` nodes have
+    registered (reference collective controller sync_peers)."""
+    kv = KVClient(master_endpoint)
+    scope = f"/rendezvous/{job_id}"
+    kv.put(f"{scope}/{rank}", endpoint)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        peers = kv.get_prefix(scope)
+        if len(peers) >= nnodes:
+            ordered = sorted(peers.items(),
+                             key=lambda kvp: int(kvp[0].rsplit("/", 1)[1]))
+            return [v for _, v in ordered]
+        time.sleep(0.2)
+    raise TimeoutError(
+        f"rendezvous: {len(kv.get_prefix(scope))}/{nnodes} nodes after "
+        f"{timeout}s")

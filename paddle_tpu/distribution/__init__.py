@@ -1,0 +1,435 @@
+"""Probability distributions.
+
+Reference: ``python/paddle/distribution/`` — Distribution base
+(distribution.py), Normal, Uniform, Bernoulli, Categorical, Beta,
+Dirichlet, Exponential, Gamma, Laplace, Gumbel, LogNormal, and the
+``kl_divergence`` dispatch (kl.py).  Densities/entropies are closed-form
+jax expressions; sampling draws from the global Generator's key stream
+(ops/random.py), so ``paddle.seed`` governs reproducibility exactly like
+the tensor random ops.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.random import default_generator
+
+
+def _d(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x, jnp.float32) if not isinstance(x, jnp.ndarray) \
+        else x
+
+
+def _shape(s):
+    if s is None:
+        return ()
+    return tuple(int(v) for v in s)
+
+
+class Distribution:
+    """Reference distribution/distribution.py Distribution."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from .. import ops
+
+        return ops.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _d(loc)
+        self.scale = _d(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    def sample(self, shape=()):
+        key = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        eps = jax.random.normal(key, s, jnp.float32)
+        return Tensor(self.loc + self.scale * eps)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _d(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale)
+                      - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        out = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return Tensor(jnp.broadcast_to(out, self.batch_shape))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _d(loc)
+        self.scale = _d(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(self._base.batch_shape)
+
+    def sample(self, shape=()):
+        return Tensor(jnp.exp(self._base.sample(shape)._data))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _d(value)
+        return Tensor(self._base.log_prob(Tensor(jnp.log(v)))._data
+                      - jnp.log(v))
+
+    def entropy(self):
+        return Tensor(self._base.entropy()._data + self.loc)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _d(low)
+        self.high = _d(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    def sample(self, shape=()):
+        key = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(key, s, jnp.float32)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _d(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                       self.batch_shape))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _d(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        key = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.bernoulli(
+            key, self.probs, s).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _d(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if logits is not None:
+            self.logits = _d(logits)
+            self._log_p = jax.nn.log_softmax(self.logits, -1)
+        else:
+            p = _d(probs)
+            p = p / jnp.sum(p, -1, keepdims=True)
+            self._log_p = jnp.log(jnp.clip(p, 1e-12))
+            self.logits = self._log_p
+        super().__init__(self._log_p.shape[:-1])
+
+    @property
+    def probs(self):
+        return Tensor(jnp.exp(self._log_p))
+
+    def sample(self, shape=()):
+        key = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.categorical(key, self.logits, -1, s))
+
+    def log_prob(self, value):
+        v = _d(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(
+            self._log_p, v[..., None], -1)[..., 0])
+
+    def entropy(self):
+        p = jnp.exp(self._log_p)
+        return Tensor(-jnp.sum(p * self._log_p, -1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _d(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    def sample(self, shape=()):
+        key = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.exponential(key, s, jnp.float32)
+                      / self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _d(value)
+        return Tensor(jnp.where(v >= 0, jnp.log(self.rate)
+                                - self.rate * v, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(1.0 - jnp.log(self.rate),
+                                       self.batch_shape))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _d(loc)
+        self.scale = _d(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        key = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale
+                      * jax.random.laplace(key, s, jnp.float32))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _d(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(1.0 + jnp.log(2 * self.scale),
+                                       self.batch_shape))
+
+
+class Gumbel(Distribution):
+    _euler = 0.5772156649015329
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _d(loc)
+        self.scale = _d(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        key = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale
+                      * jax.random.gumbel(key, s, jnp.float32))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (_d(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            jnp.log(self.scale) + 1.0 + self._euler, self.batch_shape))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _d(alpha)
+        self.beta = _d(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    def sample(self, shape=()):
+        key = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.beta(key, self.alpha, self.beta, s))
+
+    def log_prob(self, value):
+        v = _d(value)
+        a, b = self.alpha, self.beta
+        lbeta = (jax.scipy.special.gammaln(a)
+                 + jax.scipy.special.gammaln(b)
+                 - jax.scipy.special.gammaln(a + b))
+        return Tensor((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                      - lbeta)
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        dg = jax.scipy.special.digamma
+        lbeta = (jax.scipy.special.gammaln(a)
+                 + jax.scipy.special.gammaln(b)
+                 - jax.scipy.special.gammaln(a + b))
+        return Tensor(lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                      + (a + b - 2) * dg(a + b))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _d(concentration)
+        self.rate = _d(rate)
+        super().__init__(jnp.broadcast_shapes(
+            self.concentration.shape, self.rate.shape))
+
+    def sample(self, shape=()):
+        key = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.gamma(key, self.concentration, s)
+                      / self.rate)
+
+    def log_prob(self, value):
+        v = _d(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                      - jax.scipy.special.gammaln(a))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        dg = jax.scipy.special.digamma
+        return Tensor(a - jnp.log(b) + jax.scipy.special.gammaln(a)
+                      + (1 - a) * dg(a))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _d(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        key = default_generator.next_key()
+        s = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.dirichlet(key, self.concentration, s))
+
+    def log_prob(self, value):
+        v = _d(value)
+        a = self.concentration
+        lnorm = (jnp.sum(jax.scipy.special.gammaln(a), -1)
+                 - jax.scipy.special.gammaln(jnp.sum(a, -1)))
+        return Tensor(jnp.sum((a - 1) * jnp.log(v), -1) - lnorm)
+
+
+# -- KL divergence dispatch (reference distribution/kl.py) -------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    # support(p) must lie inside support(q); else +inf
+    inside = (q.low <= p.low) & (p.high <= q.high)
+    kl = jnp.log((q.high - q.low) / (p.high - p.low))
+    return Tensor(jnp.where(inside, kl, jnp.inf))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    a = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    b = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return Tensor(a * (jnp.log(a) - jnp.log(b))
+                  + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    pp = jnp.exp(p._log_p)
+    return Tensor(jnp.sum(pp * (p._log_p - q._log_p), -1))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + r - 1)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    g = jax.scipy.special.gammaln
+    dg = jax.scipy.special.digamma
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    t = (g(a1 + b1) - g(a1) - g(b1)
+         - (g(a2 + b2) - g(a2) - g(b2)))
+    return Tensor(t + (a1 - a2) * dg(a1) + (b1 - b2) * dg(b1)
+                  + (a2 - a1 + b2 - b1) * dg(a1 + b1))

@@ -4,3 +4,4 @@ Reference: ``python/paddle/incubate/`` (nn/functional fused ops, distributed
 models MoE).
 """
 from . import nn  # noqa: F401
+from . import autograd  # noqa: F401

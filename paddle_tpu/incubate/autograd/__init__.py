@@ -1,0 +1,10 @@
+"""paddle.incubate.autograd parity: functional higher-order AD.
+
+Reference: ``python/paddle/incubate/autograd/functional.py``.
+"""
+from ...autograd.functional import (  # noqa: F401
+    hessian,
+    jacobian,
+    jvp,
+    vjp,
+)

@@ -238,16 +238,19 @@ class CompiledTrainStep:
 
     def _zero_sharding(self, name, value, rules, dp_axis):
         """Opt-state sharding: param's TP sharding + dp over the first
-        still-replicated dim that divides evenly (ZeRO partitioning)."""
+        still-replicated dim that divides evenly (ZeRO partitioning);
+        warns when nothing divides (state stays replicated)."""
+        from ..distributed.fleet.sharding import _zero_dim
+
         spec = list(rules(name, value.shape))
         dp = self.mesh.get_dim_size(dp_axis) \
             if dp_axis in self.mesh.dim_names else 1
         if dp > 1:
-            for i, s in enumerate(spec):
-                if s is None and value.shape[i] % dp == 0 and \
-                        value.shape[i] >= dp:
-                    spec[i] = dp_axis
-                    break
+            free = [s if pl is None else -1
+                    for s, pl in zip(value.shape, spec)]
+            dim = _zero_dim(dp, [max(s, 0) for s in free], dp_axis, name)
+            if dim is not None and free[dim] > 0:
+                spec[dim] = dp_axis
         return NamedSharding(self.mesh.jax_mesh, PartitionSpec(*spec))
 
     def _place_batch(self, arr):

@@ -1,0 +1,171 @@
+"""Functional higher-order AD (reference incubate/autograd/functional.py)
+and the distribution module (reference python/paddle/distribution/,
+scipy-checked exactly like test/distribution)."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import hessian, jacobian, jvp, vjp
+from paddle_tpu import distribution as D
+
+
+# -- functional autograd -----------------------------------------------------
+
+def test_jacobian_matches_analytic():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+
+    def f(t):
+        return t * t
+
+    jac = jacobian(f, x)
+    np.testing.assert_allclose(jac.numpy(), np.diag([2.0, 4.0, 6.0]),
+                               rtol=1e-6)
+
+
+def test_hessian_matches_analytic():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+
+    def f(t):
+        # f = x0^2 * x1 -> H = [[2*x1, 2*x0], [2*x0, 0]]
+        return (t[0] * t[0] * t[1]).sum()
+
+    hes = hessian(f, x)
+    np.testing.assert_allclose(hes.numpy(),
+                               [[4.0, 2.0], [2.0, 0.0]], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_vjp_and_jvp():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    v = paddle.to_tensor(np.array([1.0, 0.5], np.float32))
+
+    def f(t):
+        return paddle.sin(t)
+
+    out, g = vjp(f, x, v)
+    np.testing.assert_allclose(out.numpy(), np.sin([1.0, 2.0]), rtol=1e-6)
+    np.testing.assert_allclose(g.numpy(),
+                               np.cos([1.0, 2.0]) * [1.0, 0.5],
+                               rtol=1e-6)
+    out2, t = jvp(f, x, v)
+    np.testing.assert_allclose(t.numpy(),
+                               np.cos([1.0, 2.0]) * [1.0, 0.5],
+                               rtol=1e-6)
+
+
+def test_third_order_composition():
+    """Transforms compose to any order (the prim/higher-order promise)."""
+    x = paddle.to_tensor(np.array([0.7], np.float32))
+
+    def f(t):
+        return (t ** 4).sum()
+
+    def grad_f(t):
+        return jacobian(f, t)
+
+    # d3/dx3 x^4 = 24x
+    j3 = jacobian(lambda t: hessian(f, t), x)
+    np.testing.assert_allclose(np.asarray(j3.numpy()).ravel(),
+                               [24 * 0.7], rtol=1e-5)
+    del grad_f
+
+
+# -- distributions (scipy golden) -------------------------------------------
+
+def test_normal_scipy():
+    d = D.Normal(1.5, 2.0)
+    v = np.array([0.0, 1.0, 4.0], np.float32)
+    np.testing.assert_allclose(d.log_prob(paddle.to_tensor(v)).numpy(),
+                               st.norm(1.5, 2.0).logpdf(v), rtol=1e-5)
+    np.testing.assert_allclose(float(d.entropy().numpy()),
+                               st.norm(1.5, 2.0).entropy(), rtol=1e-6)
+    paddle.seed(0)
+    s = d.sample([20000]).numpy()
+    assert abs(s.mean() - 1.5) < 0.05 and abs(s.std() - 2.0) < 0.05
+
+
+def test_uniform_bernoulli_categorical():
+    u = D.Uniform(-1.0, 3.0)
+    np.testing.assert_allclose(
+        u.log_prob(paddle.to_tensor(np.float32(0.0))).numpy(),
+        st.uniform(-1, 4).logpdf(0.0), rtol=1e-6)
+    assert np.isneginf(
+        u.log_prob(paddle.to_tensor(np.float32(5.0))).numpy())
+
+    b = D.Bernoulli(0.3)
+    np.testing.assert_allclose(
+        b.log_prob(paddle.to_tensor(np.float32(1.0))).numpy(),
+        np.log(0.3), rtol=1e-5)
+    np.testing.assert_allclose(float(b.entropy().numpy()),
+                               st.bernoulli(0.3).entropy(), rtol=1e-5)
+
+    logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+    c = D.Categorical(logits=logits)
+    np.testing.assert_allclose(
+        c.log_prob(paddle.to_tensor(np.array(2))).numpy(), np.log(0.5),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        float(c.entropy().numpy()),
+        st.entropy([0.2, 0.3, 0.5]), rtol=1e-5)
+    paddle.seed(1)
+    s = c.sample([20000]).numpy()
+    freq = np.bincount(s, minlength=3) / len(s)
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.02)
+
+
+@pytest.mark.parametrize("dist,ref", [
+    (lambda: D.Exponential(2.0), lambda: st.expon(scale=0.5)),
+    (lambda: D.Laplace(0.5, 1.5), lambda: st.laplace(0.5, 1.5)),
+    (lambda: D.Gumbel(1.0, 2.0), lambda: st.gumbel_r(1.0, 2.0)),
+    (lambda: D.Beta(2.0, 3.0), lambda: st.beta(2.0, 3.0)),
+    (lambda: D.Gamma(2.5, 2.0), lambda: st.gamma(2.5, scale=0.5)),
+])
+def test_continuous_scipy(dist, ref):
+    d, r = dist(), ref()
+    v = np.asarray(r.rvs(size=5, random_state=0), np.float32)
+    np.testing.assert_allclose(d.log_prob(paddle.to_tensor(v)).numpy(),
+                               r.logpdf(v), rtol=2e-4, atol=1e-5)
+    if hasattr(d, "entropy"):
+        np.testing.assert_allclose(float(np.asarray(
+            d.entropy().numpy())), r.entropy(), rtol=1e-4)
+
+
+def test_dirichlet_scipy():
+    a = np.array([2.0, 3.0, 4.0], np.float32)
+    d = D.Dirichlet(a)
+    v = np.array([0.2, 0.3, 0.5], np.float32)
+    v64 = v.astype(np.float64)
+    v64 = v64 / v64.sum()  # scipy demands an exact simplex point
+    np.testing.assert_allclose(
+        float(d.log_prob(paddle.to_tensor(v)).numpy()),
+        st.dirichlet(a.astype(np.float64)).logpdf(v64), rtol=1e-5)
+
+
+def test_kl_divergences():
+    p, q = D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)
+    # analytic: log(s2/s1) + (s1^2 + (m1-m2)^2)/(2 s2^2) - 1/2
+    want = np.log(2.0) + (1 + 1) / 8 - 0.5
+    np.testing.assert_allclose(float(D.kl_divergence(p, q).numpy()),
+                               want, rtol=1e-5)
+
+    c1 = D.Categorical(probs=np.array([0.5, 0.5], np.float32))
+    c2 = D.Categorical(probs=np.array([0.9, 0.1], np.float32))
+    want = 0.5 * np.log(0.5 / 0.9) + 0.5 * np.log(0.5 / 0.1)
+    np.testing.assert_allclose(float(D.kl_divergence(c1, c2).numpy()),
+                               want, rtol=1e-5)
+
+    b1, b2 = D.Bernoulli(0.3), D.Bernoulli(0.6)
+    want = 0.3 * np.log(0.3 / 0.6) + 0.7 * np.log(0.7 / 0.4)
+    np.testing.assert_allclose(float(D.kl_divergence(b1, b2).numpy()),
+                               want, rtol=1e-5)
+
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(p, c1)
+
+
+def test_lognormal_and_sampling_grad():
+    d = D.LogNormal(0.0, 0.5)
+    v = np.array([0.5, 1.0, 2.0], np.float32)
+    np.testing.assert_allclose(d.log_prob(paddle.to_tensor(v)).numpy(),
+                               st.lognorm(0.5).logpdf(v), rtol=1e-5)
