@@ -53,12 +53,22 @@ def main():
         # ~640M-param model (largest that fits 16G HBM with fp32 master +
         # bf16 moments + full-layer remat): head_dim 128 keeps the MXU
         # lanes full; scan_layers compiles one decoder body.
+        impl = os.environ.get("PT_BENCH_ATTN", "auto")
+        blocks = os.environ.get("PT_BENCH_FLASH_BLOCKS")
+        blocks = (tuple(int(x) for x in blocks.split(","))
+                  if blocks else None)
+        policy = os.environ.get("PT_BENCH_REMAT", "full")
         cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
                           intermediate_size=5504, num_hidden_layers=10,
                           num_attention_heads=16, num_key_value_heads=16,
-                          max_position_embeddings=2048, recompute=True,
-                          scan_layers=True)
-        batch, seq, steps = 8, 2048, 10
+                          max_position_embeddings=2048,
+                          recompute=os.environ.get(
+                              "PT_BENCH_RECOMPUTE", "1") == "1",
+                          recompute_policy=policy,
+                          scan_layers=True, attention_impl=impl,
+                          flash_blocks=blocks)
+        batch = int(os.environ.get("PT_BENCH_BATCH", "8"))
+        seq, steps = 2048, int(os.environ.get("PT_BENCH_STEPS", "10"))
 
     print(f"building model (layers={cfg.num_hidden_layers}, "
           f"hidden={cfg.hidden_size})...", file=sys.stderr)

@@ -29,6 +29,14 @@ def _device(device=None):
     if isinstance(device, str):
         parts = device.split(":")  # "tpu:0" / "gpu:1" / "cpu"
         idx = int(parts[1]) if len(parts) > 1 else 0
+        if parts[0]:
+            # Honor the platform prefix: on a mixed-backend process the
+            # bare global index could resolve to a different platform
+            # than requested (round-2 advisor finding).
+            try:
+                return jax.devices(parts[0])[idx]
+            except RuntimeError:
+                pass  # unknown platform → fall back to the global list
         return jax.devices()[idx]
     return device
 

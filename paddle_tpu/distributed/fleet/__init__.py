@@ -115,7 +115,9 @@ class _Fleet:
         """Reference: fleet/model.py:32,139-170 — pick the wrapper by the
         dominant parallel mode."""
         from ..parallel import DataParallel
-        from .meta_parallel import TensorParallel
+        from .meta_parallel import (
+            SegmentParallel, ShardingParallel, TensorParallel,
+        )
         from .pipeline_parallel import PipelineParallel
 
         if self._hcg is None:
@@ -124,6 +126,10 @@ class _Fleet:
             return PipelineParallel(model, self._hcg, self._strategy)
         if self._hcg.get_model_parallel_world_size() > 1:
             return TensorParallel(model, self._hcg, self._strategy)
+        if self._hcg.get_sep_parallel_world_size() > 1:
+            return SegmentParallel(model, self._hcg, self._strategy)
+        if self._hcg.get_sharding_parallel_world_size() > 1:
+            return ShardingParallel(model, self._hcg, self._strategy)
         return DataParallel(model)
 
     def distributed_optimizer(self, optimizer, strategy=None):

@@ -64,7 +64,19 @@ class ElasticManager:
 
     def exit(self, completed=True):
         self._stop.set()
+        # Join the heartbeat first: a mid-flight put() after the delete
+        # would re-create the lease and leave a ghost member for up to
+        # lease_ttl, triggering spurious RESTARTs in peers' watch()
+        # (round-2 advisor finding).  The join bound must outlast the
+        # KVClient's 5s HTTP timeout (a put can be blocked that long);
+        # if the thread still won't die, delete again once it can no
+        # longer have a put in flight.
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=6.0)
         self.kv.delete(self._lease_key())
+        if self._hb_thread is not None and self._hb_thread.is_alive():
+            self._hb_thread.join(timeout=6.0)
+            self.kv.delete(self._lease_key())
 
     def alive_nodes(self):
         """Ranks whose lease was renewed within the TTL."""

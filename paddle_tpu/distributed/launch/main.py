@@ -127,6 +127,12 @@ def launch(argv=None):
                 env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(node_endpoints)
                 env["PADDLE_CURRENT_ENDPOINT"] = \
                     node_endpoints[args.node_rank]
+                # The HTTP KV master owns master_port on node 0; give the
+                # jax coordination service its own port past the node
+                # endpoints (master_port+1..+nnodes) or the coordinator
+                # bind on node 0 collides and multi-node http mode can
+                # never bring up the jax runtime (round-2 advisor).
+                env["MASTER_PORT"] = str(int(master_port) + 1 + nnodes)
             else:
                 endpoints = [f"{master_ip}:{int(master_port) + i}"
                              for i in range(world)]

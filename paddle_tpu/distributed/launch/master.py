@@ -140,9 +140,19 @@ def rendezvous(master_endpoint, job_id, rank, endpoint, nnodes,
     registered (reference collective controller sync_peers)."""
     kv = KVClient(master_endpoint)
     scope = f"/rendezvous/{job_id}"
-    kv.put(f"{scope}/{rank}", endpoint)
     deadline = time.time() + timeout
+    registered = False
     while time.time() < deadline:
+        # Re-PUT until it lands (idempotent): a node that starts before
+        # the rank-0 master is up must keep retrying its registration,
+        # or the job deterministically times out even once the master
+        # arrives (round-2 advisor finding — staggered multi-node
+        # startup is the normal case).
+        if not registered:
+            registered = kv.put(f"{scope}/{rank}", endpoint)
+            if not registered:
+                time.sleep(0.2)
+                continue
         peers = kv.get_prefix(scope)
         if len(peers) >= nnodes:
             ordered = sorted(peers.items(),

@@ -7,6 +7,13 @@ Dirichlet, Exponential, Gamma, Laplace, Gumbel, LogNormal, and the
 jax expressions; sampling draws from the global Generator's key stream
 (ops/random.py), so ``paddle.seed`` governs reproducibility exactly like
 the tensor random ops.
+
+All math is dispatched through the op registry (``_op`` below), so the
+eager tape records it: ``dist.log_prob(x)`` in a loss back-propagates to
+Tensor parameters, and ``rsample`` is reparameterized (gradients flow to
+loc/scale through the sampled value) — matching the reference's
+differentiable distributions.  ``sample`` is ``rsample`` detached (or a
+genuinely non-reparameterizable draw).
 """
 from __future__ import annotations
 
@@ -18,14 +25,21 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..ops import registry as _registry
 from ..ops.random import default_generator
 
+_EULER = 0.5772156649015329
 
-def _d(x):
+
+def _t(x):
+    """Keep Tensor identity (the tape links through it); wrap others."""
     if isinstance(x, Tensor):
-        return x._data
-    return jnp.asarray(x, jnp.float32) if not isinstance(x, jnp.ndarray) \
-        else x
+        return x
+    return Tensor(jnp.asarray(x, jnp.float32))
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
 
 
 def _shape(s):
@@ -34,12 +48,32 @@ def _shape(s):
     return tuple(int(v) for v in s)
 
 
+_dist_ops: dict = {}
+
+
+def _op(name, fn, *args, **attrs):
+    """Dispatch a closed-form distribution computation through the op
+    registry (jit-cached, tape-recorded — the jax.vjp fallback supplies
+    the backward).  This is what makes distribution math differentiable
+    through the eager engine (round-2 advisor finding)."""
+    op = _dist_ops.get(name)
+    if op is None:
+        op = _registry.OpDef(name, fn,
+                             static_argnames=tuple(attrs.keys()))
+        _dist_ops[name] = op
+    elif attrs and set(op.static_argnames) != set(attrs.keys()):
+        op = _registry.OpDef(name, fn,
+                             static_argnames=tuple(attrs.keys()))
+        _dist_ops[name] = op
+    return _registry.apply(op, *args, **attrs)
+
+
 class Distribution:
     """Reference distribution/distribution.py Distribution."""
 
     def __init__(self, batch_shape=(), event_shape=()):
-        self._batch_shape = tuple(batch_shape)
-        self._event_shape = tuple(event_shape)
+        self._batch_shape = tuple(int(d) for d in batch_shape)
+        self._event_shape = tuple(int(d) for d in event_shape)
 
     @property
     def batch_shape(self):
@@ -50,7 +84,7 @@ class Distribution:
         return self._event_shape
 
     def sample(self, shape=()):
-        raise NotImplementedError
+        return self.rsample(shape).detach()
 
     def rsample(self, shape=()):
         raise NotImplementedError
@@ -72,113 +106,140 @@ class Distribution:
 
 class Normal(Distribution):
     def __init__(self, loc, scale, name=None):
-        self.loc = _d(loc)
-        self.scale = _d(scale)
-        super().__init__(jnp.broadcast_shapes(self.loc.shape,
-                                              self.scale.shape))
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(tuple(self.loc.shape),
+                                              tuple(self.scale.shape)))
 
     @property
     def mean(self):
-        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+        return _op("dist_broadcast",
+                   lambda x, shape: jnp.broadcast_to(x, shape),
+                   self.loc, shape=self.batch_shape)
 
     @property
     def variance(self):
-        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+        return _op("normal_variance",
+                   lambda s, shape: jnp.broadcast_to(s * s, shape),
+                   self.scale, shape=self.batch_shape)
 
-    def sample(self, shape=()):
-        key = default_generator.next_key()
+    def rsample(self, shape=()):
         s = _shape(shape) + self.batch_shape
-        eps = jax.random.normal(key, s, jnp.float32)
-        return Tensor(self.loc + self.scale * eps)
-
-    rsample = sample
+        eps = jax.random.normal(default_generator.next_key(), s,
+                                jnp.float32)
+        return _op("normal_rsample",
+                   lambda loc, scale, e: loc + scale * e,
+                   self.loc, self.scale, Tensor(eps))
 
     def log_prob(self, value):
-        v = _d(value)
-        var = self.scale ** 2
-        return Tensor(-((v - self.loc) ** 2) / (2 * var)
-                      - jnp.log(self.scale)
-                      - 0.5 * math.log(2 * math.pi))
+        def fn(loc, scale, v):
+            return (-jnp.square(v - loc) / (2 * scale * scale)
+                    - jnp.log(scale) - 0.5 * math.log(2 * math.pi))
+
+        return _op("normal_log_prob", fn, self.loc, self.scale, _t(value))
 
     def entropy(self):
-        out = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
-        return Tensor(jnp.broadcast_to(out, self.batch_shape))
+        def fn(scale, shape):
+            out = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale)
+            return jnp.broadcast_to(out, shape)
+
+        return _op("normal_entropy", fn, self.scale,
+                   shape=self.batch_shape)
 
 
 class LogNormal(Distribution):
     def __init__(self, loc, scale, name=None):
-        self.loc = _d(loc)
-        self.scale = _d(scale)
-        self._base = Normal(loc, scale)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        self._base = Normal(self.loc, self.scale)
         super().__init__(self._base.batch_shape)
 
-    def sample(self, shape=()):
-        return Tensor(jnp.exp(self._base.sample(shape)._data))
+    def rsample(self, shape=()):
+        from .. import ops
 
-    rsample = sample
+        return ops.exp(self._base.rsample(shape))
 
     def log_prob(self, value):
-        v = _d(value)
-        return Tensor(self._base.log_prob(Tensor(jnp.log(v)))._data
-                      - jnp.log(v))
+        def fn(loc, scale, v):
+            lv = jnp.log(v)
+            return (-jnp.square(lv - loc) / (2 * scale * scale)
+                    - jnp.log(scale) - 0.5 * math.log(2 * math.pi) - lv)
+
+        return _op("lognormal_log_prob", fn, self.loc, self.scale,
+                   _t(value))
 
     def entropy(self):
-        return Tensor(self._base.entropy()._data + self.loc)
+        def fn(loc, scale, shape):
+            out = (0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale)
+                   + loc)
+            return jnp.broadcast_to(out, shape)
+
+        return _op("lognormal_entropy", fn, self.loc, self.scale,
+                   shape=self.batch_shape)
 
 
 class Uniform(Distribution):
     def __init__(self, low, high, name=None):
-        self.low = _d(low)
-        self.high = _d(high)
-        super().__init__(jnp.broadcast_shapes(self.low.shape,
-                                              self.high.shape))
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(jnp.broadcast_shapes(tuple(self.low.shape),
+                                              tuple(self.high.shape)))
 
-    def sample(self, shape=()):
-        key = default_generator.next_key()
+    def rsample(self, shape=()):
         s = _shape(shape) + self.batch_shape
-        u = jax.random.uniform(key, s, jnp.float32)
-        return Tensor(self.low + (self.high - self.low) * u)
-
-    rsample = sample
+        u = jax.random.uniform(default_generator.next_key(), s,
+                               jnp.float32)
+        return _op("uniform_rsample",
+                   lambda lo, hi, u: lo + (hi - lo) * u,
+                   self.low, self.high, Tensor(u))
 
     def log_prob(self, value):
-        v = _d(value)
-        inside = (v >= self.low) & (v < self.high)
-        lp = -jnp.log(self.high - self.low)
-        return Tensor(jnp.where(inside, lp, -jnp.inf))
+        def fn(lo, hi, v):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+
+        return _op("uniform_log_prob", fn, self.low, self.high, _t(value))
 
     def entropy(self):
-        return Tensor(jnp.broadcast_to(jnp.log(self.high - self.low),
-                                       self.batch_shape))
+        def fn(lo, hi, shape):
+            return jnp.broadcast_to(jnp.log(hi - lo), shape)
+
+        return _op("uniform_entropy", fn, self.low, self.high,
+                   shape=self.batch_shape)
 
 
 class Bernoulli(Distribution):
     def __init__(self, probs, name=None):
-        self.probs = _d(probs)
-        super().__init__(self.probs.shape)
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
 
     @property
     def mean(self):
-        return Tensor(self.probs)
+        return self.probs
 
     @property
     def variance(self):
-        return Tensor(self.probs * (1 - self.probs))
+        return _op("bernoulli_variance", lambda p: p * (1 - p), self.probs)
 
     def sample(self, shape=()):
-        key = default_generator.next_key()
         s = _shape(shape) + self.batch_shape
-        return Tensor(jax.random.bernoulli(
-            key, self.probs, s).astype(jnp.float32))
+        out = jax.random.bernoulli(default_generator.next_key(),
+                                   _raw(self.probs), s)
+        return Tensor(out.astype(jnp.float32))
 
     def log_prob(self, value):
-        v = _d(value)
-        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
-        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+        def fn(p, v):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+        return _op("bernoulli_log_prob", fn, self.probs, _t(value))
 
     def entropy(self):
-        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
-        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+        def fn(p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+        return _op("bernoulli_entropy", fn, self.probs)
 
 
 class Categorical(Distribution):
@@ -186,186 +247,237 @@ class Categorical(Distribution):
         if logits is None and probs is None:
             raise ValueError("need logits or probs")
         if logits is not None:
-            self.logits = _d(logits)
-            self._log_p = jax.nn.log_softmax(self.logits, -1)
+            self.logits = _t(logits)
+            self._from_logits = True
         else:
-            p = _d(probs)
+            self.logits = _t(probs)
+            self._from_logits = False
+        super().__init__(tuple(self.logits.shape)[:-1])
+
+    def _log_p_fn(self):
+        if self._from_logits:
+            return lambda lg: jax.nn.log_softmax(lg, -1)
+
+        def fn(p):
             p = p / jnp.sum(p, -1, keepdims=True)
-            self._log_p = jnp.log(jnp.clip(p, 1e-12))
-            self.logits = self._log_p
-        super().__init__(self._log_p.shape[:-1])
+            return jnp.log(jnp.clip(p, 1e-12))
+
+        return fn
+
+    @property
+    def _log_p(self):
+        # Raw array view (used by sampling and tooling) — computed once
+        # per instance; logits are immutable after construction.
+        cached = getattr(self, "_log_p_cache", None)
+        if cached is None:
+            cached = self._log_p_fn()(_raw(self.logits))
+            self._log_p_cache = cached
+        return cached
 
     @property
     def probs(self):
-        return Tensor(jnp.exp(self._log_p))
+        fn = self._log_p_fn()
+        return _op("categorical_probs_%d" % self._from_logits,
+                   lambda lg: jnp.exp(fn(lg)), self.logits)
 
     def sample(self, shape=()):
-        key = default_generator.next_key()
         s = _shape(shape) + self.batch_shape
-        return Tensor(jax.random.categorical(key, self.logits, -1, s))
+        out = jax.random.categorical(default_generator.next_key(),
+                                     self._log_p, -1, s)
+        return Tensor(out)
 
     def log_prob(self, value):
-        v = _d(value).astype(jnp.int32)
-        return Tensor(jnp.take_along_axis(
-            self._log_p, v[..., None], -1)[..., 0])
+        fn = self._log_p_fn()
+
+        def lp(lg, v):
+            v = v.astype(jnp.int32)
+            return jnp.take_along_axis(fn(lg), v[..., None], -1)[..., 0]
+
+        return _op("categorical_log_prob_%d" % self._from_logits, lp,
+                   self.logits, _t(value))
 
     def entropy(self):
-        p = jnp.exp(self._log_p)
-        return Tensor(-jnp.sum(p * self._log_p, -1))
+        fn = self._log_p_fn()
+
+        def ent(lg):
+            logp = fn(lg)
+            return -jnp.sum(jnp.exp(logp) * logp, -1)
+
+        return _op("categorical_entropy_%d" % self._from_logits, ent,
+                   self.logits)
 
 
 class Exponential(Distribution):
     def __init__(self, rate, name=None):
-        self.rate = _d(rate)
-        super().__init__(self.rate.shape)
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
 
     @property
     def mean(self):
-        return Tensor(1.0 / self.rate)
+        return _op("exponential_mean", lambda r: 1.0 / r, self.rate)
 
-    def sample(self, shape=()):
-        key = default_generator.next_key()
+    def rsample(self, shape=()):
         s = _shape(shape) + self.batch_shape
-        return Tensor(jax.random.exponential(key, s, jnp.float32)
-                      / self.rate)
-
-    rsample = sample
+        e = jax.random.exponential(default_generator.next_key(), s,
+                                   jnp.float32)
+        return _op("exponential_rsample", lambda r, e: e / r,
+                   self.rate, Tensor(e))
 
     def log_prob(self, value):
-        v = _d(value)
-        return Tensor(jnp.where(v >= 0, jnp.log(self.rate)
-                                - self.rate * v, -jnp.inf))
+        def fn(r, v):
+            return jnp.where(v >= 0, jnp.log(r) - r * v, -jnp.inf)
+
+        return _op("exponential_log_prob", fn, self.rate, _t(value))
 
     def entropy(self):
-        return Tensor(jnp.broadcast_to(1.0 - jnp.log(self.rate),
-                                       self.batch_shape))
+        def fn(r, shape):
+            return jnp.broadcast_to(1.0 - jnp.log(r), shape)
+
+        return _op("exponential_entropy", fn, self.rate,
+                   shape=self.batch_shape)
 
 
 class Laplace(Distribution):
     def __init__(self, loc, scale, name=None):
-        self.loc = _d(loc)
-        self.scale = _d(scale)
-        super().__init__(jnp.broadcast_shapes(self.loc.shape,
-                                              self.scale.shape))
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(tuple(self.loc.shape),
+                                              tuple(self.scale.shape)))
 
-    def sample(self, shape=()):
-        key = default_generator.next_key()
+    def rsample(self, shape=()):
         s = _shape(shape) + self.batch_shape
-        return Tensor(self.loc + self.scale
-                      * jax.random.laplace(key, s, jnp.float32))
-
-    rsample = sample
+        e = jax.random.laplace(default_generator.next_key(), s,
+                               jnp.float32)
+        return _op("laplace_rsample", lambda l, sc, e: l + sc * e,
+                   self.loc, self.scale, Tensor(e))
 
     def log_prob(self, value):
-        v = _d(value)
-        return Tensor(-jnp.abs(v - self.loc) / self.scale
-                      - jnp.log(2 * self.scale))
+        def fn(l, sc, v):
+            return -jnp.abs(v - l) / sc - jnp.log(2 * sc)
+
+        return _op("laplace_log_prob", fn, self.loc, self.scale, _t(value))
 
     def entropy(self):
-        return Tensor(jnp.broadcast_to(1.0 + jnp.log(2 * self.scale),
-                                       self.batch_shape))
+        def fn(sc, shape):
+            return jnp.broadcast_to(1.0 + jnp.log(2 * sc), shape)
+
+        return _op("laplace_entropy", fn, self.scale,
+                   shape=self.batch_shape)
 
 
 class Gumbel(Distribution):
-    _euler = 0.5772156649015329
+    _euler = _EULER
 
     def __init__(self, loc, scale, name=None):
-        self.loc = _d(loc)
-        self.scale = _d(scale)
-        super().__init__(jnp.broadcast_shapes(self.loc.shape,
-                                              self.scale.shape))
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(tuple(self.loc.shape),
+                                              tuple(self.scale.shape)))
 
-    def sample(self, shape=()):
-        key = default_generator.next_key()
+    def rsample(self, shape=()):
         s = _shape(shape) + self.batch_shape
-        return Tensor(self.loc + self.scale
-                      * jax.random.gumbel(key, s, jnp.float32))
-
-    rsample = sample
+        e = jax.random.gumbel(default_generator.next_key(), s, jnp.float32)
+        return _op("gumbel_rsample", lambda l, sc, e: l + sc * e,
+                   self.loc, self.scale, Tensor(e))
 
     def log_prob(self, value):
-        z = (_d(value) - self.loc) / self.scale
-        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+        def fn(l, sc, v):
+            z = (v - l) / sc
+            return -(z + jnp.exp(-z)) - jnp.log(sc)
+
+        return _op("gumbel_log_prob", fn, self.loc, self.scale, _t(value))
 
     def entropy(self):
-        return Tensor(jnp.broadcast_to(
-            jnp.log(self.scale) + 1.0 + self._euler, self.batch_shape))
+        def fn(sc, shape):
+            return jnp.broadcast_to(jnp.log(sc) + 1.0 + _EULER, shape)
+
+        return _op("gumbel_entropy", fn, self.scale,
+                   shape=self.batch_shape)
 
 
 class Beta(Distribution):
     def __init__(self, alpha, beta, name=None):
-        self.alpha = _d(alpha)
-        self.beta = _d(beta)
-        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
-                                              self.beta.shape))
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(jnp.broadcast_shapes(tuple(self.alpha.shape),
+                                              tuple(self.beta.shape)))
 
     def sample(self, shape=()):
-        key = default_generator.next_key()
         s = _shape(shape) + self.batch_shape
-        return Tensor(jax.random.beta(key, self.alpha, self.beta, s))
+        out = jax.random.beta(default_generator.next_key(),
+                              _raw(self.alpha), _raw(self.beta), s)
+        return Tensor(out)
 
     def log_prob(self, value):
-        v = _d(value)
-        a, b = self.alpha, self.beta
-        lbeta = (jax.scipy.special.gammaln(a)
-                 + jax.scipy.special.gammaln(b)
-                 - jax.scipy.special.gammaln(a + b))
-        return Tensor((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
-                      - lbeta)
+        def fn(a, b, v):
+            lbeta = (jax.scipy.special.gammaln(a)
+                     + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b))
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta
+
+        return _op("beta_log_prob", fn, self.alpha, self.beta, _t(value))
 
     def entropy(self):
-        a, b = self.alpha, self.beta
-        dg = jax.scipy.special.digamma
-        lbeta = (jax.scipy.special.gammaln(a)
-                 + jax.scipy.special.gammaln(b)
-                 - jax.scipy.special.gammaln(a + b))
-        return Tensor(lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
-                      + (a + b - 2) * dg(a + b))
+        def fn(a, b):
+            dg = jax.scipy.special.digamma
+            lbeta = (jax.scipy.special.gammaln(a)
+                     + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b))
+            return (lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                    + (a + b - 2) * dg(a + b))
+
+        return _op("beta_entropy", fn, self.alpha, self.beta)
 
 
 class Gamma(Distribution):
     def __init__(self, concentration, rate, name=None):
-        self.concentration = _d(concentration)
-        self.rate = _d(rate)
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
         super().__init__(jnp.broadcast_shapes(
-            self.concentration.shape, self.rate.shape))
+            tuple(self.concentration.shape), tuple(self.rate.shape)))
 
     def sample(self, shape=()):
-        key = default_generator.next_key()
         s = _shape(shape) + self.batch_shape
-        return Tensor(jax.random.gamma(key, self.concentration, s)
-                      / self.rate)
+        out = jax.random.gamma(default_generator.next_key(),
+                               _raw(self.concentration), s)
+        return Tensor(out / _raw(self.rate))
 
     def log_prob(self, value):
-        v = _d(value)
-        a, b = self.concentration, self.rate
-        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
-                      - jax.scipy.special.gammaln(a))
+        def fn(a, b, v):
+            return (a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                    - jax.scipy.special.gammaln(a))
+
+        return _op("gamma_log_prob", fn, self.concentration, self.rate,
+                   _t(value))
 
     def entropy(self):
-        a, b = self.concentration, self.rate
-        dg = jax.scipy.special.digamma
-        return Tensor(a - jnp.log(b) + jax.scipy.special.gammaln(a)
-                      + (1 - a) * dg(a))
+        def fn(a, b):
+            dg = jax.scipy.special.digamma
+            return (a - jnp.log(b) + jax.scipy.special.gammaln(a)
+                    + (1 - a) * dg(a))
+
+        return _op("gamma_entropy", fn, self.concentration, self.rate)
 
 
 class Dirichlet(Distribution):
     def __init__(self, concentration, name=None):
-        self.concentration = _d(concentration)
-        super().__init__(self.concentration.shape[:-1],
-                         self.concentration.shape[-1:])
+        self.concentration = _t(concentration)
+        shp = tuple(self.concentration.shape)
+        super().__init__(shp[:-1], shp[-1:])
 
     def sample(self, shape=()):
-        key = default_generator.next_key()
         s = _shape(shape) + self.batch_shape
-        return Tensor(jax.random.dirichlet(key, self.concentration, s))
+        out = jax.random.dirichlet(default_generator.next_key(),
+                                   _raw(self.concentration), s)
+        return Tensor(out)
 
     def log_prob(self, value):
-        v = _d(value)
-        a = self.concentration
-        lnorm = (jnp.sum(jax.scipy.special.gammaln(a), -1)
-                 - jax.scipy.special.gammaln(jnp.sum(a, -1)))
-        return Tensor(jnp.sum((a - 1) * jnp.log(v), -1) - lnorm)
+        def fn(a, v):
+            lnorm = (jnp.sum(jax.scipy.special.gammaln(a), -1)
+                     - jax.scipy.special.gammaln(jnp.sum(a, -1)))
+            return jnp.sum((a - 1) * jnp.log(v), -1) - lnorm
+
+        return _op("dirichlet_log_prob", fn, self.concentration, _t(value))
 
 
 # -- KL divergence dispatch (reference distribution/kl.py) -------------------
@@ -391,45 +503,63 @@ def kl_divergence(p, q):
 
 @register_kl(Normal, Normal)
 def _kl_normal(p, q):
-    var_ratio = (p.scale / q.scale) ** 2
-    t1 = ((p.loc - q.loc) / q.scale) ** 2
-    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+    def fn(pl, ps, ql, qs):
+        var_ratio = jnp.square(ps / qs)
+        t1 = jnp.square((pl - ql) / qs)
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+    return _op("kl_normal_normal", fn, p.loc, p.scale, q.loc, q.scale)
 
 
 @register_kl(Uniform, Uniform)
 def _kl_uniform(p, q):
-    # support(p) must lie inside support(q); else +inf
-    inside = (q.low <= p.low) & (p.high <= q.high)
-    kl = jnp.log((q.high - q.low) / (p.high - p.low))
-    return Tensor(jnp.where(inside, kl, jnp.inf))
+    def fn(pl, ph, ql, qh):
+        inside = (ql <= pl) & (ph <= qh)
+        kl = jnp.log((qh - ql) / (ph - pl))
+        return jnp.where(inside, kl, jnp.inf)
+
+    return _op("kl_uniform_uniform", fn, p.low, p.high, q.low, q.high)
 
 
 @register_kl(Bernoulli, Bernoulli)
 def _kl_bernoulli(p, q):
-    a = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
-    b = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
-    return Tensor(a * (jnp.log(a) - jnp.log(b))
-                  + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+    def fn(pp, qp):
+        a = jnp.clip(pp, 1e-7, 1 - 1e-7)
+        b = jnp.clip(qp, 1e-7, 1 - 1e-7)
+        return (a * (jnp.log(a) - jnp.log(b))
+                + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+
+    return _op("kl_bernoulli_bernoulli", fn, p.probs, q.probs)
 
 
 @register_kl(Categorical, Categorical)
 def _kl_categorical(p, q):
-    pp = jnp.exp(p._log_p)
-    return Tensor(jnp.sum(pp * (p._log_p - q._log_p), -1))
+    pfn, qfn = p._log_p_fn(), q._log_p_fn()
+
+    def fn(plg, qlg):
+        plp, qlp = pfn(plg), qfn(qlg)
+        return jnp.sum(jnp.exp(plp) * (plp - qlp), -1)
+
+    return _op("kl_categorical_%d%d" % (p._from_logits, q._from_logits),
+               fn, p.logits, q.logits)
 
 
 @register_kl(Exponential, Exponential)
 def _kl_exponential(p, q):
-    r = q.rate / p.rate
-    return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + r - 1)
+    def fn(pr, qr):
+        return jnp.log(pr) - jnp.log(qr) + qr / pr - 1
+
+    return _op("kl_exponential_exponential", fn, p.rate, q.rate)
 
 
 @register_kl(Beta, Beta)
 def _kl_beta(p, q):
-    g = jax.scipy.special.gammaln
-    dg = jax.scipy.special.digamma
-    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
-    t = (g(a1 + b1) - g(a1) - g(b1)
-         - (g(a2 + b2) - g(a2) - g(b2)))
-    return Tensor(t + (a1 - a2) * dg(a1) + (b1 - b2) * dg(b1)
-                  + (a2 - a1 + b2 - b1) * dg(a1 + b1))
+    def fn(a1, b1, a2, b2):
+        g = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        t = (g(a1 + b1) - g(a1) - g(b1)
+             - (g(a2 + b2) - g(a2) - g(b2)))
+        return (t + (a1 - a2) * dg(a1) + (b1 - b2) * dg(b1)
+                + (a2 - a1 + b2 - b1) * dg(a1 + b1))
+
+    return _op("kl_beta_beta", fn, p.alpha, p.beta, q.alpha, q.beta)

@@ -50,17 +50,23 @@ class Predictor:
         from ..nn.layers import Layer
 
         if isinstance(source, Config):
-            if model_builder is None:
-                raise ValueError(
-                    "Predictor(Config) needs model_builder: a callable "
-                    "returning the Layer to load the saved weights into "
-                    "(StableHLO-only programs carry no python forward)")
-            layer = model_builder()
             from .. import jit as pjit
 
             translated = pjit.load(source.model_path)
-            layer.set_state_dict(translated.state_dict())
-            self.layer = layer
+            if model_builder is not None:
+                layer = model_builder()
+                layer.set_state_dict(translated.state_dict())
+                self.layer = layer
+            elif translated.has_program():
+                # Artifact-only inference: execute the saved program
+                # directly — no python model code (reference
+                # analysis_predictor.h:105 ability).
+                self.layer = translated
+            else:
+                raise ValueError(
+                    "this artifact carries no executable program (saved "
+                    "without input_spec) — pass model_builder: a callable "
+                    "returning the Layer to load the saved weights into")
         elif isinstance(source, Layer):
             self.layer = source
         else:

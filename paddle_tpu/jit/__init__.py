@@ -213,7 +213,19 @@ class InputSpec:
 
 
 def save(layer, path, input_spec=None, **configs):
-    """Serialize params + (when possible) the lowered StableHLO text."""
+    """Serialize params + (when given input_spec) the compiled program.
+
+    Two program forms are stored (TranslatedLayer analog —
+    ``python/paddle/jit/translated_layer.py``):
+
+    - ``stablehlo``: the lowered module text, for inspection/tooling;
+    - ``exported``: ``jax.export`` bytes of the forward with the weights
+      baked in as constants — executable after load with NO python model
+      code (the reference Predictor's "inference from artifact alone",
+      ``analysis_predictor.h:105``).  Exported multi-platform
+      (cpu+current) when every traced op allows it, else current
+      platform only (e.g. Pallas kernels are TPU-only custom calls).
+    """
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     state = {}
     if isinstance(layer, Layer):
@@ -233,8 +245,24 @@ def save(layer, path, input_spec=None, **configs):
                     lambda o: o._data if isinstance(o, Tensor) else o, out,
                     is_leaf=lambda x: isinstance(x, Tensor))
 
-            lowered = jax.jit(pure).lower(*datas)
-            payload["stablehlo"] = lowered.as_text()
+            with jax.enable_x64(False):
+                jitted = jax.jit(pure)
+                lowered = jitted.lower(*datas)
+                payload["stablehlo"] = lowered.as_text()
+                from jax import export as _export
+
+                current = jax.devices()[0].platform
+                plats = ([current] if current == "cpu"
+                         else ["cpu", current])
+                avals = [jax.ShapeDtypeStruct(d.shape, d.dtype)
+                         for d in datas]
+                try:
+                    exp = _export.export(jitted, platforms=plats)(*avals)
+                except Exception:
+                    # Platform-specific custom calls (Pallas) can't lower
+                    # cross-platform; keep the current platform only.
+                    exp = _export.export(jitted)(*avals)
+                payload["exported"] = exp.serialize()
         except Exception as e:
             # Do not silently ship a checkpoint without the program the
             # caller asked for (input_spec given == lowering requested).
@@ -244,21 +272,53 @@ def save(layer, path, input_spec=None, **configs):
         pickle.dump(payload, f)
 
 
+class TranslatedLayer(Layer):
+    """A loaded artifact: weights + (when saved with input_spec) the
+    executable program.  ``forward`` runs the deserialized program —
+    no python model class required (reference translated_layer.py)."""
+
+    def __init__(self, payload):
+        super().__init__()
+        self._payload = payload
+        self._state = {k: Tensor(v) for k, v in
+                       payload["state_dict"].items()}
+        self._exported = None
+
+    def state_dict(self, *a, **k):
+        return dict(self._state)
+
+    def program(self):
+        return self._payload.get("stablehlo", "")
+
+    def has_program(self):
+        return "exported" in self._payload
+
+    def _exp(self):
+        if self._exported is None:
+            from jax import export as _export
+
+            self._exported = _export.deserialize(
+                self._payload["exported"])
+        return self._exported
+
+    def forward(self, *inputs):
+        if not self.has_program():
+            raise RuntimeError(
+                "this artifact was saved without input_spec — no program "
+                "was lowered; rebuild the model and set_state_dict, or "
+                "re-save with input_spec")
+        exp = self._exp()
+        datas = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                 for x in inputs]
+        # Match the exported avals (the artifact was traced x64-off).
+        datas = [jnp.asarray(d, aval.dtype)
+                 for d, aval in zip(datas, exp.in_avals)]
+        with jax.enable_x64(False):
+            out = exp.call(*datas)
+        return jax.tree.map(lambda o: Tensor(o), out)
+
+
 def load(path, **configs):
     with open(path + ".pdparams", "rb") as f:
         payload = pickle.load(f)
-
-    class TranslatedLayer(Layer):
-        def __init__(self, payload):
-            super().__init__()
-            self._payload = payload
-            self._state = {k: Tensor(v) for k, v in
-                           payload["state_dict"].items()}
-
-        def state_dict(self, *a, **k):
-            return dict(self._state)
-
-        def program(self):
-            return self._payload.get("stablehlo", "")
-
     return TranslatedLayer(payload)

@@ -52,6 +52,7 @@ class LlamaConfig:
     # O(1) in depth; the XLA-native analog of the reference's static
     # pipeline program cloning)
     attention_impl: str = "auto"  # "auto" | "einsum" | "flash" (Pallas)
+    flash_blocks: tuple | None = None  # (block_q, block_k) Pallas tiles
     context_parallel: str = "none"  # "none" | "ring" | "ulysses":
     # distributed attention over the hybrid topology's 'sep' axis
     # (SURVEY §5.7 — the reference has the sep axis but no kernel; here
@@ -121,7 +122,7 @@ class LlamaAttention(nn.Layer):
             # repeat_interleave HBM blowup (VERDICT r1 weak #1).
             out = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=attn_mask, is_causal=True,
-                impl=cfg.attention_impl)
+                impl=cfg.attention_impl, flash_blocks=cfg.flash_blocks)
         out = ops.reshape(out, [B, S, cfg.hidden_size])
         return self.o_proj(out)
 
@@ -301,25 +302,22 @@ class LlamaForCausalLM(nn.Layer):
         return loss
 
     def _checkpointed_loss(self, hidden, labels):
-        """lm_head matmul + mean CE under jax.checkpoint.  Matches the
-        uncheckpointed path: fp32 log_softmax, ignore_index=-100 zeroed,
-        mean over all tokens (F.cross_entropy reduction='mean')."""
-        import jax
-        import jax.numpy as jnp
+        """Fused lm_head matmul + mean CE (ops.nn_ops.
+        fused_linear_cross_entropy): logits are recomputed in backward
+        (checkpoint semantics — the [B*S, V] residual never stays live)
+        and d_logits is formed directly, skipping the fp32 log_softmax
+        materialization + scatter of the autodiff path.  Numerics match
+        the uncheckpointed path: fp32 softmax stats, ignore_index=-100
+        zeroed, mean over all tokens."""
+        from ..ops.nn_ops import fused_linear_cross_entropy
 
         w = (self.llama.embed_tokens.weight
              if self.config.tie_word_embeddings else self.lm_head.weight)
         tied = self.config.tie_word_embeddings
-
-        from ..ops.nn_ops import _softmax_ce_plain
-
-        def loss_fn(hd, wd, lab):
-            logits = (jnp.einsum("bsh,vh->bsv", hd, wd) if tied
-                      else jnp.einsum("bsh,hv->bsv", hd, wd))
-            return jnp.mean(_softmax_ce_plain(logits, lab))
-
         lab = labels._data if isinstance(labels, Tensor) else labels
-        return Tensor(jax.checkpoint(loss_fn)(hidden._data, w._data, lab))
+        h2 = hidden._data.reshape(-1, self.config.hidden_size)
+        return Tensor(fused_linear_cross_entropy(
+            h2, w._data, lab.reshape(-1), tied, -100))
 
     def generate(self, input_ids, max_new_tokens=16):
         """Greedy KV-cache decode (see models/generation.py). The decoder

@@ -325,7 +325,8 @@ def log_loss(input, label, epsilon=1e-4, name=None):
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
-                                 training=True, name=None, impl="auto"):
+                                 training=True, name=None, impl="auto",
+                                 flash_blocks=None):
     """[batch, seq, heads, head_dim] layout — reference:
     python/paddle/nn/functional/flash_attention.py
     scaled_dot_product_attention.  GQA (key/value heads < query heads) is
@@ -339,7 +340,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         drop_key = default_generator.next_key()
     return registry.apply(nn_ops.sdpa_op, query, key, value, attn_mask,
                           drop_key, dropout=float(dropout_p),
-                          causal=bool(is_causal), impl=impl)
+                          causal=bool(is_causal), impl=impl,
+                          flash_blocks=flash_blocks)
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,

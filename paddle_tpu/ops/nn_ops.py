@@ -13,6 +13,8 @@ layout assignment makes this free inside a jit region.
 """
 from __future__ import annotations
 
+import functools as _functools
+
 import numpy as np
 
 import jax
@@ -406,6 +408,75 @@ softmax_with_cross_entropy_op = register_op(
     nondiff_argnums=(1,))
 
 
+# -- fused lm-head + cross entropy ------------------------------------------
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_linear_cross_entropy(hidden, weight, labels, tied=False,
+                               ignore_index=-100):
+    """mean CE over ``hidden @ weight`` logits without materializing the
+    fp32 log-softmax or a scatter in backward.
+
+    hidden [N, H] (bf16 ok), weight [H, V] (or [V, H] when ``tied`` —
+    an embedding table used as the output head), labels [N] int.
+    Loss = mean over ALL rows with ignore_index rows contributing 0 —
+    matching F.cross_entropy(reduction='mean', ignore_index=-100) on the
+    same logits (reference softmax_with_cross_entropy semantics).
+
+    Backward recomputes the logits (checkpoint-style) and forms
+    d_logits = (softmax - onehot) directly in the logits dtype — the
+    autodiff path through log_softmax+take_along_axis instead materializes
+    a [N, V] fp32 tensor twice and a scatter-add, ~3x the HBM traffic at
+    V=32k.  Reference parity: fused softmax_with_cross_entropy kernel
+    (phi/kernels/gpu/cross_entropy_kernel.cu fused path)."""
+    loss, _ = _flce_fwd(hidden, weight, labels, tied, ignore_index)
+    return loss
+
+
+def _flce_logits(hidden, weight, tied):
+    if tied:
+        return jnp.einsum("nh,vh->nv", hidden, weight)
+    return jnp.einsum("nh,hv->nv", hidden, weight)
+
+
+def _flce_fwd(hidden, weight, labels, tied, ignore_index):
+    logits = _flce_logits(hidden, weight, tied)
+    lf = logits.astype(jnp.float32)
+    mx = jnp.max(lf, axis=-1)
+    lse = mx + jnp.log(jnp.sum(jnp.exp(lf - mx[:, None]), axis=-1))
+    lab = jnp.clip(labels, 0, logits.shape[-1] - 1).astype(jnp.int32)
+    tgt = jnp.take_along_axis(lf, lab[:, None], axis=-1)[:, 0]
+    valid = (labels != ignore_index)
+    nll = jnp.where(valid, lse - tgt, 0.0)
+    loss = jnp.mean(nll)
+    return loss, (hidden, weight, labels, lse)
+
+
+def _flce_bwd(tied, ignore_index, saved, g):
+    hidden, weight, labels, lse = saved
+    n, v = lse.shape[0], weight.shape[0] if tied else weight.shape[1]
+    logits = _flce_logits(hidden, weight, tied)
+    lab = jnp.clip(labels, 0, v - 1).astype(jnp.int32)
+    valid = (labels != ignore_index)
+    # softmax - onehot, scaled by g/N, zeroed on ignored rows; onehot via
+    # fused iota compare (no scatter).
+    sm = jnp.exp(logits.astype(jnp.float32) - lse[:, None])
+    oh = (jax.lax.broadcasted_iota(jnp.int32, (n, v), 1) == lab[:, None])
+    scale = (g / n)
+    dlogits = ((sm - oh.astype(jnp.float32))
+               * (valid.astype(jnp.float32) * scale)[:, None]
+               ).astype(hidden.dtype)
+    if tied:
+        dh = jnp.einsum("nv,vh->nh", dlogits, weight)
+        dw = jnp.einsum("nv,nh->vh", dlogits, hidden)
+    else:
+        dh = jnp.einsum("nv,hv->nh", dlogits, weight)
+        dw = jnp.einsum("nh,nv->hv", hidden, dlogits)
+    return dh.astype(hidden.dtype), dw.astype(weight.dtype), None
+
+
+fused_linear_cross_entropy.defvjp(_flce_fwd, _flce_bwd)
+
+
 # -- dropout ----------------------------------------------------------------
 
 def _dropout_fwd_key(x, key, p=0.5, mode="upscale_in_train"):
@@ -469,24 +540,99 @@ def dropout_raw(x, p=0.5, training=True, mode="upscale_in_train"):
 
 # -- attention --------------------------------------------------------------
 
-def _flash_attention_tpu(qt, kt, vt, causal, scale):
-    """Pallas TPU flash attention ([B, H, S, D] layout), fwd+bwd via the
-    kernel's custom_vjp.  Reference parity: phi/kernels/gpu/
-    flash_attn_kernel.h — the O(S) -memory attention path."""
-    from jax.experimental.pallas.ops.tpu import flash_attention as _fa_mod
+def _fa_mod():
+    from jax.experimental.pallas.ops.tpu import flash_attention as m
 
-    # x64 off while tracing the kernel: global x64 (core/dtype.py) would
-    # make the kernel's weak-typed ints (grid index maps, iotas) int64,
-    # which trips upstream lax.select dtype checks and the mosaic lowering.
-    # The context re-enters on every (re)trace since it wraps the traced
-    # Python.
+    return m
+
+
+def _fit_block(block, n, floor=128):
+    """Largest power-of-two-ish divisor of ``n`` that is <= ``block``
+    (pallas requires seq_len % block == 0)."""
+    block = min(block, n)
+    while block > floor and n % block != 0:
+        block //= 2
+    return max(floor, block)
+
+
+def _fa_block_sizes(q_seq_len, kv_seq_len, blocks=None):
+    """Pallas flash-attention tile sizes.  ``blocks`` is a (block_q,
+    block_k) pair; defaults tuned on v5e at S=2048 (bigger q tiles than
+    the library's 128 default keep the MXU busier per grid step).  Tiles
+    are clamped to divisors of the sequence lengths — pallas'
+    _verify_block rejects non-dividing tiles (e.g. S=1536 with bk=1024)."""
+    m = _fa_mod()
+    bq, bk = blocks if blocks is not None else (512, 1024)
+    bq = _fit_block(bq, q_seq_len)
+    bk = _fit_block(bk, kv_seq_len)
+    return m.BlockSizes(
+        block_q=bq, block_k_major=bk, block_k=_fit_block(512, bk),
+        block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bk,
+        block_k_dkv=_fit_block(512, bk), block_q_dkv=bq,
+        block_k_major_dq=bk, block_k_dq=_fit_block(512, bk),
+        block_q_dq=bq)
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_core(q, k, v, causal, scale, blocks):
+    m = _fa_mod()
+    bs = _fa_block_sizes(q.shape[2], k.shape[2], blocks)
     with jax.enable_x64(False):
-        return _fa_mod.flash_attention(qt, kt, vt, causal=causal,
-                                       sm_scale=float(scale))
+        return m._flash_attention_impl(
+            q, k, v, None, None, False, causal, scale,
+            bs.block_b, bs.block_q, bs.block_k_major, bs.block_k, False)
+
+
+def _flash_core_fwd(q, k, v, causal, scale, blocks):
+    m = _fa_mod()
+    bs = _fa_block_sizes(q.shape[2], k.shape[2], blocks)
+    with jax.enable_x64(False):
+        o, lse, mx = m._flash_attention_impl(
+            q, k, v, None, None, True, causal, scale,
+            bs.block_b, bs.block_q, bs.block_k_major, bs.block_k, False)
+    return o, (q, k, v, o, lse, mx)
+
+
+def _flash_core_bwd(causal, scale, blocks, res, do):
+    m = _fa_mod()
+    q, k, v, o, lse, mx = res
+    bs = _fa_block_sizes(q.shape[2], k.shape[2], blocks)
+    with jax.enable_x64(False):
+        di = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
+                     axis=-1)
+        dk, dv = m._flash_attention_bwd_dkv(
+            q, k, v, None, None, lse, mx, do, di,
+            block_q_major=bs.block_q_major_dkv,
+            block_k_major=bs.block_k_major_dkv,
+            block_k=bs.block_k_dkv, block_q=bs.block_q_dkv,
+            sm_scale=scale, causal=causal,
+            mask_value=m.DEFAULT_MASK_VALUE, debug=False)
+        dq, _ = m._flash_attention_bwd_dq(
+            q, k, v, None, None, lse, mx, do, di,
+            block_q_major=bs.block_q_dq, block_k_major=bs.block_k_major_dq,
+            block_k=bs.block_k_dq, sm_scale=scale, causal=causal,
+            mask_value=m.DEFAULT_MASK_VALUE, debug=False)
+    return dq, dk, dv
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def _flash_attention_tpu(qt, kt, vt, causal, scale, blocks=None):
+    """Pallas TPU flash attention ([B, H, S, D] layout), O(S)-memory.
+    Reference parity: phi/kernels/gpu/flash_attn_kernel.h.
+
+    Wraps the stock pallas kernel in our own custom_vjp so that BOTH the
+    forward and backward kernel traces run with x64 disabled (the global
+    x64 mode from core/dtype.py would make the kernels' weak-typed grid
+    index arithmetic int64 and break mosaic lowering), and so the tile
+    sizes are tunable (v5e-tuned defaults in _fa_block_sizes)."""
+    return _flash_core(qt, kt, vt, bool(causal), float(scale), blocks)
 
 
 def _sdpa_plain(q, k, v, mask=None, key=None, dropout=0.0, causal=False,
-                scale=None, impl="auto"):
+                scale=None, impl="auto", flash_blocks=None):
     """Scaled dot-product attention, [B, S, H, D] layout (paddle flash-attn
     layout, nn/functional/flash_attention.py).  Computed in the MXU-friendly
     [B, H, S, D] internally.  ``key`` enables attention dropout.
@@ -518,16 +664,17 @@ def _sdpa_plain(q, k, v, mask=None, key=None, dropout=0.0, causal=False,
             f"Sq={Sq} Sk={Sk} D={D} mask={mask is not None} "
             f"dropout={key is not None} "
             f"platform={jax.devices()[0].platform}")
-    # auto: XLA's fused attention wins up to moderate S on-chip; the Pallas
-    # kernel's block skipping pays off once causal S^2 dominates (measured
-    # crossover on v5e ~4k).
+    # auto: the Pallas kernel beats the einsum path from S>=1024 on v5e
+    # (measured: S=2048 fwd+bwd 17.4ms einsum vs ~12ms flash with tuned
+    # tiles) — the einsum path's O(S^2) logits round-trip HBM.
     use_flash = impl == "flash" or (impl == "auto" and flash_ok
-                                    and Sq >= 4096)
+                                    and causal and Sq >= 1024)
     if use_flash:
         if Hkv != H:
             kt = jnp.repeat(kt, H // Hkv, axis=1)
             vt = jnp.repeat(vt, H // Hkv, axis=1)
-        out = _flash_attention_tpu(qt, kt, vt, causal, scale)
+        out = _flash_attention_tpu(qt, kt, vt, causal, scale,
+                                   blocks=flash_blocks)
         return jnp.swapaxes(out, 1, 2)
 
     grouped = Hkv != H
@@ -564,7 +711,7 @@ def _sdpa_plain(q, k, v, mask=None, key=None, dropout=0.0, causal=False,
 
 sdpa_op = register_op(
     "scaled_dot_product_attention", _sdpa_plain,
-    static_argnames=("dropout", "causal", "scale", "impl"),
+    static_argnames=("dropout", "causal", "scale", "impl", "flash_blocks"),
     nondiff_argnums=(3, 4))
 
 

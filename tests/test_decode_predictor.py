@@ -110,3 +110,27 @@ def test_generate_rebuilds_after_weight_change():
     after = model.generate(paddle.to_tensor(ids), max_new_tokens=4).numpy()
     want = _greedy_reference(model, ids, 4)
     np.testing.assert_array_equal(after, want)
+
+
+def test_predictor_artifact_only_no_model_code():
+    """VERDICT r2 #4: the saved program must be executable after load with
+    NO python model class — Predictor(Config(path)) with no model_builder
+    (reference analysis_predictor.h:105, jit/translated_layer.py)."""
+    paddle.seed(5)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    x = np.random.RandomState(5).randn(2, 8).astype(np.float32)
+    want = net(paddle.to_tensor(x)).numpy()
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "model")
+        paddle.jit.save(net, prefix,
+                        input_spec=[paddle.jit.InputSpec([2, 8])])
+        # Simulate a fresh process: load with nothing but the artifact.
+        translated = paddle.jit.load(prefix)
+        assert translated.has_program()
+        got_direct = translated(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got_direct, want, rtol=1e-6)
+
+        pred = create_predictor(Config(prefix))  # no model_builder
+        (got,) = pred.run([x])
+    np.testing.assert_allclose(got, want, rtol=1e-6)

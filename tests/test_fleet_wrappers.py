@@ -1,0 +1,178 @@
+"""Eager fleet wrappers must be REAL (round-2 verdict #3): the reference
+idiom — fleet.init + fleet.distributed_model(model) +
+fleet.distributed_optimizer(opt) + loss.backward() + opt.step() — must
+compute multi-device semantics that match a single-device run.
+
+Reference: fleet/model.py:139-170 (wrapper pick), parallel.py:218
+(DataParallel + EagerReducer), meta_parallel/tensor_parallel.py:28,
+dygraph_optimizer/hybrid_parallel_optimizer.py:255 (cross-axis clip).
+"""
+import copy
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.hybrid_optimizer import (
+    HybridParallelClipGrad,
+)
+from paddle_tpu.distributed.fleet.topology import (
+    set_hybrid_communicate_group,
+)
+
+
+def _mlp(seed):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+
+
+def _train_steps(model, opt, xs, ys, scale_loss=None):
+    losses = []
+    for x, y in zip(xs, ys):
+        out = model(paddle.to_tensor(x))
+        loss = ((out - paddle.to_tensor(y)) ** 2).mean()
+        losses.append(float(loss.numpy()))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return losses
+
+
+@pytest.fixture(autouse=True)
+def _reset_hcg():
+    yield
+    set_hybrid_communicate_group(None)
+
+
+def test_dp_wrapper_matches_single_device():
+    """fleet.distributed_model DP + HybridParallelOptimizer +
+    loss.backward() on the 8-device mesh == single-device numerics."""
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(16, 16).astype(np.float32) for _ in range(3)]
+    ys = [rng.randn(16, 8).astype(np.float32) for _ in range(3)]
+
+    # single-device golden
+    ref = _mlp(42)
+    ref_opt = paddle.optimizer.AdamW(
+        learning_rate=0.01, parameters=ref.parameters(),
+        grad_clip=nn.ClipGradByGlobalNorm(0.5))
+    ref_losses = _train_steps(ref, ref_opt, xs, ys)
+
+    # distributed (dp over all 8 virtual devices)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8}
+    fleet.init(is_collective=True, strategy=strategy)
+    model = _mlp(42)
+    dist = fleet.distributed_model(model)
+    opt = paddle.optimizer.AdamW(
+        learning_rate=0.01, parameters=model.parameters(),
+        grad_clip=nn.ClipGradByGlobalNorm(0.5))
+    opt = fleet.distributed_optimizer(opt)
+    assert isinstance(opt._inner_opt._grad_clip, HybridParallelClipGrad)
+    dist_losses = _train_steps(dist, opt, xs, ys)
+
+    np.testing.assert_allclose(dist_losses, ref_losses, rtol=2e-5)
+    for (_, pr), (_, pd) in zip(ref.named_parameters(),
+                                model.named_parameters()):
+        np.testing.assert_allclose(pr.numpy(), pd.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_dp_input_actually_sharded():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8}
+    fleet.init(is_collective=True, strategy=strategy)
+    model = _mlp(1)
+    dist = fleet.distributed_model(model)
+    x = paddle.to_tensor(np.ones((16, 16), np.float32))
+    out = dist(x)
+    # params replicated over the mesh
+    from jax.sharding import NamedSharding
+
+    p = next(iter(model.parameters()))
+    assert isinstance(p._data.sharding, NamedSharding)
+    assert p._data.sharding.is_fully_replicated
+    # forward works and output is finite
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_tp_wrapper_matches_single_device():
+    """mpu Column/Row pair under the TensorParallel wrapper (mp=2, dp=4)
+    vs a plain Linear stack with identical weights."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(7)
+    from paddle_tpu.distributed.fleet import mpu
+
+    class TPBlock(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = mpu.ColumnParallelLinear(16, 32, has_bias=True,
+                                                gather_output=False)
+            self.fc2 = mpu.RowParallelLinear(32, 8, has_bias=True,
+                                             input_is_parallel=True)
+
+        def forward(self, x):
+            return self.fc2(nn.functional.relu(self.fc1(x)))
+
+    block = TPBlock()
+    dist = fleet.distributed_model(block)
+    from paddle_tpu.distributed.fleet.meta_parallel import TensorParallel
+
+    assert isinstance(dist, TensorParallel)
+
+    # golden: plain layers with the same weights
+    ref = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    sd = ref.state_dict()
+    sd["0.weight"].set_value(block.fc1.weight.numpy())
+    sd["0.bias"].set_value(block.fc1.bias.numpy())
+    sd["2.weight"].set_value(block.fc2.weight.numpy())
+    sd["2.bias"].set_value(block.fc2.bias.numpy())
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(8, 16).astype(np.float32)
+    want = ref(paddle.to_tensor(x))
+    got = dist(paddle.to_tensor(x))
+    np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=2e-5,
+                               atol=1e-6)
+
+    # grads flow and match
+    got.mean().backward()
+    want.mean().backward()
+    np.testing.assert_allclose(block.fc1.weight.grad.numpy(),
+                               ref[0].weight.grad.numpy(), rtol=2e-5,
+                               atol=1e-6)
+
+
+def test_sharding_and_segment_wrappers_forward():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"sharding_degree": 4, "dp_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    model = _mlp(5)
+    dist = fleet.distributed_model(model)
+    from paddle_tpu.distributed.fleet.meta_parallel import ShardingParallel
+
+    assert isinstance(dist, ShardingParallel)
+    x = np.random.RandomState(0).randn(16, 16).astype(np.float32)
+    out = dist(paddle.to_tensor(x))
+    want = _mlp(5)(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), want.numpy(), rtol=2e-5,
+                               atol=1e-6)
+
+
+def test_hybrid_clip_matches_plain_global_norm_clip():
+    """Single-controller cross-axis clip == the plain global-norm clip
+    (every grad is a global array); verify numerics explicitly."""
+    model = _mlp(9)
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(4, 16).astype(np.float32) * 10)
+    (model(x) ** 2).sum().backward()
+    pg = [(p, p.grad) for p in model.parameters() if p.grad is not None]
+    plain = nn.ClipGradByGlobalNorm(0.1)(pg)
+    hybrid = HybridParallelClipGrad(nn.ClipGradByGlobalNorm(0.1), None)(pg)
+    for (_, a), (_, b) in zip(plain, hybrid):
+        np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-6)
