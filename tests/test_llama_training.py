@@ -175,3 +175,48 @@ def test_checkpointed_loss_matches_plain(tiny_cfg):
     s2 = CompiledTrainStep(m2, lr=1e-3, donate=False)
     l2 = float(s2.step(x, y))
     np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_single_copy_bf16_sr_training():
+    """master_dtype='bfloat16_sr' (VERDICT r3 #2 enabler): one bf16 param
+    tree serves as master, fp32 update math in-step, stochastic-rounding
+    writeback — 8 bytes/param of state.  Must converge on a memorization
+    task and keep no master tree."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import (
+        CompiledTrainStep, LlamaConfig, LlamaForCausalLM,
+    )
+
+    paddle.seed(11)
+    cfg = LlamaConfig.tiny(recompute=True, scan_layers=True)
+    m = LlamaForCausalLM(cfg)
+    s = CompiledTrainStep(m, lr=5e-3, compute_dtype="bfloat16",
+                          moments_dtype="bfloat16",
+                          master_dtype="bfloat16_sr")
+    assert s._master == {}
+    ids = np.random.RandomState(0).randint(0, 256, (2, 64)).astype(np.int32)
+    losses = [float(s.step(ids, ids)) for _ in range(30)]
+    assert losses[-1] < losses[0] - 1.5, losses
+    # params stayed bf16 (single copy)
+    import jax.numpy as jnp
+
+    assert all(v.dtype == jnp.bfloat16 for k, v in s.params.items()
+               if "norm" not in k)
+
+
+def test_stochastic_round_unbiased():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.models.training import _stochastic_round_bf16
+
+    x = jnp.full((20000,), 1.0 + 1e-3, jnp.float32)  # between bf16 grid pts
+    out = _stochastic_round_bf16(x, jax.random.PRNGKey(0))
+    assert out.dtype == jnp.bfloat16
+    mean = float(jnp.mean(out.astype(jnp.float32)))
+    # unbiased: mean of rounded values ~ the fp32 value, far tighter than
+    # the 1/256 bf16 ulp that deterministic rounding would miss by
+    np.testing.assert_allclose(mean, 1.0 + 1e-3, atol=2e-4)
