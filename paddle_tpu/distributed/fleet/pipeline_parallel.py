@@ -149,19 +149,20 @@ def static_scheduler(num_stages, num_micro_batches, stage_id,
     M, P, i = num_micro_batches, num_stages, stage_id
     steps = []
     if schedule in ("1F1B", "1f1b"):
-        warmup = min(P - 1 - i, M)
-        f = b = 0
-        for _ in range(warmup):
-            steps.append(f"f{f}")
-            f += 1
-        while f < M:
-            steps.append(f"f{f}")
-            f += 1
-            steps.append(f"b{b}")
-            b += 1
-        while b < M:
-            steps.append(f"b{b}")
-            b += 1
+        # Byte-exact reproduction of the reference's
+        # forward_backward_pipeline(static_scheduler=True) string
+        # (pipeline_parallel.py:587,620,675): startup forwards, steady
+        # f/b pairs, cooldown backwards — each token ';'-terminated.
+        startup = min(P - i - 1, M)
+        steady = M - startup
+        out = ""
+        for s in range(startup):
+            out += f"f{s};"
+        for s in range(steady):
+            out += f"f{startup + s};b{s};"
+        for s in range(startup):
+            out += f"b{steady + s};"
+        return out
     elif schedule in ("FThenB", "F-then-B", "fthenb"):
         steps = [f"f{m}" for m in range(M)] + [f"b{m}" for m in range(M)]
     elif schedule in ("VPP", "vpp", "interleave"):
@@ -220,8 +221,16 @@ class PipelineParallel(MetaParallelBase):
         mb = self.micro_batch_size
         layers = self._layers
 
-        order = static_scheduler(self.num_stages, M, self.stage_id,
-                                 self._schedule_mode).split(";")
+        # On a single driver the micro-step outcome is schedule-order
+        # invariant, and VPP's f{m}.{chunk} micro-steps only exist when
+        # stages are split across devices — run the 1F1B order here; the
+        # true interleaved execution is the SPMD engine
+        # (distributed/pipeline.py spmd_pipeline_interleaved).
+        mode = ("1F1B" if self._schedule_mode.upper() in ("VPP",
+                                                          "INTERLEAVE")
+                else self._schedule_mode)
+        order = [s for s in static_scheduler(
+            self.num_stages, M, self.stage_id, mode).split(";") if s]
         losses = {}
         total = None
         for step in order:

@@ -74,34 +74,100 @@ def spmd_pipeline(mesh, stage_fn, last_fn, axis="pp", dp_axis=None,
     The returned fn is pure/differentiable — call under jax.jit /
     value_and_grad.
     """
-    P = mesh.shape[axis]
-    body = jax.checkpoint(stage_fn, prevent_cse=False) if remat else stage_fn
+    # The V=1 special case of the interleaved schedule: one chunk per
+    # device, the V-axis roll at device 0 is the identity, the skewed
+    # scan and loss masking coincide exactly (parity tests pin this).
+    return spmd_pipeline_interleaved(mesh, stage_fn, last_fn, 1,
+                                     axis=axis, dp_axis=dp_axis,
+                                     remat=remat)
 
-    def local(stage_params, last_params, xs, ys, extra):
-        sp = jax.tree.map(lambda a: a[0], stage_params)  # drop stage dim
+
+def interleave_placement_order(num_stages_per_device, pp_size):
+    """Model-order chunk index for each placement slot.
+
+    VPP round-robin placement (reference PipelineParallelWithInterleave,
+    pipeline_parallel.py:1136): model chunk c runs on device c % P, local
+    slot c // P.  Stacking chunks in placement order j = p*V + v (so a
+    plain PartitionSpec('pp') on dim 0 gives device p its V chunks)
+    means placement slot j holds model chunk (j % V) * P + (j // V)."""
+    V, P = num_stages_per_device, pp_size
+    return [(j % V) * P + (j // V) for j in range(P * V)]
+
+
+def spmd_pipeline_interleaved(mesh, chunk_fn, last_fn, num_virtual,
+                              axis="pp", dp_axis=None, remat=True):
+    """Interleaved (VPP) variant of ``spmd_pipeline``: S = P*V virtual
+    stages, V chunks per device in round-robin placement, one ring
+    ppermute per tick carrying all V slot outputs.
+
+    ``chunk_params``: {name: [P*V, ...]} stacked in PLACEMENT order (use
+    ``interleave_placement_order`` to reorder a model-order stack).
+
+    Execution semantics match the reference's
+    ``PipelineParallelWithInterleave`` exactly (each microbatch traverses
+    chunks 0..S-1 in order; tied/chunked weights stay on their devices).
+    Scheduling note (honest): inside ONE synchronous XLA program every
+    scan tick runs V chunk bodies on every device, so the bubble is
+    (S-1)/(M+S-1) ticks — the reference's async runtime shrinks its
+    warmup with interleaving, a compiled SPMD scan cannot.  The value
+    here is placement parity (fine-grained layer->device mapping, tied
+    embed/head locality, heterogeneous depth) with identical numerics;
+    for raw throughput the plain skewed scan remains the default.
+    """
+    P = mesh.shape[axis]
+    V = num_virtual
+    S = P * V
+    body = jax.checkpoint(chunk_fn, prevent_cse=False) if remat else chunk_fn
+
+    def local(chunk_params, last_params, xs, ys, extra):
+        # [1, V, ...] -> [V, ...] local chunk stacks.
+        cp = jax.tree.map(lambda a: a[0], chunk_params)
         p = jax.lax.axis_index(axis)
         M = xs.shape[0]
-        T = M + P - 1
-        pad = jnp.zeros((P - 1,) + xs.shape[1:], xs.dtype)
+        T = M + S - 1
+        pad = jnp.zeros((S,) + xs.shape[1:], xs.dtype)
         xs_pad = jnp.concatenate([xs, pad], axis=0)
 
-        def step(recv, t):
-            x_t = jax.lax.dynamic_index_in_dim(xs_pad, t, 0, keepdims=False)
-            inp = jnp.where(p == 0, x_t, recv)
-            out = body(sp, inp, extra)
-            m = t - (P - 1)
+        def tick(carry, t):
+            # carry: [V, mb, ...] inputs arriving at this device's slots.
+            slots = carry
+
+            def run_slot(_, sv):
+                cp_v, in_v = sv
+                return None, body(cp_v, in_v, extra)
+
+            _, outs = jax.lax.scan(run_slot, None, (cp, slots))
+
+            # Loss on the final virtual stage (device P-1, slot V-1):
+            # its output at tick t is microbatch t - (S - 1).
+            m = t - (S - 1)
             y_m = jax.lax.dynamic_index_in_dim(
                 ys, jnp.clip(m, 0, M - 1), 0, keepdims=False)
             valid = jnp.logical_and(p == P - 1, m >= 0)
             contrib = jnp.where(
-                valid, last_fn(last_params, out, y_m, extra), 0.0)
-            nxt = jax.lax.ppermute(
-                out, axis, [(i, (i + 1) % P) for i in range(P)]) \
-                if P > 1 else out
+                valid, last_fn(last_params, outs[V - 1], y_m, extra), 0.0)
+
+            # Ring transfer of ALL slot outputs to the next device.
+            recv = jax.lax.ppermute(
+                outs, axis, [(i, (i + 1) % P) for i in range(P)]) \
+                if P > 1 else outs
+            # Crossing the P-1 -> 0 boundary advances the virtual round:
+            # device 0's slot v input is device P-1's slot v-1 output;
+            # other devices take slot v directly.  Slot 0 of device 0 is
+            # the fresh microbatch.
+            rolled = jnp.roll(recv, 1, axis=0)
+            nxt = jnp.where(p == 0, rolled, recv)
+            x_t = jax.lax.dynamic_index_in_dim(xs_pad, jnp.clip(t + 1, 0,
+                                                                M + S - 1),
+                                               0, keepdims=False)
+            inject = jnp.logical_and(p == 0, t + 1 < M)
+            nxt = nxt.at[0].set(jnp.where(inject, x_t, nxt[0]))
             return nxt, contrib
 
-        recv0 = jnp.zeros(xs.shape[1:], xs.dtype)
-        _, contribs = jax.lax.scan(step, recv0, jnp.arange(T))
+        x0 = jax.lax.dynamic_index_in_dim(xs_pad, 0, 0, keepdims=False)
+        init = jnp.zeros((V,) + xs.shape[1:], xs.dtype)
+        init = init.at[0].set(jnp.where(p == 0, x0, init[0]))
+        _, contribs = jax.lax.scan(tick, init, jnp.arange(T))
         loss = jnp.sum(contribs)
         if P > 1:
             loss = jax.lax.psum(loss, axis)
@@ -114,9 +180,13 @@ def spmd_pipeline(mesh, stage_fn, last_fn, axis="pp", dp_axis=None,
     data_spec = (PartitionSpec(None, dp_axis)
                  if dp_axis is not None else PartitionSpec())
 
-    def fn(stage_params, last_params, xs, ys, extra=()):
+    def fn(chunk_params, last_params, xs, ys, extra=()):
+        # [S, ...] placement-ordered stacks -> [P, V, ...] so dim 0
+        # shards over 'pp' and each device sees [1, V, ...].
+        cp = jax.tree.map(
+            lambda a: a.reshape((P, V) + a.shape[1:]), chunk_params)
         in_specs = (
-            jax.tree.map(lambda _: stage_spec, stage_params),
+            jax.tree.map(lambda _: stage_spec, cp),
             jax.tree.map(lambda _: PartitionSpec(), last_params),
             data_spec, data_spec,
             jax.tree.map(lambda _: PartitionSpec(), extra),
@@ -124,7 +194,7 @@ def spmd_pipeline(mesh, stage_fn, last_fn, axis="pp", dp_axis=None,
         return shard_map(
             local, mesh=mesh, in_specs=in_specs,
             out_specs=PartitionSpec(),
-            check_vma=False)(stage_params, last_params, xs, ys, extra)
+            check_vma=False)(cp, last_params, xs, ys, extra)
 
     return fn
 
@@ -141,18 +211,48 @@ class PipelineTrainStep:
     def __init__(self, mesh, embed_fn, stage_fn, last_fn, embed_params,
                  stage_params_stacked, last_params, extra=(), axis="pp",
                  dp_axis=None, lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
-                 weight_decay=0.0, remat=True, donate=True):
+                 weight_decay=0.0, remat=True, donate=True,
+                 tie_embed_head=False, num_virtual=1):
+        """tie_embed_head=True: ``last_fn`` receives ``(last_params,
+        embed_params)`` and may read the embedding table for the output
+        projection (reference SharedLayerDesc, pp_layers.py:257).  The
+        shared table's gradient accumulates from both uses automatically:
+        the head contribution is computed on the last pp stage and the
+        transpose of the replicated shard_map in_spec psums it over the
+        'pp' axis — the reference's explicit shared-weight allreduce,
+        compiler-generated.
+
+        num_virtual>1: interleaved VPP execution
+        (spmd_pipeline_interleaved); ``stage_params_stacked`` has P*V
+        chunks stacked in MODEL order, reordered here to round-robin
+        placement."""
         self.mesh = mesh
         self.lr = lr
         self._t = 0
-        pipe = spmd_pipeline(mesh, stage_fn, last_fn, axis=axis,
-                             dp_axis=dp_axis, remat=remat)
+        P = mesh.shape[axis]
+        self.num_virtual = num_virtual
+        if num_virtual > 1:
+            order = interleave_placement_order(num_virtual, P)
+            stage_params_stacked = {
+                k: jnp.take(v, jnp.asarray(order), axis=0)
+                for k, v in stage_params_stacked.items()}
+            self._placement_order = order
+            pipe = spmd_pipeline_interleaved(
+                mesh, stage_fn, last_fn, num_virtual, axis=axis,
+                dp_axis=dp_axis, remat=remat)
+        else:
+            self._placement_order = None
+            pipe = spmd_pipeline(mesh, stage_fn, last_fn, axis=axis,
+                                 dp_axis=dp_axis, remat=remat)
         self._extra = extra
 
         def loss_of(params, xs, ys):
             ep, sp, lp = params
             xs_h = embed_fn(ep, xs, extra)
-            return pipe(sp, lp, xs_h, ys, extra)
+            last_p = (lp, ep) if tie_embed_head else lp
+            return pipe(sp, last_p, xs_h, ys, extra)
+
+        self._loss_of = loss_of
 
         st_sh = stage_sharding(mesh, stage_params_stacked, axis)
         repl = NamedSharding(mesh, PartitionSpec())

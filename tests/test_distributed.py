@@ -203,12 +203,14 @@ def test_moe_matches_dense_topk1_full_capacity():
 def test_1f1b_schedule_strings():
     from paddle_tpu.distributed.fleet import static_scheduler
 
-    # 2 stages, 4 micro-batches — stage 0 warms up 1 forward
+    # 2 stages, 4 micro-batches — stage 0 warms up 1 forward.
+    # 1F1B strings are byte-exact with the reference's
+    # static_scheduler=True output (';'-terminated tokens).
     s0 = static_scheduler(2, 4, 0)
-    assert s0 == "f0;f1;b0;f2;b1;f3;b2;b3"
+    assert s0 == "f0;f1;b0;f2;b1;f3;b2;b3;"
     # last stage: strict alternation
     s1 = static_scheduler(2, 4, 1)
-    assert s1 == "f0;b0;f1;b1;f2;b2;f3;b3"
+    assert s1 == "f0;b0;f1;b1;f2;b2;f3;b3;"
     # FThenB
     assert static_scheduler(2, 2, 0, "FThenB") == "f0;f1;b0;b1"
     # 4-stage first stage warmup = 3

@@ -146,3 +146,146 @@ def test_vpp_schedule_string():
         for v in range(2):
             assert f"f{m}.{v}" in steps and f"b{m}.{v}" in steps
             assert steps.index(f"f{m}.{v}") < steps.index(f"b{m}.{v}")
+
+
+def test_static_scheduler_exact_reference_strings():
+    """Byte-exact vs the reference's forward_backward_pipeline(
+    static_scheduler=True) output (pipeline_parallel.py:587,620,675):
+    ';'-terminated tokens, startup = min(P - stage - 1, M)."""
+    from paddle_tpu.distributed.fleet.pipeline_parallel import (
+        static_scheduler)
+
+    # P=4, M=8: reference algorithm traced by hand per stage.
+    assert static_scheduler(4, 8, 0) == (
+        "f0;f1;f2;f3;b0;f4;b1;f5;b2;f6;b3;f7;b4;b5;b6;b7;")
+    assert static_scheduler(4, 8, 2) == (
+        "f0;f1;b0;f2;b1;f3;b2;f4;b3;f5;b4;f6;b5;f7;b6;b7;")
+    assert static_scheduler(4, 8, 3) == (
+        "f0;b0;f1;b1;f2;b2;f3;b3;f4;b4;f5;b5;f6;b6;f7;b7;")
+    # M smaller than the pipeline: startup clamps to M (last stage idles)
+    assert static_scheduler(4, 2, 0) == "f0;f1;b0;b1;"
+    assert static_scheduler(4, 2, 3) == "f0;b0;f1;b1;"
+
+
+def _embed_fn_tied(ep, tok, extra):
+    return jnp.take(ep["emb"], tok, axis=0)
+
+
+def _last_fn_tied(params, x, y, extra):
+    lp, ep = params  # tie_embed_head contract
+    logits = x @ ep["emb"].T + lp["head_b"]
+    lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(lsm, y[..., None].astype(jnp.int32),
+                               axis=-1)
+    return jnp.mean(nll)
+
+
+def test_pipeline_tied_embed_head_parity():
+    """SharedLayerDesc semantics (VERDICT r3 #6): embedding table shared
+    between the (replicated) embed and the last-stage head; its gradient
+    must accumulate from BOTH uses — the head contribution is psum'd
+    over 'pp' by the shard_map transpose (the reference's explicit
+    shared-weight allreduce, pp_layers.py:257)."""
+    pp = 4
+    stages, _ = _make_params(pp, seed=5)
+    rng = np.random.RandomState(6)
+    ep = {"emb": jnp.asarray(rng.randn(VOCAB, HID) * 0.3, jnp.float32)}
+    lp = {"head_b": jnp.asarray(rng.randn(VOCAB) * 0.1, jnp.float32)}
+    toks = jnp.asarray(rng.randint(0, VOCAB, (M, MB, SEQ)), jnp.int32)
+    ys = jnp.asarray(rng.randint(0, VOCAB, (M, MB, SEQ)), jnp.int32)
+
+    def ref_loss(ep, stages, lp):
+        total = 0.0
+        for m in range(M):
+            x = _embed_fn_tied(ep, toks[m], ())
+            for tree in stages:
+                x = _stage_fn(tree, x, ())
+            total = total + _last_fn_tied((lp, ep), x, ys[m], ())
+        return total / M
+
+    ref_l, (ref_ge, ref_gs, ref_gl) = jax.value_and_grad(
+        ref_loss, argnums=(0, 1, 2))(ep, stages, lp)
+
+    devs = np.array(jax.devices()[:pp]).reshape(pp)
+    mesh = Mesh(devs, ("pp",))
+    step = PipelineTrainStep(
+        mesh, _embed_fn_tied, _stage_fn, _last_fn_tied,
+        embed_params=ep, stage_params_stacked=stack_stage_params(stages),
+        last_params=lp, lr=1e-2, donate=False, tie_embed_head=True)
+
+    # grad parity via the step's internal loss function
+    lf = step._loss_of
+    loss, (ge, gs, gl) = jax.jit(jax.value_and_grad(
+        lambda e, s, l: lf((e, s, l), toks, ys),
+        argnums=(0, 1, 2)))(ep, stack_stage_params(stages), lp)
+
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+    ref_stack = jnp.stack([g["w1"] for g in ref_gs])
+    np.testing.assert_allclose(np.asarray(gs["w1"]),
+                               np.asarray(ref_stack), rtol=1e-4,
+                               atol=1e-5)
+    # the tied table's grad includes embed + head contributions
+    np.testing.assert_allclose(np.asarray(ge["emb"]),
+                               np.asarray(ref_ge["emb"]), rtol=1e-4,
+                               atol=1e-5)
+
+    # and the full train step converges
+    losses = [float(step.step(toks, ys)) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_interleaved_vpp_execution_parity():
+    """VPP EXECUTION (VERDICT r3 #6, not just strings): P=2 devices x
+    V=2 chunks in round-robin placement must reproduce the sequential
+    4-chunk model exactly (reference PipelineParallelWithInterleave,
+    pipeline_parallel.py:1136)."""
+    from paddle_tpu.distributed.pipeline import (
+        interleave_placement_order, spmd_pipeline_interleaved,
+    )
+
+    P, V = 2, 2
+    S = P * V
+    chunks, last = _make_params(S, seed=7)
+    xs, ys = _data(seed=8)
+    ref_loss, (ref_gs, ref_gl) = _reference_loss_and_grads(
+        chunks, last, xs, ys)
+
+    devs = np.array(jax.devices()[:P]).reshape(P)
+    mesh = Mesh(devs, ("pp",))
+    pipe = spmd_pipeline_interleaved(mesh, _stage_fn, _last_fn, V,
+                                     axis="pp", remat=True)
+    order = interleave_placement_order(V, P)
+    stacked_model = stack_stage_params(chunks)
+    stacked_placed = {k: jnp.take(v, jnp.asarray(order), axis=0)
+                      for k, v in stacked_model.items()}
+
+    loss, (g_placed, g_last) = jax.jit(jax.value_and_grad(
+        lambda sp, lp: pipe(sp, lp, xs, ys),
+        argnums=(0, 1)))(stacked_placed, last)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    inv = np.argsort(order)  # placement -> model order
+    for k in stacked_model:
+        got = np.asarray(g_placed[k])[inv]
+        ref_stack = np.stack([np.asarray(g[k]) for g in ref_gs])
+        np.testing.assert_allclose(got, ref_stack, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g_last["head"]),
+                               np.asarray(ref_gl["head"]), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_interleaved_train_step_converges():
+    from paddle_tpu.distributed.pipeline import stack_stage_params
+
+    P, V = 2, 2
+    chunks, last = _make_params(P * V, seed=9)
+    xs, ys = _data(seed=10)
+
+    devs = np.array(jax.devices()[:P]).reshape(P)
+    mesh = Mesh(devs, ("pp",))
+    step = PipelineTrainStep(
+        mesh, lambda ep, x, extra: x, _stage_fn, _last_fn,
+        embed_params={}, stage_params_stacked=stack_stage_params(chunks),
+        last_params=last, lr=1e-2, donate=False, num_virtual=V)
+    losses = [float(step.step(xs, ys)) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
