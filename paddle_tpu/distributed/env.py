@@ -62,11 +62,27 @@ def init_parallel_env():
     master = os.environ.get("MASTER_ADDR")
     port = os.environ.get("MASTER_PORT")
     nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
-    if master and port and nnodes > 1 and jax.process_count() == 1:
-        jax.distributed.initialize(
-            coordinator_address=f"{master}:{port}",
-            num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", nnodes)),
-            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    # NB: do NOT probe jax.process_count() here — it would initialize
+    # the XLA backend, after which jax.distributed.initialize refuses to
+    # run.  Check the distributed client state directly.
+    from jax._src import distributed as _jax_dist
+
+    not_connected = _jax_dist.global_state.client is None
+    if master and port and nnodes > 1 and not_connected:
+        from .watchdog import CommWatchdog
+
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", nnodes))
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        # Guard the blocking rendezvous: a rank that never arrives must
+        # fail with a who-is-missing diagnosis, not hang (reference
+        # CommTaskManager watchdog, comm_task_manager.h:37).
+        wd = CommWatchdog(world_size=world, rank=rank)
+        with wd.task("jax.distributed.initialize (rendezvous)"):
+            jax.distributed.initialize(
+                coordinator_address=f"{master}:{port}",
+                num_processes=world,
+                process_id=rank,
+                initialization_timeout=int(wd.timeout) + 60)
     _initialized = True
     global _parallel_env
     _parallel_env = ParallelEnv()

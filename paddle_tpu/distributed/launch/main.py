@@ -133,6 +133,8 @@ def launch(argv=None):
                 # bind on node 0 collides and multi-node http mode can
                 # never bring up the jax runtime (round-2 advisor).
                 env["MASTER_PORT"] = str(int(master_port) + 1 + nnodes)
+                # Original KV port for watchdog roll-call diagnostics.
+                env["PADDLE_RDZV_PORT"] = master_port
             else:
                 endpoints = [f"{master_ip}:{int(master_port) + i}"
                              for i in range(world)]

@@ -1,0 +1,134 @@
+"""Comm watchdog — hang detection with diagnosis for rendezvous and
+collective operations.
+
+Reference: ``paddle/phi/core/distributed/comm_task_manager.h:37``
+(``CommTaskManager``: an async watchdog thread that stamps each comm
+task, detects ``IsTimeout()`` and aborts with a diagnostic instead of
+hanging forever) and the store-based barrier diagnostics.
+
+TPU-native mapping: in-graph collectives cannot hang a correct XLA
+program (the compiler schedules them); what CAN hang is the *host-side*
+control plane — ``jax.distributed.initialize`` waiting for a rank that
+never arrives, a barrier over the HTTP KV store, a checkpoint sync.
+``CommWatchdog.task(...)`` wraps those blocking host calls: a timer
+thread fires after ``timeout`` seconds, gathers who-is-present evidence
+from the rendezvous KV store (when the launch env provides one), prints
+a diagnosis naming the missing ranks, and aborts the process (the
+reference behavior) — or records the event when ``abort=False`` (tests).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+
+class CommWatchdog:
+    """Watchdog over blocking host-side comm operations."""
+
+    def __init__(self, timeout=None, abort=True, world_size=None,
+                 rank=None):
+        if timeout is None:
+            timeout = float(os.environ.get("PADDLE_COMM_TIMEOUT", "300"))
+        self.timeout = float(timeout)
+        self.abort = abort
+        self.world_size = world_size
+        self.rank = rank
+        self.fired = []  # (desc, diagnosis) records when abort=False
+
+    # -- evidence gathering --------------------------------------------------
+    def _registered_ranks(self):
+        """NODE ranks visible in the launch rendezvous scope, when an
+        HTTP KV master is reachable (launch/master.py wire protocol).
+        Returns None when the store is unreachable — a failed probe must
+        not masquerade as an empty roll call (an empty list would make
+        the diagnosis report every rank, including this one, missing)."""
+        master = os.environ.get("MASTER_ADDR")
+        port = os.environ.get("PADDLE_RDZV_PORT",
+                              os.environ.get("MASTER_PORT"))
+        job = os.environ.get("PADDLE_JOB_ID", "default")
+        if not master or not port:
+            return None
+        try:
+            import json
+
+            from .launch.master import KVClient
+
+            kv = KVClient(f"{master}:{port}")
+            # Raw request (not get_prefix): its error-swallowing {}
+            # would be indistinguishable from a genuinely-empty scope.
+            raw = kv._req("GET", f"/rendezvous/{job}/").read()
+            peers = json.loads(raw)
+            return sorted(int(k.rsplit("/", 1)[1]) for k in peers)
+        except Exception:
+            return None
+
+    def diagnose(self, desc, waited):
+        world = self.world_size
+        if world is None:
+            world = int(os.environ.get("PADDLE_TRAINERS_NUM", "0")) or None
+        rank = self.rank
+        if rank is None:
+            rank = os.environ.get("PADDLE_TRAINER_ID", "?")
+        present = self._registered_ranks()
+        # The KV store registers NODE ranks (one entry per launch
+        # invocation) — roll-call against nnodes, not the trainer world
+        # (with nproc_per_node > 1 they differ and comparing trainer
+        # ranks against node registrations would mark healthy trainers
+        # missing).
+        nnodes = int(os.environ.get("PADDLE_NNODES", "0")) or world
+        lines = [
+            f"[comm-watchdog] '{desc}' exceeded {self.timeout:.0f}s "
+            f"(waited {waited:.0f}s) on rank {rank}"]
+        if present is not None and nnodes:
+            missing = [r for r in range(nnodes) if r not in present]
+            lines.append(
+                f"[comm-watchdog] registered node ranks: {present} / "
+                f"nnodes {nnodes}; MISSING: {missing or 'none'}")
+            if missing:
+                lines.append(
+                    "[comm-watchdog] likely cause: the missing node(s) "
+                    "never started, crashed before rendezvous, or cannot "
+                    "reach the master — check their worker logs")
+        elif world:
+            lines.append(
+                f"[comm-watchdog] expected world size {world}; no "
+                "rendezvous store reachable for a per-rank roll call")
+        return "\n".join(lines)
+
+    # -- the guard -----------------------------------------------------------
+    def task(self, desc):
+        """Context manager guarding one blocking operation."""
+        return _Task(self, desc)
+
+
+class _Task:
+    def __init__(self, wd, desc):
+        self.wd = wd
+        self.desc = desc
+        self._done = threading.Event()
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.time()
+        self._thread = threading.Thread(target=self._watch, daemon=True)
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._done.set()
+        return False
+
+    def _watch(self):
+        if self._done.wait(self.wd.timeout):
+            return
+        waited = time.time() - self._t0
+        diag = self.wd.diagnose(self.desc, waited)
+        print(diag, file=sys.stderr, flush=True)
+        if self.wd.abort:
+            # The blocked call sits in C code and cannot be interrupted
+            # from Python — abort the process like the reference's
+            # CommTaskManager (comm_task_manager.h watchdog abort).
+            os._exit(124)
+        self.wd.fired.append((self.desc, diag))

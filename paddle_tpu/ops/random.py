@@ -28,8 +28,12 @@ class Generator:
         self.manual_seed(seed)
 
     def manual_seed(self, seed: int):
+        # Key creation is deferred to first use: materializing it here
+        # would initialize the jax backend at `import paddle_tpu` time,
+        # breaking multi-host jobs that must call
+        # jax.distributed.initialize first (env.init_parallel_env).
         self._seed = int(seed)
-        self._key = jax.random.key(int(seed))
+        self._key = None
         return self
 
     def initial_seed(self) -> int:
@@ -37,16 +41,29 @@ class Generator:
 
     seed = initial_seed
 
+    def _ensure_key(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+
     def next_key(self):
         with self._lock:
+            self._ensure_key()
             self._key, sub = jax.random.split(self._key)
             return sub
 
     def get_state(self):
-        return jax.random.key_data(self._key)
+        with self._lock:
+            self._ensure_key()
+            return jax.random.key_data(self._key)
 
     def set_state(self, state):
-        self._key = jax.random.wrap_key_data(jnp.asarray(state))
+        # Same lock as next_key: an unlocked write here could be
+        # overwritten by a concurrent next_key's split-writeback,
+        # silently discarding the restored stream.  NB initial_seed()
+        # keeps reporting the creation seed (the reference Generator's
+        # seed/offset state behaves the same after SetState).
+        with self._lock:
+            self._key = jax.random.wrap_key_data(jnp.asarray(state))
 
 
 default_generator = Generator(0)

@@ -1,0 +1,137 @@
+"""Real multi-host execution (VERDICT r3 #5): two OS processes, each
+with 4 virtual CPU devices, rendezvous through the launch CLI + HTTP KV
+master, jax.distributed.initialize, one dp step with grad parity — the
+reference's local-process cluster strategy
+(test/legacy_test/test_dist_base.py:952).  Plus the comm watchdog
+(comm_task_manager.h:37): a missing rank produces a diagnosis, not a
+hang.
+"""
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "multihost_worker.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch_node(node_rank, master_port, out_dir, nnodes=2,
+                 extra_env=None):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--master", f"127.0.0.1:{master_port}",
+           "--nnodes", str(nnodes), "--node_rank", str(node_rank),
+           "--rendezvous", "http", "--max_restart", "0",
+           "--log_dir", os.path.join(out_dir, f"log{node_rank}"),
+           WORKER, out_dir]
+    return subprocess.Popen(cmd, env=env, cwd=REPO,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+
+
+def _drain(procs, timeout):
+    deadline = time.time() + timeout
+    outs = {}
+    for p in procs:
+        remaining = max(5, deadline - time.time())
+        try:
+            out, _ = p.communicate(timeout=remaining)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs[p] = out.decode(errors="replace")
+    return outs
+
+
+def test_two_process_dp_grad_parity():
+    port = _free_port()
+    with tempfile.TemporaryDirectory() as d:
+        p0 = _launch_node(0, port, d)
+        p1 = _launch_node(1, port, d)
+        outs = _drain([p0, p1], timeout=300)
+        logs = ""
+        for node in (0, 1):
+            wl = os.path.join(d, f"log{node}", "workerlog.0")
+            if os.path.exists(wl):
+                logs += open(wl).read()
+        assert p0.returncode == 0, (outs[p0], logs)
+        assert p1.returncode == 0, (outs[p1], logs)
+        ok = os.path.join(d, "ok")
+        assert os.path.exists(ok), logs
+        assert "grads-match world=2 devices=8" in open(ok).read()
+        assert "worker rank 0: OK" in logs and "worker rank 1: OK" in logs
+
+
+def test_missing_rank_watchdog_diagnosis():
+    """Start only node 0 of a 2-node job with a short comm timeout: the
+    worker must abort with the watchdog's missing-rank diagnosis instead
+    of hanging in jax.distributed.initialize."""
+    port = _free_port()
+    with tempfile.TemporaryDirectory() as d:
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.update({
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+            "PADDLE_NNODES": "2",
+            "PADDLE_TRAINERS_NUM": "2",
+            "PADDLE_TRAINER_ID": "0",
+            "PADDLE_COMM_TIMEOUT": "20",
+        })
+        p = subprocess.Popen(
+            [sys.executable, WORKER, d], env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            pytest.fail("worker hung: watchdog did not abort\n"
+                        + out.decode(errors="replace")[-2000:])
+        text = out.decode(errors="replace")
+        assert p.returncode == 124, (p.returncode, text[-2000:])
+        assert "comm-watchdog" in text
+        assert "exceeded 20s" in text
+
+
+def test_watchdog_diagnosis_names_missing_ranks(monkeypatch):
+    """Unit: with a KV store holding rank 0 of world 2, the diagnosis
+    names rank 1 as missing."""
+    from paddle_tpu.distributed.launch.master import HTTPMaster, KVClient
+    from paddle_tpu.distributed.watchdog import CommWatchdog
+
+    master = HTTPMaster(f"127.0.0.1:{_free_port()}").start()
+    try:
+        kv = KVClient(master.endpoint)
+        assert kv.put("/rendezvous/default/0", "127.0.0.1:1")
+        host, port = master.endpoint.split(":")
+        monkeypatch.setenv("MASTER_ADDR", host)
+        monkeypatch.setenv("PADDLE_RDZV_PORT", port)
+        monkeypatch.setenv("PADDLE_JOB_ID", "default")
+        wd = CommWatchdog(timeout=0.2, abort=False, world_size=2, rank=0)
+        with wd.task("unit-op"):
+            time.sleep(1.0)
+        assert len(wd.fired) == 1
+        desc, diag = wd.fired[0]
+        assert "MISSING: [1]" in diag
+        assert "registered node ranks: [0]" in diag
+    finally:
+        master.stop()
