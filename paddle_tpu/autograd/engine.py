@@ -218,8 +218,45 @@ def _mark_needed(root_nodes, slot_targets, leaf_target_ids):
     return {k for k, v in needed.items() if v}
 
 
+def _node_backward_recorded(node, grads_out):
+    """create_graph=True step: compute this node's input grads THROUGH
+    the op registry (a recompute-based VJP grad-op, registry.grad_op),
+    so the backward computation itself is recorded on the tape and
+    supports another backward.  Reference: eager double grad
+    (general_grad.h + backward.yaml *_double_grad pairs)."""
+    from ..core.tensor import Tensor
+    from ..ops import registry
+
+    op = node.op
+    if op is None or getattr(op, "fn", None) is None:
+        raise NotImplementedError(
+            f"create_graph=True cannot differentiate through "
+            f"'{node.name}': the node has no re-traceable forward "
+            "(PyLayer/compiled custom nodes); wrap that region in "
+            "autograd.functional (jax.grad) instead")
+    nondiff = getattr(op, "nondiff_argnums", frozenset())
+    diff_idx = tuple(
+        i for i, t in enumerate(node.inputs)
+        if isinstance(t, Tensor)
+        and not t.stop_gradient
+        and i not in nondiff
+        and jnp.issubdtype(t._data.dtype, jnp.inexact))
+    if not diff_idx:
+        return [None] * len(node.inputs)
+    gop = registry.grad_op(op, node.attrs, node.n_outs, diff_idx,
+                           len(node.inputs))
+    outs = registry.apply(gop, *(list(grads_out) + list(node.inputs)))
+    if not isinstance(outs, tuple):
+        outs = (outs,)
+    grads = [None] * len(node.inputs)
+    for j, g in zip(diff_idx, outs):
+        grads[j] = g
+    return grads
+
+
 def run_backward(tensors, grad_tensors=None, retain_graph=False,
-                 targets=None, accumulate_into_grad=True):
+                 targets=None, accumulate_into_grad=True,
+                 create_graph=False):
     """Core engine used by Tensor.backward() and paddle.grad().
 
     Accumulates into leaf ``.grad`` (unless accumulate_into_grad=False);
@@ -275,6 +312,13 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
                     "grad can be implicitly created only for scalar outputs; "
                     f"got shape {t.shape}")
             g = jnp.ones(t.shape, t.dtype)
+            if create_graph:
+                g = Tensor(g, stop_gradient=True)
+        elif create_graph:
+            # Keep Tensor cotangents as-is — a graph-carrying seed makes
+            # the returned grads differentiable w.r.t. it too.
+            g = g if isinstance(g, Tensor) \
+                else Tensor(jnp.asarray(g), stop_gradient=True)
         else:
             g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
         if t._grad_node is None:
@@ -309,16 +353,30 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
             hooks = node.out_hooks[slot] if node.out_hooks else None
             if hooks:
                 for hook in hooks:
-                    out = hook(Tensor(g, stop_gradient=True))
+                    out = hook(g if isinstance(g, Tensor)
+                               else Tensor(g, stop_gradient=True))
                     if out is not None:
-                        g = out._data if isinstance(out, Tensor) else out
+                        g = out if create_graph and isinstance(out, Tensor) \
+                            else (out._data if isinstance(out, Tensor)
+                                  else out)
                 grads_out[slot] = g
             key = (id(node), slot)
             if key in slot_targets:
                 for tid in slot_targets[key]:
                     captured[tid] = _acc(captured.get(tid), g)
 
-        grads_in = node.run_backward(grads_out)
+        if create_graph:
+            filled = []
+            for slot in range(node.n_outs):
+                g = grads_out[slot]
+                if g is None:
+                    shape, dtype = node.out_meta[slot]
+                    g = Tensor(jnp.zeros(shape, dtype),
+                               stop_gradient=True)
+                filled.append(g)
+            grads_in = _node_backward_recorded(node, filled)
+        else:
+            grads_in = node.run_backward(grads_out)
 
         for kind, i, obj, slot in node.parent_edges():
             g = grads_in[i]
@@ -347,13 +405,15 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
     for tid, (tensor, g) in leaf_buf.items():
         if tensor._hooks:
             for hook in tensor._hooks:
-                out = hook(Tensor(g, stop_gradient=True))
+                out = hook(g if isinstance(g, Tensor)
+                           else Tensor(g, stop_gradient=True))
                 if out is not None:
-                    g = out._data if isinstance(out, Tensor) else out
+                    g = out if create_graph and isinstance(out, Tensor) \
+                        else (out._data if isinstance(out, Tensor) else out)
         if tid in leaf_targets:
             captured[tid] = _acc(captured.get(tid), g)
         if accumulate_into_grad:
-            _leaf_write(tensor, g)
+            _leaf_write(tensor, g._data if isinstance(g, Tensor) else g)
 
     return captured
 
@@ -388,15 +448,14 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         inputs = [inputs]
     if isinstance(grad_outputs, Tensor):
         grad_outputs = [grad_outputs]
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (double grad) is not supported yet")
     if retain_graph is None:
-        retain_graph = False
+        # paddle semantics: retain the graph when building a new one.
+        retain_graph = bool(create_graph)
 
     captured = run_backward(outputs, grad_outputs,
                             retain_graph=retain_graph, targets=inputs,
-                            accumulate_into_grad=False)
+                            accumulate_into_grad=False,
+                            create_graph=create_graph)
     results = []
     for t in inputs:
         g = captured.get(id(t))
@@ -406,6 +465,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                     "One of the differentiated tensors appears unused; "
                     "pass allow_unused=True to return None for it")
             results.append(None)
+        elif isinstance(g, Tensor):
+            # create_graph path: the grad carries its own graph and can
+            # be differentiated again (reference double-grad contract).
+            results.append(g)
         else:
             results.append(Tensor(g, stop_gradient=True))
     return results

@@ -45,7 +45,7 @@ _CHECKER_CFG = None
 class OpDef:
     __slots__ = (
         "name", "fn", "fwd", "bwd", "n_outputs", "jit_fn", "jit_fwd",
-        "jit_bwd", "static_argnames", "nondiff_argnums",
+        "jit_bwd", "static_argnames", "nondiff_argnums", "_grad_ops",
     )
 
     def __init__(self, name, fn, fwd=None, bwd=None, n_outputs=1,
@@ -92,6 +92,59 @@ def get_op(name: str) -> OpDef:
     return _OPS[name]
 
 
+def grad_op(op: OpDef, attrs: dict, n_outs: int, diff_idx: tuple,
+            n_inputs: int) -> OpDef:
+    """OpDef computing d(inputs[diff_idx]) from (cotangents, *inputs) —
+    used by create_graph=True backward: the VJP is recomputed from the
+    op's forward fn and dispatched through apply(), so the backward
+    itself lands on the tape (second-order edges recorded).  Reference
+    analog: the eager engine's double-grad support
+    (paddle/fluid/eager/general_grad.h + backward.yaml double_grad).
+
+    Signature of the returned op's fn:
+        fn(*cotangents[n_outs], *forward_inputs[n_inputs]) ->
+            grads for the diff_idx positions (bare array when one).
+    The cache lives ON the OpDef instance — dynamically-created OpDefs
+    can share a name with different closures (MoE per-layer ops), so a
+    name-keyed global cache would hand back the wrong forward."""
+    cache = getattr(op, "_grad_ops", None)
+    if cache is None:
+        cache = {}
+        try:
+            op._grad_ops = cache
+        except AttributeError:  # non-OpDef custom op objects
+            pass
+    key = (tuple(sorted(attrs.items())), n_outs, diff_idx, n_inputs)
+    cached = cache.get(key)
+    if cached is not None:
+        return cached
+    fwd_fn = op.fn
+
+    def bwd_plain(*args):
+        cots = args[:n_outs]
+        ins = list(args[n_outs:])
+
+        def f(*dins):
+            full = list(ins)
+            for j, d in zip(diff_idx, dins):
+                full[j] = d
+            return fwd_fn(*full, **attrs)
+
+        _, vjp_fn = jax.vjp(f, *[ins[j] for j in diff_idx])
+        cot = cots[0] if n_outs == 1 else tuple(cots)
+        gs = vjp_fn(cot)
+        # Single diff input -> bare array: apply()'s n_outputs=1 contract
+        # (and the cotangent structure of THIS op's own vjp) expects it.
+        return gs[0] if len(diff_idx) == 1 else tuple(gs)
+
+    gop = OpDef(
+        f"grad[{op.name}]", bwd_plain, n_outputs=max(1, len(diff_idx)),
+        nondiff_argnums=tuple(n_outs + i for i in range(n_inputs)
+                              if i not in diff_idx))
+    cache[key] = gop
+    return gop
+
+
 def all_ops() -> dict:
     return dict(_OPS)
 
@@ -114,7 +167,11 @@ def apply(op: OpDef, *tensor_args, attrs=None, **kw_attrs):
     attrs = dict(attrs or {})
     attrs.update(kw_attrs)
 
-    if _amp_state.amp_enabled():
+    # Derived grad ops ("grad[<name>]", create_graph backward) skip AMP:
+    # the normal backward (jit_bwd) is never amp-cast either, and their
+    # names are in no AMP list — casting here would make create_graph
+    # grads numerically diverge from plain ones under auto_cast.
+    if _amp_state.amp_enabled() and not op.name.startswith("grad["):
         tensor_args = _amp_state.amp_transform(op.name, tensor_args)
 
     datas = []
