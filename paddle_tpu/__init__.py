@@ -54,6 +54,8 @@ from . import incubate  # noqa: F401
 from . import hapi  # noqa: F401
 from . import inference  # noqa: F401
 from . import distribution  # noqa: F401
+from . import sparse  # noqa: F401
+from . import quantization  # noqa: F401
 from .hapi import Model  # noqa: F401
 
 disable_static = lambda *a, **k: None  # dygraph is the default  # noqa: E731

@@ -157,6 +157,16 @@ class Tensor:
 
         return ops.assign(self)
 
+    # -- sparse conversions (reference Tensor.to_sparse_coo/csr) ----------
+
+    def to_sparse_coo(self, sparse_dim=None):
+        from .. import sparse as _sp
+
+        return _sp.dense_to_coo(self, sparse_dim)
+
+    def to_sparse_csr(self):
+        return self.to_sparse_coo().to_sparse_csr()
+
     # -- device movement --------------------------------------------------
     def to(self, *args, device=None, dtype=None, blocking=None, place=None):
         """Reference signature: Tensor.to(device=None, dtype=None,

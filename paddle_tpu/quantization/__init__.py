@@ -1,0 +1,310 @@
+"""paddle.quantization — PTQ observers and QAT fake-quanters.
+
+Reference: ``python/paddle/quantization/`` — ``QuantConfig``
+(config.py:67), ``PTQ`` (ptq.py:29), ``QAT`` (qat.py:27),
+``observers.AbsmaxObserver`` (observers/abs_max.py),
+``quanters.FakeQuanterWithAbsMaxObserver`` (quanters/abs_max.py), and
+the Quanted layer wrappers (wrapper.py / nn/quant wrappers).
+
+TPU-native: fake quantization is a pure elementwise chain
+(scale -> round -> clip -> descale) that XLA fuses into the surrounding
+matmul; QAT's straight-through estimator is the standard
+``x + stop_gradient(q(x) - x)`` so backward sees identity — no custom
+kernels needed.  Flow (same as the reference):
+
+    config = QuantConfig(activation=AbsmaxObserver(),
+                         weight=AbsmaxObserver())
+    ptq = PTQ(config); qm = ptq.quantize(model)   # insert observers
+    qm(calibration_batches...)                    # collect ranges
+    infer_model = ptq.convert(qm)                 # bake fake-quant
+or
+    qat = QAT(q_config_with_quanters); qm = qat.quantize(model)
+    ...train qm...                                # STE gradients
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layers import Layer
+from .. import nn as _nn
+from ..ops import registry as _registry
+
+_qops: dict = {}
+
+
+def _op(name, fn, *args, **attrs):
+    op = _qops.get(name)
+    if op is None:
+        op = _registry.OpDef(name, fn,
+                             static_argnames=tuple(attrs.keys()))
+        _qops[name] = op
+    return _registry.apply(op, *args, **attrs)
+
+
+def _fake_quant(x, scale, bits=8):
+    """Simulated int quantization: round(x/scale*qmax) clipped, descaled
+    — with a straight-through estimator so gradients pass unchanged
+    (reference quanters/abs_max.py FakeQuanterWithAbsMaxObserverLayer)."""
+    import jax
+
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def fn(x, scale, qmax):
+        s = jnp.maximum(scale, 1e-9) / qmax
+        q = jnp.clip(jnp.round(x / s), -qmax - 1, qmax) * s
+        # STE: forward q, backward identity.
+        return x + jax.lax.stop_gradient(q - x)
+
+    return _op("fake_quant", fn, x, scale, qmax=qmax)
+
+
+# -- observers / quanters (factory pattern, reference factory.py) -----------
+
+class BaseObserver(Layer):
+    """Collects the quantization range; scale() yields abs-max."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self._bits = quant_bits
+        self._absmax = 0.0
+
+    def bit_length(self):
+        return self._bits
+
+    def scales(self):
+        return Tensor(jnp.asarray(self._absmax, jnp.float32))
+
+    def forward(self, x):
+        cur = float(jnp.max(jnp.abs(x._data)).astype(jnp.float32))
+        self._absmax = max(self._absmax, cur)
+        return x
+
+
+class AbsmaxObserverLayer(BaseObserver):
+    pass
+
+
+class FakeQuanterWithAbsMaxObserverLayer(BaseObserver):
+    """QAT: observe with a moving-rate absmax AND fake-quantize with STE
+    (reference quanters/abs_max.py, moving_rate default 0.9)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__(quant_bits)
+        self._rate = moving_rate
+
+    def forward(self, x):
+        cur = float(jnp.max(jnp.abs(x._data)).astype(jnp.float32))
+        if self._absmax == 0.0:
+            self._absmax = cur
+        else:
+            self._absmax = (self._rate * self._absmax
+                            + (1 - self._rate) * cur)
+        return _fake_quant(x, Tensor(jnp.float32(self._absmax)),
+                           bits=self._bits)
+
+
+class _Factory:
+    def __init__(self, layer_cls, **kwargs):
+        self._cls = layer_cls
+        self._kwargs = kwargs
+
+    def _instance(self, layer=None):
+        return self._cls(**self._kwargs)
+
+
+class AbsmaxObserver(_Factory):
+    """observers.AbsmaxObserver (observers/abs_max.py)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(AbsmaxObserverLayer, quant_bits=quant_bits)
+
+
+class FakeQuanterWithAbsMaxObserver(_Factory):
+    """quanters.FakeQuanterWithAbsMaxObserver (quanters/abs_max.py)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__(FakeQuanterWithAbsMaxObserverLayer,
+                         quant_bits=quant_bits, moving_rate=moving_rate)
+
+
+# namespace parity: paddle.quantization.observers / .quanters
+class observers:  # noqa: N801
+    AbsmaxObserver = AbsmaxObserver
+    AbsmaxObserverLayer = AbsmaxObserverLayer
+
+
+class quanters:  # noqa: N801
+    FakeQuanterWithAbsMaxObserver = FakeQuanterWithAbsMaxObserver
+    FakeQuanterWithAbsMaxObserverLayer = FakeQuanterWithAbsMaxObserverLayer
+
+
+# -- config (reference config.py:67) ----------------------------------------
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self._activation = activation
+        self._weight = weight
+        self._layer_configs = {}  # id(layer) -> (act, w)
+        self._type_configs = {}   # layer type -> (act, w)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for ly in layers:
+            self._layer_configs[id(ly)] = (activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            self._type_configs[t] = (activation, weight)
+
+    def _config_for(self, layer):
+        if id(layer) in self._layer_configs:
+            return self._layer_configs[id(layer)]
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        return (self._activation, self._weight)
+
+
+# -- quanted layer wrappers (reference nn/quant wrappers) -------------------
+
+class QuantedLinear(Layer):
+    def __init__(self, inner, act_factory, w_factory):
+        super().__init__()
+        self._inner = inner
+        self.activation_quanter = (act_factory._instance(inner)
+                                   if act_factory else None)
+        self.weight_quanter = (w_factory._instance(inner)
+                               if w_factory else None)
+        if self.activation_quanter is not None:
+            self.add_sublayer("activation_quanter",
+                              self.activation_quanter)
+        if self.weight_quanter is not None:
+            self.add_sublayer("weight_quanter", self.weight_quanter)
+        self.add_sublayer("_inner", inner)
+
+    def forward(self, x):
+        w = self._inner.weight
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        from ..nn import functional as F
+
+        return F.linear(x, w, self._inner.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, inner, act_factory, w_factory):
+        super().__init__()
+        self._inner = inner
+        self.activation_quanter = (act_factory._instance(inner)
+                                   if act_factory else None)
+        self.weight_quanter = (w_factory._instance(inner)
+                               if w_factory else None)
+        if self.activation_quanter is not None:
+            self.add_sublayer("activation_quanter",
+                              self.activation_quanter)
+        if self.weight_quanter is not None:
+            self.add_sublayer("weight_quanter", self.weight_quanter)
+        self.add_sublayer("_inner", inner)
+
+    def forward(self, x):
+        inner = self._inner
+        w = inner.weight
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        from ..nn import functional as F
+
+        return F.conv2d(x, w, inner.bias, stride=inner._stride,
+                        padding=inner._padding,
+                        dilation=inner._dilation, groups=inner._groups)
+
+
+_WRAPPABLE = None
+
+
+def _wrappable():
+    global _WRAPPABLE
+    if _WRAPPABLE is None:
+        _WRAPPABLE = {_nn.Linear: QuantedLinear,
+                      _nn.Conv2D: QuantedConv2D}
+    return _WRAPPABLE
+
+
+# -- PTQ / QAT (reference ptq.py:29, qat.py:27) -----------------------------
+
+class Quantization:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        self._quantize_inplace(model)
+        return model
+
+    def _quantize_inplace(self, model):
+        for name, child in list(model._sub_layers.items()):
+            wrapper = _wrappable().get(type(child))
+            if wrapper is not None:
+                act, w = self._config._config_for(child)
+                if act is None and w is None:
+                    continue
+                model._sub_layers[name] = wrapper(child, act, w)
+                setattr(model, name, model._sub_layers[name])
+            else:
+                self._quantize_inplace(child)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Bake collected scales into inference-time fake-quant layers:
+        observers become fixed-scale quantizers (reference
+        ptq.py convert -> onnx-style Q/DQ form, simulated here)."""
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        self._convert_inplace(model)
+        return model
+
+    def _convert_inplace(self, model):
+        for name, child in list(model._sub_layers.items()):
+            if isinstance(child, BaseObserver):
+                fixed = _FixedScaleQuant(float(child._absmax),
+                                         child._bits)
+                model._sub_layers[name] = fixed
+                setattr(model, name, fixed)
+            else:
+                self._convert_inplace(child)
+
+
+class _FixedScaleQuant(Layer):
+    def __init__(self, absmax, bits):
+        super().__init__()
+        self._absmax = absmax
+        self._bits = bits
+
+    def scales(self):
+        return Tensor(jnp.asarray(self._absmax, jnp.float32))
+
+    def forward(self, x):
+        if self._absmax == 0.0:
+            return x
+        return _fake_quant(x, Tensor(jnp.float32(self._absmax)),
+                           bits=self._bits)
+
+
+class PTQ(Quantization):
+    """Insert observers; calibrate by running eval data; convert()."""
+
+
+class QAT(Quantization):
+    """Insert trainable fake-quanters (STE backward)."""

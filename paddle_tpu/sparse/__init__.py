@@ -1,0 +1,425 @@
+"""paddle.sparse — COO/CSR sparse tensors and ops.
+
+Reference: ``python/paddle/sparse/`` — ``sparse_coo_tensor`` /
+``sparse_csr_tensor`` (creation.py:83,204), binary ops
+(binary.py: matmul:62, masked_matmul:140, mv:206, add/subtract/
+multiply/divide, mask_as:511, is_same_shape:478), value-wise unary ops
+(unary.py), and ``Tensor.to_dense``/``to_sparse_coo``/``to_sparse_csr``.
+
+TPU-native design: XLA has no sparse kernels — sparse compute lowers to
+dense gather/scatter/segment ops, which is also how the reference's GPU
+kernels behave for these shapes (cuSPARSE aside).  A ``SparseTensor``
+holds immutable integer layout arrays (COO ``indices`` [ndim, nnz] or
+CSR ``crows``/``cols``) plus a VALUES tensor that is a first-class
+``paddle_tpu`` Tensor: every op here dispatches through the op registry
+on the values (layout arrays ride along as non-differentiable inputs),
+so gradients flow to ``values`` — and through ``matmul``'s dense operand
+— exactly like the reference's differentiable sparse ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops import registry as _registry
+
+_ops_cache: dict = {}
+
+
+def _op(name, fn, *args, **attrs):
+    op = _ops_cache.get(name)
+    if op is None or (attrs and set(op.static_argnames)
+                      != set(attrs.keys())):
+        op = _registry.OpDef(name, fn,
+                             static_argnames=tuple(attrs.keys()))
+        _ops_cache[name] = op
+    return _registry.apply(op, *args, **attrs)
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseTensor:
+    """COO or CSR sparse tensor (values differentiable)."""
+
+    def __init__(self, fmt, shape, values, indices=None, crows=None,
+                 cols=None):
+        assert fmt in ("coo", "csr")
+        self._fmt = fmt
+        self._shape = tuple(int(s) for s in shape)
+        self.values_t = values if isinstance(values, Tensor) \
+            else Tensor(jnp.asarray(values))
+        self._indices = indices  # [ndim, nnz] int (coo)
+        self._crows = crows      # [nrows+1] int (csr)
+        self._cols = cols        # [nnz] int (csr)
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self.values_t.dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def nnz(self):
+        return int(self.values_t._data.shape[0])
+
+    @property
+    def stop_gradient(self):
+        return self.values_t.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self.values_t.stop_gradient = v
+
+    def is_sparse_coo(self):
+        return self._fmt == "coo"
+
+    def is_sparse_csr(self):
+        return self._fmt == "csr"
+
+    def indices(self):
+        if self._fmt != "coo":
+            raise ValueError("indices() requires a COO tensor")
+        return Tensor(self._indices, stop_gradient=True)
+
+    def values(self):
+        return self.values_t
+
+    def crows(self):
+        if self._fmt != "csr":
+            raise ValueError("crows() requires a CSR tensor")
+        return Tensor(self._crows, stop_gradient=True)
+
+    def cols(self):
+        if self._fmt != "csr":
+            raise ValueError("cols() requires a CSR tensor")
+        return Tensor(self._cols, stop_gradient=True)
+
+    # -- conversions ---------------------------------------------------------
+    def _coo_indices(self):
+        """[ndim, nnz] index rows regardless of format (2-D for csr)."""
+        if self._fmt == "coo":
+            return self._indices
+        counts = jnp.diff(self._crows)
+        rows = jnp.repeat(jnp.arange(self._shape[0]), counts,
+                          total_repeat_length=self._cols.shape[0])
+        return jnp.stack([rows.astype(self._cols.dtype), self._cols])
+
+    def to_dense(self):
+        idx = self._coo_indices()
+
+        def fn(values, idx, shape):
+            out = jnp.zeros(shape, values.dtype)
+            return out.at[tuple(idx[i] for i in range(idx.shape[0]))
+                          ].add(values)
+
+        return _op("sparse_to_dense", fn, self.values_t, idx,
+                   shape=self._shape)
+
+    def to_sparse_coo(self, sparse_dim=None):
+        if self._fmt == "coo":
+            return self
+        return SparseTensor("coo", self._shape, self.values_t,
+                            indices=self._coo_indices())
+
+    def to_sparse_csr(self):
+        if self._fmt == "csr":
+            return self
+        if self.ndim != 2:
+            raise ValueError("CSR requires 2-D")
+        rows, cols = self._indices[0], self._indices[1]
+        order = jnp.lexsort((cols, rows))
+        rows, cols = rows[order], cols[order]
+        vals = Tensor(self.values_t._data[order],
+                      stop_gradient=self.values_t.stop_gradient)
+        crows = jnp.concatenate([
+            jnp.zeros((1,), rows.dtype),
+            jnp.cumsum(jnp.bincount(rows, length=self._shape[0]))
+        ]).astype(rows.dtype)
+        return SparseTensor("csr", self._shape, vals, crows=crows,
+                            cols=cols)
+
+    def coalesce(self):
+        """Merge duplicate COO coordinates (values summed)."""
+        if self._fmt != "coo":
+            return self
+        idx = np.asarray(self._indices)
+        flat = np.ravel_multi_index(idx, self._shape)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        new_idx = jnp.asarray(np.stack(
+            np.unravel_index(uniq, self._shape)))
+
+        def fn(values, inv, n):
+            seg = jax.ops.segment_sum(values, inv, num_segments=n)
+            return seg
+
+        vals = _op("sparse_coalesce", fn, self.values_t,
+                   jnp.asarray(inv), n=int(uniq.shape[0]))
+        return SparseTensor("coo", self._shape, vals, indices=new_idx)
+
+    def __repr__(self):
+        return (f"SparseTensor(fmt={self._fmt}, shape={self._shape}, "
+                f"nnz={self.nnz}, dtype={self.dtype})")
+
+
+# -- creation (reference creation.py:83,204) --------------------------------
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    idx = jnp.asarray(_raw(indices)).astype(jnp.int32)
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx.max(axis=1)))
+    if isinstance(values, Tensor) and dtype is None:
+        v = values  # keep tape identity — grads flow to the caller's
+    else:
+        from ..core import dtype as _dt
+
+        vals = _raw(values)
+        if dtype is not None:
+            vals = vals.astype(_dt.convert_dtype(dtype))
+        v = Tensor(vals, stop_gradient=stop_gradient)
+    return SparseTensor("coo", shape, v, indices=idx)
+
+
+def dense_to_coo(t, sparse_dim=None):
+    """Tensor -> COO SparseTensor (Tensor.to_sparse_coo backend).
+
+    The index pattern comes from a host-side ``nonzero`` (inherently
+    data-dependent), but the VALUES are gathered through the op registry
+    so gradients flow back to the dense source (reference
+    to_sparse_coo is differentiable)."""
+    nd = t._data.ndim
+    if sparse_dim is not None and int(sparse_dim) != nd:
+        raise NotImplementedError(
+            f"hybrid COO (sparse_dim={sparse_dim} of {nd} dims) is not "
+            "supported — only fully-sparse conversion (sparse_dim=ndim)")
+    dense_np = np.asarray(jax.lax.stop_gradient(t._data))
+    idx = jnp.asarray(np.stack(np.nonzero(dense_np)), jnp.int32)
+
+    def fn(dense, idx):
+        return dense[tuple(idx[i] for i in range(idx.shape[0]))]
+
+    vals = _op("sparse_gather_values", fn, t, idx)
+    return SparseTensor("coo", dense_np.shape, vals, indices=idx)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    crows = jnp.asarray(_raw(crows)).astype(jnp.int32)
+    cols = jnp.asarray(_raw(cols)).astype(jnp.int32)
+    v = values if isinstance(values, Tensor) else Tensor(_raw(values))
+    if dtype is not None:
+        from ..core import dtype as _dt
+
+        v = Tensor(v._data.astype(_dt.convert_dtype(dtype)))
+    return SparseTensor("csr", shape, v, crows=crows, cols=cols)
+
+
+# -- binary ops (reference binary.py) ---------------------------------------
+
+def _same_pattern(x, y):
+    if x._fmt != y._fmt or x._shape != y._shape:
+        return False
+    if x._fmt == "coo":
+        return x._indices.shape == y._indices.shape and bool(
+            jnp.all(x._indices == y._indices))
+    return x._crows.shape == y._crows.shape and bool(
+        jnp.all(x._crows == y._crows)) and bool(
+        jnp.all(x._cols == y._cols))
+
+
+def _ewise(name, fn, x, y):
+    if not _same_pattern(x, y):
+        raise ValueError(
+            f"sparse.{name}: operands must share the sparsity pattern "
+            "(reference kernels require same indices); call .coalesce() "
+            "or convert formats first")
+    vals = _op(f"sparse_{name}", fn, x.values_t, y.values_t)
+    if x._fmt == "coo":
+        return SparseTensor("coo", x._shape, vals, indices=x._indices)
+    return SparseTensor("csr", x._shape, vals, crows=x._crows,
+                        cols=x._cols)
+
+
+def add(x, y, name=None):
+    return _ewise("add", lambda a, b: a + b, x, y)
+
+
+def subtract(x, y, name=None):
+    return _ewise("subtract", lambda a, b: a - b, x, y)
+
+
+def multiply(x, y, name=None):
+    return _ewise("multiply", lambda a, b: a * b, x, y)
+
+
+def divide(x, y, name=None):
+    return _ewise("divide", lambda a, b: a / b, x, y)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def matmul(x, y, name=None):
+    """sparse [M, N] @ dense [N, K] -> dense [M, K] (binary.py:62);
+    differentiable w.r.t. both the sparse values and the dense operand."""
+    if isinstance(x, SparseTensor):
+        idx = x._coo_indices()
+        M = x._shape[0]
+
+        def fn(values, dense, idx, M):
+            rows, cols = idx[0], idx[1]
+            contrib = values[:, None] * dense[cols]
+            return jax.ops.segment_sum(contrib, rows, num_segments=M)
+
+        return _op("sparse_matmul", fn, x.values_t, y, idx, M=M)
+    raise TypeError("sparse.matmul expects a SparseTensor lhs")
+
+
+def mv(x, vec, name=None):
+    """sparse [M, N] @ dense [N] -> dense [M] (binary.py:206)."""
+    idx = x._coo_indices()
+    M = x._shape[0]
+
+    def fn(values, v, idx, M):
+        rows, cols = idx[0], idx[1]
+        return jax.ops.segment_sum(values * v[cols], rows,
+                                   num_segments=M)
+
+    return _op("sparse_mv", fn, x.values_t, vec, idx, M=M)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense [M, N] @ dense [N, K] sampled at ``mask``'s sparsity
+    pattern -> sparse (binary.py:140, the SDDMM kernel)."""
+    idx = mask._coo_indices()
+
+    def fn(a, b, idx):
+        rows, cols = idx[0], idx[1]
+        return jnp.einsum("nk,nk->n", a[rows], b.T[cols])
+
+    vals = _op("sparse_masked_matmul", fn, x, y, idx)
+    if mask._fmt == "coo":
+        return SparseTensor("coo", mask._shape, vals,
+                            indices=mask._indices)
+    return SparseTensor("csr", mask._shape, vals, crows=mask._crows,
+                        cols=mask._cols)
+
+
+def mask_as(x, mask, name=None):
+    """Sample dense ``x`` at ``mask``'s pattern -> sparse (binary.py:511)."""
+    idx = mask._coo_indices()
+
+    def fn(dense, idx):
+        return dense[tuple(idx[i] for i in range(idx.shape[0]))]
+
+    vals = _op("sparse_mask_as", fn, x, idx)
+    if mask._fmt == "coo":
+        return SparseTensor("coo", mask._shape, vals,
+                            indices=mask._indices)
+    return SparseTensor("csr", mask._shape, vals, crows=mask._crows,
+                        cols=mask._cols)
+
+
+# -- unary value ops (reference unary.py; zero-preserving only) -------------
+
+def _unary(name, jfn):
+    def op(x, name_=None):
+        vals = _op(f"sparse_{name}", jfn, x.values_t)
+        if x._fmt == "coo":
+            return SparseTensor("coo", x._shape, vals,
+                                indices=x._indices)
+        return SparseTensor("csr", x._shape, vals, crows=x._crows,
+                            cols=x._cols)
+
+    op.__name__ = name
+    return op
+
+
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+expm1 = _unary("expm1", jnp.expm1)
+abs = _unary("abs", jnp.abs)  # noqa: A001
+neg = _unary("neg", jnp.negative)
+relu = _unary("relu", lambda v: jnp.maximum(v, 0))
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    vals = _op("sparse_pow", lambda v, factor: v ** factor, x.values_t,
+               factor=float(factor))
+    if x._fmt == "coo":
+        return SparseTensor("coo", x._shape, vals, indices=x._indices)
+    return SparseTensor("csr", x._shape, vals, crows=x._crows,
+                        cols=x._cols)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..core import dtype as _dt
+
+    vals = x.values_t
+    if value_dtype is not None:
+        vals = _op("sparse_cast",
+                   lambda v, dt: v.astype(dt), vals,
+                   dt=_dt.convert_dtype(value_dtype))
+    out = SparseTensor(x._fmt, x._shape, vals, indices=x._indices,
+                       crows=x._crows, cols=x._cols)
+    if index_dtype is not None:
+        idt = _dt.convert_dtype(index_dtype)
+        if out._indices is not None:
+            out._indices = out._indices.astype(idt)
+        if out._crows is not None:
+            out._crows = out._crows.astype(idt)
+            out._cols = out._cols.astype(idt)
+    return out
+
+
+def transpose(x, perm, name=None):
+    if x._fmt != "coo":
+        return transpose(x.to_sparse_coo(), perm, name)
+    idx = x._indices[jnp.asarray(perm)]
+    shape = tuple(x._shape[p] for p in perm)
+    return SparseTensor("coo", shape, x.values_t, indices=idx)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    """Reference unary.py:188 — returns a dense Tensor reduction."""
+    dense_sum = _op("sparse_sum_values",
+                    lambda v: jnp.sum(v), x.values_t)
+    if axis is None:
+        return dense_sum
+    return __import__("paddle_tpu").sum(x.to_dense(), axis=axis,
+                                        keepdim=keepdim)
+
+
+# -- nn sub-namespace -------------------------------------------------------
+
+class _SparseReLU:
+    def __call__(self, x):
+        return relu(x)
+
+
+class nn:  # noqa: N801 — namespace shim (reference paddle.sparse.nn)
+    ReLU = _SparseReLU
