@@ -87,19 +87,7 @@ def main():
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
 
     print("compiling + warmup...", file=sys.stderr)
-    t0 = time.perf_counter()
-    loss = step.step(ids, ids)
-    jax.block_until_ready(loss)
-    print(f"first step (compile) {time.perf_counter() - t0:.1f}s, "
-          f"loss {float(loss):.3f}", file=sys.stderr)
-    loss = step.step(ids, ids)
-    jax.block_until_ready(loss)
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step.step(ids, ids)
-    jax.block_until_ready(loss)
-    dt = (time.perf_counter() - t0) / steps
+    dt, loss = _time_steps(step.step, (ids, ids), steps, "llama")
 
     tokens_per_step = batch * seq
     tok_s = tokens_per_step / dt
@@ -140,7 +128,66 @@ def main():
         except Exception as e:  # never lose the small-config measurement
             print(f"large: FAILED: {e}", file=sys.stderr)
             result["large"] = {"error": str(e)[:200]}
+    if not on_cpu and os.environ.get("PT_BENCH_SKIP_RESNET") != "1":
+        try:
+            result["resnet50"] = _bench_resnet(jax)
+        except Exception as e:
+            print(f"resnet50: FAILED: {e}", file=sys.stderr)
+            result["resnet50"] = {"error": str(e)[:200]}
     print(json.dumps(result))
+
+
+def _bench_resnet(jax):
+    """BASELINE config 1: ResNet-50 ImageNet train step (fwd+bwd+SGD
+    momentum, bf16 compute), images/sec on the single chip."""
+    import gc
+
+    from paddle_tpu.models.training import CompiledTrainStep
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.vision.models import resnet50
+
+    gc.collect()
+    model = resnet50(num_classes=1000)
+    model.train()
+    step = CompiledTrainStep(model, lr=0.1, compute_dtype="bfloat16",
+                             loss_fn=F.cross_entropy)
+    import jax.numpy as jnp
+
+    batch = int(os.environ.get("PT_BENCH_RESNET_BATCH", "128"))
+    rng = np.random.RandomState(0)
+    # bf16 images to match the bf16-cast conv weights (XLA convs require
+    # matching operand dtypes; matmul-only models auto-promote).
+    imgs = jnp.asarray(rng.randn(batch, 3, 224, 224), jnp.bfloat16)
+    labels = rng.randint(0, 1000, (batch,)).astype(np.int32)
+    print("resnet50: compiling...", file=sys.stderr)
+    dt, loss = _time_steps(step.step, (imgs, labels), 5, "resnet50")
+    imgs_s = batch / dt
+    # ~4.1 GFLOP fwd per 224x224 image; train ~= 3x fwd.
+    mfu = imgs_s * 3 * 4.1e9 / _peak_flops_per_chip()
+    print(f"resnet50: step {dt * 1e3:.1f} ms, {imgs_s:.0f} imgs/s, "
+          f"~MFU {mfu:.3f}", file=sys.stderr)
+    return {"value": round(imgs_s, 1), "unit": "imgs/s/chip",
+            "batch": batch, "mfu_est": round(mfu, 4)}
+
+
+
+def _time_steps(step_fn, args, steps, tag):
+    """Shared compile/warmup/timed-loop harness (one methodology for
+    every bench section)."""
+    import jax
+
+    t0 = time.perf_counter()
+    loss = step_fn(*args)
+    jax.block_until_ready(loss)
+    print(f"{tag}: first step {time.perf_counter() - t0:.1f}s, "
+          f"loss {float(loss):.3f}", file=sys.stderr)
+    loss = step_fn(*args)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step_fn(*args)
+    jax.block_until_ready(loss)
+    return (time.perf_counter() - t0) / steps, loss
 
 
 def _bench_large(jax):
@@ -179,18 +226,7 @@ def _bench_large(jax):
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     print("large: compiling (~1.6B params)...", file=sys.stderr)
-    t0 = time.perf_counter()
-    loss = step.step(ids, ids)
-    jax.block_until_ready(loss)
-    print(f"large: first step {time.perf_counter() - t0:.1f}s, "
-          f"loss {float(loss):.3f}", file=sys.stderr)
-    loss = step.step(ids, ids)
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step.step(ids, ids)
-    jax.block_until_ready(loss)
-    dt = (time.perf_counter() - t0) / steps
+    dt, loss = _time_steps(step.step, (ids, ids), steps, "large")
 
     # The large config trains on exactly ONE chip (state_device above);
     # other local chips idle, so per-chip throughput divides by 1.

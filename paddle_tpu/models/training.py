@@ -143,9 +143,15 @@ class CompiledTrainStep:
             from ..core import dtype as dt
 
             cd = dt.convert_dtype(compute_dtype)
+            # Keep only norm-scale params out of the low-precision cast
+            # (the norm ops cast them into the stream dtype per-op, so
+            # fp32 storage is free precision).  Biases ARE cast: a fp32
+            # bias added to a bf16 stream would silently promote every
+            # downstream matmul/conv to fp32.
+            keep_fp32 = lambda k: "norm" in k  # noqa: E731
             params = {k: (v.astype(cd)
                           if jnp.issubdtype(v.dtype, jnp.floating)
-                          and not no_decay_fn(k) else v)
+                          and not keep_fp32(k) else v)
                       for k, v in params.items()}
         # jnp.array (not astype): a no-op astype aliases the param buffer,
         # which breaks double-donation in the jitted step.

@@ -141,10 +141,17 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         mean_t, var_t = registry.apply(nn_ops.batch_norm_stats_op, x,
                                        data_format=data_format)
         # Update running stats in place (reference batch_norm semantics).
-        with_np = running_mean is not None
-        if with_np:
-            import jax.numpy as jnp
+        # NOT under a jit trace: storing a tracer into the persistent
+        # buffer would leak it (UnexpectedTracerError on any later use)
+        # and the "update" would never really happen.  Compiled train
+        # steps (CompiledTrainStep) therefore train with batch stats and
+        # leave running stats at their last eager value — functionalized
+        # buffer updates ride the to_static path (jit/__init__.py),
+        # which returns new buffer values explicitly.
+        import jax as _jax
 
+        if running_mean is not None and not isinstance(
+                mean_t._data, _jax.core.Tracer):
             m = momentum
             running_mean.set_value(
                 m * running_mean._data + (1 - m) * mean_t._data)

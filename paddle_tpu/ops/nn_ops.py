@@ -34,8 +34,19 @@ def _pair(v, n=2):
 
 # -- convolution ------------------------------------------------------------
 
+def _conv_dtype(x, w):
+    """XLA convs reject mixed dtypes; follow the activation stream's
+    dtype (bf16-first mixed precision: a fp32 master weight joins a
+    bf16 stream as bf16 — the reference amp O2 conv behavior).  Applied
+    by every conv variant."""
+    if w.dtype != x.dtype:
+        w = w.astype(x.dtype)
+    return w
+
+
 def _conv2d_plain(x, w, stride=(1, 1), padding=(0, 0), dilation=(1, 1),
                   groups=1, data_format="NCHW"):
+    w = _conv_dtype(x, w)
     dn = jax.lax.conv_dimension_numbers(
         x.shape, w.shape,
         ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
@@ -68,6 +79,7 @@ def conv2d_raw(x, weight, stride=1, padding=0, dilation=1, groups=1,
 
 
 def _conv1d_plain(x, w, stride=1, padding=0, dilation=1, groups=1):
+    w = _conv_dtype(x, w)
     dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
                                         ("NCH", "OIH", "NCH"))
     return jax.lax.conv_general_dilated(
@@ -84,6 +96,7 @@ conv1d_op = register_op(
 def _conv2d_transpose_plain(x, w, stride=(1, 1), padding=(0, 0),
                             output_padding=(0, 0), dilation=(1, 1), groups=1,
                             data_format="NCHW"):
+    w = _conv_dtype(x, w)
     dn = jax.lax.conv_dimension_numbers(
         x.shape, w.shape,
         ("NCHW", "IOHW", "NCHW") if data_format == "NCHW"
@@ -237,12 +250,18 @@ def _batch_norm_infer(x, mean, var, weight=None, bias=None, epsilon=1e-5,
         shape = (1, -1)
     else:
         shape = (1,) * (x.ndim - 1) + (-1,)
-    inv = jax.lax.rsqrt(var.reshape(shape) + epsilon)
-    out = (x - mean.reshape(shape)) * inv
+    # Stats/affine params cast to x's dtype (see _layer_norm_plain): fp32
+    # running stats must not promote a bf16 activation stream — that
+    # silently turns every downstream conv/matmul into fp32 (and XLA
+    # convs hard-reject mixed dtypes).
+    dt = x.dtype
+    inv = jax.lax.rsqrt(var.astype(jnp.float32).reshape(shape)
+                        + epsilon).astype(dt)
+    out = (x - mean.astype(dt).reshape(shape)) * inv
     if weight is not None:
-        out = out * weight.reshape(shape)
+        out = out * weight.astype(dt).reshape(shape)
     if bias is not None:
-        out = out + bias.reshape(shape)
+        out = out + bias.astype(dt).reshape(shape)
     return out
 
 

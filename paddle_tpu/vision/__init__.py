@@ -3,3 +3,4 @@ milestones; reference: python/paddle/vision/)."""
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
+from . import ops  # noqa: F401
