@@ -56,6 +56,10 @@ from . import inference  # noqa: F401
 from . import distribution  # noqa: F401
 from . import sparse  # noqa: F401
 from . import quantization  # noqa: F401
+from . import audio  # noqa: F401
+from . import text  # noqa: F401
+from . import geometric  # noqa: F401
+from . import onnx  # noqa: F401
 from .hapi import Model  # noqa: F401
 
 disable_static = lambda *a, **k: None  # dygraph is the default  # noqa: E731
@@ -85,4 +89,12 @@ def set_printoptions(**kwargs):
 
 
 def flops(net, input_size, custom_ops=None, print_detail=False):
-    return 0
+    """FLOPs of one forward pass (reference hapi/dynamic_flops.py).
+
+    Counted per layer type via forward hooks on a dry run with zeros of
+    ``input_size``; ``custom_ops`` maps Layer classes to
+    ``fn(layer, input, output) -> flops`` overrides."""
+    from .hapi.dynamic_flops import dynamic_flops
+
+    return dynamic_flops(net, input_size, custom_ops=custom_ops,
+                         print_detail=print_detail)

@@ -1,0 +1,265 @@
+"""paddle.audio — spectrogram features + functional DSP.
+
+Reference: ``python/paddle/audio/`` — ``functional/functional.py``
+(hz_to_mel:24, mel_to_hz:80, mel_frequencies:125, fft_frequencies:165,
+compute_fbank_matrix:188, power_to_db:261, create_dct:305),
+``functional/window.py`` (get_window), ``features/layers.py``
+(Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC).
+
+TPU-native: the STFT is framing (gather) + window (elementwise) + rfft
+— XLA has a native FFT, so a whole feature pipeline is one fused jitted
+program; all layers dispatch through the op registry (differentiable
+w.r.t. the waveform).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layers import Layer
+from ..ops import registry as _registry
+
+_aops: dict = {}
+
+
+def _op(name, fn, *args, **attrs):
+    op = _aops.get(name)
+    if op is None:
+        op = _registry.OpDef(name, fn,
+                             static_argnames=tuple(attrs.keys()))
+        _aops[name] = op
+    return _registry.apply(op, *args, **attrs)
+
+
+class functional:  # noqa: N801 — namespace (reference audio.functional)
+    @staticmethod
+    def hz_to_mel(freq, htk=False):
+        """functional.py:24 (slaney by default, htk option)."""
+        scalar = not isinstance(freq, (Tensor, np.ndarray, jnp.ndarray))
+        f = freq._data if isinstance(freq, Tensor) else jnp.asarray(
+            freq, jnp.float32)
+        if htk:
+            mel = 2595.0 * jnp.log10(1.0 + f / 700.0)
+        else:
+            f_min, f_sp = 0.0, 200.0 / 3
+            mel = (f - f_min) / f_sp
+            min_log_hz = 1000.0
+            min_log_mel = (min_log_hz - f_min) / f_sp
+            logstep = math.log(6.4) / 27.0
+            mel = jnp.where(f >= min_log_hz,
+                            min_log_mel + jnp.log(
+                                jnp.maximum(f, 1e-10) / min_log_hz)
+                            / logstep, mel)
+        return float(mel) if scalar else Tensor(mel)
+
+    @staticmethod
+    def mel_to_hz(mel, htk=False):
+        scalar = not isinstance(mel, (Tensor, np.ndarray, jnp.ndarray))
+        m = mel._data if isinstance(mel, Tensor) else jnp.asarray(
+            mel, jnp.float32)
+        if htk:
+            hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+        else:
+            f_min, f_sp = 0.0, 200.0 / 3
+            hz = f_min + f_sp * m
+            min_log_hz = 1000.0
+            min_log_mel = (min_log_hz - f_min) / f_sp
+            logstep = math.log(6.4) / 27.0
+            hz = jnp.where(m >= min_log_mel,
+                           min_log_hz * jnp.exp(
+                               logstep * (m - min_log_mel)), hz)
+        return float(hz) if scalar else Tensor(hz)
+
+    @staticmethod
+    def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                        dtype="float32"):
+        lo = functional.hz_to_mel(f_min, htk)
+        hi = functional.hz_to_mel(f_max, htk)
+        mels = jnp.linspace(lo, hi, n_mels)
+        return functional.mel_to_hz(Tensor(mels), htk)
+
+    @staticmethod
+    def fft_frequencies(sr, n_fft, dtype="float32"):
+        return Tensor(jnp.linspace(0, sr / 2, n_fft // 2 + 1))
+
+    @staticmethod
+    def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0,
+                             f_max=None, htk=False, norm="slaney",
+                             dtype="float32"):
+        """functional.py:188 — [n_mels, n_fft//2+1] triangular filters."""
+        f_max = f_max or sr / 2.0
+        fft_f = functional.fft_frequencies(sr, n_fft)._data
+        mel_f = functional.mel_frequencies(n_mels + 2, f_min, f_max,
+                                           htk)._data
+        fdiff = jnp.diff(mel_f)
+        ramps = mel_f[:, None] - fft_f[None, :]
+        lower = -ramps[:-2] / fdiff[:-1, None]
+        upper = ramps[2:] / fdiff[1:, None]
+        weights = jnp.maximum(0, jnp.minimum(lower, upper))
+        if norm == "slaney":
+            enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+            weights = weights * enorm[:, None]
+        return Tensor(weights)
+
+    @staticmethod
+    def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+        """functional.py:261."""
+        def fn(x, ref_value, amin, top_db):
+            log_spec = 10.0 * jnp.log10(jnp.maximum(x, amin))
+            log_spec = log_spec - 10.0 * jnp.log10(
+                jnp.maximum(ref_value, amin))
+            if top_db is not None:
+                log_spec = jnp.maximum(log_spec,
+                                       jnp.max(log_spec) - top_db)
+            return log_spec
+
+        return _op("power_to_db", fn, spect, ref_value=float(ref_value),
+                   amin=float(amin),
+                   top_db=None if top_db is None else float(top_db))
+
+    @staticmethod
+    def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+        """functional.py:305 — [n_mels, n_mfcc] DCT-II basis."""
+        n = jnp.arange(n_mels, dtype=jnp.float32)
+        k = jnp.arange(n_mfcc, dtype=jnp.float32)
+        basis = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5)
+                        * k[None, :])
+        if norm == "ortho":
+            basis = basis * jnp.sqrt(2.0 / n_mels)
+            basis = basis.at[:, 0].multiply(1.0 / math.sqrt(2.0))
+        else:
+            basis = basis * 2.0
+        return Tensor(basis)
+
+    @staticmethod
+    def get_window(window, win_length, fftbins=True, dtype="float32"):
+        """functional/window.py get_window subset (hann/hamming/
+        blackman/ones)."""
+        N = win_length if fftbins else win_length - 1
+        n = jnp.arange(win_length, dtype=jnp.float32)
+        if window in ("hann", "hanning"):
+            w = 0.5 - 0.5 * jnp.cos(2 * math.pi * n / N)
+        elif window == "hamming":
+            w = 0.54 - 0.46 * jnp.cos(2 * math.pi * n / N)
+        elif window == "blackman":
+            w = (0.42 - 0.5 * jnp.cos(2 * math.pi * n / N)
+                 + 0.08 * jnp.cos(4 * math.pi * n / N))
+        elif window in ("ones", "rectangular", "boxcar"):
+            w = jnp.ones(win_length, jnp.float32)
+        else:
+            raise ValueError(f"unsupported window {window!r}")
+        return Tensor(w)
+
+
+def _stft_power(x, window, n_fft, hop_length, power, center):
+    """[B, T] -> [B, n_fft//2+1, frames] |STFT|^power."""
+    if center:
+        pad = n_fft // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)],
+                    mode="reflect")
+    T = x.shape[-1]
+    frames = 1 + (T - n_fft) // hop_length
+    starts = jnp.arange(frames) * hop_length
+    idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+    seg = x[..., idx]                      # [B, frames, n_fft]
+    seg = seg * window[None, None, :]
+    spec = jnp.fft.rfft(seg, axis=-1)      # [B, frames, n_fft//2+1]
+    mag = jnp.abs(spec) ** power
+    return jnp.swapaxes(mag, -1, -2)       # [B, bins, frames]
+
+
+class Spectrogram(Layer):
+    """features/layers.py Spectrogram (power spectrogram)."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        win_length = win_length or n_fft
+        w = functional.get_window(window, win_length)._data
+        if win_length < n_fft:  # zero-pad the window to n_fft
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        self._window = w
+        self.power = power
+        self.center = center
+
+    def forward(self, x):
+        return _op("spectrogram", _stft_power, x, Tensor(self._window),
+                   n_fft=self.n_fft, hop_length=self.hop_length,
+                   power=float(self.power), center=self.center)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 n_mels=64, f_min=50.0, f_max=None, htk=False,
+                 norm="slaney", dtype="float32"):
+        super().__init__()
+        self._spect = Spectrogram(n_fft, hop_length, win_length, window,
+                                  power, center)
+        self.add_sublayer("_spect", self._spect)
+        self._fbank = functional.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm)._data
+
+    def forward(self, x):
+        s = self._spect(x)
+
+        def fn(s, fb):
+            return jnp.einsum("mf,...ft->...mt", fb, s)
+
+        return _op("mel_project", fn, s, Tensor(self._fbank))
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 n_mels=64, f_min=50.0, f_max=None, htk=False,
+                 norm="slaney", ref_value=1.0, amin=1e-10, top_db=None,
+                 dtype="float32"):
+        super().__init__()
+        self._mel = MelSpectrogram(sr, n_fft, hop_length, win_length,
+                                   window, power, center, n_mels, f_min,
+                                   f_max, htk, norm)
+        self.add_sublayer("_mel", self._mel)
+        self._ref, self._amin, self._top_db = ref_value, amin, top_db
+
+    def forward(self, x):
+        return functional.power_to_db(self._mel(x), self._ref,
+                                      self._amin, self._top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 n_mels=64, f_min=50.0, f_max=None, htk=False,
+                 norm="slaney", ref_value=1.0, amin=1e-10, top_db=None,
+                 dtype="float32"):
+        super().__init__()
+        self._logmel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            n_mels, f_min, f_max, htk, norm, ref_value, amin, top_db)
+        self.add_sublayer("_logmel", self._logmel)
+        self._dct = functional.create_dct(n_mfcc, n_mels)._data
+
+    def forward(self, x):
+        lm = self._logmel(x)
+
+        def fn(lm, dct):
+            return jnp.einsum("mk,...mt->...kt", dct, lm)
+
+        return _op("mfcc_dct", fn, lm, Tensor(self._dct))
+
+
+class features:  # noqa: N801 — namespace (reference audio.features)
+    Spectrogram = Spectrogram
+    MelSpectrogram = MelSpectrogram
+    LogMelSpectrogram = LogMelSpectrogram
+    MFCC = MFCC

@@ -1,0 +1,154 @@
+"""Hybrid-parallel auto-tuner.
+
+Reference: ``python/paddle/distributed/auto_tuner/`` — ``tuner.py``
+(AutoTuner: search + prune + trial loop), ``search.py`` (grid over
+dp/mp/pp/sharding/micro-batch), ``prune.py`` (divisibility + memory
+rules), ``cost_model.py`` (per-config cost estimate); driven by
+relaunching trial jobs.
+
+TPU-native: the degrees map to mesh axis sizes (dp/mp/pp/sharding over
+one ``jax.sharding.Mesh``); a trial is one compiled step on tiny shapes
+(the ``dryrun_multichip`` pattern) instead of a relaunched job, so the
+whole tune runs in-process.  The memory model mirrors the ZeRO math in
+PERF.md: params/(mp·pp) bytes for weights + optimizer state /(sharding
+when ZeRO), plus an activation term linear in micro_batch·seq·hidden.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TunerConfig:
+    dp: int
+    mp: int
+    pp: int
+    sharding: int
+    micro_batch: int
+
+    def as_dict(self):
+        return {"dp_degree": self.dp, "mp_degree": self.mp,
+                "pp_degree": self.pp, "sharding_degree": self.sharding,
+                "micro_batch_size": self.micro_batch}
+
+
+@dataclass
+class AutoTuner:
+    """Search dp/mp/pp/sharding/micro-batch for a model + cluster.
+
+    tuner = AutoTuner(world_size=8, model_params=1.5e9, hidden=2048,
+                      layers=24, seq_len=2048, hbm_bytes=16e9)
+    best, history = tuner.tune(trial_fn)   # trial_fn(cfg)->tokens/s
+    """
+
+    world_size: int
+    model_params: float
+    hidden: int
+    layers: int
+    seq_len: int
+    hbm_bytes: float = 16e9
+    vocab: int = 32000
+    max_mp: int = 8           # keep mp inside one ICI domain
+    micro_batches: tuple = (1, 2, 4, 8)
+    zero_opt_states: bool = True
+    bytes_per_param_weights: int = 2   # bf16 compute copy
+    bytes_per_param_opt: int = 8       # fp32 master + bf16 moments
+    history: list = field(default_factory=list)
+
+    # -- search (reference search.py grid) ---------------------------------
+    def search_space(self):
+        degs = [d for d in range(1, self.world_size + 1)
+                if self.world_size % d == 0]
+        out = []
+        for dp, mp, pp in itertools.product(degs, degs, degs):
+            rest = self.world_size // (dp * mp * pp) \
+                if dp * mp * pp and self.world_size % (dp * mp * pp) == 0 \
+                else 0
+            if rest < 1:
+                continue
+            sharding = rest  # remaining ways go to the sharding axis
+            for mb in self.micro_batches:
+                out.append(TunerConfig(dp, mp, pp, sharding, mb))
+        return out
+
+    # -- prune (reference prune.py rules) ----------------------------------
+    def _prune_reason(self, c: TunerConfig):
+        if c.dp * c.mp * c.pp * c.sharding != self.world_size:
+            return "degrees must multiply to world_size"
+        if c.mp > self.max_mp:
+            return f"mp>{self.max_mp} leaves the ICI domain"
+        if self.hidden % c.mp != 0:
+            return "hidden not divisible by mp"
+        if self.layers % c.pp != 0:
+            return "layers not divisible by pp"
+        if self.vocab % c.mp != 0:
+            return "vocab not divisible by mp"
+        mem = self.estimate_memory(c)
+        if mem > self.hbm_bytes:
+            return f"memory {mem / 1e9:.1f}G > HBM"
+        return None
+
+    def prune(self, space=None):
+        space = space if space is not None else self.search_space()
+        kept, pruned = [], []
+        for c in space:
+            reason = self._prune_reason(c)
+            (pruned if reason else kept).append(
+                (c, reason) if reason else c)
+        return kept, pruned
+
+    # -- cost model (reference cost_model.py) ------------------------------
+    def estimate_memory(self, c: TunerConfig):
+        shard_w = c.mp * c.pp
+        shard_opt = shard_w * (c.sharding * c.dp
+                               if self.zero_opt_states else 1)
+        weights = self.model_params * self.bytes_per_param_weights \
+            / shard_w
+        opt = self.model_params * self.bytes_per_param_opt / shard_opt
+        # full-remat activations: layer-boundary carries + head logits
+        act = (c.micro_batch * self.seq_len * self.hidden * 2
+               * (self.layers / c.pp))
+        head = c.micro_batch * self.seq_len * self.vocab * 2 / c.mp
+        return weights + opt + act + head
+
+    def estimate_cost(self, c: TunerConfig):
+        """Relative step-time estimate (lower = better): compute spread
+        over the mesh + mp collective tax + pp bubble + small-batch
+        inefficiency."""
+        compute = 1.0 / self.world_size
+        mp_tax = 0.07 * math.log2(c.mp) if c.mp > 1 else 0.0
+        num_micro = max(1, 8 // c.micro_batch)
+        bubble = (c.pp - 1) / (num_micro + c.pp - 1) if c.pp > 1 else 0.0
+        small_batch = 0.05 / c.micro_batch
+        return compute * (1 + mp_tax + small_batch) / (1 - bubble) \
+            if bubble < 1 else float("inf")
+
+    # -- trial loop (reference tuner.py) -----------------------------------
+    def tune(self, trial_fn=None, max_trials=8):
+        """Rank pruned candidates by the cost model, run up to
+        ``max_trials`` through ``trial_fn(cfg)->throughput`` (higher
+        better; raise/return None to mark a failed trial), return
+        (best_cfg, history).  Without a trial_fn the cost-model ranking
+        decides (pure analytical mode)."""
+        kept, _ = self.prune()
+        kept.sort(key=self.estimate_cost)
+        if trial_fn is None:
+            self.history = [{"config": c.as_dict(),
+                             "est_cost": self.estimate_cost(c)}
+                            for c in kept[:max_trials]]
+            return (kept[0] if kept else None), self.history
+        best, best_tp = None, -1.0
+        for c in kept[:max_trials]:
+            try:
+                tp = trial_fn(c)
+            except Exception as e:  # OOM/compile failure = failed trial
+                self.history.append({"config": c.as_dict(),
+                                     "error": str(e)[:120]})
+                continue
+            self.history.append({"config": c.as_dict(),
+                                 "throughput": tp})
+            if tp is not None and tp > best_tp:
+                best, best_tp = c, tp
+        return best, self.history

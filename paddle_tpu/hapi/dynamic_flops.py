@@ -1,0 +1,130 @@
+"""FLOPs counting by forward hooks.
+
+Reference: ``python/paddle/hapi/dynamic_flops.py`` — per-layer-type
+count functions registered as forward post-hooks, summed over a dry
+run.  Convention (matching the reference): one multiply-add = 2 FLOPs is
+NOT used — the reference counts MACs-style "flops" per its table
+(conv: Cin/g * K * K * out_numel, linear: in*out, ...); we reproduce
+that so numbers are comparable.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+
+
+def _numel(t):
+    return int(np.prod(t.shape)) if hasattr(t, "shape") else 0
+
+
+def _count_conv2d(layer, x, y):
+    cin = layer.weight.shape[1]  # [out, in/g, kh, kw]
+    kh, kw = layer.weight.shape[2], layer.weight.shape[3]
+    out_numel = _numel(y)
+    fl = cin * kh * kw * out_numel
+    if getattr(layer, "bias", None) is not None:
+        fl += out_numel
+    return fl
+
+
+def _count_linear(layer, x, y):
+    in_f, out_f = layer.weight.shape[0], layer.weight.shape[1]
+    batch = _numel(y) // max(out_f, 1)
+    fl = batch * in_f * out_f
+    if getattr(layer, "bias", None) is not None:
+        fl += _numel(y)
+    return fl
+
+
+def _count_norm(layer, x, y):
+    return 2 * _numel(y)
+
+
+def _count_act(layer, x, y):
+    return _numel(y)
+
+
+def _count_pool(layer, x, y):
+    return _numel(y)
+
+
+_DEFAULT = []
+
+
+def _default_table():
+    global _DEFAULT
+    if _DEFAULT:
+        return _DEFAULT
+    table = [
+        (nn.Conv2D, _count_conv2d),
+        (nn.Linear, _count_linear),
+        (nn.BatchNorm2D, _count_norm),
+        (nn.LayerNorm, _count_norm),
+        (nn.ReLU, _count_act),
+        (nn.GELU, _count_act),
+        (nn.Sigmoid, _count_act),
+        (nn.MaxPool2D, _count_pool),
+        (nn.AvgPool2D, _count_pool),
+    ]
+    for name in ("BatchNorm1D", "BatchNorm", "RMSNorm", "Tanh",
+                 "Softmax", "AdaptiveAvgPool2D"):
+        cls = getattr(nn, name, None)
+        if cls is not None:
+            fn = _count_norm if "Norm" in name else (
+                _count_pool if "Pool" in name else _count_act)
+            table.append((cls, fn))
+    _DEFAULT = table
+    return table
+
+
+def dynamic_flops(net, input_size, custom_ops=None, print_detail=False):
+    custom_ops = custom_ops or {}
+    table = list(custom_ops.items()) + _default_table()
+    total = [0]
+    rows = []
+    handles = []
+
+    def make_hook(layer, fn):
+        def hook(lyr, inputs, output):
+            fl = int(fn(lyr, inputs, output))
+            total[0] += fl
+            rows.append((type(lyr).__name__, fl))
+
+        return hook
+
+    def attach(layer):
+        for child in layer._sub_layers.values():
+            attach(child)
+        for cls, fn in table:
+            if type(layer) is cls:
+                handles.append(layer.register_forward_post_hook(
+                    make_hook(layer, fn)))
+                break
+
+    attach(net)
+    training = net.training
+    try:
+        import jax.numpy as jnp
+
+        x = Tensor(jnp.zeros(tuple(input_size), jnp.float32))
+        net.eval()
+        from ..autograd import engine as _engine
+
+        with _engine.no_grad():
+            net(x)
+    finally:
+        # Restore mode even when the dry-run forward raises — leaving
+        # the model in eval() would silently freeze BN/Dropout for the
+        # caller's subsequent training steps.
+        if training:
+            net.train()
+        for h in handles:
+            h.remove()
+
+    if print_detail:
+        for name, fl in rows:
+            print(f"{name:>20}: {fl:,}")
+        print(f"{'Total':>20}: {total[0]:,}")
+    return total[0]

@@ -1,0 +1,103 @@
+"""paddle.text — Viterbi decoding (+ dataset stubs).
+
+Reference: ``python/paddle/text/`` — ``viterbi_decode``/``ViterbiDecoder``
+(viterbi_decode.py:28, CRF decode) and the downloadable datasets
+(datasets/: Imdb, Conll05st, ...).  The datasets require network
+downloads (zero-egress here) and raise with instructions; the decoder is
+full semantics.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layers import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Highest-scoring tag path per sequence (reference
+    viterbi_decode.py:31; the C++ kernel is phi viterbi_decode_kernel).
+
+    potentials [B, T, N], transition_params [N, N], lengths [B] ->
+    (scores [B], paths [B, max(lengths)]).  With
+    ``include_bos_eos_tag``, the last tag is BOS (transitions from it
+    score the first step) and the second-to-last is EOS (transitions to
+    it score the sequence end) — both are excluded from the emitted
+    path, matching the reference kernel.
+    """
+    pot = np.asarray(potentials._data if isinstance(potentials, Tensor)
+                     else potentials, np.float64)
+    trans = np.asarray(
+        transition_params._data if isinstance(transition_params, Tensor)
+        else transition_params, np.float64)
+    lens = np.asarray(lengths._data if isinstance(lengths, Tensor)
+                      else lengths).astype(np.int64)
+    B, T, N = pot.shape
+    if include_bos_eos_tag:
+        bos, eos = N - 1, N - 2
+    max_len = int(lens.max()) if B else 0
+    scores = np.zeros(B, np.float32)
+    paths = np.zeros((B, max_len), np.int64)
+
+    for b in range(B):
+        L = int(lens[b])
+        if L == 0:
+            continue
+        alpha = pot[b, 0].copy()
+        if include_bos_eos_tag:
+            alpha = alpha + trans[bos]
+        back = np.zeros((L, N), np.int64)
+        for t in range(1, L):
+            cand = alpha[:, None] + trans  # [from, to]
+            back[t] = np.argmax(cand, axis=0)
+            alpha = cand[back[t], np.arange(N)] + pot[b, t]
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, eos]
+        last = int(np.argmax(alpha))
+        scores[b] = alpha[last]
+        path = [last]
+        for t in range(L - 1, 0, -1):
+            path.append(int(back[t, path[-1]]))
+        paths[b, :L] = path[::-1]
+
+    return (Tensor(jnp.asarray(scores)),
+            Tensor(jnp.asarray(paths)))
+
+
+class ViterbiDecoder(Layer):
+    """Reference viterbi_decode.py ViterbiDecoder layer form."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class _NeedsDownload:
+    def __init__(self, name):
+        self._name = name
+
+    def __call__(self, *a, **k):
+        raise RuntimeError(
+            f"paddle.text.datasets.{self._name} needs a network download "
+            "(reference text/datasets); this environment has no egress — "
+            "load the corpus from local files with paddle.io.Dataset")
+
+
+class datasets:  # noqa: N801
+    Imdb = _NeedsDownload("Imdb")
+    Imikolov = _NeedsDownload("Imikolov")
+    Movielens = _NeedsDownload("Movielens")
+    Conll05st = _NeedsDownload("Conll05st")
+    UCIHousing = _NeedsDownload("UCIHousing")
+    WMT14 = _NeedsDownload("WMT14")
+    WMT16 = _NeedsDownload("WMT16")

@@ -1,0 +1,241 @@
+"""Domain breadth (VERDICT r3 missing #5 + weak #5): flops, audio,
+text (viterbi), geometric, onnx export decision, auto-tuner.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+# -- flops ------------------------------------------------------------------
+
+def test_flops_linear_and_conv():
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    fl = paddle.flops(net, [4, 16])
+    # linear1: 4*16*32 + 4*32 bias; relu: 4*32; linear2: 4*32*8 + 4*8
+    want = (4 * 16 * 32 + 4 * 32) + 4 * 32 + (4 * 32 * 8 + 4 * 8)
+    assert fl == want, (fl, want)
+
+    conv = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1))
+    fl = paddle.flops(conv, [1, 3, 8, 8])
+    # cin*k*k*out_numel + bias*out_numel
+    want = 3 * 3 * 3 * (1 * 8 * 8 * 8) + 1 * 8 * 8 * 8
+    assert fl == want, (fl, want)
+
+
+def test_flops_custom_ops():
+    class Odd(nn.Layer):
+        def forward(self, x):
+            return x
+
+    net = nn.Sequential(Odd())
+    fl = paddle.flops(net, [2, 4],
+                      custom_ops={Odd: lambda lyr, i, o: 123})
+    assert fl == 123
+
+
+# -- audio ------------------------------------------------------------------
+
+def test_audio_mel_scale_roundtrip():
+    from paddle_tpu.audio import functional as AF
+
+    for htk in (False, True):
+        hz = AF.mel_to_hz(AF.hz_to_mel(440.0, htk), htk)
+        assert abs(hz - 440.0) < 1e-2, (htk, hz)
+
+
+def test_audio_fbank_properties():
+    from paddle_tpu.audio import functional as AF
+
+    fb = AF.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    # every filter has some support
+    assert (fb.sum(axis=1) > 0).all()
+
+
+def test_audio_spectrogram_parity_with_numpy():
+    from paddle_tpu.audio import Spectrogram
+
+    rng = np.random.RandomState(0)
+    wav = rng.randn(2, 2048).astype(np.float32)
+    n_fft, hop = 256, 128
+    layer = Spectrogram(n_fft=n_fft, hop_length=hop, window="hann",
+                        power=2.0, center=False)
+    got = layer(paddle.to_tensor(wav)).numpy()
+
+    # independent numpy STFT golden
+    w = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n_fft) / n_fft)
+    frames = 1 + (2048 - n_fft) // hop
+    want = np.zeros((2, n_fft // 2 + 1, frames), np.float32)
+    for b in range(2):
+        for t in range(frames):
+            seg = wav[b, t * hop:t * hop + n_fft] * w
+            want[b, :, t] = np.abs(np.fft.rfft(seg)) ** 2
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_audio_mfcc_pipeline_shapes():
+    from paddle_tpu.audio import MFCC, LogMelSpectrogram
+
+    wav = paddle.to_tensor(
+        np.random.RandomState(1).randn(1, 4096).astype(np.float32))
+    lm = LogMelSpectrogram(sr=16000, n_fft=512, n_mels=64)(wav)
+    assert lm.shape[1] == 64
+    mf = MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=64)(wav)
+    assert mf.shape[1] == 13
+
+
+# -- text / viterbi ---------------------------------------------------------
+
+def _viterbi_bruteforce(pot, trans, L):
+    import itertools
+
+    best, best_s = None, -1e30
+    N = pot.shape[-1]
+    for path in itertools.product(range(N), repeat=L):
+        s = pot[0, path[0]] + sum(
+            trans[path[t - 1], path[t]] + pot[t, path[t]]
+            for t in range(1, L))
+        if s > best_s:
+            best, best_s = path, s
+    return best_s, list(best)
+
+
+def test_viterbi_decode_matches_bruteforce():
+    rng = np.random.RandomState(2)
+    B, T, N = 2, 5, 4
+    pot = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    lens = np.array([5, 3], np.int64)
+    scores, paths = paddle.text.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        paddle.to_tensor(lens), include_bos_eos_tag=False)
+    for b in range(B):
+        ws, wp = _viterbi_bruteforce(pot[b], trans, int(lens[b]))
+        np.testing.assert_allclose(scores.numpy()[b], ws, rtol=1e-5)
+        assert paths.numpy()[b, :lens[b]].tolist() == wp
+
+
+def test_viterbi_decoder_layer_and_bos_eos():
+    rng = np.random.RandomState(3)
+    pot = rng.randn(1, 4, 5).astype(np.float32)
+    trans = rng.randn(5, 5).astype(np.float32)
+    dec = paddle.text.ViterbiDecoder(paddle.to_tensor(trans),
+                                     include_bos_eos_tag=True)
+    scores, paths = dec(paddle.to_tensor(pot),
+                        paddle.to_tensor(np.array([4], np.int64)))
+    # brute force with bos/eos augmentation (bos=N-1, eos=N-2)
+    import itertools
+
+    N, L = 5, 4
+    best_s = -1e30
+    for path in itertools.product(range(N), repeat=L):
+        s = (trans[N - 1, path[0]] + pot[0, 0, path[0]]
+             + sum(trans[path[t - 1], path[t]] + pot[0, t, path[t]]
+                   for t in range(1, L)) + trans[path[-1], N - 2])
+        best_s = max(best_s, s)
+    np.testing.assert_allclose(scores.numpy()[0], best_s, rtol=1e-5)
+
+
+def test_text_datasets_raise_with_guidance():
+    with pytest.raises(RuntimeError, match="download"):
+        paddle.text.datasets.Imdb()
+
+
+# -- geometric --------------------------------------------------------------
+
+def test_segment_reductions():
+    from paddle_tpu import geometric as G
+
+    data = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                     np.float32))
+    ids = paddle.to_tensor(np.array([0, 0, 1]))
+    np.testing.assert_allclose(G.segment_sum(data, ids).numpy(),
+                               [[4, 6], [5, 6]])
+    np.testing.assert_allclose(G.segment_mean(data, ids).numpy(),
+                               [[2, 3], [5, 6]])
+    np.testing.assert_allclose(G.segment_max(data, ids).numpy(),
+                               [[3, 4], [5, 6]])
+    np.testing.assert_allclose(G.segment_min(data, ids).numpy(),
+                               [[1, 2], [5, 6]])
+
+
+def test_send_u_recv_and_grads():
+    from paddle_tpu import geometric as G
+
+    x = paddle.to_tensor(np.array([[1.], [2.], [4.]], np.float32))
+    x.stop_gradient = False
+    src = paddle.to_tensor(np.array([0, 1, 2, 0]))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0]))
+    out = G.send_u_recv(x, src, dst, reduce_op="sum")
+    np.testing.assert_allclose(out.numpy(), [[1.], [5.], [2.]])
+    out.sum().backward()
+    # each node's feature used once per outgoing edge
+    np.testing.assert_allclose(x.grad.numpy(), [[2.], [1.], [1.]])
+
+
+def test_send_ue_recv_and_send_uv():
+    from paddle_tpu import geometric as G
+
+    x = paddle.to_tensor(np.array([[1.], [2.]], np.float32))
+    y = paddle.to_tensor(np.array([[10.], [20.], [30.]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 0]))
+    dst = paddle.to_tensor(np.array([1, 0, 0]))
+    out = G.send_ue_recv(x, y, src, dst, message_op="add",
+                         reduce_op="max")
+    # edges: (0->1: 1+10=11), (1->0: 2+20=22), (0->0: 1+30=31)
+    np.testing.assert_allclose(out.numpy(), [[31.], [11.]])
+
+    uv = G.send_uv(x, x, src, dst, message_op="mul")
+    np.testing.assert_allclose(uv.numpy(), [[2.], [2.], [1.]])
+
+
+# -- onnx + auto tuner -------------------------------------------------------
+
+def test_onnx_export_writes_executable_artifact(tmp_path):
+    net = nn.Sequential(nn.Linear(4, 2))
+    out = paddle.onnx.export(net, str(tmp_path / "m"),
+                             input_spec=[paddle.jit.InputSpec([1, 4])])
+    assert out.endswith(".pdparams")
+    from paddle_tpu.inference import Config, create_predictor
+
+    pred = create_predictor(Config(str(tmp_path / "m")))
+    (res,) = pred.run([np.ones((1, 4), np.float32)])
+    assert res.shape == (1, 2)
+
+
+def test_auto_tuner_prune_and_rank():
+    from paddle_tpu.distributed.auto_tuner import AutoTuner
+
+    t = AutoTuner(world_size=8, model_params=7e9, hidden=2048,
+                  layers=22, seq_len=2048, hbm_bytes=16e9)
+    kept, pruned = t.prune()
+    assert kept, "no valid configs survived"
+    for c in kept:
+        assert c.dp * c.mp * c.pp * c.sharding == 8
+        assert 2048 % c.mp == 0 and 22 % c.pp == 0
+        assert t.estimate_memory(c) <= 16e9
+    reasons = {r for _, r in pruned}
+    # 22 layers prune pp in {4,8}; a 7B model prunes low-shard configs
+    assert any("divisible" in r for r in reasons)
+    assert any("memory" in r for r in reasons)
+
+
+def test_auto_tuner_trial_loop_picks_best():
+    from paddle_tpu.distributed.auto_tuner import AutoTuner
+
+    t = AutoTuner(world_size=8, model_params=1e8, hidden=1024,
+                  layers=8, seq_len=512, hbm_bytes=16e9)
+
+    def trial(cfg):
+        if cfg.mp == 4:
+            raise RuntimeError("simulated OOM")
+        # fake world where mp=2 is the winner
+        return 100.0 + (50.0 if cfg.mp == 2 else 0.0) - cfg.pp
+
+    best, history = t.tune(trial, max_trials=10_000)  # sweep all kept
+    assert best is not None and best.mp == 2
+    # failed trials (simulated OOM at mp=4) are recorded, not fatal
+    assert any("error" in h for h in history)
