@@ -24,23 +24,14 @@ from ..core.tensor import Tensor
 from ..nn.layers import Layer
 from ..ops import registry as _registry
 
-_aops: dict = {}
-
-
-def _op(name, fn, *args, **attrs):
-    op = _aops.get(name)
-    if op is None:
-        op = _registry.OpDef(name, fn,
-                             static_argnames=tuple(attrs.keys()))
-        _aops[name] = op
-    return _registry.apply(op, *args, **attrs)
+_op = _registry.cached_apply
 
 
 class functional:  # noqa: N801 — namespace (reference audio.functional)
     @staticmethod
     def hz_to_mel(freq, htk=False):
         """functional.py:24 (slaney by default, htk option)."""
-        scalar = not isinstance(freq, (Tensor, np.ndarray, jnp.ndarray))
+        scalar = isinstance(freq, (int, float))
         f = freq._data if isinstance(freq, Tensor) else jnp.asarray(
             freq, jnp.float32)
         if htk:
@@ -59,7 +50,7 @@ class functional:  # noqa: N801 — namespace (reference audio.functional)
 
     @staticmethod
     def mel_to_hz(mel, htk=False):
-        scalar = not isinstance(mel, (Tensor, np.ndarray, jnp.ndarray))
+        scalar = isinstance(mel, (int, float))
         m = mel._data if isinstance(mel, Tensor) else jnp.asarray(
             mel, jnp.float32)
         if htk:
@@ -156,13 +147,18 @@ class functional:  # noqa: N801 — namespace (reference audio.functional)
         return Tensor(w)
 
 
-def _stft_power(x, window, n_fft, hop_length, power, center):
+def _stft_power(x, window, n_fft, hop_length, power, center,
+                pad_mode="reflect"):
     """[B, T] -> [B, n_fft//2+1, frames] |STFT|^power."""
     if center:
         pad = n_fft // 2
         x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)],
-                    mode="reflect")
+                    mode=pad_mode)
     T = x.shape[-1]
+    if T < n_fft:
+        raise ValueError(
+            f"signal too short for STFT: {T} samples (after centering "
+            f"pad) < n_fft={n_fft} — would produce 0 frames")
     frames = 1 + (T - n_fft) // hop_length
     starts = jnp.arange(frames) * hop_length
     idx = starts[:, None] + jnp.arange(n_fft)[None, :]
@@ -190,11 +186,13 @@ class Spectrogram(Layer):
         self._window = w
         self.power = power
         self.center = center
+        self.pad_mode = pad_mode
 
     def forward(self, x):
         return _op("spectrogram", _stft_power, x, Tensor(self._window),
                    n_fft=self.n_fft, hop_length=self.hop_length,
-                   power=float(self.power), center=self.center)
+                   power=float(self.power), center=self.center,
+                   pad_mode=self.pad_mode)
 
 
 class MelSpectrogram(Layer):
@@ -205,7 +203,6 @@ class MelSpectrogram(Layer):
         super().__init__()
         self._spect = Spectrogram(n_fft, hop_length, win_length, window,
                                   power, center)
-        self.add_sublayer("_spect", self._spect)
         self._fbank = functional.compute_fbank_matrix(
             sr, n_fft, n_mels, f_min, f_max, htk, norm)._data
 
@@ -228,7 +225,6 @@ class LogMelSpectrogram(Layer):
         self._mel = MelSpectrogram(sr, n_fft, hop_length, win_length,
                                    window, power, center, n_mels, f_min,
                                    f_max, htk, norm)
-        self.add_sublayer("_mel", self._mel)
         self._ref, self._amin, self._top_db = ref_value, amin, top_db
 
     def forward(self, x):
@@ -246,7 +242,6 @@ class MFCC(Layer):
         self._logmel = LogMelSpectrogram(
             sr, n_fft, hop_length, win_length, window, power, center,
             n_mels, f_min, f_max, htk, norm, ref_value, amin, top_db)
-        self.add_sublayer("_logmel", self._logmel)
         self._dct = functional.create_dct(n_mfcc, n_mels)._data
 
     def forward(self, x):

@@ -134,6 +134,7 @@ class AutoTuner:
         decides (pure analytical mode)."""
         kept, _ = self.prune()
         kept.sort(key=self.estimate_cost)
+        self.history = []
         if trial_fn is None:
             self.history = [{"config": c.as_dict(),
                              "est_cost": self.estimate_cost(c)}

@@ -48,24 +48,11 @@ def _shape(s):
     return tuple(int(v) for v in s)
 
 
-_dist_ops: dict = {}
-
-
-def _op(name, fn, *args, **attrs):
-    """Dispatch a closed-form distribution computation through the op
-    registry (jit-cached, tape-recorded — the jax.vjp fallback supplies
-    the backward).  This is what makes distribution math differentiable
-    through the eager engine (round-2 advisor finding)."""
-    op = _dist_ops.get(name)
-    if op is None:
-        op = _registry.OpDef(name, fn,
-                             static_argnames=tuple(attrs.keys()))
-        _dist_ops[name] = op
-    elif attrs and set(op.static_argnames) != set(attrs.keys()):
-        op = _registry.OpDef(name, fn,
-                             static_argnames=tuple(attrs.keys()))
-        _dist_ops[name] = op
-    return _registry.apply(op, *args, **attrs)
+# Dispatch closed-form distribution math through the op registry
+# (jit-cached, tape-recorded — the jax.vjp fallback supplies the
+# backward), which is what makes it differentiable through the eager
+# engine (round-2 advisor finding).
+_op = _registry.cached_apply
 
 
 class Distribution:

@@ -21,16 +21,17 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..ops import registry as _registry
 
-_gops: dict = {}
+_op = _registry.cached_apply
 
 
-def _op(name, fn, *args, **attrs):
-    op = _gops.get(name)
-    if op is None:
-        op = _registry.OpDef(name, fn,
-                             static_argnames=tuple(attrs.keys()))
-        _gops[name] = op
-    return _registry.apply(op, *args, **attrs)
+def _out_size(out_size, x):
+    """Destination-node count: an explicit out_size (0 is a valid empty
+    graph) wins over the source-node count."""
+    if out_size is not None:
+        return int(out_size)
+    if not hasattr(x, "shape"):
+        raise ValueError("out_size is required when x has no .shape")
+    return int(x.shape[0])
 
 
 def _nseg(segment_ids, out_size=None):
@@ -41,65 +42,44 @@ def _nseg(segment_ids, out_size=None):
     return int(ids.max()) + 1 if ids.size else 0
 
 
-def segment_sum(data, segment_ids, name=None):
+def _reduce(gathered, dst, n, pool_type):
+    """Single segment-reduce used by both the segment_* ops and the
+    message-passing ops.  Empty segments yield 0 — detected via a
+    segment count, so legitimate +/-inf data values survive min/max."""
+    if pool_type == "sum":
+        return jax.ops.segment_sum(gathered, dst, num_segments=n)
+    cnt = jax.ops.segment_sum(
+        jnp.ones(gathered.shape[:1], jnp.float32), dst, num_segments=n)
+    cnt = cnt[(...,) + (None,) * (gathered.ndim - 1)]
+    if pool_type == "mean":
+        s = jax.ops.segment_sum(gathered, dst, num_segments=n)
+        return s / jnp.maximum(cnt, 1.0)
+    red = jax.ops.segment_max if pool_type == "max" else jax.ops.segment_min
+    out = red(gathered, dst, num_segments=n)
+    return jnp.where(cnt > 0, out, jnp.zeros_like(out))
+
+
+def _segment_op(pool, data, segment_ids):
     n = _nseg(segment_ids)
-    return _op("segment_sum",
-               lambda d, i, n: jax.ops.segment_sum(d, i, num_segments=n),
-               data, segment_ids, n=n)
+    return _op(f"segment_{pool}",
+               lambda d, i, n, pool: _reduce(d, i, n, pool),
+               data, segment_ids, n=n, pool=pool)
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment_op("sum", data, segment_ids)
 
 
 def segment_mean(data, segment_ids, name=None):
-    n = _nseg(segment_ids)
-
-    def fn(d, i, n):
-        s = jax.ops.segment_sum(d, i, num_segments=n)
-        cnt = jax.ops.segment_sum(jnp.ones(d.shape[:1], d.dtype), i,
-                                  num_segments=n)
-        return s / jnp.maximum(cnt, 1.0)[(...,) + (None,) * (d.ndim - 1)]
-
-    return _op("segment_mean", fn, data, segment_ids, n=n)
+    return _segment_op("mean", data, segment_ids)
 
 
 def segment_min(data, segment_ids, name=None):
-    n = _nseg(segment_ids)
-
-    def fn(d, i, n):
-        out = jax.ops.segment_min(d, i, num_segments=n)
-        return jnp.where(jnp.isfinite(out), out, 0.0)
-
-    return _op("segment_min", fn, data, segment_ids, n=n)
+    return _segment_op("min", data, segment_ids)
 
 
 def segment_max(data, segment_ids, name=None):
-    n = _nseg(segment_ids)
-
-    def fn(d, i, n):
-        out = jax.ops.segment_max(d, i, num_segments=n)
-        return jnp.where(jnp.isfinite(out), out, 0.0)
-
-    return _op("segment_max", fn, data, segment_ids, n=n)
-
-
-_REDUCERS = {
-    "sum": lambda g, dst, n: jax.ops.segment_sum(g, dst, num_segments=n),
-    "mean": None,  # handled via sum/count
-    "max": lambda g, dst, n: jax.ops.segment_max(g, dst, num_segments=n),
-    "min": lambda g, dst, n: jax.ops.segment_min(g, dst, num_segments=n),
-}
-
-
-def _reduce(gathered, dst, n, pool_type):
-    if pool_type == "mean":
-        s = jax.ops.segment_sum(gathered, dst, num_segments=n)
-        cnt = jax.ops.segment_sum(
-            jnp.ones(gathered.shape[:1], gathered.dtype), dst,
-            num_segments=n)
-        return s / jnp.maximum(cnt, 1.0)[(...,) + (None,)
-                                         * (gathered.ndim - 1)]
-    out = _REDUCERS[pool_type](gathered, dst, n)
-    if pool_type in ("max", "min"):
-        out = jnp.where(jnp.isfinite(out), out, 0.0)
-    return out
+    return _segment_op("max", data, segment_ids)
 
 
 def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
@@ -108,7 +88,7 @@ def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
     reduce_op = reduce_op.lower()
     if reduce_op not in ("sum", "mean", "max", "min"):
         raise ValueError(f"unsupported reduce_op {reduce_op!r}")
-    n = out_size or (x.shape[0] if hasattr(x, "shape") else None)
+    n = _out_size(out_size, x)
 
     def fn(x, src, dst, n, pool):
         return _reduce(x[src], dst, n, pool)
@@ -125,7 +105,7 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
     reduce_op = reduce_op.lower()
     if message_op not in ("add", "sub", "mul", "div"):
         raise ValueError(f"unsupported message_op {message_op!r}")
-    n = out_size or (x.shape[0] if hasattr(x, "shape") else None)
+    n = _out_size(out_size, x)
 
     def fn(x, y, src, dst, n, msg, pool):
         g = x[src]

@@ -25,6 +25,14 @@ def export(layer, path, input_spec=None, opset_version=9, **configs):
         raise ValueError(
             "paddle.onnx.export needs input_spec to lower the program "
             "(same requirement as the reference's export)")
+    if configs.get("enable_onnx_checker"):
+        raise NotImplementedError(
+            "enable_onnx_checker=True demands a true .onnx protobuf, "
+            "which requires the external paddle2onnx package (not "
+            "bundled in the reference either). This framework's "
+            "deployment artifact is the executable StableHLO program "
+            "(jit.save / inference.Predictor); call export() without "
+            "enable_onnx_checker to produce it.")
     from .. import jit as _jit
 
     _jit.save(layer, path, input_spec=input_spec)

@@ -92,6 +92,26 @@ def get_op(name: str) -> OpDef:
     return _OPS[name]
 
 
+_DYN_OPS: dict = {}
+
+
+def cached_apply(name, fn, *args, **attrs):
+    """Dispatch ``fn`` through a cached ad-hoc OpDef (full dispatch
+    semantics: jit cache, NaN checks, eager tape) without entering the
+    global registry sweep.  The OpDef is rebuilt whenever the attr-key
+    set changes so ``static_argnames`` never goes stale.  Shared by the
+    domain namespaces (sparse/audio/geometric/...)."""
+    # Key on the code object too: per-call closures share one compiled
+    # OpDef, but two modules reusing an op name with different bodies
+    # get distinct entries instead of silently running the first fn.
+    key = (name, getattr(fn, "__code__", fn))
+    op = _DYN_OPS.get(key)
+    if op is None or set(op.static_argnames) != set(attrs.keys()):
+        op = OpDef(name, fn, static_argnames=tuple(attrs.keys()))
+        _DYN_OPS[key] = op
+    return apply(op, *args, **attrs)
+
+
 def grad_op(op: OpDef, attrs: dict, n_outs: int, diff_idx: tuple,
             n_inputs: int) -> OpDef:
     """OpDef computing d(inputs[diff_idx]) from (cotangents, *inputs) —

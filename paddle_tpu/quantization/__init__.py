@@ -32,16 +32,7 @@ from ..nn.layers import Layer
 from .. import nn as _nn
 from ..ops import registry as _registry
 
-_qops: dict = {}
-
-
-def _op(name, fn, *args, **attrs):
-    op = _qops.get(name)
-    if op is None:
-        op = _registry.OpDef(name, fn,
-                             static_argnames=tuple(attrs.keys()))
-        _qops[name] = op
-    return _registry.apply(op, *args, **attrs)
+_op = _registry.cached_apply
 
 
 def _fake_quant(x, scale, bits=8):

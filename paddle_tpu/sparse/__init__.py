@@ -26,17 +26,7 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..ops import registry as _registry
 
-_ops_cache: dict = {}
-
-
-def _op(name, fn, *args, **attrs):
-    op = _ops_cache.get(name)
-    if op is None or (attrs and set(op.static_argnames)
-                      != set(attrs.keys())):
-        op = _registry.OpDef(name, fn,
-                             static_argnames=tuple(attrs.keys()))
-        _ops_cache[name] = op
-    return _registry.apply(op, *args, **attrs)
+_op = _registry.cached_apply
 
 
 def _raw(x):

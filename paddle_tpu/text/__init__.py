@@ -27,8 +27,9 @@ def viterbi_decode(potentials, transition_params, lengths,
     (scores [B], paths [B, max(lengths)]).  With
     ``include_bos_eos_tag``, the last tag is BOS (transitions from it
     score the first step) and the second-to-last is EOS (transitions to
-    it score the sequence end) — both are excluded from the emitted
-    path, matching the reference kernel.
+    it score the sequence end).  Matching the reference kernel, the
+    argmax still ranges over all N tags — trained transition scores,
+    not masking, are what keep reserved tags out of decoded paths.
     """
     pot = np.asarray(potentials._data if isinstance(potentials, Tensor)
                      else potentials, np.float64)

@@ -29,16 +29,7 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..ops import registry as _registry
 
-_vops: dict = {}
-
-
-def _op(name, fn, *args, **attrs):
-    op = _vops.get(name)
-    if op is None:
-        op = _registry.OpDef(name, fn,
-                             static_argnames=tuple(attrs.keys()))
-        _vops[name] = op
-    return _registry.apply(op, *args, **attrs)
+_op = _registry.cached_apply
 
 
 def _np(x):

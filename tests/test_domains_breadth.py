@@ -239,3 +239,107 @@ def test_auto_tuner_trial_loop_picks_best():
     assert best is not None and best.mp == 2
     # failed trials (simulated OOM at mp=4) are recorded, not fatal
     assert any("error" in h for h in history)
+
+
+# -- round-3 advisor/review regressions --------------------------------
+
+
+def test_spectrogram_pad_mode_honored():
+    """pad_mode reaches the STFT padding (review: was hardcoded reflect)."""
+    import paddle_tpu as paddle
+
+    wav = paddle.to_tensor(
+        np.random.RandomState(0).randn(1, 2000).astype("float32"))
+    s_ref = paddle.audio.features.Spectrogram(n_fft=256)(wav)
+    s_con = paddle.audio.features.Spectrogram(
+        n_fft=256, pad_mode="constant")(wav)
+    edge = np.abs(s_ref.numpy()[..., 0] - s_con.numpy()[..., 0]).max()
+    assert edge > 1e-3, "pad_mode=constant produced identical edge frames"
+
+
+def test_spectrogram_too_short_raises():
+    import paddle_tpu as paddle
+
+    wav = paddle.to_tensor(np.zeros((1, 100), "float32"))
+    with np.testing.assert_raises(ValueError):
+        paddle.audio.features.Spectrogram(n_fft=256, center=False)(wav)
+
+
+def test_hz_mel_accepts_list():
+    import paddle_tpu as paddle
+
+    m = paddle.audio.functional.hz_to_mel([100.0, 200.0])
+    assert tuple(m.shape) == (2,)
+    h = paddle.audio.functional.mel_to_hz([1.0, 2.0])
+    assert tuple(h.shape) == (2,)
+
+
+def test_segment_max_preserves_inf():
+    """Empty-segment fill must not rewrite legitimate inf data values."""
+    import paddle_tpu as paddle
+
+    data = paddle.to_tensor(np.array([np.inf, 1.0, -np.inf], "float32"))
+    ids = paddle.to_tensor(np.array([0, 0, 2], "int64"))
+    mx = paddle.geometric.segment_max(data, ids).numpy()
+    assert np.isposinf(mx[0]) and mx[1] == 0.0 and np.isneginf(mx[2])
+    mn = paddle.geometric.segment_min(data, ids).numpy()
+    assert np.isposinf(-mn[0]) or mn[0] == 1.0  # min(inf,1)=1
+    assert mn[1] == 0.0 and np.isneginf(mn[2])
+
+
+def test_send_u_recv_out_size_zero():
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.random.randn(4, 3).astype("float32"))
+    src = paddle.to_tensor(np.array([0, 1], "int64"))
+    dst = paddle.to_tensor(np.array([0, 0], "int64"))
+    out = paddle.geometric.send_u_recv(x, src, dst, out_size=0)
+    assert tuple(out.shape) == (0, 3)
+
+
+def test_viterbi_argmax_over_all_tags():
+    """Matching the reference kernel, reserved BOS/EOS tags are NOT
+    masked out of the argmax — transition scores, not masking, keep
+    them out of trained decodes (phi viterbi_decode_kernel.cc:255)."""
+    import paddle_tpu as paddle
+
+    N = 3  # tags: 0 real, eos=1, bos=2
+    pot = np.full((1, 2, N), -1.0, "float32")
+    pot[:, :, N - 1] = 10.0  # BOS emission dominates
+    trans = np.zeros((N, N), "float32")
+    score, path = paddle.text.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        paddle.to_tensor(np.array([2], "int64")), include_bos_eos_tag=True)
+    assert set(np.asarray(path.numpy()).ravel()) == {N - 1}
+    np.testing.assert_allclose(score.numpy()[0], 20.0, rtol=1e-6)
+
+
+def test_onnx_checker_raises():
+    import paddle_tpu as paddle
+
+    with np.testing.assert_raises(NotImplementedError):
+        paddle.onnx.export(paddle.nn.Linear(3, 2), "/tmp/_onnx_chk",
+                           input_spec=[((1, 3), "float32")],
+                           enable_onnx_checker=True)
+
+
+def test_auto_tuner_history_resets():
+    from paddle_tpu.distributed.auto_tuner import AutoTuner
+
+    t = AutoTuner(world_size=8, model_params=1e8, hidden=512, layers=4,
+                  seq_len=512)
+    _, h1 = t.tune()
+    _, h2 = t.tune()
+    assert len(h1) == len(h2)
+
+
+def test_cached_apply_name_collision():
+    """Two different fns under one name run their own bodies."""
+    import paddle_tpu as paddle
+    from paddle_tpu.ops import registry
+
+    x = paddle.to_tensor(np.ones(3, "float32"))
+    a = registry.cached_apply("collide_demo", lambda v, k: v * k, x, k=3.0)
+    b = registry.cached_apply("collide_demo", lambda v, k: v + k, x, k=3.0)
+    np.testing.assert_allclose(a.numpy(), 3.0 * np.ones(3), rtol=1e-6)
+    np.testing.assert_allclose(b.numpy(), 1.0 + 3.0 * np.ones(3), rtol=1e-6)
