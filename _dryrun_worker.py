@@ -205,7 +205,8 @@ def _phase5_ep(np, jax, paddle):
     mesh = ProcessMesh(list(range(n)), dim_names=["ep"])
     paddle.seed(11)
     layer = MoELayer(d_model=32, d_hidden=64, num_experts=n * 2,
-                     top_k=2, mesh=mesh, ep_axis="ep")
+                     top_k=2, mesh=mesh, ep_axis="ep",
+                     dispatch_mode="alltoall")
     x = paddle.to_tensor(
         np.random.RandomState(3).randn(n * 2, 8, 32).astype("float32"))
     out = layer(x)

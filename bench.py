@@ -134,7 +134,56 @@ def main():
         except Exception as e:
             print(f"resnet50: FAILED: {e}", file=sys.stderr)
             result["resnet50"] = {"error": str(e)[:200]}
+    if not on_cpu and os.environ.get("PT_BENCH_SKIP_BERT") != "1":
+        try:
+            result["bert_base_squad"] = _bench_bert(jax)
+        except Exception as e:
+            print(f"bert: FAILED: {e}", file=sys.stderr)
+            result["bert_base_squad"] = {"error": str(e)[:200]}
     print(json.dumps(result))
+
+
+def _bench_bert(jax):
+    """BASELINE config 2: BERT-base SQuAD fine-tune step (span QA loss,
+    fwd+bwd+AdamW, bf16 compute).  DP on one chip = the plain step; the
+    dp-sharded CompiledTrainStep covers multi-chip (tests/test_engine)."""
+    import gc
+
+    from paddle_tpu import nn
+    from paddle_tpu.models.bert import BertConfig, BertForQuestionAnswering
+    from paddle_tpu.models.training import CompiledTrainStep
+
+    gc.collect()
+    cfg = BertConfig.base()
+
+    class QATrain(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.qa = BertForQuestionAnswering(cfg)
+
+        def forward(self, ids, starts, ends):
+            return self.qa(ids, start_positions=starts,
+                           end_positions=ends)
+
+    model = QATrain()
+    model.train()
+    step = CompiledTrainStep(model, lr=3e-5, compute_dtype="bfloat16")
+    batch, seq = (int(os.environ.get("PT_BENCH_BERT_BATCH", "16")), 384)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    starts = rng.randint(0, seq, (batch,)).astype(np.int32)
+    ends = rng.randint(0, seq, (batch,)).astype(np.int32)
+    print("bert: compiling...", file=sys.stderr)
+    dt, loss = _time_steps(step.step, (ids, starts, ends), 5, "bert")
+    seqs_s = batch / dt
+    tok_s = batch * seq / dt
+    mfu = tok_s * model.qa.bert.flops_per_token(seq) / \
+        _peak_flops_per_chip()
+    print(f"bert: step {dt * 1e3:.1f} ms, {seqs_s:.1f} seq/s, "
+          f"MFU {mfu:.3f}", file=sys.stderr)
+    return {"value": round(seqs_s, 1), "unit": "sequences/s/chip",
+            "batch": batch, "seq": seq, "mfu": round(mfu, 4),
+            "model_params": model.qa.bert.num_params()}
 
 
 def _bench_resnet(jax):

@@ -31,7 +31,7 @@ class functional:  # noqa: N801 — namespace (reference audio.functional)
     @staticmethod
     def hz_to_mel(freq, htk=False):
         """functional.py:24 (slaney by default, htk option)."""
-        scalar = isinstance(freq, (int, float))
+        scalar = isinstance(freq, (int, float, np.floating, np.integer))
         f = freq._data if isinstance(freq, Tensor) else jnp.asarray(
             freq, jnp.float32)
         if htk:
@@ -50,7 +50,7 @@ class functional:  # noqa: N801 — namespace (reference audio.functional)
 
     @staticmethod
     def mel_to_hz(mel, htk=False):
-        scalar = isinstance(mel, (int, float))
+        scalar = isinstance(mel, (int, float, np.floating, np.integer))
         m = mel._data if isinstance(mel, Tensor) else jnp.asarray(
             mel, jnp.float32)
         if htk:

@@ -48,12 +48,17 @@ def _reduce(gathered, dst, n, pool_type):
     segment count, so legitimate +/-inf data values survive min/max."""
     if pool_type == "sum":
         return jax.ops.segment_sum(gathered, dst, num_segments=n)
+    # Count in fp32 (exact for any realistic segment size), but keep the
+    # result in the data's dtype so bf16/fp16 pipelines stay low-precision.
     cnt = jax.ops.segment_sum(
         jnp.ones(gathered.shape[:1], jnp.float32), dst, num_segments=n)
     cnt = cnt[(...,) + (None,) * (gathered.ndim - 1)]
     if pool_type == "mean":
         s = jax.ops.segment_sum(gathered, dst, num_segments=n)
-        return s / jnp.maximum(cnt, 1.0)
+        out = s.astype(jnp.float32) / jnp.maximum(cnt, 1.0)
+        dt = gathered.dtype
+        return out.astype(dt if jnp.issubdtype(dt, jnp.floating)
+                          else jnp.float32)
     red = jax.ops.segment_max if pool_type == "max" else jax.ops.segment_min
     out = red(gathered, dst, num_segments=n)
     return jnp.where(cnt > 0, out, jnp.zeros_like(out))

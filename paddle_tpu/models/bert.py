@@ -1,0 +1,181 @@
+"""BERT family (BASELINE config 2: BERT-base SQuAD fine-tune, DP).
+
+Reference architecture: the PaddleNLP BertModel consumed by the
+reference's config-2 workload (token+position+type embeddings →
+post-LN transformer encoder → pooler), with task heads for sequence
+classification, question answering (SQuAD start/end spans) and masked
+LM.  Built on this repo's nn.TransformerEncoder — one jittable forward
+whose attention/matmuls land on the MXU in bf16 under amp.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn, ops
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+
+    @classmethod
+    def base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        base = dict(vocab_size=1024, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=128,
+                    max_position_embeddings=128)
+        base.update(kw)
+        return cls(**base)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size,
+                                            cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        seq = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = ops.arange(seq, dtype="int32")
+            position_ids = ops.expand(
+                ops.unsqueeze(position_ids, 0), [input_ids.shape[0], seq])
+        if token_type_ids is None:
+            token_type_ids = ops.zeros_like(input_ids)
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(position_ids)
+               + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.activation = nn.Tanh()
+
+    def forward(self, hidden):
+        return self.activation(self.dense(hidden[:, 0]))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.config = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads,
+            cfg.intermediate_size, dropout=cfg.hidden_dropout_prob,
+            activation=cfg.hidden_act,
+            attn_dropout=cfg.attention_probs_dropout_prob)
+        self.encoder = nn.TransformerEncoder(layer,
+                                             cfg.num_hidden_layers)
+        self.pooler = BertPooler(cfg)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        emb = self.embeddings(input_ids, token_type_ids, position_ids)
+        if attention_mask is not None:
+            # [B, S] 1/0 -> additive [B, 1, 1, S]
+            m = ops.unsqueeze(ops.unsqueeze(attention_mask, 1), 1)
+            attention_mask = (1.0 - ops.cast(m, "float32")) * -1e4
+        seq_out = self.encoder(emb, src_mask=attention_mask)
+        return seq_out, self.pooler(seq_out)
+
+    def num_params(self):
+        import numpy as np
+
+        return int(sum(np.prod(p.shape)
+                       for _, p in self.named_parameters()))
+
+    def flops_per_token(self, seq_len):
+        """6N + attention, fwd+bwd (same convention as llama.py)."""
+        cfg = self.config
+        n = self.num_params() - cfg.vocab_size * cfg.hidden_size
+        attn = (12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len)
+        return 6 * n + attn
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, cfg: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None,
+                attention_mask=None, labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return nn.functional.cross_entropy(logits, labels)
+        return logits
+
+
+class BertForQuestionAnswering(nn.Layer):
+    """SQuAD span head (BASELINE config 2's task)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.qa_outputs = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None,
+                attention_mask=None, start_positions=None,
+                end_positions=None):
+        seq_out, _ = self.bert(input_ids, token_type_ids,
+                               attention_mask=attention_mask)
+        logits = self.qa_outputs(seq_out)          # [B, S, 2]
+        start_logits = logits[:, :, 0]
+        end_logits = logits[:, :, 1]
+        if start_positions is not None:
+            loss = (nn.functional.cross_entropy(start_logits,
+                                                start_positions)
+                    + nn.functional.cross_entropy(end_logits,
+                                                  end_positions)) / 2.0
+            return loss
+        return start_logits, end_logits
+
+
+class BertForMaskedLM(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.activation = nn.GELU()
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.decoder = nn.Linear(cfg.hidden_size, cfg.vocab_size)
+
+    def forward(self, input_ids, token_type_ids=None,
+                attention_mask=None, labels=None):
+        seq_out, _ = self.bert(input_ids, token_type_ids,
+                               attention_mask=attention_mask)
+        h = self.layer_norm(self.activation(self.transform(seq_out)))
+        logits = self.decoder(h)
+        if labels is not None:
+            return nn.functional.cross_entropy(
+                ops.reshape(logits, [-1, logits.shape[-1]]),
+                ops.reshape(labels, [-1]), ignore_index=-100)
+        return logits

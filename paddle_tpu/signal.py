@@ -1,0 +1,185 @@
+"""paddle.signal — frame / overlap_add / stft / istft.
+
+Reference: ``python/paddle/signal.py`` (frame:38, overlap_add:161,
+stft:266, istft:443).
+
+TPU-native: framing is one gather, the transform is the XLA FFT HLO,
+and istft's overlap-add is a segment-sum — each API is a single jitted
+program through the op registry.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Tensor
+from .ops import registry as _registry
+
+_op = _registry.cached_apply
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice ``x`` into overlapping frames along ``axis`` (signal.py:38).
+    axis=-1: [..., T] -> [..., frame_length, num_frames];
+    axis=0:  [T, ...] -> [num_frames, frame_length, ...]."""
+    if frame_length <= 0 or hop_length <= 0:
+        raise ValueError("frame_length and hop_length must be positive")
+    if axis not in (0, -1):
+        raise ValueError("axis must be 0 or -1")
+
+    def fn(a, frame_length, hop_length, axis):
+        T = a.shape[axis]
+        if T < frame_length:
+            raise ValueError(
+                f"input too short: {T} < frame_length {frame_length}")
+        n = 1 + (T - frame_length) // hop_length
+        starts = jnp.arange(n) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        if axis == -1:
+            seg = a[..., idx]              # [..., n, frame_length]
+            return jnp.swapaxes(seg, -1, -2)
+        seg = a[idx]                       # [n, frame_length, ...]
+        return seg
+
+    return _op("signal_frame", fn, _t(x), frame_length=int(frame_length),
+               hop_length=int(hop_length), axis=int(axis))
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: sum overlapping frames (signal.py:161).
+    axis=-1: [..., frame_length, n] -> [..., T];
+    axis=0:  [n, frame_length, ...] -> [T, ...]."""
+    if hop_length <= 0:
+        raise ValueError("hop_length must be positive")
+    if axis not in (0, -1):
+        raise ValueError("axis must be 0 or -1")
+
+    def fn(a, hop_length, axis):
+        if axis == -1:
+            fl, n = a.shape[-2], a.shape[-1]
+            frames = jnp.moveaxis(a, -1, -2)  # [..., n, fl]
+        else:
+            n, fl = a.shape[0], a.shape[1]
+            frames = jnp.moveaxis(a, (0, 1), (-2, -1))  # [..., n, fl]
+        T = (n - 1) * hop_length + fl
+        starts = jnp.arange(n) * hop_length
+        idx = (starts[:, None] + jnp.arange(fl)[None, :]).reshape(-1)
+        flat = frames.reshape(frames.shape[:-2] + (n * fl,))
+        out = jax.vmap(
+            lambda row: jax.ops.segment_sum(row, idx, num_segments=T),
+        )(flat.reshape(-1, n * fl)).reshape(frames.shape[:-2] + (T,))
+        if axis == 0:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+
+    return _op("signal_overlap_add", fn, _t(x),
+               hop_length=int(hop_length), axis=int(axis))
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False,
+         onesided=True, name=None):
+    """Short-time Fourier transform (signal.py:266).
+
+    x: [B, T] (or [T]) real or complex; returns [B, n_fft//2+1 or
+    n_fft, num_frames] complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        w = window._data if isinstance(window, Tensor) else \
+            jnp.asarray(window)
+    else:
+        w = jnp.ones(win_length, jnp.float32)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+
+    def fn(a, w, n_fft, hop_length, center, pad_mode, normalized,
+           onesided):
+        squeeze = a.ndim == 1
+        if squeeze:
+            a = a[None]
+        if center:
+            pad = n_fft // 2
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        T = a.shape[-1]
+        if T < n_fft:
+            raise ValueError(f"signal too short: {T} < n_fft {n_fft}")
+        n = 1 + (T - n_fft) // hop_length
+        starts = jnp.arange(n) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        seg = a[..., idx] * w[None, None, :]
+        if jnp.iscomplexobj(seg) or not onesided:
+            spec = jnp.fft.fft(seg, axis=-1)
+        else:
+            spec = jnp.fft.rfft(seg, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        out = jnp.swapaxes(spec, -1, -2)   # [B, bins, frames]
+        return out[0] if squeeze else out
+
+    return _op("signal_stft", fn, _t(x), Tensor(w), n_fft=int(n_fft),
+               hop_length=int(hop_length), center=bool(center),
+               pad_mode=str(pad_mode), normalized=bool(normalized),
+               onesided=bool(onesided))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-envelope normalization (signal.py:443)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        w = window._data if isinstance(window, Tensor) else \
+            jnp.asarray(window)
+    else:
+        w = jnp.ones(win_length, jnp.float32)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+
+    def fn(spec, w, n_fft, hop_length, center, normalized, onesided,
+           length, return_complex):
+        squeeze = spec.ndim == 2
+        if squeeze:
+            spec = spec[None]
+        spec = jnp.swapaxes(spec, -1, -2)  # [B, frames, bins]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        if onesided:
+            seg = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            seg = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                seg = seg.real
+        seg = seg * w[None, None, :]
+        B, n = seg.shape[0], seg.shape[1]
+        T = (n - 1) * hop_length + n_fft
+        starts = jnp.arange(n) * hop_length
+        idx = (starts[:, None] + jnp.arange(n_fft)[None, :]).reshape(-1)
+        num = jax.vmap(lambda row: jax.ops.segment_sum(
+            row, idx, num_segments=T))(seg.reshape(B, -1))
+        env = jax.ops.segment_sum(
+            jnp.tile(w * w, n), idx, num_segments=T)
+        out = num / jnp.maximum(env, 1e-11)[None]
+        if center:
+            pad = n_fft // 2
+            out = out[..., pad:T - pad]
+        if length is not None:
+            out = out[..., :length]
+        return out[0] if squeeze else out
+
+    return _op("signal_istft", fn, _t(x), Tensor(w), n_fft=int(n_fft),
+               hop_length=int(hop_length), center=bool(center),
+               normalized=bool(normalized), onesided=bool(onesided),
+               length=None if length is None else int(length),
+               return_complex=bool(return_complex))

@@ -1,0 +1,118 @@
+"""DenseNet (reference: python/paddle/vision/models/densenet.py).
+
+Dense blocks concatenate every prior layer's features; growth_rate new
+channels per layer, halving transition layers between blocks.
+"""
+from __future__ import annotations
+
+from ... import nn, ops
+
+
+class DenseLayer(nn.Layer):
+    def __init__(self, in_ch, growth_rate, bn_size):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(in_ch)
+        self.conv1 = nn.Conv2D(in_ch, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        return ops.concat([x, out], axis=1)
+
+
+class DenseBlock(nn.Layer):
+    def __init__(self, num_layers, in_ch, growth_rate, bn_size):
+        super().__init__()
+        self.layers = nn.LayerList([
+            DenseLayer(in_ch + i * growth_rate, growth_rate, bn_size)
+            for i in range(num_layers)])
+
+    def forward(self, x):
+        for layer in self.layers:
+            x = layer(x)
+        return x
+
+
+class Transition(nn.Layer):
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(in_ch)
+        self.conv = nn.Conv2D(in_ch, out_ch, 1, bias_attr=False)
+        self.relu = nn.ReLU()
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+_CFG = {
+    121: (32, [6, 12, 24, 16]), 161: (48, [6, 12, 36, 24]),
+    169: (32, [6, 12, 32, 32]), 201: (32, [6, 12, 48, 32]),
+    264: (32, [6, 12, 64, 48]),
+}
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        growth_rate, block_cfg = _CFG[layers]
+        num_init = 2 * growth_rate
+
+        self.conv1 = nn.Conv2D(3, num_init, 7, stride=2, padding=3,
+                               bias_attr=False)
+        self.norm1 = nn.BatchNorm2D(num_init)
+        self.relu = nn.ReLU()
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+
+        blocks = []
+        ch = num_init
+        for i, num_layers in enumerate(block_cfg):
+            blocks.append(DenseBlock(num_layers, ch, growth_rate,
+                                     bn_size))
+            ch += num_layers * growth_rate
+            if i != len(block_cfg) - 1:
+                blocks.append(Transition(ch, ch // 2))
+                ch //= 2
+        self.blocks = nn.Sequential(*blocks)
+        self.norm_final = nn.BatchNorm2D(ch)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.norm1(self.conv1(x))))
+        x = self.relu(self.norm_final(self.blocks(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(ops.flatten(x, 1))
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return DenseNet(161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return DenseNet(264, **kwargs)

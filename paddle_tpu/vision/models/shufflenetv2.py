@@ -1,0 +1,135 @@
+"""ShuffleNetV2 (reference:
+python/paddle/vision/models/shufflenetv2.py).
+
+Channel shuffle is a reshape+transpose — XLA fuses it into the
+surrounding elementwise work, so it costs nothing on TPU.
+"""
+from __future__ import annotations
+
+from ... import nn, ops
+
+
+def channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = ops.reshape(x, [n, groups, c // groups, h, w])
+    x = ops.transpose(x, [0, 2, 1, 3, 4])
+    return ops.reshape(x, [n, c, h, w])
+
+
+def _conv_bn_act(in_ch, out_ch, kernel, stride=1, groups=1, act=True):
+    layers = [nn.Conv2D(in_ch, out_ch, kernel, stride=stride,
+                        padding=kernel // 2, groups=groups,
+                        bias_attr=False),
+              nn.BatchNorm2D(out_ch)]
+    if act:
+        layers.append(nn.ReLU())
+    return nn.Sequential(*layers)
+
+
+class InvertedResidual(nn.Layer):
+    """Stride-1 unit: split channels, transform one branch, shuffle."""
+
+    def __init__(self, channels):
+        super().__init__()
+        half = channels // 2
+        self.branch = nn.Sequential(
+            _conv_bn_act(half, half, 1),
+            _conv_bn_act(half, half, 3, groups=half, act=False),
+            _conv_bn_act(half, half, 1))
+
+    def forward(self, x):
+        half = x.shape[1] // 2
+        x1 = x[:, :half]
+        x2 = x[:, half:]
+        out = ops.concat([x1, self.branch(x2)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class InvertedResidualDS(nn.Layer):
+    """Stride-2 (downsampling) unit: both branches transform."""
+
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        half = out_ch // 2
+        self.branch1 = nn.Sequential(
+            _conv_bn_act(in_ch, in_ch, 3, stride=2, groups=in_ch,
+                         act=False),
+            _conv_bn_act(in_ch, half, 1))
+        self.branch2 = nn.Sequential(
+            _conv_bn_act(in_ch, half, 1),
+            _conv_bn_act(half, half, 3, stride=2, groups=half, act=False),
+            _conv_bn_act(half, half, 1))
+
+    def forward(self, x):
+        out = ops.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048],
+}
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_out = _STAGE_OUT[scale]
+        stage_repeats = [4, 8, 4]
+
+        self.conv1 = _conv_bn_act(3, stage_out[0], 3, stride=2)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        blocks = []
+        in_ch = stage_out[0]
+        for stage, repeats in enumerate(stage_repeats):
+            out_ch = stage_out[stage + 1]
+            blocks.append(InvertedResidualDS(in_ch, out_ch))
+            for _ in range(repeats - 1):
+                blocks.append(InvertedResidual(out_ch))
+            in_ch = out_ch
+        self.blocks = nn.Sequential(*blocks)
+        self.conv_last = _conv_bn_act(in_ch, stage_out[-1], 1)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(stage_out[-1], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.blocks(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(ops.flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)

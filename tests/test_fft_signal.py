@@ -1,0 +1,155 @@
+"""paddle.fft + paddle.signal vs NumPy goldens.
+
+Reference surfaces: python/paddle/fft.py, python/paddle/signal.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _rand(*shape, complex=False, seed=0):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(*shape).astype(np.float32)
+    if complex:
+        a = a + 1j * rng.randn(*shape).astype(np.float32)
+        a = a.astype(np.complex64)
+    return a
+
+
+@pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+@pytest.mark.parametrize("kind", ["fft", "ifft", "rfft", "irfft",
+                                  "hfft", "ihfft"])
+def test_fft_1d_matches_numpy(kind, norm):
+    complex_in = kind in ("ifft", "irfft", "hfft", "fft")
+    x = _rand(3, 16, complex=complex_in)
+    got = getattr(paddle.fft, kind)(paddle.to_tensor(x), norm=norm)
+    want = getattr(np.fft, kind)(x, norm=norm)
+    np.testing.assert_allclose(got.numpy(), want, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("kind", ["fft2", "ifft2", "rfft2", "irfft2",
+                                  "fftn", "ifftn", "rfftn", "irfftn"])
+def test_fft_nd_matches_numpy(kind):
+    complex_in = kind.startswith(("ifft", "irfft"))
+    x = _rand(2, 8, 8, complex=complex_in)
+    got = getattr(paddle.fft, kind)(paddle.to_tensor(x))
+    want = getattr(np.fft, kind)(x)
+    np.testing.assert_allclose(got.numpy(), want, rtol=2e-4, atol=2e-4)
+
+
+def test_fft_n_axis_args():
+    x = _rand(4, 10)
+    got = paddle.fft.fft(paddle.to_tensor(x), n=16, axis=0)
+    np.testing.assert_allclose(got.numpy(), np.fft.fft(x, n=16, axis=0),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_hfftn_ihfftn_roundtrip():
+    """Reference promise: ihfftn(hfftn(x, s)) == x with
+    s[-1] = 2*x.shape[-1] - 1, for x that is a valid Hermitian
+    half-spectrum (hfft drops the DC bin's imaginary part otherwise —
+    same caveat as the reference's c2r kernel)."""
+    spec_real = _rand(4, 9)
+    x = paddle.fft.ihfftn(paddle.to_tensor(spec_real))
+    assert tuple(x.shape) == (4, 5)
+    y = paddle.fft.hfftn(x, s=(4, 9))
+    assert y.numpy().dtype.kind == "f"
+    np.testing.assert_allclose(y.numpy(), spec_real, rtol=2e-3,
+                               atol=2e-3)
+    back = paddle.fft.ihfftn(paddle.to_tensor(y.numpy()))
+    np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=2e-3,
+                               atol=2e-3)
+
+
+def test_hfftn_1d_reference_example():
+    """The reference docstring's worked example (fft.py:871)."""
+    x = np.array([2 + 2j, 2 + 2j, 3 + 3j], np.complex64)
+    got = paddle.fft.hfftn(paddle.to_tensor(x))
+    np.testing.assert_allclose(got.numpy(), [9.0, 3.0, 1.0, -5.0],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fftfreq_shift():
+    np.testing.assert_allclose(paddle.fft.fftfreq(8, d=0.5).numpy(),
+                               np.fft.fftfreq(8, d=0.5), rtol=1e-6)
+    np.testing.assert_allclose(paddle.fft.rfftfreq(8).numpy(),
+                               np.fft.rfftfreq(8), rtol=1e-6)
+    x = _rand(4, 6)
+    np.testing.assert_allclose(
+        paddle.fft.fftshift(paddle.to_tensor(x)).numpy(),
+        np.fft.fftshift(x), rtol=1e-6)
+    np.testing.assert_allclose(
+        paddle.fft.ifftshift(paddle.to_tensor(x), axes=1).numpy(),
+        np.fft.ifftshift(x, axes=1), rtol=1e-6)
+
+
+def test_fft_invalid_norm_raises():
+    with pytest.raises(ValueError, match="norm"):
+        paddle.fft.fft(paddle.to_tensor(_rand(4)), norm="bogus")
+
+
+def test_fft_grad_flows():
+    """rfft -> abs -> sum backward reaches the waveform (registry vjp)."""
+    x = paddle.to_tensor(_rand(2, 16))
+    x.stop_gradient = False
+    spec = paddle.fft.rfft(x)
+    mag = paddle.abs(spec) if hasattr(paddle, "abs") else None
+    (spec.real() ** 2).sum().backward() if mag is None else \
+        (mag * mag).sum().backward()
+    assert x.grad is not None
+    assert np.isfinite(x.grad.numpy()).all()
+    assert np.abs(x.grad.numpy()).sum() > 0
+
+
+# -- signal -------------------------------------------------------------
+
+
+def test_frame_overlap_add_roundtrip():
+    x = _rand(3, 64)
+    f = paddle.signal.frame(paddle.to_tensor(x), frame_length=16,
+                            hop_length=16)  # non-overlapping
+    assert tuple(f.shape) == (3, 16, 4)
+    back = paddle.signal.overlap_add(f, hop_length=16)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+
+
+def test_frame_axis0():
+    x = _rand(32)
+    f = paddle.signal.frame(paddle.to_tensor(x), frame_length=8,
+                            hop_length=4, axis=0)
+    assert tuple(f.shape) == (7, 8)
+    np.testing.assert_allclose(f.numpy()[1], x[4:12], rtol=1e-6)
+
+
+def test_overlap_add_matches_manual():
+    frames = _rand(5, 8)  # [n, fl] axis=0
+    got = paddle.signal.overlap_add(paddle.to_tensor(frames),
+                                    hop_length=4, axis=0).numpy()
+    want = np.zeros((4 * 4 + 8,), np.float32)
+    for i in range(5):
+        want[i * 4:i * 4 + 8] += frames[i]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_stft_matches_manual_dft():
+    x = _rand(2, 128)
+    w = np.hanning(32).astype(np.float32)
+    got = paddle.signal.stft(paddle.to_tensor(x), n_fft=32,
+                             hop_length=16, window=paddle.to_tensor(w),
+                             center=False).numpy()
+    # manual: frame, window, rfft
+    n = 1 + (128 - 32) // 16
+    want = np.stack([np.fft.rfft(x[:, i * 16:i * 16 + 32] * w)
+                     for i in range(n)], axis=-1)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_stft_istft_roundtrip():
+    x = _rand(2, 256)
+    w = paddle.to_tensor(np.hanning(64).astype(np.float32))
+    spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=64,
+                              hop_length=16, window=w)
+    back = paddle.signal.istft(spec, n_fft=64, hop_length=16, window=w,
+                               length=256)
+    np.testing.assert_allclose(back.numpy(), x, rtol=2e-3, atol=2e-3)

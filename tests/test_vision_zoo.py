@@ -1,0 +1,76 @@
+"""Vision model zoo: all 14 reference families forward (and one
+trains).  Reference: python/paddle/vision/models/__init__.py — alexnet,
+densenet, googlenet, inceptionv3, lenet, mobilenetv1/v2/v3, resnet
+(+resnext/wide), shufflenetv2, squeezenet, vgg.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models as M
+
+
+def _x(n=1, c=3, hw=64):
+    return paddle.to_tensor(
+        np.random.RandomState(0).randn(n, c, hw, hw).astype("float32"))
+
+
+SMALL_64 = [
+    "mobilenet_v1", "mobilenet_v2", "mobilenet_v3_small",
+    "mobilenet_v3_large", "squeezenet1_0", "squeezenet1_1",
+    "shufflenet_v2_x0_25", "shufflenet_v2_x1_0", "densenet121",
+    "resnet18", "resnext50_32x4d", "wide_resnet50_2", "vgg11",
+]
+
+
+@pytest.mark.parametrize("name", SMALL_64)
+def test_zoo_forward(name):
+    m = getattr(M, name)(num_classes=10)
+    m.eval()
+    y = m(_x())
+    assert tuple(y.shape) == (1, 10)
+    assert np.isfinite(y.numpy()).all()
+
+
+def test_googlenet_aux_heads():
+    g = M.googlenet(num_classes=10)
+    g.train()
+    main, aux1, aux2 = g(_x(hw=224))
+    assert tuple(main.shape) == tuple(aux1.shape) == tuple(aux2.shape) \
+        == (1, 10)
+    g.eval()
+    assert tuple(g(_x(hw=224)).shape) == (1, 10)
+
+
+def test_inception_v3_forward():
+    m = M.inception_v3(num_classes=10)
+    m.eval()
+    assert tuple(m(_x(hw=299)).shape) == (1, 10)
+
+
+def test_zoo_trains():
+    """One representative model takes a full eager train step."""
+    m = M.shufflenet_v2_x0_25(num_classes=4)
+    m.train()
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=m.parameters())
+    x = _x(n=2)
+    labels = paddle.to_tensor(np.array([1, 3], "int64"))
+    losses = []
+    for _ in range(3):
+        loss = paddle.nn.functional.cross_entropy(m(x), labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_zoo_family_count():
+    """Every reference family has a constructor exported."""
+    for fam in ["alexnet", "densenet121", "googlenet", "inception_v3",
+                "LeNet", "mobilenet_v1", "mobilenet_v2",
+                "mobilenet_v3_small", "resnet50", "shufflenet_v2_x1_0",
+                "squeezenet1_0", "vgg16", "resnext101_64x4d",
+                "wide_resnet101_2"]:
+        assert hasattr(M, fam), fam
