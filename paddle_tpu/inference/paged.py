@@ -1,0 +1,246 @@
+"""Paged KV cache + decode attention for serving.
+
+Reference: ``paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu``
+(block/paged KV cache with a block table per sequence) and
+``masked_multihead_attention`` (single-token decode attention against a
+length-masked cache), the two kernels behind the reference Predictor's
+continuous-batching serving path.
+
+TPU-native: the page pool is a static [n_kv, num_pages, page_size, d]
+array per layer (XLA-friendly fixed shape — page capacity plays the
+role of the reference's pre-allocated block pool), the block table is a
+host-side free-list (allocation is control plane, not compute), decode
+attention runs the Pallas ``paged_attention`` TPU kernel over the page
+pool (dense gather fallback off-TPU), and prefill writes whole pages
+with one scatter.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops import registry as _registry
+
+_op = _registry.cached_apply
+
+
+def _on_tpu():
+    return jax.default_backend() == "tpu"
+
+
+# -- decode attention ops ----------------------------------------------
+
+
+def masked_multihead_attention(q, k_cache, v_cache, lengths, name=None):
+    """Single-token decode attention against a dense cache (reference
+    masked_multihead_attention_kernel).
+
+    q: [B, H, D]; k_cache/v_cache: [B, KV, T, D]; lengths: [B] valid
+    token counts.  Returns [B, H, D].  Supports GQA (H % KV == 0).
+    """
+
+    def fn(q, kc, vc, lens):
+        B, H, D = q.shape
+        KV, T = kc.shape[1], kc.shape[2]
+        g = H // KV
+        qg = q.reshape(B, KV, g, D)
+        logits = jnp.einsum("bkgd,bktd->bkgt", qg.astype(jnp.float32),
+                            kc.astype(jnp.float32)) / np.sqrt(D)
+        mask = jnp.arange(T)[None, None, None, :] < \
+            lens[:, None, None, None]
+        logits = jnp.where(mask, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bkgt,bktd->bkgd", p, vc.astype(jnp.float32))
+        return out.reshape(B, H, D).astype(q.dtype)
+
+    wrap = isinstance(q, Tensor)
+    out = _op("masked_multihead_attention", fn,
+              q if wrap else Tensor(jnp.asarray(q)),
+              Tensor(jnp.asarray(k_cache._data if isinstance(k_cache, Tensor)
+                                 else k_cache)),
+              Tensor(jnp.asarray(v_cache._data if isinstance(v_cache, Tensor)
+                                 else v_cache)),
+              Tensor(jnp.asarray(lengths._data if isinstance(lengths, Tensor)
+                                 else lengths)))
+    return out if wrap else out._data
+
+
+def _dense_paged_attention(q, k_pages, v_pages, lengths, page_indices):
+    """Reference semantics of the Pallas kernel, in plain XLA ops —
+    the off-TPU fallback and the parity oracle for tests.
+
+    q [B, H, D]; k/v_pages [KV, P, ps, D]; page_indices [B, pages_per_seq].
+    """
+    B, H, D = q.shape
+    KV, _, ps, _ = k_pages.shape
+    pages_per_seq = page_indices.shape[1]
+    T = pages_per_seq * ps
+    # gather each sequence's pages -> dense [B, KV, T, D]
+    kc = jnp.swapaxes(k_pages[:, page_indices], 0, 1)  # [B, KV, pps, ps, D]
+    vc = jnp.swapaxes(v_pages[:, page_indices], 0, 1)
+    kc = kc.reshape(B, KV, T, D)
+    vc = vc.reshape(B, KV, T, D)
+    g = H // KV
+    qg = q.reshape(B, KV, g, D)
+    logits = jnp.einsum("bkgd,bktd->bkgt", qg.astype(jnp.float32),
+                        kc.astype(jnp.float32)) / np.sqrt(D)
+    mask = jnp.arange(T)[None, None, None, :] < \
+        lengths[:, None, None, None]
+    logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgt,bktd->bkgd", p, vc.astype(jnp.float32))
+    return out.reshape(B, H, D).astype(q.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
+                           pages_per_compute_block=4):
+    """Decode attention over the page pool.  On TPU this is the Pallas
+    ``paged_attention`` kernel (flash-style, page-gathering in VMEM);
+    elsewhere the dense-gather fallback."""
+    q = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    page_indices = jnp.asarray(page_indices, jnp.int32)
+    if _on_tpu():
+        from jax.experimental.pallas.ops.tpu.paged_attention import (
+            paged_attention,
+        )
+
+        blk = min(pages_per_compute_block, page_indices.shape[1])
+        while page_indices.shape[1] % blk:
+            blk -= 1
+        # The stock kernel mixes int32/int64 under global x64 mode —
+        # trace it x64-off (same guard as the flash-attention wrappers).
+        # It also applies NO logits scaling: pre-scale q by 1/sqrt(D).
+        q = q / np.sqrt(q.shape[-1])
+        with jax.enable_x64(False):
+            return paged_attention(
+                jnp.asarray(q), jnp.asarray(k_pages),
+                jnp.asarray(v_pages), jnp.asarray(lengths, jnp.int32),
+                jnp.asarray(page_indices, jnp.int32),
+                pages_per_compute_block=blk)
+    return _dense_paged_attention(q, k_pages, v_pages, lengths,
+                                  page_indices)
+
+
+# -- block-table cache manager ------------------------------------------
+
+
+class PagedKVCache:
+    """Block-table KV cache (reference block_multi_head_attention's
+    pre-allocated block pool + per-sequence block table).
+
+    The pools are [L, KV, num_pages, page_size, D] device arrays; page
+    allocation is a host-side free list (control plane).  Sequences are
+    dense slots 0..max_seqs-1 with a fixed-size page table row each —
+    static shapes end-to-end, so every compute step is one cached XLA
+    program.
+    """
+
+    def __init__(self, n_layers, n_kv_heads, head_dim, num_pages,
+                 page_size=16, max_seqs=8, dtype=jnp.bfloat16):
+        self.n_layers = n_layers
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.max_pages_per_seq = num_pages // max_seqs
+        self.max_seqs = max_seqs
+        shape = (n_layers, n_kv_heads, num_pages, page_size, head_dim)
+        self.k_pages = jnp.zeros(shape, dtype)
+        self.v_pages = jnp.zeros(shape, dtype)
+        self._free = list(range(num_pages - 1, -1, -1))
+        # page table: [max_seqs, max_pages_per_seq] int32 (0-padded)
+        self.page_table = np.zeros((max_seqs, self.max_pages_per_seq),
+                                   np.int32)
+        self.lengths = np.zeros((max_seqs,), np.int32)
+        self._active = [False] * max_seqs
+
+    # -- control plane (host) ------------------------------------------
+
+    def allocate(self) -> int:
+        """Claim a sequence slot."""
+        for s in range(self.max_seqs):
+            if not self._active[s]:
+                self._active[s] = True
+                self.lengths[s] = 0
+                return s
+        raise RuntimeError("no free sequence slots (continuous batching "
+                           "is full) — free() a finished sequence first")
+
+    def free(self, seq: int) -> None:
+        """Return a sequence's pages to the pool."""
+        n_used = -(-int(self.lengths[seq]) // self.page_size)
+        for i in range(n_used):
+            self._free.append(int(self.page_table[seq, i]))
+        self.page_table[seq] = 0
+        self.lengths[seq] = 0
+        self._active[seq] = False
+
+    def _ensure_capacity(self, seq: int, new_len: int) -> None:
+        have = -(-int(self.lengths[seq]) // self.page_size)
+        need = -(-new_len // self.page_size)
+        if need > self.max_pages_per_seq:
+            raise RuntimeError(
+                f"sequence {seq} needs {need} pages > per-seq budget "
+                f"{self.max_pages_per_seq}")
+        # Check before popping: a partial allocation would leak pages
+        # (they'd sit in page_table but outside lengths, so free()
+        # would never return them).
+        if need - have > len(self._free):
+            raise RuntimeError("KV page pool exhausted")
+        for i in range(have, need):
+            self.page_table[seq, i] = self._free.pop()
+
+    # -- data plane (device) -------------------------------------------
+
+    def prefill(self, seq: int, k, v) -> None:
+        """Write a prompt's KV: k/v [L, KV, T, D]."""
+        k = jnp.asarray(k, self.k_pages.dtype)
+        v = jnp.asarray(v, self.v_pages.dtype)
+        T = k.shape[2]
+        self._ensure_capacity(seq, T)
+        ps = self.page_size
+        n_full = T // ps
+        for i in range(n_full):  # whole-page scatters
+            pid = int(self.page_table[seq, i])
+            self.k_pages = self.k_pages.at[:, :, pid].set(
+                k[:, :, i * ps:(i + 1) * ps])
+            self.v_pages = self.v_pages.at[:, :, pid].set(
+                v[:, :, i * ps:(i + 1) * ps])
+        rem = T - n_full * ps
+        if rem:
+            pid = int(self.page_table[seq, n_full])
+            self.k_pages = self.k_pages.at[:, :, pid, :rem].set(
+                k[:, :, n_full * ps:])
+            self.v_pages = self.v_pages.at[:, :, pid, :rem].set(
+                v[:, :, n_full * ps:])
+        self.lengths[seq] = T
+
+    def append(self, seqs, k, v) -> None:
+        """Decode-step write: one new token per listed sequence.
+        k/v: [L, KV, B, D] for B = len(seqs)."""
+        k = jnp.asarray(k, self.k_pages.dtype)
+        v = jnp.asarray(v, self.v_pages.dtype)
+        pids, offs = [], []
+        for j, s in enumerate(seqs):
+            pos = int(self.lengths[s])
+            self._ensure_capacity(s, pos + 1)
+            pids.append(int(self.page_table[s, pos // self.page_size]))
+            offs.append(pos % self.page_size)
+            self.lengths[s] = pos + 1
+        pids = jnp.asarray(pids)
+        offs = jnp.asarray(offs)
+        # advanced indexing: [L, KV, B, D] written at (page, offset)[B]
+        self.k_pages = self.k_pages.at[:, :, pids, offs].set(k)
+        self.v_pages = self.v_pages.at[:, :, pids, offs].set(v)
+
+    def attend(self, layer: int, q, seqs,
+               pages_per_compute_block=4):
+        """Decode attention for one layer: q [B, H, D] over the listed
+        sequences' pages."""
+        table = jnp.asarray(self.page_table[seqs])
+        lens = jnp.asarray(self.lengths[seqs])
+        return paged_decode_attention(
+            q, self.k_pages[layer], self.v_pages[layer], lens, table,
+            pages_per_compute_block=pages_per_compute_block)

@@ -50,13 +50,39 @@ class _Guard:
         return treedef, tuple(sig)
 
 
+_TO_STATIC_ENABLED = True
+
+
+def enable_to_static(flag: bool) -> None:
+    """Global dygraph/static switch (reference
+    ``python/paddle/jit/api.py`` enable_to_static / ProgramTranslator
+    ``enable``): False makes every StaticFunction run its original
+    eager body."""
+    global _TO_STATIC_ENABLED
+    _TO_STATIC_ENABLED = bool(flag)
+
+
+# jax error types that mean "the traced python needed a concrete value"
+# — i.e. data-dependent control flow the whole-graph trace can't honor.
+_BREAK_ERRORS = (
+    jax.errors.TracerBoolConversionError,
+    jax.errors.TracerArrayConversionError,
+    jax.errors.TracerIntegerConversionError,
+    jax.errors.ConcretizationTypeError,
+)
+
+_FALLBACK = object()  # cache sentinel: this guard key runs eagerly
+
+
 class StaticFunction:
     def __init__(self, function, layer=None, input_spec=None,
                  full_graph=True):
         self._fn = function
         self._layer = layer
         self._input_spec = input_spec
+        self._full_graph = full_graph
         self._cache = {}
+        self._warned_break = False
         functools.update_wrapper(self, function)
 
     def _state_tensors(self):
@@ -66,10 +92,42 @@ class StaticFunction:
         tensors += [b for _, b in self._layer.named_buffers()]
         return tensors
 
+    def _graph_break(self, key, err):
+        """Record the SOT-analog decision: this guard key cannot be one
+        whole graph (data-dependent python control flow), so it executes
+        eagerly — each registry op is still its own cached XLA program,
+        the TPU analog of SOT's per-segment subgraphs
+        (reference program_translator.py:711 fallback)."""
+        if self._full_graph:
+            raise RuntimeError(
+                "to_static(full_graph=True): the traced function needs a "
+                "concrete tensor value for python control flow "
+                f"({type(err).__name__}). Rewrite with paddle.where/"
+                "lax.cond-style ops, or use full_graph=False to let this "
+                "call site fall back to eager per-op execution.") from err
+        self._cache[key] = _FALLBACK
+        if not self._warned_break:
+            self._warned_break = True
+            import warnings
+
+            warnings.warn(
+                f"to_static: graph break in "
+                f"{getattr(self._fn, '__qualname__', self._fn)} — "
+                f"{type(err).__name__}: a tensor value drives python "
+                "control flow. Falling back to eager per-op execution "
+                "for this input signature (per-op XLA programs stay "
+                "jit-cached). Use jax-style ops (paddle.where, masking) "
+                "to recover whole-graph compilation.",
+                stacklevel=3)
+
     def __call__(self, *args, **kwargs):
+        if not _TO_STATIC_ENABLED:
+            return self._fn(*args, **kwargs)
         state = self._state_tensors()
         key = _Guard.key(args, kwargs)
         entry = self._cache.get(key)
+        if entry is _FALLBACK:
+            return self._fn(*args, **kwargs)
         if entry is None:
             entry = self._compile(args, kwargs, state)
             self._cache[key] = entry
@@ -91,7 +149,11 @@ class StaticFunction:
         need_grad = engine.is_grad_enabled() and bool(diff_idx)
 
         if not need_grad:
-            out_datas, new_state = jitted(sdatas, idatas)
+            try:
+                out_datas, new_state = jitted(sdatas, idatas)
+            except _BREAK_ERRORS as e:
+                self._graph_break(key, e)
+                return self._fn(*args, **kwargs)
             for t, d in zip(state, new_state):
                 t._data = d
             return jax.tree.map(
@@ -109,8 +171,12 @@ class StaticFunction:
                 full[i] = d
             return jitted(full[:n_state], full[n_state:])
 
-        out_datas, vjp_fn, new_state = jax.vjp(
-            f, *[all_datas[i] for i in diff_idx], has_aux=True)
+        try:
+            out_datas, vjp_fn, new_state = jax.vjp(
+                f, *[all_datas[i] for i in diff_idx], has_aux=True)
+        except _BREAK_ERRORS as e:
+            self._graph_break(key, e)
+            return self._fn(*args, **kwargs)
         for t, d in zip(state, new_state):
             t._data = d
 
@@ -177,18 +243,28 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True, **kwargs):
-    """Reference: python/paddle/jit/api.py:197."""
+              backend=None, full_graph=False, **kwargs):
+    """Reference: python/paddle/jit/api.py:197.  Like the reference's
+    default SOT path, ``full_graph=False`` allows graph breaks: a call
+    site whose trace needs concrete tensor values falls back to eager
+    per-op execution (warned once); ``full_graph=True`` raises instead
+    (the reference's AST whole-graph contract)."""
 
     def decorate(fn):
+        if getattr(fn, "_not_to_static", False):
+            return fn
         if isinstance(fn, Layer):
+            if getattr(fn.forward, "_not_to_static", False):
+                return fn
             sf = StaticFunction(fn.forward, layer=fn,
-                                input_spec=input_spec)
+                                input_spec=input_spec,
+                                full_graph=full_graph)
             fn.forward = sf
             return fn
         layer = getattr(fn, "__self__", None)
         layer = layer if isinstance(layer, Layer) else None
-        return StaticFunction(fn, layer=layer, input_spec=input_spec)
+        return StaticFunction(fn, layer=layer, input_spec=input_spec,
+                              full_graph=full_graph)
 
     if function is not None:
         return decorate(function)

@@ -41,15 +41,15 @@ class InvertedResidual(nn.Layer):
         if exp_ch != in_ch:
             layers += [nn.Conv2D(in_ch, exp_ch, 1, bias_attr=False),
                        nn.BatchNorm2D(exp_ch), act_layer()]
+        # reference block order: dw-conv -> BN -> act -> SE -> pw-conv
         layers += [nn.Conv2D(exp_ch, exp_ch, kernel, stride=stride,
                              padding=kernel // 2, groups=exp_ch,
                              bias_attr=False),
-                   nn.BatchNorm2D(exp_ch)]
+                   nn.BatchNorm2D(exp_ch), act_layer()]
         if use_se:
             layers.append(SqueezeExcitation(
                 exp_ch, _make_divisible(exp_ch // 4)))
-        layers += [act_layer(),
-                   nn.Conv2D(exp_ch, out_ch, 1, bias_attr=False),
+        layers += [nn.Conv2D(exp_ch, out_ch, 1, bias_attr=False),
                    nn.BatchNorm2D(out_ch)]
         self.block = nn.Sequential(*layers)
 
@@ -104,7 +104,8 @@ class MobileNetV3(nn.Layer):
                                            se, act))
             in_ch = out_ch
         self.blocks = nn.Sequential(*blocks)
-        last_conv = _make_divisible(6 * in_ch * scale)
+        # in_ch is already scale-adjusted; 6x expansion only.
+        last_conv = _make_divisible(6 * in_ch)
         self.lastconv = nn.Sequential(
             nn.Conv2D(in_ch, last_conv, 1, bias_attr=False),
             nn.BatchNorm2D(last_conv), nn.Hardswish())

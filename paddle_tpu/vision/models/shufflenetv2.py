@@ -16,26 +16,30 @@ def channel_shuffle(x, groups):
     return ops.reshape(x, [n, c, h, w])
 
 
-def _conv_bn_act(in_ch, out_ch, kernel, stride=1, groups=1, act=True):
+def _act_layer(act):
+    return nn.Swish() if act == "swish" else nn.ReLU()
+
+
+def _conv_bn_act(in_ch, out_ch, kernel, stride=1, groups=1, act="relu"):
     layers = [nn.Conv2D(in_ch, out_ch, kernel, stride=stride,
                         padding=kernel // 2, groups=groups,
                         bias_attr=False),
               nn.BatchNorm2D(out_ch)]
-    if act:
-        layers.append(nn.ReLU())
+    if act is not None:
+        layers.append(_act_layer(act))
     return nn.Sequential(*layers)
 
 
 class InvertedResidual(nn.Layer):
     """Stride-1 unit: split channels, transform one branch, shuffle."""
 
-    def __init__(self, channels):
+    def __init__(self, channels, act="relu"):
         super().__init__()
         half = channels // 2
         self.branch = nn.Sequential(
-            _conv_bn_act(half, half, 1),
-            _conv_bn_act(half, half, 3, groups=half, act=False),
-            _conv_bn_act(half, half, 1))
+            _conv_bn_act(half, half, 1, act=act),
+            _conv_bn_act(half, half, 3, groups=half, act=None),
+            _conv_bn_act(half, half, 1, act=act))
 
     def forward(self, x):
         half = x.shape[1] // 2
@@ -48,17 +52,17 @@ class InvertedResidual(nn.Layer):
 class InvertedResidualDS(nn.Layer):
     """Stride-2 (downsampling) unit: both branches transform."""
 
-    def __init__(self, in_ch, out_ch):
+    def __init__(self, in_ch, out_ch, act="relu"):
         super().__init__()
         half = out_ch // 2
         self.branch1 = nn.Sequential(
             _conv_bn_act(in_ch, in_ch, 3, stride=2, groups=in_ch,
-                         act=False),
-            _conv_bn_act(in_ch, half, 1))
+                         act=None),
+            _conv_bn_act(in_ch, half, 1, act=act))
         self.branch2 = nn.Sequential(
-            _conv_bn_act(in_ch, half, 1),
-            _conv_bn_act(half, half, 3, stride=2, groups=half, act=False),
-            _conv_bn_act(half, half, 1))
+            _conv_bn_act(in_ch, half, 1, act=act),
+            _conv_bn_act(half, half, 3, stride=2, groups=half, act=None),
+            _conv_bn_act(half, half, 1, act=act))
 
     def forward(self, x):
         out = ops.concat([self.branch1(x), self.branch2(x)], axis=1)
@@ -81,18 +85,18 @@ class ShuffleNetV2(nn.Layer):
         stage_out = _STAGE_OUT[scale]
         stage_repeats = [4, 8, 4]
 
-        self.conv1 = _conv_bn_act(3, stage_out[0], 3, stride=2)
+        self.conv1 = _conv_bn_act(3, stage_out[0], 3, stride=2, act=act)
         self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
         blocks = []
         in_ch = stage_out[0]
         for stage, repeats in enumerate(stage_repeats):
             out_ch = stage_out[stage + 1]
-            blocks.append(InvertedResidualDS(in_ch, out_ch))
+            blocks.append(InvertedResidualDS(in_ch, out_ch, act=act))
             for _ in range(repeats - 1):
-                blocks.append(InvertedResidual(out_ch))
+                blocks.append(InvertedResidual(out_ch, act=act))
             in_ch = out_ch
         self.blocks = nn.Sequential(*blocks)
-        self.conv_last = _conv_bn_act(in_ch, stage_out[-1], 1)
+        self.conv_last = _conv_bn_act(in_ch, stage_out[-1], 1, act=act)
         if with_pool:
             self.pool = nn.AdaptiveAvgPool2D((1, 1))
         if num_classes > 0:

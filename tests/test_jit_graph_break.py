@@ -1,0 +1,132 @@
+"""to_static graph-break fallback (reference SOT semantics:
+jit/api.py:197, program_translator.py:711 — data-dependent python
+control flow falls back per-segment instead of hard-failing; here the
+segments are the per-op XLA programs of eager dispatch).
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn
+
+
+class BranchyNet(nn.Layer):
+    """Data-dependent python `if` on a tensor value — untraceable as one
+    whole graph."""
+
+    def __init__(self):
+        super().__init__()
+        self.pos = nn.Linear(4, 4)
+        self.neg = nn.Linear(4, 4)
+
+    def forward(self, x):
+        if float(x.numpy().mean()) > 0:  # concrete value needed
+            return self.pos(x)
+        return self.neg(x)
+
+
+def test_graph_break_falls_back_and_is_correct():
+    paddle.seed(0)
+    net = BranchyNet()
+    ref_pos = net.pos
+    ref_neg = net.neg
+    sf = jit.to_static(net)
+    xp = paddle.to_tensor(np.full((2, 4), 0.5, "float32"))
+    xn = paddle.to_tensor(np.full((2, 4), -0.5, "float32"))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with paddle.no_grad():
+            yp = sf(xp)
+            yn = sf(xn)
+        assert any("graph break" in str(x.message) for x in w)
+    np.testing.assert_allclose(yp.numpy(), ref_pos(xp).numpy(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(yn.numpy(), ref_neg(xn).numpy(),
+                               rtol=1e-5)
+
+
+def test_graph_break_training_works():
+    """Backward flows through the eager fallback path."""
+    paddle.seed(1)
+    net = jit.to_static(BranchyNet())
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    x = paddle.to_tensor(np.full((2, 4), 0.5, "float32"))
+    losses = []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for _ in range(4):
+            loss = (net(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0], losses
+
+
+def test_full_graph_true_raises():
+    net = jit.to_static(BranchyNet(), full_graph=True)
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    with pytest.raises(RuntimeError, match="full_graph"):
+        with paddle.no_grad():
+            net(x)
+
+
+def test_clean_function_stays_compiled_no_warning():
+    """A traceable forward compiles whole-graph — no break warning."""
+    paddle.seed(2)
+    net = jit.to_static(nn.Linear(4, 2))
+    x = paddle.to_tensor(np.ones((3, 4), "float32"))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with paddle.no_grad():
+            y1 = net(x)
+            y2 = net(x)
+        assert not any("graph break" in str(x.message) for x in w)
+    np.testing.assert_allclose(y1.numpy(), y2.numpy(), rtol=1e-6)
+    # whole-graph entry cached (not the fallback sentinel)
+    assert all(e is not jit._FALLBACK
+               for e in net.forward._cache.values())
+    assert len(net.forward._cache) == 1
+
+
+def test_enable_to_static_global_switch():
+    calls = []
+
+    @jit.to_static
+    def f(x):
+        calls.append(1)
+        return x * 2
+
+    x = paddle.to_tensor(np.ones(3, "float32"))
+    try:
+        jit.enable_to_static(False)
+        with paddle.no_grad():
+            y = f(x)
+        np.testing.assert_allclose(y.numpy(), 2 * np.ones(3), rtol=1e-6)
+    finally:
+        jit.enable_to_static(True)
+
+
+def test_not_to_static_honored():
+    @jit.not_to_static
+    def f(x):
+        return x + 1
+
+    g = jit.to_static(f)
+    assert g is f
+
+
+def test_break_cache_is_per_signature():
+    """A breaking signature falls back; the cache records it once."""
+    net = jit.to_static(BranchyNet())
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with paddle.no_grad():
+            net(x)
+            net(x)
+    vals = list(net.forward._cache.values())
+    assert vals.count(jit._FALLBACK) == 1

@@ -1,0 +1,147 @@
+"""Paged KV cache + decode attention (reference
+block_multi_head_attention / masked_multihead_attention serving
+kernels).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.paged import (
+    PagedKVCache, _dense_paged_attention, masked_multihead_attention,
+    paged_decode_attention,
+)
+
+
+def _dense_ref(q, kc, vc, lens):
+    """Independent numpy oracle."""
+    B, H, D = q.shape
+    KV, T = kc.shape[1], kc.shape[2]
+    g = H // KV
+    out = np.zeros_like(q, np.float32)
+    for b in range(B):
+        for h in range(H):
+            kv = h // g
+            lg = (q[b, h].astype(np.float64)
+                  @ kc[b, kv, :lens[b]].astype(np.float64).T) / np.sqrt(D)
+            p = np.exp(lg - lg.max())
+            p /= p.sum()
+            out[b, h] = p @ vc[b, kv, :lens[b]].astype(np.float64)
+    return out
+
+
+def test_masked_multihead_attention_matches_oracle():
+    rng = np.random.RandomState(0)
+    B, H, KV, T, D = 3, 8, 4, 10, 16
+    q = rng.randn(B, H, D).astype(np.float32)
+    kc = rng.randn(B, KV, T, D).astype(np.float32)
+    vc = rng.randn(B, KV, T, D).astype(np.float32)
+    lens = np.array([10, 7, 3], np.int32)
+    got = masked_multihead_attention(
+        paddle.to_tensor(q), paddle.to_tensor(kc), paddle.to_tensor(vc),
+        paddle.to_tensor(lens))
+    np.testing.assert_allclose(got.numpy(), _dense_ref(q, kc, vc, lens),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_paged_equals_dense():
+    """The paged layout computes the same attention as a dense cache."""
+    rng = np.random.RandomState(1)
+    B, H, KV, D, ps, pps = 2, 4, 2, 8, 4, 3
+    T = ps * pps
+    q = rng.randn(B, H, D).astype(np.float32)
+    P = 16
+    k_pages = rng.randn(KV, P, ps, D).astype(np.float32)
+    v_pages = rng.randn(KV, P, ps, D).astype(np.float32)
+    table = np.array([[3, 7, 1], [2, 9, 4]], np.int32)
+    lens = np.array([T, 6], np.int32)
+
+    got = paged_decode_attention(q, jnp.asarray(k_pages),
+                                 jnp.asarray(v_pages), lens, table)
+    # build the dense cache by hand and compare with the oracle
+    kc = np.stack([k_pages[:, table[b]].reshape(KV, T, D)
+                   for b in range(B)])
+    vc = np.stack([v_pages[:, table[b]].reshape(KV, T, D)
+                   for b in range(B)])
+    np.testing.assert_allclose(np.asarray(got),
+                               _dense_ref(q, kc, vc, lens),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_cache_prefill_append_attend():
+    """End-to-end: prefill a prompt, append decode tokens, attention
+    equals dense attention over the concatenated KV."""
+    rng = np.random.RandomState(2)
+    L, KV, D = 2, 2, 8
+    cache = PagedKVCache(n_layers=L, n_kv_heads=KV, head_dim=D,
+                         num_pages=32, page_size=4, max_seqs=4,
+                         dtype=jnp.float32)
+    s = cache.allocate()
+    T0 = 6
+    k0 = rng.randn(L, KV, T0, D).astype(np.float32)
+    v0 = rng.randn(L, KV, T0, D).astype(np.float32)
+    cache.prefill(s, k0, v0)
+    assert cache.lengths[s] == T0
+
+    k_steps, v_steps = [], []
+    for _ in range(3):
+        kt = rng.randn(L, KV, 1, D).astype(np.float32)
+        vt = rng.randn(L, KV, 1, D).astype(np.float32)
+        cache.append([s], kt, vt)  # [L, KV, B=1, D]
+        k_steps.append(kt)
+        v_steps.append(vt)
+    assert cache.lengths[s] == T0 + 3
+
+    q = rng.randn(1, 4, D).astype(np.float32)
+    got = cache.attend(1, q, [s])
+    k_all = np.concatenate([k0] + k_steps, axis=2)
+    v_all = np.concatenate([v0] + v_steps, axis=2)
+    np.testing.assert_allclose(
+        np.asarray(got),
+        _dense_ref(q, k_all[1][None], v_all[1][None],
+                   np.array([T0 + 3])),
+        rtol=2e-4, atol=2e-4)
+
+
+def test_cache_allocation_lifecycle():
+    cache = PagedKVCache(n_layers=1, n_kv_heads=1, head_dim=4,
+                         num_pages=8, page_size=2, max_seqs=2,
+                         dtype=jnp.float32)
+    a = cache.allocate()
+    b = cache.allocate()
+    with pytest.raises(RuntimeError, match="slots"):
+        cache.allocate()
+    k = np.zeros((1, 1, 8, 4), np.float32)
+    cache.prefill(a, k, k)  # 8 tokens = 4 pages = per-seq budget
+    with pytest.raises(RuntimeError, match="budget"):
+        cache._ensure_capacity(a, 9)
+    cache.free(a)
+    c = cache.allocate()
+    assert c == a  # slot recycled
+    assert len(cache._free) + 4 == 8 or len(cache._free) == 8
+
+
+def test_pool_exhaustion_raises():
+    cache = PagedKVCache(n_layers=1, n_kv_heads=1, head_dim=4,
+                         num_pages=2, page_size=2, max_seqs=1,
+                         dtype=jnp.float32)
+    s = cache.allocate()
+    cache._free = []  # simulate pool pressure
+    with pytest.raises(RuntimeError, match="exhausted"):
+        cache._ensure_capacity(s, 1)
+
+
+def test_failed_allocation_leaks_no_pages():
+    """Atomic capacity check: a failed _ensure_capacity leaves the free
+    list intact (review: partial pops leaked pages)."""
+    cache = PagedKVCache(n_layers=1, n_kv_heads=1, head_dim=4,
+                         num_pages=4, page_size=2, max_seqs=1,
+                         dtype=jnp.float32)
+    s = cache.allocate()
+    cache._free = cache._free[:1]  # only one page left
+    before = list(cache._free)
+    with pytest.raises(RuntimeError, match="exhausted"):
+        cache._ensure_capacity(s, 6)  # needs 3 pages
+    assert cache._free == before
+    assert (cache.page_table[s] == 0).all()

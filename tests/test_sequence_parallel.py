@@ -1,0 +1,146 @@
+"""Sequence parallelism (Megatron SP, VERDICT r2 row 41): the
+Column/RowSequenceParallelLinear pair trains with parity vs single
+device over an mp mesh, and the inter-linear activation really is
+sequence-sharded (reduce-scatter placement), not just replicated.
+
+Reference: fleet/utils/sequence_parallel_utils.py:85,97,111,427.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.fleet import DistributedStrategy, fleet
+from paddle_tpu.distributed.fleet.sequence_parallel_utils import (
+    ColumnSequenceParallelLinear, GatherOp, RowSequenceParallelLinear,
+    ScatterOp, mark_as_sequence_parallel_parameter,
+    register_sequence_parallel_allreduce_hooks,
+)
+from paddle_tpu.models.training import CompiledTrainStep
+
+pytestmark = pytest.mark.skipif(jax.device_count() < 8,
+                                reason="needs the 8-device CPU mesh")
+
+
+class SPBlock(nn.Layer):
+    """norm -> [seq-scatter] -> col-linear(gather seq) -> gelu ->
+    row-linear(reduce-scatter seq) -> [seq-gather] — the Megatron SP
+    transformer-MLP pattern."""
+
+    def __init__(self, hidden, ffn):
+        super().__init__()
+        self.norm = nn.LayerNorm(hidden)
+        mark_as_sequence_parallel_parameter(self.norm.weight)
+        mark_as_sequence_parallel_parameter(self.norm.bias)
+        self.up = ColumnSequenceParallelLinear(hidden, ffn,
+                                               gather_output=False)
+        self.act = nn.GELU()
+        self.down = RowSequenceParallelLinear(ffn, hidden,
+                                              input_is_parallel=True) \
+            if _row_takes_input_is_parallel() else \
+            RowSequenceParallelLinear(ffn, hidden)
+
+    def forward(self, x):          # x: [S, B, H] seq-major like Megatron
+        h = ScatterOp.apply(self.norm(x))
+        h = self.act(self.up(h))
+        h = self.down(h)
+        return GatherOp.apply(h)
+
+
+def _row_takes_input_is_parallel():
+    import inspect
+
+    from paddle_tpu.distributed.fleet.mpu import RowParallelLinear
+
+    return "input_is_parallel" in inspect.signature(
+        RowParallelLinear.__init__).parameters
+
+
+class SPNet(nn.Layer):
+    def __init__(self, hidden=16, ffn=32):
+        super().__init__()
+        self.block = SPBlock(hidden, ffn)
+
+    def forward(self, x, y):
+        out = self.block(x)
+        return ((out - y) ** 2).mean()
+
+
+def _init_mp(mp=4, dp=2):
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp}
+    fleet.init(is_collective=True, strategy=strategy)
+    return fleet.get_hybrid_communicate_group()
+
+
+def _reset():
+    fleet.init(is_collective=True, strategy=DistributedStrategy())
+
+
+def test_sequence_parallel_train_parity():
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 4, 16).astype(np.float32)   # [S, B, H]
+    y = rng.randn(8, 4, 16).astype(np.float32)
+
+    hcg = _init_mp()
+    paddle.seed(5)
+    net = SPNet()
+    sd = {k: v.numpy().copy() for k, v in net.state_dict().items()}
+    step = CompiledTrainStep(net, lr=1e-2, mesh=hcg.mesh, donate=False)
+    sharded = [float(step.step(x, y)) for _ in range(3)]
+
+    _reset()
+    paddle.seed(5)
+    net2 = SPNet()
+    net2.set_state_dict({k: paddle.to_tensor(v) for k, v in sd.items()})
+    single = CompiledTrainStep(net2, lr=1e-2, mesh=None, donate=False)
+    want = [float(single.step(x, y)) for _ in range(3)]
+
+    np.testing.assert_allclose(sharded, want, rtol=2e-4, atol=1e-6)
+    assert sharded[-1] < sharded[0]
+
+
+def test_sp_activation_actually_seq_sharded():
+    """Inside the traced program the scattered activation carries a
+    Shard(seq-dim) constraint over the mp axis."""
+    hcg = _init_mp()
+    try:
+        seen = {}
+
+        def probe(x):
+            h = ScatterOp.apply(x)
+
+            def cb(sharding):
+                seen["spec"] = sharding.spec
+
+            jax.debug.inspect_array_sharding(h._data, callback=cb)
+            return h
+
+        from paddle_tpu.core.tensor import Tensor
+
+        def fn(data):
+            return probe(Tensor(data))._data
+
+        x = jnp.zeros((8, 4, 16), jnp.float32)
+        jax.jit(fn)(x)
+        assert "spec" in seen
+        assert "mp" in str(seen["spec"]), seen["spec"]
+    finally:
+        _reset()
+
+
+def test_register_hooks_is_coherent():
+    """The hook registrar accepts a marked model (GSPMD reduces SP-param
+    grads in-graph; the API records the marks and returns)."""
+    _init_mp()
+    try:
+        net = SPNet()
+        register_sequence_parallel_allreduce_hooks(net)
+        marked = [p for _, p in net.named_parameters()
+                  if getattr(p, "is_sequence_parallel", False)]
+        assert len(marked) == 2
+    finally:
+        _reset()

@@ -74,3 +74,27 @@ def test_zoo_family_count():
                 "squeezenet1_0", "vgg16", "resnext101_64x4d",
                 "wide_resnet101_2"]:
         assert hasattr(M, fam), fam
+
+
+def test_shufflenet_swish_differs_from_relu():
+    """The swish variant builds a genuinely different network (review:
+    act was silently ignored)."""
+    paddle.seed(7)
+    a = M.shufflenet_v2_swish(num_classes=4)
+    paddle.seed(7)
+    b = M.shufflenet_v2_x1_0(num_classes=4)
+    a.eval(); b.eval()
+    x = _x()
+    d = np.abs(a(x).numpy() - b(x).numpy()).max()
+    assert d > 1e-5, "swish variant identical to relu"
+
+
+def test_mobilenetv3_scale_half_width():
+    """scale=0.5: last conv is 6x the scaled channel count, not 6x
+    twice-scaled (review regression)."""
+    m = M.MobileNetV3Large(scale=0.5, num_classes=10)
+    # reference: in_ch = make_div(160*0.5) = 80 -> last_conv = 480
+    w = m.lastconv[0].weight
+    assert w.shape[0] == 480, w.shape
+    m.eval()
+    assert tuple(m(_x()).shape) == (1, 10)
