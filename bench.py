@@ -140,7 +140,61 @@ def main():
         except Exception as e:
             print(f"bert: FAILED: {e}", file=sys.stderr)
             result["bert_base_squad"] = {"error": str(e)[:200]}
+    if not on_cpu and os.environ.get("PT_BENCH_SKIP_UNET") != "1":
+        try:
+            result["sd_unet"] = _bench_unet(jax)
+        except Exception as e:
+            print(f"unet: FAILED: {e}", file=sys.stderr)
+            result["sd_unet"] = {"error": str(e)[:200]}
     print(json.dumps(result))
+
+
+def _bench_unet(jax):
+    """BASELINE config 5: SD v1.5 UNet train step — noise-prediction
+    MSE over [B, 4, 32, 32] latents + [B, 77, 768] text context,
+    bf16 compute, AdamW with bf16 moments (memory pressure is the
+    point of this config)."""
+    import gc
+
+    from paddle_tpu import nn
+    from paddle_tpu.models.training import CompiledTrainStep
+    from paddle_tpu.models.unet import UNet2DConditionModel
+
+    gc.collect()
+
+    class UNetTrain(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.unet = UNet2DConditionModel()
+
+        def forward(self, latents, t, ctx, noise):
+            pred = self.unet(latents, t, ctx)
+            return ((pred - noise) ** 2).mean()
+
+    with jax.default_device(jax.devices("cpu")[0]):
+        model = UNetTrain()
+    n_params = model.unet.num_params()
+    model.train()
+    step = CompiledTrainStep(model, lr=1e-4, compute_dtype="bfloat16",
+                             moments_dtype="bfloat16",
+                             state_device=jax.devices()[0])
+    for _, p in model.named_parameters():
+        p._data = None
+    gc.collect()
+    batch = int(os.environ.get("PT_BENCH_UNET_BATCH", "4"))
+    rng = np.random.RandomState(0)
+    lat = rng.randn(batch, 4, 32, 32).astype(np.float32)
+    t = rng.randint(0, 1000, (batch,)).astype(np.int32)
+    ctx = rng.randn(batch, 77, 768).astype(np.float32)
+    noise = rng.randn(batch, 4, 32, 32).astype(np.float32)
+    print("unet: compiling (~810M params)...", file=sys.stderr)
+    dt, loss = _time_steps(step.step, (lat, t, ctx, noise), 5, "unet")
+    samples_s = batch / dt
+    print(f"unet: step {dt * 1e3:.1f} ms, {samples_s:.1f} samples/s",
+          file=sys.stderr)
+    return {"value": round(samples_s, 2), "unit": "samples/s/chip",
+            "batch": batch, "latent": [4, 32, 32],
+            "model_params": n_params}
 
 
 def _bench_bert(jax):

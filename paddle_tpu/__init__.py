@@ -60,6 +60,7 @@ from . import audio  # noqa: F401
 from . import text  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
+from . import static  # noqa: F401
 from . import geometric  # noqa: F401
 from . import onnx  # noqa: F401
 from .hapi import Model  # noqa: F401

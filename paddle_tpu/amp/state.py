@@ -67,6 +67,10 @@ def amp_transform(op_name: str, tensors):
 
     if not _state.enabled:
         return tensors
+    # dtype-management ops must never be re-cast (cast would recurse on
+    # its own input under O2) — they ARE the policy's mechanism.
+    if op_name in ("cast", "assign"):
+        return tensors
     low = amp_dtype()
     white = (WHITE_LIST | _state.custom_white) - _state.custom_black
     in_white = op_name in white

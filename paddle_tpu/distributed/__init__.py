@@ -29,6 +29,7 @@ from .parallel import DataParallel  # noqa: F401
 from .ring_attention import ring_attention, ulysses_attention  # noqa: F401
 from . import auto_tuner  # noqa: F401
 from . import watchdog  # noqa: F401
+from . import rpc  # noqa: F401
 from .engine import Engine  # noqa: F401
 from . import utils  # noqa: F401
 from .fleet.sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401

@@ -1,0 +1,268 @@
+"""paddle.distributed.rpc — remote procedure calls between workers.
+
+Reference: ``python/paddle/distributed/rpc/rpc.py`` (init_rpc:73,
+rpc_sync:143, rpc_async:183, shutdown:276, get_worker_info:307) — a
+name-addressed RPC layer used for parameter-server-style and
+heterogeneous jobs.
+
+TPU-native runtime note: tensor traffic between chips rides XLA
+collectives over ICI; RPC is the CONTROL plane (job coordination,
+metric aggregation, PS-style lookups of host-resident state), so a
+threaded TCP server per worker with the HTTP KV master for discovery
+is the right altitude — it stays off the device path entirely.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info", "WorkerInfo"]
+
+_DEFAULT_TIMEOUT = 30.0
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+class _RpcServer:
+    """Length-prefixed pickle frames over TCP; one thread per client.
+
+    Frame: 8-byte big-endian length + pickle((fn, args, kwargs)).
+    Reply: same framing, pickle(("ok", result) | ("err", repr)).
+    """
+
+    def __init__(self, bind_host="127.0.0.1"):
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((bind_host, 0))
+        self.sock.listen(64)
+        self.port = self.sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                self.sock.settimeout(0.2)
+                conn, _ = self.sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            with conn:
+                payload = _recv_frame(conn)
+                if payload is None:
+                    return
+                fn, args, kwargs = pickle.loads(payload)
+                try:
+                    result = fn(*args, **kwargs)
+                    reply = ("ok", result)
+                except Exception as e:  # deliver the remote error
+                    reply = ("err", f"{type(e).__name__}: {e}")
+                _send_frame(conn, pickle.dumps(reply))
+        except Exception:
+            pass
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2)
+
+
+def _send_frame(conn, data: bytes):
+    conn.sendall(struct.pack(">Q", len(data)) + data)
+
+
+def _recv_frame(conn):
+    header = _recv_exact(conn, 8)
+    if header is None:
+        return None
+    (n,) = struct.unpack(">Q", header)
+    return _recv_exact(conn, n)
+
+
+def _recv_exact(conn, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class _RpcState:
+    def __init__(self):
+        self.server = None
+        self.info = None
+        self.workers = {}
+        self.kv = None
+
+
+_state = _RpcState()
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's RPC server and exchange worker infos.
+
+    Single-process (world_size None/1): a purely local registry — every
+    named worker lives in this process (the reference's tests do the
+    same via localhost).  Multi-process: discovery through the HTTP KV
+    master at ``master_endpoint`` (the launch stack's store)."""
+    if _state.server is not None:
+        raise RuntimeError("init_rpc called twice; call shutdown() first")
+    rank = 0 if rank is None else int(rank)
+    world_size = 1 if world_size is None else int(world_size)
+    # Multi-worker: bind all interfaces and advertise a routable address
+    # (PADDLE_RPC_IP override, else the interface that routes to the
+    # master) so cross-host peers don't resolve us to their own loopback.
+    if world_size > 1:
+        server = _RpcServer(bind_host="0.0.0.0")
+        ip = _routable_ip(master_endpoint)
+    else:
+        server = _RpcServer()
+        ip = "127.0.0.1"
+    info = WorkerInfo(name=name, rank=rank, ip=ip, port=server.port)
+    _state.server = server
+    _state.info = info
+    _state.workers[name] = info
+
+    if world_size > 1:
+        if master_endpoint is None:
+            raise ValueError("master_endpoint is required for "
+                             "world_size > 1")
+        from ..launch.master import KVClient
+
+        kv = KVClient(master_endpoint)
+        _state.kv = kv
+        import json
+        import time
+
+        deadline = time.time() + _DEFAULT_TIMEOUT
+        while not kv.put(f"/rpc/{name}",
+                         json.dumps([name, rank, info.ip, info.port])):
+            if time.time() > deadline:  # master never came up
+                raise TimeoutError(
+                    f"init_rpc: could not register with the KV master at "
+                    f"{master_endpoint} within {_DEFAULT_TIMEOUT}s")
+            time.sleep(0.2)  # master may come up after us
+        while time.time() < deadline:
+            entries = kv.get_prefix("/rpc")
+            if len(entries) >= world_size:
+                for v in entries.values():
+                    n, r, ip, port = json.loads(v)
+                    _state.workers[n] = WorkerInfo(n, int(r), ip,
+                                                   int(port))
+                return
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"init_rpc: saw {len(kv.get_prefix('/rpc'))} of "
+            f"{world_size} workers before timeout")
+
+
+def _routable_ip(master_endpoint):
+    """The address peers should dial: PADDLE_RPC_IP env override, else
+    the local interface that routes toward the master (UDP-connect
+    trick, no packet sent), else hostname resolution."""
+    import os
+
+    override = os.environ.get("PADDLE_RPC_IP")
+    if override:
+        return override
+    try:
+        host = (master_endpoint or "8.8.8.8:80").split(":")[0]
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect((host, 1))
+            return s.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+
+
+def _resolve(to) -> WorkerInfo:
+    if _state.server is None:
+        raise RuntimeError("init_rpc has not been called")
+    info = _state.workers.get(to)
+    if info is None:
+        raise ValueError(f"unknown rpc worker {to!r}; known: "
+                         f"{sorted(_state.workers)}")
+    return info
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_TIMEOUT):
+    """Run ``fn(*args, **kwargs)`` on worker ``to``; block for result."""
+    info = _resolve(to)
+    with socket.create_connection((info.ip, info.port),
+                                  timeout=timeout) as conn:
+        conn.settimeout(timeout)
+        _send_frame(conn, pickle.dumps((fn, args or (), kwargs or {})))
+        payload = _recv_frame(conn)
+    if payload is None:
+        raise ConnectionError(f"rpc to {to!r}: connection closed")
+    status, value = pickle.loads(payload)
+    if status == "err":
+        raise RuntimeError(f"rpc to {to!r} failed remotely: {value}")
+    return value
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_TIMEOUT):
+    """Like rpc_sync but returns a Future (``.wait()`` like the
+    reference's FutureWrapper)."""
+    fut = Future()
+
+    def run():
+        try:
+            fut.set_result(rpc_sync(to, fn, args, kwargs, timeout))
+        except Exception as e:
+            fut.set_exception(e)
+
+    threading.Thread(target=run, daemon=True).start()
+    fut.wait = lambda t=None: fut.result(t)  # reference API
+    return fut
+
+
+def shutdown():
+    if _state.server is not None:
+        if _state.kv is not None and _state.info is not None:
+            try:
+                _state.kv.delete(f"/rpc/{_state.info.name}")
+            except Exception:
+                pass
+        _state.server.stop()
+    _state.server = None
+    _state.info = None
+    _state.workers.clear()
+    _state.kv = None
+
+
+def get_worker_info(name):
+    return _resolve(name)
+
+
+def get_all_worker_infos():
+    return sorted(_state.workers.values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info():
+    if _state.info is None:
+        raise RuntimeError("init_rpc has not been called")
+    return _state.info

@@ -99,30 +99,36 @@ def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
                            pages_per_compute_block=4):
     """Decode attention over the page pool.  On TPU this is the Pallas
     ``paged_attention`` kernel (flash-style, page-gathering in VMEM);
-    elsewhere the dense-gather fallback."""
-    q = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    elsewhere the dense-gather fallback jit-cached through the op
+    registry.  Returns a Tensor iff ``q`` is a Tensor."""
+    wrap = isinstance(q, Tensor)
+    q = q._data if wrap else jnp.asarray(q)
     lengths = jnp.asarray(lengths, jnp.int32)
     page_indices = jnp.asarray(page_indices, jnp.int32)
-    if _on_tpu():
-        from jax.experimental.pallas.ops.tpu.paged_attention import (
-            paged_attention,
-        )
+    if not _on_tpu():
+        out = _op("paged_decode_attention", _dense_paged_attention,
+                  Tensor(q), Tensor(jnp.asarray(k_pages)),
+                  Tensor(jnp.asarray(v_pages)), Tensor(lengths),
+                  Tensor(page_indices))
+        return out if wrap else out._data
+    from jax.experimental.pallas.ops.tpu.paged_attention import (
+        paged_attention,
+    )
 
-        blk = min(pages_per_compute_block, page_indices.shape[1])
-        while page_indices.shape[1] % blk:
-            blk -= 1
-        # The stock kernel mixes int32/int64 under global x64 mode —
-        # trace it x64-off (same guard as the flash-attention wrappers).
-        # It also applies NO logits scaling: pre-scale q by 1/sqrt(D).
-        q = q / np.sqrt(q.shape[-1])
-        with jax.enable_x64(False):
-            return paged_attention(
-                jnp.asarray(q), jnp.asarray(k_pages),
-                jnp.asarray(v_pages), jnp.asarray(lengths, jnp.int32),
-                jnp.asarray(page_indices, jnp.int32),
-                pages_per_compute_block=blk)
-    return _dense_paged_attention(q, k_pages, v_pages, lengths,
-                                  page_indices)
+    blk = min(pages_per_compute_block, page_indices.shape[1])
+    while page_indices.shape[1] % blk:
+        blk -= 1
+    # The stock kernel mixes int32/int64 under global x64 mode — trace
+    # it x64-off (same guard as the flash-attention wrappers).  It also
+    # applies NO logits scaling: pre-scale q by 1/sqrt(D).
+    q = q / np.sqrt(q.shape[-1])
+    with jax.enable_x64(False):
+        out = paged_attention(
+            jnp.asarray(q), jnp.asarray(k_pages),
+            jnp.asarray(v_pages), jnp.asarray(lengths, jnp.int32),
+            jnp.asarray(page_indices, jnp.int32),
+            pages_per_compute_block=blk)
+    return Tensor(out) if wrap else out
 
 
 # -- block-table cache manager ------------------------------------------
@@ -219,15 +225,35 @@ class PagedKVCache:
 
     def append(self, seqs, k, v) -> None:
         """Decode-step write: one new token per listed sequence.
-        k/v: [L, KV, B, D] for B = len(seqs)."""
+        k/v: [L, KV, B, D] for B = len(seqs).
+
+        Two-phase so a capacity failure mutates NOTHING: plan every
+        sequence's allocation first, commit only if the whole batch
+        fits (otherwise an earlier seq would record a length whose
+        page slot never got written)."""
         k = jnp.asarray(k, self.k_pages.dtype)
         v = jnp.asarray(v, self.v_pages.dtype)
-        pids, offs = [], []
-        for j, s in enumerate(seqs):
+        ps = self.page_size
+        plans = []
+        total_new = 0
+        for s in seqs:
             pos = int(self.lengths[s])
-            self._ensure_capacity(s, pos + 1)
-            pids.append(int(self.page_table[s, pos // self.page_size]))
-            offs.append(pos % self.page_size)
+            have = -(-pos // ps)
+            need = -(-(pos + 1) // ps)
+            if need > self.max_pages_per_seq:
+                raise RuntimeError(
+                    f"sequence {s} needs {need} pages > per-seq budget "
+                    f"{self.max_pages_per_seq}")
+            total_new += need - have
+            plans.append((s, pos, need - have))
+        if total_new > len(self._free):
+            raise RuntimeError("KV page pool exhausted")
+        pids, offs = [], []
+        for s, pos, n_new in plans:
+            if n_new:
+                self.page_table[s, pos // ps] = self._free.pop()
+            pids.append(int(self.page_table[s, pos // ps]))
+            offs.append(pos % ps)
             self.lengths[s] = pos + 1
         pids = jnp.asarray(pids)
         offs = jnp.asarray(offs)

@@ -1,0 +1,244 @@
+"""Diffusion UNet (BASELINE config 5: Stable Diffusion v1.5 UNet
+training — the ppdiffusers UNet2DConditionModel workload).
+
+Architecture follows the SD v1.5 shape: sinusoidal timestep embedding →
+MLP, down path of ResNet blocks + (self + cross)-attention transformer
+blocks with downsampling, a mid block, and a skip-connected up path.
+TPU notes: GroupNorm/SiLU fuse into the conv epilogues under XLA;
+attention over the [H*W, C] tokens is batched MXU matmuls; channel
+counts stay multiples of 128 at the attention widths.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .. import nn, ops
+
+
+def timestep_embedding(t, dim, max_period=10000.0):
+    """Sinusoidal embedding [B] -> [B, dim] (SD convention)."""
+    half = dim // 2
+    freqs = np.exp(-math.log(max_period)
+                   * np.arange(half, dtype=np.float32) / half)
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+
+    tt = t._data if isinstance(t, Tensor) else jnp.asarray(t)
+    emb = tt.astype(jnp.float32)[:, None] * jnp.asarray(freqs)[None, :]
+    return Tensor(jnp.concatenate([jnp.cos(emb), jnp.sin(emb)], axis=-1))
+
+
+class ResnetBlock(nn.Layer):
+    def __init__(self, in_ch, out_ch, temb_ch, groups=32):
+        super().__init__()
+        g1 = min(groups, in_ch)
+        while in_ch % g1:
+            g1 -= 1
+        g2 = min(groups, out_ch)
+        while out_ch % g2:
+            g2 -= 1
+        self.norm1 = nn.GroupNorm(g1, in_ch)
+        self.conv1 = nn.Conv2D(in_ch, out_ch, 3, padding=1)
+        self.temb_proj = nn.Linear(temb_ch, out_ch)
+        self.norm2 = nn.GroupNorm(g2, out_ch)
+        self.conv2 = nn.Conv2D(out_ch, out_ch, 3, padding=1)
+        self.act = nn.Silu()
+        self.skip = nn.Conv2D(in_ch, out_ch, 1) if in_ch != out_ch \
+            else None
+
+    def forward(self, x, temb):
+        h = self.conv1(self.act(self.norm1(x)))
+        h = h + ops.unsqueeze(ops.unsqueeze(
+            self.temb_proj(self.act(temb)), -1), -1)
+        h = self.conv2(self.act(self.norm2(h)))
+        return h + (self.skip(x) if self.skip is not None else x)
+
+
+class CrossAttention(nn.Layer):
+    def __init__(self, query_dim, context_dim, heads=8):
+        super().__init__()
+        self.heads = heads
+        self.to_q = nn.Linear(query_dim, query_dim, bias_attr=False)
+        self.to_k = nn.Linear(context_dim, query_dim, bias_attr=False)
+        self.to_v = nn.Linear(context_dim, query_dim, bias_attr=False)
+        self.to_out = nn.Linear(query_dim, query_dim)
+
+    def forward(self, x, context=None):
+        context = x if context is None else context
+        B, N, C = x.shape
+        H = self.heads
+        q = ops.reshape(self.to_q(x), [B, N, H, C // H])
+        k = ops.reshape(self.to_k(context),
+                        [B, context.shape[1], H, C // H])
+        v = ops.reshape(self.to_v(context),
+                        [B, context.shape[1], H, C // H])
+        logits = ops.einsum("bnhd,bmhd->bhnm", q, k) / math.sqrt(C // H)
+        p = ops.softmax(logits, axis=-1)
+        out = ops.einsum("bhnm,bmhd->bnhd", p, v)
+        return self.to_out(ops.reshape(out, [B, N, C]))
+
+
+class TransformerBlock(nn.Layer):
+    """self-attn -> cross-attn -> geglu FFN over [B, H*W, C] tokens."""
+
+    def __init__(self, channels, context_dim, heads=8):
+        super().__init__()
+        self.norm_in = nn.GroupNorm(min(32, channels), channels)
+        self.proj_in = nn.Conv2D(channels, channels, 1)
+        self.norm1 = nn.LayerNorm(channels)
+        self.attn1 = CrossAttention(channels, channels, heads)
+        self.norm2 = nn.LayerNorm(channels)
+        self.attn2 = CrossAttention(channels, context_dim, heads)
+        self.norm3 = nn.LayerNorm(channels)
+        self.ff1 = nn.Linear(channels, channels * 4)
+        self.ff2 = nn.Linear(channels * 4, channels)
+        self.act = nn.GELU()
+        self.proj_out = nn.Conv2D(channels, channels, 1)
+
+    def forward(self, x, context):
+        B, C, H, W = x.shape
+        res = x
+        h = self.proj_in(self.norm_in(x))
+        h = ops.transpose(ops.reshape(h, [B, C, H * W]), [0, 2, 1])
+        h = h + self.attn1(self.norm1(h))
+        h = h + self.attn2(self.norm2(h), context)
+        h = h + self.ff2(self.act(self.ff1(self.norm3(h))))
+        h = ops.reshape(ops.transpose(h, [0, 2, 1]), [B, C, H, W])
+        return res + self.proj_out(h)
+
+
+class Downsample(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2D(ch, ch, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class Upsample(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2D(ch, ch, 3, padding=1)
+
+    def forward(self, x):
+        x = nn.functional.interpolate(x, scale_factor=2, mode="nearest")
+        return self.conv(x)
+
+
+class UNet2DConditionModel(nn.Layer):
+    """SD v1.5-shaped conditional UNet (ppdiffusers
+    UNet2DConditionModel).  block_out_channels=(320, 640, 1280, 1280)
+    and cross_attention_dim=768 reproduce the v1.5 config; the tiny()
+    preset is for tests."""
+
+    def __init__(self, in_channels=4, out_channels=4,
+                 block_out_channels=(320, 640, 1280, 1280),
+                 layers_per_block=2, cross_attention_dim=768,
+                 attention_head_dim=8, sample_size=64):
+        super().__init__()
+        self.config_in_channels = in_channels
+        chs = list(block_out_channels)
+        temb_ch = chs[0] * 4
+        self.time_embed_dim = chs[0]
+        self.time_mlp1 = nn.Linear(chs[0], temb_ch)
+        self.time_mlp2 = nn.Linear(temb_ch, temb_ch)
+        self.act = nn.Silu()
+        self.conv_in = nn.Conv2D(in_channels, chs[0], 3, padding=1)
+
+        # down path: blocks 0..n-2 have attention; last is conv-only
+        self.down_blocks = nn.LayerList()
+        self.downsamplers = nn.LayerList()
+        skip_chs = [chs[0]]
+        ch = chs[0]
+        for i, out_ch in enumerate(chs):
+            with_attn = i < len(chs) - 1
+            stage = nn.LayerList()
+            for _ in range(layers_per_block):
+                blk = nn.LayerList([ResnetBlock(ch, out_ch, temb_ch)])
+                if with_attn:
+                    blk.append(TransformerBlock(
+                        out_ch, cross_attention_dim,
+                        heads=max(1, out_ch // (attention_head_dim * 8))))
+                stage.append(blk)
+                ch = out_ch
+                skip_chs.append(ch)
+            self.down_blocks.append(stage)
+            if i < len(chs) - 1:
+                self.downsamplers.append(Downsample(ch))
+                skip_chs.append(ch)
+            else:
+                self.downsamplers.append(nn.Identity())
+
+        self.mid_res1 = ResnetBlock(ch, ch, temb_ch)
+        self.mid_attn = TransformerBlock(
+            ch, cross_attention_dim,
+            heads=max(1, ch // (attention_head_dim * 8)))
+        self.mid_res2 = ResnetBlock(ch, ch, temb_ch)
+
+        # up path mirrors down with skip concat
+        self.up_blocks = nn.LayerList()
+        self.upsamplers = nn.LayerList()
+        for i, out_ch in enumerate(reversed(chs)):
+            with_attn = i > 0
+            stage = nn.LayerList()
+            for _ in range(layers_per_block + 1):
+                skip = skip_chs.pop()
+                blk = nn.LayerList(
+                    [ResnetBlock(ch + skip, out_ch, temb_ch)])
+                if with_attn:
+                    blk.append(TransformerBlock(
+                        out_ch, cross_attention_dim,
+                        heads=max(1, out_ch // (attention_head_dim * 8))))
+                stage.append(blk)
+                ch = out_ch
+            self.up_blocks.append(stage)
+            if i < len(chs) - 1:
+                self.upsamplers.append(Upsample(ch))
+            else:
+                self.upsamplers.append(nn.Identity())
+
+        self.norm_out = nn.GroupNorm(min(32, ch), ch)
+        self.conv_out = nn.Conv2D(ch, out_channels, 3, padding=1)
+
+    @classmethod
+    def tiny(cls):
+        return cls(in_channels=4, out_channels=4,
+                   block_out_channels=(32, 64), layers_per_block=1,
+                   cross_attention_dim=32, attention_head_dim=4,
+                   sample_size=8)
+
+    def forward(self, sample, timestep, encoder_hidden_states):
+        temb = timestep_embedding(timestep, self.time_embed_dim)
+        temb = self.time_mlp2(self.act(self.time_mlp1(temb)))
+
+        h = self.conv_in(sample)
+        skips = [h]
+        for stage, down in zip(self.down_blocks, self.downsamplers):
+            for blk in stage:
+                h = blk[0](h, temb)
+                if len(blk) > 1:
+                    h = blk[1](h, encoder_hidden_states)
+                skips.append(h)
+            if not isinstance(down, nn.Identity):
+                h = down(h)
+                skips.append(h)
+
+        h = self.mid_res2(self.mid_attn(self.mid_res1(h, temb),
+                                        encoder_hidden_states), temb)
+
+        for stage, up in zip(self.up_blocks, self.upsamplers):
+            for blk in stage:
+                h = blk[0](ops.concat([h, skips.pop()], axis=1), temb)
+                if len(blk) > 1:
+                    h = blk[1](h, encoder_hidden_states)
+            if not isinstance(up, nn.Identity):
+                h = up(h)
+
+        return self.conv_out(self.act(self.norm_out(h)))
+
+    def num_params(self):
+        return int(sum(np.prod(p.shape)
+                       for _, p in self.named_parameters()))

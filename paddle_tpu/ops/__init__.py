@@ -58,6 +58,14 @@ from .creation import (  # noqa: F401
     arange, linspace, logspace, eye, diag_embed, clone, to_tensor, complex,
     as_complex, as_real,
 )
+from .extra import (  # noqa: F401
+    kron, trace, heaviside, copysign, ldexp, hypot, deg2rad, rad2deg,
+    positive, diff, trapezoid, vander, logcumsumexp, renorm, cdist,
+    tensordot, bucketize, searchsorted, nanmedian, mode, kthvalue, rot90,
+    take, index_add, index_fill, unfold, as_strided, select_scatter,
+    slice_scatter, atleast_1d, atleast_2d, atleast_3d, column_stack,
+    row_stack, dstack, tensor_split, hsplit, vsplit, dsplit, diagflat,
+)
 from .random import (  # noqa: F401
     seed, get_rng_state, set_rng_state, randn, standard_normal, normal,
     gaussian, rand, uniform, randint, randint_like, randperm, bernoulli,

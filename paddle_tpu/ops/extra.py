@@ -1,0 +1,449 @@
+"""Long-tail tensor ops (VERDICT r2 row 3: the manipulation/math tail).
+
+Reference: ``python/paddle/tensor/{math,manipulation,linalg,stat}.py`` —
+each function below names its reference counterpart.  All dispatch
+through the registry (jit cache + vjp-fallback grads); implementations
+are single fused jnp programs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import apply, register_op
+
+
+def _axis_t(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _simple(name, fn, static=()):
+    op = register_op(name, fn, static_argnames=static)
+
+    def call(*args, **kwargs):
+        return apply(op, *args, **kwargs)
+
+    call.__name__ = name
+    return call
+
+
+# -- math tail ----------------------------------------------------------
+
+kron = _simple("kron", jnp.kron)
+trace = _simple(
+    "trace",
+    lambda x, offset=0, axis1=0, axis2=1: jnp.trace(
+        x, offset=offset, axis1=axis1, axis2=axis2),
+    static=("offset", "axis1", "axis2"))
+heaviside = _simple("heaviside", jnp.heaviside)
+copysign = _simple("copysign", jnp.copysign)
+ldexp = _simple("ldexp", lambda x, y: jnp.ldexp(x, y.astype(jnp.int32)))
+hypot = _simple("hypot", jnp.hypot)
+deg2rad = _simple("deg2rad", jnp.deg2rad)
+rad2deg = _simple("rad2deg", jnp.rad2deg)
+positive = _simple("positive", jnp.positive)
+diff = _simple(
+    "diff",
+    lambda x, n=1, axis=-1: jnp.diff(x, n=n, axis=axis),
+    static=("n", "axis"))
+trapezoid = _simple(
+    "trapezoid",
+    lambda y, x=None, dx=1.0, axis=-1: jnp.trapezoid(
+        y, x=x, dx=dx, axis=axis),
+    static=("dx", "axis"))
+vander = _simple(
+    "vander",
+    lambda x, n=None, increasing=False: jnp.vander(
+        x, N=n, increasing=increasing),
+    static=("n", "increasing"))
+logcumsumexp = _simple(
+    "logcumsumexp",
+    lambda x, axis=-1: jax.lax.cumlogsumexp(x, axis=axis % x.ndim),
+    static=("axis",))
+renorm = _simple(
+    "renorm",
+    lambda x, p, axis, max_norm: _renorm_impl(x, p, axis, max_norm),
+    static=("p", "axis", "max_norm"))
+
+
+def _renorm_impl(x, p, axis, max_norm):
+    """tensor/math.py renorm: scale each sub-tensor along ``axis`` whose
+    p-norm exceeds max_norm down to max_norm."""
+    axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+    scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * scale
+
+
+def _cdist_impl(x, y, p):
+    d = jnp.abs(x[..., :, None, :] - y[..., None, :, :])
+    if p == 2.0:
+        return jnp.sqrt(jnp.maximum(jnp.sum(d * d, -1), 0.0))
+    if p == float("inf"):
+        return jnp.max(d, -1)
+    return jnp.sum(d ** p, -1) ** (1.0 / p)
+
+
+cdist = _simple("cdist",
+                lambda x, y, p=2.0: _cdist_impl(x, y, p),
+                static=("p",))
+_tensordot_op = register_op(
+    "tensordot",
+    lambda x, y, axes=2: jnp.tensordot(x, y, axes=axes),
+    static_argnames=("axes",))
+
+
+def tensordot(x, y, axes=2, name=None):
+    # normalize the documented list/nested-list forms to hashable tuples
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(int(i) for i in a)
+                     if isinstance(a, (list, tuple)) else int(a)
+                     for a in axes)
+    else:
+        axes = int(axes)
+    return apply(_tensordot_op, x, y, axes=axes)
+
+
+# -- search / stat tail -------------------------------------------------
+
+bucketize = _simple(
+    "bucketize",
+    lambda x, sorted_sequence, out_int32=False, right=False:
+        jnp.searchsorted(sorted_sequence, x,
+                         side="right" if right else "left").astype(
+            jnp.int32 if out_int32 else jnp.int64),
+    static=("out_int32", "right"))
+searchsorted = _simple(
+    "searchsorted",
+    lambda sorted_sequence, values, out_int32=False, right=False:
+        jnp.searchsorted(sorted_sequence, values,
+                         side="right" if right else "left").astype(
+            jnp.int32 if out_int32 else jnp.int64),
+    static=("out_int32", "right"))
+
+
+def _nanmedian_impl(x, axis, keepdim):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+nanmedian = _simple(
+    "nanmedian",
+    lambda x, axis=None, keepdim=False: _nanmedian_impl(x, axis, keepdim),
+    static=("axis", "keepdim"))
+
+_mode_op = register_op(
+    "mode",
+    lambda x, axis: _mode_impl(x, axis),
+    static_argnames=("axis",), n_outputs=2)
+
+
+def _mode_impl(x, axis):
+    """tensor/search.py mode: most frequent value (ties -> largest
+    value, matching the reference's last-index convention on sorted
+    data) + its index."""
+    xm = jnp.moveaxis(x, axis, -1)
+    n = xm.shape[-1]
+    s = jnp.sort(xm, axis=-1)
+    # run lengths in sorted order
+    eq = jnp.concatenate(
+        [jnp.ones(s.shape[:-1] + (1,), bool), s[..., 1:] == s[..., :-1]],
+        axis=-1)
+    run_id = jnp.cumsum(~eq, axis=-1)
+
+    def counts_1d(rid):
+        return jax.ops.segment_sum(jnp.ones_like(rid), rid,
+                                   num_segments=n)
+
+    flat = run_id.reshape(-1, n)
+    cnt = jax.vmap(counts_1d)(flat)          # [B, n] counts per run id
+    run_cnt = jnp.take_along_axis(cnt, flat, axis=1).reshape(run_id.shape)
+    best = jnp.argmax(run_cnt + run_id * 1e-6, axis=-1)  # ties -> larger
+    vals = jnp.take_along_axis(s, best[..., None], axis=-1)[..., 0]
+    idx = jnp.argmax(xm == vals[..., None], axis=-1)
+    return vals, idx.astype(jnp.int64)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    vals, idx = apply(_mode_op, x, axis=int(axis))
+    if keepdim:
+        from .manipulation import unsqueeze
+
+        return unsqueeze(vals, axis), unsqueeze(idx, axis)
+    return vals, idx
+
+
+def _kthvalue_impl(x, k, axis):
+    xm = jnp.moveaxis(x, axis, -1)
+    return (jnp.sort(xm, axis=-1)[..., k - 1],
+            jnp.argsort(xm, axis=-1)[..., k - 1].astype(jnp.int64))
+
+
+_kthvalue_op = register_op(
+    "kthvalue", _kthvalue_impl, static_argnames=("k", "axis"),
+    n_outputs=2)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    vals, idx = apply(_kthvalue_op, x, k=int(k), axis=int(axis))
+    if keepdim:
+        from .manipulation import unsqueeze
+
+        return unsqueeze(vals, axis), unsqueeze(idx, axis)
+    return vals, idx
+
+
+# -- manipulation tail --------------------------------------------------
+
+_rot90_op = register_op(
+    "rot90", lambda x, k=1, axes=(0, 1): jnp.rot90(x, k=k, axes=axes),
+    static_argnames=("k", "axes"))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(_rot90_op, x, k=int(k),
+                 axes=tuple(int(a) for a in axes))
+
+
+_take_op = register_op(
+    "take",
+    lambda x, index, mode="raise": jnp.take(
+        x.reshape(-1), index,
+        mode="clip" if mode == "raise" else mode),
+    static_argnames=("mode",))
+
+
+def take(x, index, mode="raise", name=None):
+    """tensor/math.py take.  mode='raise' checks bounds eagerly when the
+    index is concrete; under tracing it degrades to 'clip' (XLA cannot
+    raise data-dependently — documented divergence)."""
+    if mode == "raise":
+        import numpy as _np
+
+        idx_data = getattr(index, "_data", index)
+        if not isinstance(idx_data, jax.core.Tracer):
+            size = 1
+            for d in jnp.shape(getattr(x, "_data", x)):
+                size *= d
+            arr = _np.asarray(idx_data)
+            if arr.size and (arr.min() < -size or arr.max() >= size):
+                raise IndexError(
+                    f"take: index out of range for tensor of {size} "
+                    f"elements (got [{arr.min()}, {arr.max()}])")
+    return apply(_take_op, x, index, mode=str(mode))
+index_add = _simple(
+    "index_add",
+    lambda x, index, value, axis=0: _index_put(x, index, value, axis,
+                                               add=True),
+    static=("axis",))
+index_fill = _simple(
+    "index_fill",
+    lambda x, index, fill_value, axis=0: _index_fill_impl(
+        x, index, fill_value, axis),
+    static=("axis", "fill_value"))
+
+
+def _index_put(x, index, value, axis, add):
+    xm = jnp.moveaxis(x, axis, 0)
+    vm = jnp.moveaxis(value, axis, 0)
+    out = xm.at[index].add(vm) if add else xm.at[index].set(vm)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def _index_fill_impl(x, index, fill_value, axis):
+    xm = jnp.moveaxis(x, axis, 0)
+    out = xm.at[index].set(jnp.asarray(fill_value, x.dtype))
+    return jnp.moveaxis(out, 0, axis)
+
+
+def _unfold_impl(x, axis, size, step):
+    n = (x.shape[axis] - size) // step + 1
+    starts = jnp.arange(n) * step
+    idx = starts[:, None] + jnp.arange(size)[None, :]
+    xm = jnp.moveaxis(x, axis, 0)
+    seg = xm[idx]                       # [n, size, ...rest]
+    seg = jnp.moveaxis(seg, (0, 1), (axis, x.ndim))
+    return seg
+
+
+_unfold_op = register_op(
+    "tensor_unfold",
+    lambda x, axis, size, step: _unfold_impl(x, axis, size, step),
+    static_argnames=("axis", "size", "step"))
+
+
+def unfold(x, axis, size, step, name=None):
+    return apply(_unfold_op, x, axis=int(axis), size=int(size),
+                 step=int(step))
+
+
+_as_strided_op = register_op(
+    "as_strided",
+    lambda x, shape, stride, offset=0: _as_strided_impl(
+        x, shape, stride, offset),
+    static_argnames=("shape", "stride", "offset"))
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    return apply(_as_strided_op, x, shape=tuple(int(s) for s in shape),
+                 stride=tuple(int(s) for s in stride),
+                 offset=int(offset))
+
+
+def _as_strided_impl(x, shape, stride, offset):
+    flat = x.reshape(-1)
+    idx = jnp.full((), offset, jnp.int32)
+    for dim, st in zip(shape, stride):
+        idx = idx[..., None] + jnp.arange(dim) * st
+    return flat[idx.reshape(tuple(shape))]
+
+
+_select_scatter_op = register_op(
+    "select_scatter",
+    lambda x, value, axis, index: jnp.moveaxis(
+        jnp.moveaxis(x, axis, 0).at[index].set(value), 0, axis),
+    static_argnames=("axis", "index"))
+
+
+def select_scatter(x, value, axis, index, name=None):
+    return apply(_select_scatter_op, x, value, axis=int(axis),
+                 index=int(index))
+
+
+_slice_scatter_op = register_op(
+    "slice_scatter",
+    lambda x, value, axes, starts, ends, strides: _slice_scatter_impl(
+        x, value, axes, starts, ends, strides),
+    static_argnames=("axes", "starts", "ends", "strides"))
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    return apply(_slice_scatter_op, x, value,
+                 axes=tuple(int(a) for a in axes),
+                 starts=tuple(int(s) for s in starts),
+                 ends=tuple(int(e) for e in ends),
+                 strides=tuple(int(s) for s in strides))
+
+
+def _slice_scatter_impl(x, value, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    return x.at[tuple(idx)].set(value)
+
+
+# -- stack / split family (python-level compositions) -------------------
+
+
+def _t(x):
+    from ..core.tensor import Tensor
+
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def atleast_1d(*inputs):
+    from .manipulation import reshape
+
+    outs = [x if x.ndim >= 1 else reshape(x, [1]) for x in
+            map(_t, inputs)]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_2d(*inputs):
+    from .manipulation import reshape
+
+    outs = []
+    for x in map(_t, inputs):
+        if x.ndim == 0:
+            outs.append(reshape(x, [1, 1]))
+        elif x.ndim == 1:
+            outs.append(reshape(x, [1, x.shape[0]]))
+        else:
+            outs.append(x)
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_3d(*inputs):
+    from .manipulation import reshape
+
+    outs = []
+    for x in map(_t, inputs):
+        if x.ndim == 0:
+            outs.append(reshape(x, [1, 1, 1]))
+        elif x.ndim == 1:
+            outs.append(reshape(x, [1, x.shape[0], 1]))
+        elif x.ndim == 2:
+            outs.append(reshape(x, list(x.shape) + [1]))
+        else:
+            outs.append(x)
+    return outs if len(outs) > 1 else outs[0]
+
+
+def column_stack(x, name=None):
+    from .manipulation import concat
+
+    return concat([_col2d(c) for c in map(_t, x)], axis=1)
+
+
+def _col2d(c):
+    from .manipulation import reshape
+
+    c = _t(c)
+    return reshape(c, [c.shape[0], 1]) if c.ndim == 1 else c
+
+
+def row_stack(x, name=None):
+    from .manipulation import concat
+
+    return concat([atleast_2d(c) for c in map(_t, x)], axis=0)
+
+
+def dstack(x, name=None):
+    from .manipulation import concat
+
+    return concat([atleast_3d(c) for c in map(_t, x)], axis=2)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    from .manipulation import slice as _slice
+
+    x = _t(x)
+    axis = int(axis) % x.ndim
+    n = x.shape[axis]
+    if isinstance(num_or_indices, int):
+        k = num_or_indices
+        base, extra = divmod(n, k)
+        sizes = [base + (1 if i < extra else 0) for i in range(k)]
+        bounds = [0]
+        for s in sizes:
+            bounds.append(bounds[-1] + s)
+    else:
+        bounds = [0] + [int(i) for i in num_or_indices] + [n]
+    return [_slice(x, [axis], [bounds[i]], [bounds[i + 1]])
+            for i in range(len(bounds) - 1)]
+
+
+def hsplit(x, num_or_indices, name=None):
+    x = _t(x)
+    return tensor_split(x, num_or_indices, axis=0 if x.ndim == 1 else 1)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+_diagflat_op = register_op(
+    "diagflat",
+    lambda x, offset=0: jnp.diagflat(x, k=offset),
+    static_argnames=("offset",))
+
+
+def diagflat(x, offset=0, name=None):
+    return apply(_diagflat_op, x, offset=int(offset))

@@ -1,0 +1,35 @@
+"""paddle.static — compatibility surface.
+
+Recorded decision (SURVEY §7 addendum): the static graph API is
+subsumed by ``paddle.jit`` — tracing to StableHLO is the Program
+analog, ``jit.save``/``jit.load`` + ``inference.Predictor`` replace
+Program/Executor serialization, and GSPMD replaces the dist passes.
+This module provides the symbols programs actually import
+(``InputSpec``) and raises with guidance for the rest.
+"""
+from __future__ import annotations
+
+from ..jit import InputSpec  # noqa: F401
+
+__all__ = ["InputSpec"]
+
+
+def _subsumed(name, use):
+    def stub(*a, **k):
+        raise NotImplementedError(
+            f"paddle.static.{name} is subsumed by the jit path in this "
+            f"framework — use {use} instead (SURVEY §7 addendum).")
+
+    stub.__name__ = name
+    return stub
+
+
+Program = _subsumed("Program", "paddle_tpu.jit.to_static")
+program_guard = _subsumed("program_guard", "paddle_tpu.jit.to_static")
+Executor = _subsumed("Executor", "paddle_tpu.jit.to_static / "
+                     "inference.Predictor")
+data = _subsumed("data", "paddle_tpu.jit.InputSpec")
+save_inference_model = _subsumed("save_inference_model",
+                                 "paddle_tpu.jit.save")
+load_inference_model = _subsumed("load_inference_model",
+                                 "paddle_tpu.jit.load")

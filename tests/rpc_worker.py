@@ -1,0 +1,17 @@
+"""Subprocess worker for test_rpc: joins the rpc world then waits for
+stdin to close (parent-controlled lifetime)."""
+import sys
+
+from paddle_tpu.distributed import rpc
+
+
+def main():
+    name, rank, world, master = sys.argv[1:5]
+    rpc.init_rpc(name, int(rank), int(world), master)
+    print("ready", flush=True)
+    sys.stdin.read()  # parent closes stdin -> exit
+    rpc.shutdown()
+
+
+if __name__ == "__main__":
+    main()

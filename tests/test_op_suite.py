@@ -567,6 +567,70 @@ op("fused_rotary_position_embedding",
                                                         sin=s),
    _rope_inputs(), None, out_index=0, grad_inputs=[0, 1])
 
+# --- long-tail ops (ops/extra.py) ------------------------------------------
+
+op("kron", ops.kron, [fa(2, 3), fa(3, 2)], np.kron)
+op("trace", ops.trace, [fa(4, 4)], np.trace)
+op("heaviside", ops.heaviside,
+   [away(fa(3, 4), [0.0]), fa(3, 4)], np.heaviside, grad=False)
+op("copysign", ops.copysign, [away(fa(3, 4), [0.0]),
+                              away(fa(3, 4), [0.0])],
+   np.copysign, grad_inputs=[0])
+op("ldexp", ops.ldexp, [fa(3, 4), ints(3, 4).astype(np.float32)],
+   lambda x, y: np.ldexp(x, y.astype(np.int32)), grad_inputs=[0])
+op("hypot", ops.hypot, [fpos(3, 4), fpos(3, 4)], np.hypot)
+op("deg2rad", ops.deg2rad, [fa(3, 4)], np.deg2rad)
+op("rad2deg", ops.rad2deg, [fa(3, 4)], np.rad2deg)
+op("positive", ops.positive, [fa(3, 4)], np.positive)
+op("diff", lambda x: ops.diff(x, n=1, axis=-1), [fa(3, 5)],
+   lambda x: np.diff(x, 1, -1))
+op("trapezoid", lambda y: ops.trapezoid(y, dx=0.5), [fa(3, 6)],
+   lambda y: np.trapezoid(y, dx=0.5, axis=-1))
+op("vander", lambda x: ops.vander(x, n=4), [funit(5)],
+   lambda x: np.vander(x, 4), gtol=5e-2)
+op("logcumsumexp", lambda x: ops.logcumsumexp(x, axis=-1), [fa(3, 5)],
+   lambda x: np.log(np.cumsum(np.exp(x), -1)))
+op("renorm", lambda x: ops.renorm(x, p=2.0, axis=0, max_norm=1.0),
+   [fa(4, 6)], None, grad=False)
+op("cdist", ops.cdist, [fa(4, 3), fa(5, 3) + 3.0],
+   lambda x, y: np.sqrt(((x[:, None] - y[None]) ** 2).sum(-1)))
+op("tensordot", lambda x, y: ops.tensordot(x, y, axes=1),
+   [fa(3, 4), fa(4, 5)], lambda x, y: np.tensordot(x, y, 1))
+op("bucketize",
+   lambda v, s: ops.bucketize(v, s),
+   [fa(8), np.sort(fa(4))], lambda v, s: np.searchsorted(s, v),
+   grad=False, bf16=False)
+op("searchsorted",
+   lambda s, v: ops.searchsorted(s, v),
+   [np.sort(fa(4)), fa(8)], lambda s, v: np.searchsorted(s, v),
+   grad=False, bf16=False)
+op("nanmedian", lambda x: ops.nanmedian(x, axis=1), [fa(3, 5)],
+   lambda x: np.nanmedian(x, axis=1), grad=False)
+op("mode", lambda x: ops.mode(x, axis=-1), [ints(3, 6).astype(np.float32)],
+   None, out_index=0, grad=False)
+op("kthvalue", lambda x: ops.kthvalue(x, k=2, axis=-1), [fa(3, 6)],
+   lambda x: np.sort(x, -1)[..., 1], out_index=0, grad=False)
+op("rot90", ops.rot90, [fa(3, 4)], np.rot90)
+op("take", lambda x, i: ops.take(x, i),
+   [fa(3, 4), np.array([0, 5, 11], np.int64)],
+   lambda x, i: x.reshape(-1)[i], grad_inputs=[0])
+op("index_add", lambda x, i, v: ops.index_add(x, i, v),
+   [fa(4, 3), np.array([1, 2], np.int64), fa(2, 3)],
+   None, grad_inputs=[0, 2])
+op("index_fill", lambda x, i: ops.index_fill(x, i, 7.0, axis=0),
+   [fa(4, 3), np.array([0, 2], np.int64)], None, grad_inputs=[0])
+op("tensor_unfold", lambda x: ops.unfold(x, 0, 4, 3), [fa(10)], None)
+op("as_strided", lambda x: ops.as_strided(x, [3, 2], [2, 1], 1),
+   [fa(10)], None)
+op("select_scatter",
+   lambda x, v: ops.select_scatter(x, v, axis=0, index=2),
+   [fa(4, 3), fa(3)], None)
+op("slice_scatter",
+   lambda x, v: ops.slice_scatter(x, v, axes=[0], starts=[1], ends=[3],
+                                  strides=[1]),
+   [fa(4, 3), fa(2, 3)], None)
+op("diagflat", ops.diagflat, [fa(4)], np.diagflat)
+
 # ---------------------------------------------------------------------------
 
 SKIP = {

@@ -1,0 +1,190 @@
+"""Long-tail tensor ops vs NumPy goldens (ops/extra.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def _r(*s, seed=0):
+    return np.random.RandomState(seed).randn(*s).astype("float32")
+
+
+def test_math_tail_goldens():
+    a, b = _r(3, 4), _r(3, 4, seed=1)
+    np.testing.assert_allclose(paddle.kron(_t(a), _t(b)).numpy(),
+                               np.kron(a, b), rtol=1e-5)
+    np.testing.assert_allclose(paddle.trace(_t(a)).numpy(),
+                               np.trace(a), rtol=1e-5)
+    np.testing.assert_allclose(paddle.hypot(_t(a), _t(b)).numpy(),
+                               np.hypot(a, b), rtol=1e-5)
+    np.testing.assert_allclose(paddle.copysign(_t(a), _t(b)).numpy(),
+                               np.copysign(a, b), rtol=1e-6)
+    np.testing.assert_allclose(paddle.deg2rad(_t(a)).numpy(),
+                               np.deg2rad(a), rtol=1e-6)
+    np.testing.assert_allclose(paddle.rad2deg(_t(a)).numpy(),
+                               np.rad2deg(a), rtol=1e-6)
+    np.testing.assert_allclose(paddle.heaviside(_t(a), _t(b)).numpy(),
+                               np.heaviside(a, b), rtol=1e-6)
+    np.testing.assert_allclose(
+        paddle.diff(_t(a), n=2, axis=1).numpy(), np.diff(a, 2, 1),
+        rtol=1e-5)
+    np.testing.assert_allclose(paddle.trapezoid(_t(a), dx=0.5).numpy(),
+                               np.trapezoid(a, dx=0.5, axis=-1),
+                               rtol=1e-5)
+    v = _r(5)
+    np.testing.assert_allclose(paddle.vander(_t(v), n=3).numpy(),
+                               np.vander(v, 3), rtol=1e-4)
+    np.testing.assert_allclose(
+        paddle.logcumsumexp(_t(a), axis=1).numpy(),
+        np.log(np.cumsum(np.exp(a), axis=1)), rtol=1e-4)
+    np.testing.assert_allclose(
+        paddle.tensordot(_t(a), _t(b.T), axes=1).numpy(),
+        np.tensordot(a, b.T, 1), rtol=1e-4)
+
+
+def test_cdist_and_renorm():
+    x, y = _r(4, 3), _r(5, 3, seed=2)
+    from scipy.spatial.distance import cdist as sp_cdist  # noqa
+
+    np.testing.assert_allclose(
+        paddle.cdist(_t(x), _t(y)).numpy(),
+        np.sqrt(((x[:, None] - y[None]) ** 2).sum(-1)), rtol=1e-4,
+        atol=1e-5)
+    a = _r(4, 6)
+    out = paddle.renorm(_t(a), p=2.0, axis=0, max_norm=1.0).numpy()
+    norms = np.sqrt((out ** 2).sum(1))
+    assert (norms <= 1.0 + 1e-4).all()
+
+
+def test_search_tail():
+    seq = np.array([1.0, 3.0, 5.0, 7.0], "float32")
+    vals = np.array([0.0, 3.0, 6.0, 9.0], "float32")
+    np.testing.assert_array_equal(
+        paddle.searchsorted(_t(seq), _t(vals)).numpy(),
+        np.searchsorted(seq, vals))
+    np.testing.assert_array_equal(
+        paddle.bucketize(_t(vals), _t(seq), right=True).numpy(),
+        np.searchsorted(seq, vals, side="right"))
+    a = _r(3, 5)
+    a[0, 1] = np.nan
+    np.testing.assert_allclose(
+        paddle.nanmedian(_t(a), axis=1).numpy(),
+        np.nanmedian(a, axis=1), rtol=1e-6)
+
+
+def test_mode_and_kthvalue():
+    x = np.array([[1, 2, 2, 3], [5, 5, 5, 1]], "float32")
+    vals, idx = paddle.mode(_t(x))
+    np.testing.assert_array_equal(vals.numpy(), [2.0, 5.0])
+    assert (x[np.arange(2), idx.numpy()] == vals.numpy()).all()
+    a = _r(3, 6)
+    v, i = paddle.kthvalue(_t(a), k=2, axis=1)
+    np.testing.assert_allclose(v.numpy(), np.sort(a, 1)[:, 1], rtol=1e-6)
+
+
+def test_manipulation_tail():
+    a = _r(3, 4)
+    np.testing.assert_allclose(paddle.rot90(_t(a)).numpy(),
+                               np.rot90(a), rtol=1e-6)
+    idx = np.array([0, 5, 11], "int64")
+    np.testing.assert_allclose(paddle.take(_t(a), _t(idx)).numpy(),
+                               a.reshape(-1)[idx], rtol=1e-6)
+    np.testing.assert_allclose(paddle.diagflat(_t(_r(3))).numpy(),
+                               np.diagflat(_r(3)), rtol=1e-6)
+
+    x = np.zeros((4, 3), "float32")
+    got = paddle.index_add(_t(x), _t(np.array([1, 1], "int64")),
+                           _t(np.ones((2, 3), "float32"))).numpy()
+    want = x.copy()
+    np.add.at(want, [1, 1], np.ones((2, 3), "float32"))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    got = paddle.index_fill(_t(x), _t(np.array([0, 2], "int64")),
+                            7.0).numpy()
+    assert (got[[0, 2]] == 7.0).all() and (got[[1, 3]] == 0.0).all()
+
+
+def test_unfold_as_strided():
+    a = _r(10)
+    u = paddle.unfold(_t(a), 0, 4, 3).numpy()
+    assert u.shape == (3, 4)
+    np.testing.assert_allclose(u[1], a[3:7], rtol=1e-6)
+    s = paddle.as_strided(_t(a), [3, 2], [2, 1], offset=1).numpy()
+    np.testing.assert_allclose(
+        s, np.lib.stride_tricks.as_strided(a[1:], (3, 2), (8, 4)),
+        rtol=1e-6)
+
+
+def test_scatter_tail():
+    a = _r(4, 3)
+    v = np.ones(3, "float32")
+    got = paddle.select_scatter(_t(a), _t(v), axis=0, index=2).numpy()
+    want = a.copy()
+    want[2] = 1.0
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    got = paddle.slice_scatter(_t(a), _t(np.zeros((2, 3), "float32")),
+                               axes=[0], starts=[1], ends=[3],
+                               strides=[1]).numpy()
+    want = a.copy()
+    want[1:3] = 0.0
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_stack_split_family():
+    a, b = _r(3), _r(3, seed=1)
+    np.testing.assert_allclose(
+        paddle.column_stack([_t(a), _t(b)]).numpy(),
+        np.column_stack([a, b]), rtol=1e-6)
+    np.testing.assert_allclose(
+        paddle.row_stack([_t(a), _t(b)]).numpy(),
+        np.vstack([a, b]), rtol=1e-6)
+    m = _r(2, 3)
+    np.testing.assert_allclose(paddle.dstack([_t(m), _t(m)]).numpy(),
+                               np.dstack([m, m]), rtol=1e-6)
+    x = _r(7, 4)
+    parts = paddle.tensor_split(_t(x), 3)
+    for got, want in zip(parts, np.array_split(x, 3)):
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-6)
+    parts = paddle.vsplit(_t(_r(6, 2)), 3)
+    assert len(parts) == 3 and tuple(parts[0].shape) == (2, 2)
+    parts = paddle.hsplit(_t(x), 2)
+    assert tuple(parts[0].shape) == (7, 2)
+    assert tuple(paddle.atleast_2d(_t(np.float32(3.0))).shape) == (1, 1)
+    assert tuple(paddle.atleast_3d(_t(a)).shape) == (1, 3, 1)
+
+
+def test_extra_grads_flow():
+    """vjp-fallback grads through a few differentiable tail ops."""
+    x = _t(_r(3, 4))
+    x.stop_gradient = False
+    y = paddle.kron(x, _t(_r(2, 2, seed=3)))
+    y.sum().backward()
+    assert x.grad is not None and np.abs(x.grad.numpy()).sum() > 0
+
+    z = _t(_r(4, 3))
+    z.stop_gradient = False
+    paddle.cdist(z, _t(_r(5, 3, seed=4))).sum().backward()
+    assert z.grad is not None and np.isfinite(z.grad.numpy()).all()
+
+
+def test_tensordot_list_axes():
+    a, b = _r(3, 4), _r(4, 5, seed=5)
+    np.testing.assert_allclose(
+        paddle.tensordot(_t(a), _t(b), axes=[[1], [0]]).numpy(),
+        np.tensordot(a, b, axes=([1], [0])), rtol=1e-4)
+
+
+def test_take_raise_checks_bounds_eagerly():
+    import pytest
+
+    with pytest.raises(IndexError, match="out of range"):
+        paddle.take(_t(_r(3, 4)), _t(np.array([100], "int64")))
+    # clip mode is explicit and allowed
+    got = paddle.take(_t(_r(3, 4)), _t(np.array([100], "int64")),
+                      mode="clip")
+    assert got.numpy().shape == (1,)

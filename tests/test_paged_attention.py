@@ -145,3 +145,28 @@ def test_failed_allocation_leaks_no_pages():
         cache._ensure_capacity(s, 6)  # needs 3 pages
     assert cache._free == before
     assert (cache.page_table[s] == 0).all()
+
+
+def test_batch_append_capacity_failure_is_atomic():
+    """A later sequence's capacity failure must not advance an earlier
+    sequence's length past its written KV (review finding)."""
+    cache = PagedKVCache(n_layers=1, n_kv_heads=1, head_dim=4,
+                         num_pages=4, page_size=1, max_seqs=2,
+                         dtype=jnp.float32)
+    a = cache.allocate()
+    b = cache.allocate()
+    cache._free = cache._free[:1]  # one page for two appends
+    k = np.ones((1, 1, 2, 4), np.float32)
+    with pytest.raises(RuntimeError, match="exhausted"):
+        cache.append([a, b], k, k)
+    assert cache.lengths[a] == 0 and cache.lengths[b] == 0
+    assert len(cache._free) == 1
+
+
+def test_paged_fallback_returns_tensor_for_tensor():
+    rng = np.random.RandomState(5)
+    q = paddle.to_tensor(rng.randn(1, 2, 8).astype("float32"))
+    kp = jnp.asarray(rng.randn(2, 4, 2, 8), jnp.float32)
+    out = paged_decode_attention(q, kp, kp, np.array([4], np.int32),
+                                 np.array([[0, 1]], np.int32))
+    assert hasattr(out, "numpy")  # Tensor in -> Tensor out
