@@ -19,7 +19,7 @@
 
 extern "C" {
 
-int64_t ptn_version() { return 2; }
+int64_t ptn_version() { return 3; }
 
 // ---------------------------------------------------------------------------
 // Flags registry (PD_DEFINE_* / PHI_DEFINE_EXPORTED_* analog).
@@ -183,6 +183,101 @@ int64_t ptn_fill_windows(const int64_t* tokens, const int64_t* offsets,
     out_used[b] += len;
   }
   return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Byte-level BPE tokenizer core (serving-side text pipeline).
+//
+// Reference parity: the reference ships fast_tokenizer (C++) for its
+// serving stack; here the BPE merge loop -- the O(word_len^2) hot path --
+// is native, with Python owning vocab files and pre-tokenization.
+// Vocabulary: n_tokens byte-strings (token_bytes + offsets); merge table:
+// rows (left_id, right_id, merged_id) ranked by row order.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct BpeTok {
+  // pair (left,right) -> (rank, merged_id)
+  std::map<std::pair<int32_t, int32_t>, std::pair<int32_t, int32_t>> ranks;
+  int32_t byte_to_id[256];
+  std::vector<std::string> id_to_bytes;
+};
+
+}  // namespace
+
+void* ptn_bpe_create(const int32_t* merges, int64_t n_merges,
+                     const uint8_t* token_bytes, const int64_t* offsets,
+                     int64_t n_tokens) {
+  auto* t = new BpeTok();
+  t->id_to_bytes.reserve(static_cast<size_t>(n_tokens));
+  for (int i = 0; i < 256; ++i) t->byte_to_id[i] = -1;
+  for (int64_t i = 0; i < n_tokens; ++i) {
+    t->id_to_bytes.emplace_back(
+        reinterpret_cast<const char*>(token_bytes) + offsets[i],
+        static_cast<size_t>(offsets[i + 1] - offsets[i]));
+    const std::string& tok = t->id_to_bytes.back();
+    if (tok.size() == 1) {
+      t->byte_to_id[static_cast<uint8_t>(tok[0])] = static_cast<int32_t>(i);
+    }
+  }
+  for (int64_t r = 0; r < n_merges; ++r) {
+    t->ranks[{merges[3 * r], merges[3 * r + 1]}] = {
+        static_cast<int32_t>(r), merges[3 * r + 2]};
+  }
+  return t;
+}
+
+void ptn_bpe_free(void* tok) { delete static_cast<BpeTok*>(tok); }
+
+// Encode one pre-tokenized word (raw bytes). Returns the number of ids
+// written, or -1 if a byte has no single-byte token, -2 if out overflows.
+int64_t ptn_bpe_encode_word(void* tok, const uint8_t* word, int64_t len,
+                            int32_t* out, int64_t max_out) {
+  auto* t = static_cast<BpeTok*>(tok);
+  std::vector<int32_t> ids;
+  ids.reserve(static_cast<size_t>(len));
+  for (int64_t i = 0; i < len; ++i) {
+    int32_t id = t->byte_to_id[word[i]];
+    if (id < 0) return -1;
+    ids.push_back(id);
+  }
+  // Greedy lowest-rank merging (the BPE contract).
+  while (ids.size() >= 2) {
+    int32_t best_rank = INT32_MAX, best_pos = -1, best_merged = -1;
+    for (size_t i = 0; i + 1 < ids.size(); ++i) {
+      auto it = t->ranks.find({ids[i], ids[i + 1]});
+      if (it != t->ranks.end() && it->second.first < best_rank) {
+        best_rank = it->second.first;
+        best_pos = static_cast<int32_t>(i);
+        best_merged = it->second.second;
+      }
+    }
+    if (best_pos < 0) break;
+    ids[static_cast<size_t>(best_pos)] = best_merged;
+    ids.erase(ids.begin() + best_pos + 1);
+  }
+  if (static_cast<int64_t>(ids.size()) > max_out) return -2;
+  std::memcpy(out, ids.data(), ids.size() * sizeof(int32_t));
+  return static_cast<int64_t>(ids.size());
+}
+
+// Decode ids back to bytes. Returns bytes written or -1 (bad id) /
+// -2 (overflow).
+int64_t ptn_bpe_decode(void* tok, const int32_t* ids, int64_t n,
+                       uint8_t* out, int64_t max_out) {
+  auto* t = static_cast<BpeTok*>(tok);
+  int64_t used = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (ids[i] < 0 ||
+        ids[i] >= static_cast<int32_t>(t->id_to_bytes.size()))
+      return -1;
+    const std::string& b = t->id_to_bytes[static_cast<size_t>(ids[i])];
+    if (used + static_cast<int64_t>(b.size()) > max_out) return -2;
+    std::memcpy(out + used, b.data(), b.size());
+    used += static_cast<int64_t>(b.size());
+  }
+  return used;
 }
 
 }  // extern "C"

@@ -98,6 +98,21 @@ def _build_and_load():
                                      ctypes.c_int64, ctypes.c_int64,
                                      ctypes.c_int64, i64p, i64p]
     lib.ptn_fill_windows.restype = ctypes.c_int64
+    if lib.ptn_version() >= 3:
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        lib.ptn_bpe_create.argtypes = [i32p, ctypes.c_int64, u8p, i64p,
+                                       ctypes.c_int64]
+        lib.ptn_bpe_create.restype = ctypes.c_void_p
+        lib.ptn_bpe_free.argtypes = [ctypes.c_void_p]
+        lib.ptn_bpe_encode_word.argtypes = [ctypes.c_void_p, u8p,
+                                            ctypes.c_int64, i32p,
+                                            ctypes.c_int64]
+        lib.ptn_bpe_encode_word.restype = ctypes.c_int64
+        lib.ptn_bpe_decode.argtypes = [ctypes.c_void_p, i32p,
+                                       ctypes.c_int64, u8p,
+                                       ctypes.c_int64]
+        lib.ptn_bpe_decode.restype = ctypes.c_int64
     return lib
 
 

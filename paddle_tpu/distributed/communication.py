@@ -158,11 +158,23 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         return out
     if group.nranks <= 1:
         return tensor
-    raise RuntimeError(
-        "Eager cross-device all_reduce outside an SPMD region requires a "
-        "mesh-bound group; wrap the step with "
-        "paddle_tpu.distributed.spmd.shard_step or use auto-parallel "
-        "shardings.")
+    gathered = _eager_process_gather(tensor, group, "all_reduce")
+    if op in (ReduceOp.SUM, "sum"):
+        out = gathered.sum(axis=0)
+    elif op in (ReduceOp.MAX, "max"):
+        out = gathered.max(axis=0)
+    elif op in (ReduceOp.MIN, "min"):
+        out = gathered.min(axis=0)
+    elif op in (ReduceOp.AVG, "avg"):
+        out = gathered.mean(axis=0)
+    elif op in (ReduceOp.PROD, "prod"):
+        out = gathered.prod(axis=0)
+    else:
+        raise ValueError(f"unsupported reduce op {op}")
+    if isinstance(tensor, Tensor):
+        tensor._data = jnp.asarray(out)
+        return tensor
+    return jnp.asarray(out)
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
@@ -180,11 +192,60 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
             tensor_list.append(tensor)
             return tensor_list
         return tensor
-    raise RuntimeError("all_gather outside SPMD needs a mesh-bound group")
+    gathered = _eager_process_gather(tensor, group, "all_gather")
+    if isinstance(tensor_list, list):
+        for i in range(gathered.shape[0]):
+            tensor_list.append(_wrap_like(tensor, jnp.asarray(gathered[i])))
+        return tensor_list
+    return _wrap_like(tensor, jnp.asarray(gathered))
+
+
+def _eager_process_gather(tensor, group, what):
+    """Cross-process eager collective substrate: gather every process's
+    value as [P, ...] via multihost_utils (a compiled all-gather over
+    ICI/DCN — the reference's out-of-graph ProcessGroup transfer).
+    Only the full world group is supported eagerly; subgroups belong in
+    the SPMD regime."""
+    if jax.process_count() <= 1:
+        # single-controller world>1 groups describe mesh axes; outside
+        # SPMD each "rank" holds the same global value.
+        d = _data(tensor)
+        return jnp.stack([d] * group.nranks)
+    if group.nranks != jax.process_count():
+        raise RuntimeError(
+            f"eager {what} supports only the full world group "
+            f"({jax.process_count()} processes); got a {group.nranks}-rank "
+            "subgroup — run subgroup collectives in the SPMD regime")
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(_data(tensor))
 
 
 def all_gather_object(object_list, obj, group=None):
-    object_list.append(obj)
+    """Gather picklable objects from every process (reference
+    communication/all_gather.py all_gather_object): pickle -> uint8
+    payload padded to the max length -> process allgather."""
+    group = group or _get_default_group()
+    if jax.process_count() <= 1:
+        # single controller: every "rank" of the group holds this obj
+        for _ in range(max(1, group.nranks)):
+            object_list.append(obj)
+        return object_list
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+    n = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray([payload.size], jnp.int32)))
+    max_len = int(n.max())
+    padded = np.zeros(max_len, np.uint8)
+    padded[:payload.size] = payload
+    datas = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray(padded)))
+    for i in range(datas.shape[0]):
+        object_list.append(pickle.loads(
+            datas[i, :int(n.reshape(-1)[i])].tobytes()))
     return object_list
 
 
@@ -216,6 +277,13 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         d = _data(tensor)
         src_local = group.get_group_rank(src) if src in group.ranks else src
         out = jax.lax.all_gather(d, group.axis_name)[src_local]
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return out
+    if group.nranks > 1 and jax.process_count() > 1:
+        gathered = _eager_process_gather(tensor, group, "broadcast")
+        out = jnp.asarray(gathered[int(src)])
         if isinstance(tensor, Tensor):
             tensor._data = out
             return tensor

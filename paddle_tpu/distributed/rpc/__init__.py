@@ -148,36 +148,47 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     _state.workers[name] = info
 
     if world_size > 1:
-        if master_endpoint is None:
-            raise ValueError("master_endpoint is required for "
-                             "world_size > 1")
-        from ..launch.master import KVClient
+        try:
+            if master_endpoint is None:
+                raise ValueError("master_endpoint is required for "
+                                 "world_size > 1")
+            from ..launch.master import KVClient
 
-        kv = KVClient(master_endpoint)
-        _state.kv = kv
-        import json
-        import time
+            kv = KVClient(master_endpoint)
+            _state.kv = kv
+            import json
+            import time
 
-        deadline = time.time() + _DEFAULT_TIMEOUT
-        while not kv.put(f"/rpc/{name}",
-                         json.dumps([name, rank, info.ip, info.port])):
-            if time.time() > deadline:  # master never came up
-                raise TimeoutError(
-                    f"init_rpc: could not register with the KV master at "
-                    f"{master_endpoint} within {_DEFAULT_TIMEOUT}s")
-            time.sleep(0.2)  # master may come up after us
-        while time.time() < deadline:
-            entries = kv.get_prefix("/rpc")
-            if len(entries) >= world_size:
-                for v in entries.values():
-                    n, r, ip, port = json.loads(v)
-                    _state.workers[n] = WorkerInfo(n, int(r), ip,
-                                                   int(port))
-                return
-            time.sleep(0.2)
-        raise TimeoutError(
-            f"init_rpc: saw {len(kv.get_prefix('/rpc'))} of "
-            f"{world_size} workers before timeout")
+            deadline = time.time() + _DEFAULT_TIMEOUT
+            while not kv.put(f"/rpc/{name}",
+                             json.dumps([name, rank, info.ip, info.port])):
+                if time.time() > deadline:  # master never came up
+                    raise TimeoutError(
+                        f"init_rpc: could not register with the KV master at "
+                        f"{master_endpoint} within {_DEFAULT_TIMEOUT}s")
+                time.sleep(0.2)  # master may come up after us
+            while time.time() < deadline:
+                entries = kv.get_prefix("/rpc")
+                if len(entries) >= world_size:
+                    for v in entries.values():
+                        n, r, ip, port = json.loads(v)
+                        _state.workers[n] = WorkerInfo(n, int(r), ip,
+                                                       int(port))
+                    return
+                time.sleep(0.2)
+            raise TimeoutError(
+                f"init_rpc: saw {len(kv.get_prefix('/rpc'))} of "
+                f"{world_size} workers before timeout")
+        except BaseException:
+            # a failed init must be retryable: tear down the
+            # half-built state (else 'init_rpc called twice' and
+            # an orphaned listener thread)
+            server.stop()
+            _state.server = None
+            _state.info = None
+            _state.workers.clear()
+            _state.kv = None
+            raise
 
 
 def _routable_ip(master_endpoint):

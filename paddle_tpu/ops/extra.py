@@ -208,12 +208,17 @@ def rot90(x, k=1, axes=(0, 1), name=None):
                  axes=tuple(int(a) for a in axes))
 
 
-_take_op = register_op(
-    "take",
-    lambda x, index, mode="raise": jnp.take(
-        x.reshape(-1), index,
-        mode="clip" if mode == "raise" else mode),
-    static_argnames=("mode",))
+def _take_impl(x, index, mode="raise"):
+    flat = x.reshape(-1)
+    if mode in ("raise", "clip"):
+        # negatives index from the end (python convention) — normalize
+        # BEFORE clipping or clip would send them to element 0.
+        index = jnp.where(index < 0, index + flat.shape[0], index)
+    return jnp.take(flat, index,
+                    mode="clip" if mode == "raise" else mode)
+
+
+_take_op = register_op("take", _take_impl, static_argnames=("mode",))
 
 
 def take(x, index, mode="raise", name=None):

@@ -13,6 +13,7 @@ layout assignment makes this free inside a jit region.
 """
 from __future__ import annotations
 
+import os
 import functools as _functools
 
 import numpy as np
@@ -47,14 +48,30 @@ def _conv_dtype(x, w):
 def _conv2d_plain(x, w, stride=(1, 1), padding=(0, 0), dilation=(1, 1),
                   groups=1, data_format="NCHW"):
     w = _conv_dtype(x, w)
-    dn = jax.lax.conv_dimension_numbers(
-        x.shape, w.shape,
-        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
-        else ("NHWC", "HWIO", "NHWC"))
     if isinstance(padding, str):
         pad = padding
     else:
         pad = [(padding[0], padding[0]), (padding[1], padding[1])]
+    nhwc = os.environ.get("PT_CONV_NHWC")
+    if data_format == "NCHW" and (nhwc == "1" or (
+            nhwc is None and jax.default_backend() == "tpu")):
+        # Compute in NHWC — the TPU's native conv layout (+8% measured
+        # on the ResNet-50 bench); boundary transposes cancel between
+        # layers under XLA.  PT_CONV_NHWC=0 restores direct NCHW.
+        dn = jax.lax.conv_dimension_numbers(
+            (x.shape[0], x.shape[2], x.shape[3], x.shape[1]),
+            (w.shape[2], w.shape[3], w.shape[1], w.shape[0]),
+            ("NHWC", "HWIO", "NHWC"))
+        out = jax.lax.conv_general_dilated(
+            jnp.transpose(x, (0, 2, 3, 1)),
+            jnp.transpose(w, (2, 3, 1, 0)),
+            window_strides=stride, padding=pad, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups)
+        return jnp.transpose(out, (0, 3, 1, 2))
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
+        else ("NHWC", "HWIO", "NHWC"))
     return jax.lax.conv_general_dilated(
         x, w, window_strides=stride, padding=pad, rhs_dilation=dilation,
         dimension_numbers=dn, feature_group_count=groups,

@@ -102,3 +102,6 @@ class datasets:  # noqa: N801
     UCIHousing = _NeedsDownload("UCIHousing")
     WMT14 = _NeedsDownload("WMT14")
     WMT16 = _NeedsDownload("WMT16")
+
+
+from .tokenizer import BPETokenizer  # noqa: F401,E402

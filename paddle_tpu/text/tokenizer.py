@@ -1,0 +1,177 @@
+"""Byte-level BPE tokenizer for the serving-side text pipeline.
+
+Reference parity: the reference's serving stack ships ``fast_tokenizer``
+(C++); here the BPE merge loop runs in the native core
+(``csrc/common/paddle_tpu_native.cc`` ptn_bpe_*) with a pure-Python
+fallback, and Python owns vocab handling + pre-tokenization.  Device
+work (embedding lookup onward) is XLA's; tokenization is host control
+plane, so native C++ is the right tool.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from ..core import native
+
+_PRETOKEN = re.compile(
+    r"\s+|[A-Za-z]+|[0-9]+|[^\sA-Za-z0-9]+")
+
+
+class BPETokenizer:
+    """vocab: {bytes_or_str token: id}; merges: ordered [(left, right)]
+    pairs of existing tokens (byte strings).  Single-byte tokens for
+    every byte reachable from the text must exist in the vocab."""
+
+    def __init__(self, vocab, merges):
+        self.vocab = {self._b(k): int(v) for k, v in vocab.items()}
+        self.id_to_token = {v: k for k, v in self.vocab.items()}
+        self.merges = [(self._b(a), self._b(b)) for a, b in merges]
+        self._ranks = {}
+        for r, (a, b) in enumerate(self.merges):
+            merged = a + b
+            if merged not in self.vocab:
+                raise ValueError(
+                    f"merge {a!r}+{b!r} -> {merged!r} not in vocab")
+            self._ranks[(self.vocab[a], self.vocab[b])] = (
+                r, self.vocab[merged])
+        self._native = None
+        lib = native.get_lib()
+        if lib is not None and hasattr(lib, "ptn_bpe_create"):
+            self._native_lib = lib
+            self._native = self._build_native(lib)
+        self._cache: dict = {}
+
+    @staticmethod
+    def _b(s):
+        return s.encode("utf-8") if isinstance(s, str) else bytes(s)
+
+    def _build_native(self, lib):
+        n = len(self.vocab)
+        toks = [self.id_to_token.get(i) for i in range(n)]
+        if any(t is None for t in toks):
+            return None  # ids must be dense 0..n-1 for the native table
+        offsets = np.zeros(n + 1, np.int64)
+        for i, t in enumerate(toks):
+            offsets[i + 1] = offsets[i] + len(t)
+        blob = np.frombuffer(b"".join(toks), np.uint8).copy() \
+            if offsets[-1] else np.zeros(1, np.uint8)
+        rows = np.zeros((max(len(self.merges), 1), 3), np.int32)
+        for r, (a, b) in enumerate(self.merges):
+            rows[r] = (self.vocab[a], self.vocab[b],
+                       self.vocab[a + b])
+        handle = lib.ptn_bpe_create(np.ascontiguousarray(rows.reshape(-1)),
+                                    len(self.merges), blob, offsets, n)
+        return handle
+
+    # -- encoding ------------------------------------------------------
+
+    def _encode_word(self, word: bytes):
+        hit = self._cache.get(word)
+        if hit is not None:
+            return hit
+        if self._native:
+            out = np.zeros(max(len(word), 1), np.int32)
+            n = self._native_lib.ptn_bpe_encode_word(
+                self._native, np.frombuffer(word, np.uint8).copy(),
+                len(word), out, out.size)
+            if n == -1:
+                raise ValueError(
+                    f"byte with no single-byte token in {word!r}")
+            ids = out[:n].tolist()
+        else:
+            ids = self._encode_word_py(word)
+        self._cache[word] = ids
+        return ids
+
+    def _encode_word_py(self, word: bytes):
+        try:
+            ids = [self.vocab[bytes([c])] for c in word]
+        except KeyError as e:
+            raise ValueError(
+                f"byte with no single-byte token in {word!r}") from e
+        while len(ids) >= 2:
+            best = None
+            for i in range(len(ids) - 1):
+                r = self._ranks.get((ids[i], ids[i + 1]))
+                if r is not None and (best is None or r[0] < best[0]):
+                    best = (r[0], i, r[1])
+            if best is None:
+                break
+            _, i, merged = best
+            ids[i:i + 2] = [merged]
+        return ids
+
+    def encode(self, text: str):
+        ids = []
+        for m in _PRETOKEN.finditer(text):
+            ids.extend(self._encode_word(m.group().encode("utf-8")))
+        return ids
+
+    def decode(self, ids):
+        if self._native:
+            ids_arr = np.asarray(list(ids), np.int32)
+            cap = 16 + 16 * max(len(ids_arr), 1)
+            out = np.zeros(cap, np.uint8)
+            n = self._native_lib.ptn_bpe_decode(
+                self._native, ids_arr, len(ids_arr), out, cap)
+            if n == -1:
+                raise ValueError("id out of range")
+            if n >= 0:
+                return out[:n].tobytes().decode("utf-8", errors="replace")
+        return b"".join(self.id_to_token[int(i)] for i in ids).decode(
+            "utf-8", errors="replace")
+
+    @property
+    def uses_native(self):
+        return bool(self._native)
+
+    def __del__(self):
+        if getattr(self, "_native", None):
+            try:
+                self._native_lib.ptn_bpe_free(self._native)
+            except Exception:
+                pass
+
+    # -- training (host-side, small corpora) ---------------------------
+
+    @classmethod
+    def train(cls, texts, vocab_size=512):
+        """Learn merges from ``texts`` (classic BPE count-and-merge) —
+        enough to build self-contained tokenizers for tests/tools."""
+        words = {}
+        for t in texts:
+            for m in _PRETOKEN.finditer(t):
+                w = tuple(bytes([c]) for c in m.group().encode("utf-8"))
+                words[w] = words.get(w, 0) + 1
+        vocab = {bytes([i]): i for i in range(256)}
+        merges = []
+        while len(vocab) < vocab_size:
+            counts = {}
+            for w, c in words.items():
+                for i in range(len(w) - 1):
+                    counts[(w[i], w[i + 1])] = \
+                        counts.get((w[i], w[i + 1]), 0) + c
+            if not counts:
+                break
+            (a, b), c = max(counts.items(), key=lambda kv: (kv[1], kv[0]))
+            if c < 2:
+                break
+            merged = a + b
+            vocab[merged] = len(vocab)
+            merges.append((a, b))
+            new_words = {}
+            for w, cnt in words.items():
+                out = []
+                i = 0
+                while i < len(w):
+                    if i + 1 < len(w) and w[i] == a and w[i + 1] == b:
+                        out.append(merged)
+                        i += 2
+                    else:
+                        out.append(w[i])
+                        i += 1
+                new_words[tuple(out)] = new_words.get(tuple(out), 0) + cnt
+            words = new_words
+        return cls(vocab, merges)

@@ -48,8 +48,6 @@ def test_math_tail_goldens():
 
 def test_cdist_and_renorm():
     x, y = _r(4, 3), _r(5, 3, seed=2)
-    from scipy.spatial.distance import cdist as sp_cdist  # noqa
-
     np.testing.assert_allclose(
         paddle.cdist(_t(x), _t(y)).numpy(),
         np.sqrt(((x[:, None] - y[None]) ** 2).sum(-1)), rtol=1e-4,
@@ -188,3 +186,10 @@ def test_take_raise_checks_bounds_eagerly():
     got = paddle.take(_t(_r(3, 4)), _t(np.array([100], "int64")),
                       mode="clip")
     assert got.numpy().shape == (1,)
+
+
+def test_take_negative_indices():
+    a = _r(3, 4)
+    got = paddle.take(_t(a), _t(np.array([-1, -12], "int64"))).numpy()
+    np.testing.assert_allclose(got, [a.reshape(-1)[-1],
+                                     a.reshape(-1)[0]], rtol=1e-6)

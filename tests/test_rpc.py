@@ -87,3 +87,21 @@ def test_rpc_two_processes():
             worker.kill()
         master.stop()
         time.sleep(0.1)
+
+
+def test_init_rpc_failure_is_retryable():
+    """A registration timeout tears the half-built state down so
+    init_rpc can be retried (review finding)."""
+    import paddle_tpu.distributed.rpc as rpc_mod
+
+    old_timeout = rpc_mod._DEFAULT_TIMEOUT
+    rpc_mod._DEFAULT_TIMEOUT = 0.5
+    try:
+        with pytest.raises(TimeoutError):
+            rpc.init_rpc("w0", 0, 2, "127.0.0.1:1")  # no master there
+        assert rpc_mod._state.server is None
+        rpc.init_rpc("solo")  # retry (single-process) succeeds
+        assert rpc.rpc_sync("solo", operator.add, args=(1, 1)) == 2
+    finally:
+        rpc_mod._DEFAULT_TIMEOUT = old_timeout
+        rpc.shutdown()

@@ -1,0 +1,83 @@
+"""Native BPE tokenizer (csrc ptn_bpe_*): roundtrip, native-vs-python
+parity, training.
+"""
+import numpy as np
+
+from paddle_tpu.core import native
+from paddle_tpu.text.tokenizer import BPETokenizer
+
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the quicker the better, the lazier the worse",
+    "pack my box with five dozen liquor jugs 12345",
+]
+
+
+def test_train_encode_decode_roundtrip():
+    tok = BPETokenizer.train(CORPUS, vocab_size=300)
+    for text in CORPUS + ["unseen words still tokenize fine 678"]:
+        ids = tok.encode(text)
+        assert tok.decode(ids) == text
+        assert all(isinstance(i, int) for i in ids)
+    # merges actually compress
+    assert len(tok.encode(CORPUS[0])) < len(CORPUS[0].encode())
+
+
+def test_native_matches_python():
+    tok = BPETokenizer.train(CORPUS, vocab_size=280)
+    if not tok.uses_native:
+        import pytest
+
+        pytest.skip("native lib unavailable")
+    for text in CORPUS:
+        native_ids = tok.encode(text)
+        tok._cache.clear()
+        py_ids = []
+        import re as _re
+        from paddle_tpu.text.tokenizer import _PRETOKEN
+
+        for m in _PRETOKEN.finditer(text):
+            py_ids.extend(tok._encode_word_py(m.group().encode()))
+        assert native_ids == py_ids, (text, native_ids, py_ids)
+
+
+def test_greedy_rank_order():
+    """Lowest-rank (earliest) merge wins, not leftmost-pair."""
+    vocab = {bytes([c]): c for c in range(256)}
+    vocab[b"ab"] = 256
+    vocab[b"bc"] = 257
+    vocab[b"abc"] = 258
+    # bc ranks before ab: "abc" -> a + bc, never ab + c
+    tok = BPETokenizer(vocab, [(b"b", b"c"), (b"a", b"b"),
+                               (b"ab", b"c")])
+    assert tok.encode("abc") == [ord("a"), 257]
+
+
+def test_decode_rejects_bad_id():
+    tok = BPETokenizer.train(CORPUS, vocab_size=260)
+    import pytest
+
+    with pytest.raises((ValueError, KeyError)):
+        tok.decode([10 ** 6])
+
+
+def test_native_available_and_version():
+    lib = native.get_lib()
+    if lib is None:
+        import pytest
+
+        pytest.skip("no toolchain")
+    assert lib.ptn_version() >= 3
+    assert hasattr(lib, "ptn_bpe_create")
+
+
+def test_sparse_vocab_falls_back_to_python():
+    """Non-dense ids (special-token gaps) construct fine and use the
+    pure-Python path (review finding)."""
+    vocab = {bytes([c]): c for c in range(256)}
+    vocab[b"ab"] = 300  # gap: ids 256..299 unused
+    tok = BPETokenizer(vocab, [(b"a", b"b")])
+    assert not tok.uses_native
+    assert tok.encode("ab") == [300]
+    assert tok.decode([300]) == "ab"
