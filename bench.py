@@ -146,7 +146,65 @@ def main():
         except Exception as e:
             print(f"unet: FAILED: {e}", file=sys.stderr)
             result["sd_unet"] = {"error": str(e)[:200]}
+    if not on_cpu and os.environ.get("PT_BENCH_SKIP_DET") != "1":
+        try:
+            result["detection_amp_o2"] = _bench_detection(jax)
+        except Exception as e:
+            print(f"detection: FAILED: {e}", file=sys.stderr)
+            result["detection_amp_o2"] = {"error": str(e)[:200]}
     print(json.dumps(result))
+
+
+def _bench_detection(jax):
+    """BASELINE config 4: detection train step under O2-equivalent
+    mixed precision (bf16 compute weights+activations, fp32 master) —
+    ResNet-18 backbone + anchor-free box/cls heads at 320px, the
+    PP-YOLOE-style workload shape (dynamic shapes re-expressed
+    statically per SURVEY §7; nms/roi_align are eval-side, tested in
+    tests/test_detection_amp.py)."""
+    import gc
+
+    from paddle_tpu import nn
+    from paddle_tpu.models.training import CompiledTrainStep
+    from paddle_tpu.vision.models import resnet18
+
+    gc.collect()
+
+    class Detector(nn.Layer):
+        def __init__(self, num_classes=80):
+            super().__init__()
+            self.backbone = resnet18(num_classes=0, with_pool=False)
+            self.box = nn.Conv2D(512, 4, 1)
+            self.cls = nn.Conv2D(512, num_classes, 1)
+
+        def forward(self, x, box_t, cls_t):
+            from paddle_tpu import ops
+
+            f = self.backbone(x)
+            l_box = ops.mean(ops.abs(self.box(f) - box_t))
+            l_cls = nn.functional.binary_cross_entropy_with_logits(
+                self.cls(f), cls_t)
+            return l_box + l_cls
+
+    model = Detector()
+    model.train()
+    step = CompiledTrainStep(model, lr=1e-3, compute_dtype="bfloat16")
+    batch = int(os.environ.get("PT_BENCH_DET_BATCH", "64"))
+    rng = np.random.RandomState(0)
+    import jax.numpy as jnp
+
+    imgs = jnp.asarray(rng.randn(batch, 3, 320, 320), jnp.bfloat16)
+    box_t = rng.randn(batch, 4, 10, 10).astype(np.float32)
+    cls_t = (rng.rand(batch, 80, 10, 10) > 0.95).astype(np.float32)
+    print("detection: compiling...", file=sys.stderr)
+    dt, loss = _time_steps(step.step, (imgs, box_t, cls_t), 5,
+                           "detection")
+    imgs_s = batch / dt
+    print(f"detection: step {dt * 1e3:.1f} ms, {imgs_s:.0f} imgs/s",
+          file=sys.stderr)
+    return {"value": round(imgs_s, 1), "unit": "imgs/s/chip",
+            "batch": batch, "image": 320,
+            "precision": "bf16 compute (O2-equivalent)"}
 
 
 def _bench_unet(jax):
@@ -256,7 +314,7 @@ def _bench_resnet(jax):
                              loss_fn=F.cross_entropy)
     import jax.numpy as jnp
 
-    batch = int(os.environ.get("PT_BENCH_RESNET_BATCH", "128"))
+    batch = int(os.environ.get("PT_BENCH_RESNET_BATCH", "256"))
     rng = np.random.RandomState(0)
     # bf16 images to match the bf16-cast conv weights (XLA convs require
     # matching operand dtypes; matmul-only models auto-promote).
@@ -274,22 +332,31 @@ def _bench_resnet(jax):
 
 
 
+def _sync(x):
+    """Block on device completion.  The step returns a paddle Tensor —
+    an opaque pytree leaf jax.block_until_ready would silently skip
+    (it would then time only async dispatch) — so sync the raw array."""
+    import jax
+
+    jax.block_until_ready(getattr(x, "_data", x))
+
+
 def _time_steps(step_fn, args, steps, tag):
     """Shared compile/warmup/timed-loop harness (one methodology for
     every bench section)."""
-    import jax
-
     t0 = time.perf_counter()
     loss = step_fn(*args)
-    jax.block_until_ready(loss)
+    _sync(loss)
     print(f"{tag}: first step {time.perf_counter() - t0:.1f}s, "
           f"loss {float(loss):.3f}", file=sys.stderr)
     loss = step_fn(*args)
-    jax.block_until_ready(loss)
+    _sync(loss)
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = step_fn(*args)
-    jax.block_until_ready(loss)
+    # steps chain through the (donated) param state, so the last loss
+    # being ready implies the whole sequence executed on device.
+    _sync(loss)
     return (time.perf_counter() - t0) / steps, loss
 
 

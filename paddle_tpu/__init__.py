@@ -61,6 +61,10 @@ from . import text  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from . import static  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import utils  # noqa: F401
+from .hapi import callbacks  # noqa: F401
+from .hapi.summary import summary  # noqa: F401
 from . import geometric  # noqa: F401
 from . import onnx  # noqa: F401
 from .hapi import Model  # noqa: F401
@@ -101,3 +105,21 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
 
     return dynamic_flops(net, input_size, custom_ops=custom_ops,
                          print_detail=print_detail)
+
+
+from ._misc_api import (  # noqa: F401,E402
+    broadcast_tensors, finfo, iinfo, is_complex, is_floating_point,
+    is_tensor, rank,
+)
+
+__version__ = "0.3.0"
+
+
+class version:  # noqa: N801 — namespace (reference paddle.version)
+    full_version = __version__
+    major, minor, patch = "0", "3", "0"
+    commit = "tpu-native"
+
+    @staticmethod
+    def show():
+        print(f"paddle_tpu {__version__} (tpu-native)")

@@ -1,0 +1,78 @@
+"""Small top-level API conveniences (reference python/paddle/framework/
++ tensor/attribute.py): iinfo/finfo, is_tensor/is_complex/
+is_floating_point, rank, broadcast_tensors, version."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Tensor
+
+
+class _DtypeInfo:
+    def __init__(self, info, bits):
+        self.min = info.min
+        self.max = info.max
+        self.bits = bits
+        self.dtype = str(np.dtype(info.dtype)) if hasattr(info, "dtype") \
+            else None
+        if hasattr(info, "eps"):
+            self.eps = float(info.eps)
+            self.tiny = float(info.tiny)
+            self.smallest_normal = float(info.tiny)
+            self.resolution = float(info.resolution)
+
+
+def iinfo(dtype):
+    from .core.dtype import convert_dtype
+
+    d = np.dtype(str(convert_dtype(dtype)))
+    return _DtypeInfo(np.iinfo(d), d.itemsize * 8)
+
+
+def finfo(dtype):
+    from .core.dtype import convert_dtype
+
+    d = convert_dtype(dtype)
+    if str(d) == "bfloat16":
+        info = jnp.finfo(jnp.bfloat16)
+        out = _DtypeInfo.__new__(_DtypeInfo)
+        out.min = float(info.min)
+        out.max = float(info.max)
+        out.bits = 16
+        out.eps = float(info.eps)
+        out.tiny = float(info.tiny)
+        out.smallest_normal = float(info.tiny)
+        out.resolution = float(info.resolution)
+        out.dtype = "bfloat16"
+        return out
+    d = np.dtype(str(d))
+    return _DtypeInfo(np.finfo(d), d.itemsize * 8)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_complex(x):
+    return jnp.issubdtype(
+        (x._data if isinstance(x, Tensor) else jnp.asarray(x)).dtype,
+        jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(
+        (x._data if isinstance(x, Tensor) else jnp.asarray(x)).dtype,
+        jnp.floating)
+
+
+def rank(x):
+    return Tensor(jnp.asarray(
+        (x._data if isinstance(x, Tensor) else jnp.asarray(x)).ndim))
+
+
+def broadcast_tensors(inputs, name=None):
+    datas = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+             for x in inputs]
+    shape = jnp.broadcast_shapes(*[d.shape for d in datas])
+    return [Tensor(jnp.broadcast_to(d, shape)) for d in datas]

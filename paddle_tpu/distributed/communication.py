@@ -231,6 +231,12 @@ def all_gather_object(object_list, obj, group=None):
         for _ in range(max(1, group.nranks)):
             object_list.append(obj)
         return object_list
+    if group.nranks != jax.process_count():
+        raise RuntimeError(
+            f"eager all_gather_object supports only the full world group "
+            f"({jax.process_count()} processes); got a {group.nranks}-rank "
+            "subgroup — a subgroup call would deadlock the whole-world "
+            "process allgather")
     import pickle
 
     from jax.experimental import multihost_utils

@@ -1,0 +1,83 @@
+"""paddle.summary — layer-by-layer model summary.
+
+Reference: ``python/paddle/hapi/model_summary.py`` — prints a table of
+(layer, output shape, params) via forward hooks on a dry run and
+returns {'total_params': N, 'trainable_params': N}.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn.layers import Layer
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    from .. import autograd
+
+    if input is None:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        sizes = [input_size] if isinstance(input_size[0], int) \
+            else list(input_size)
+        dts = dtypes or ["float32"] * len(sizes)
+        input = [Tensor(jnp.zeros([d if d and d > 0 else 1
+                                   for d in s], dt))
+                 for s, dt in zip(sizes, dts)]
+    elif not isinstance(input, (list, tuple)):
+        input = [input]
+
+    rows = []
+    handles = []
+
+    def make_hook(name, layer):
+        def hook(lyr, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (tuple, list)) \
+                else outputs
+            shape = list(out.shape) if hasattr(out, "shape") else []
+            n_params = int(sum(np.prod(p.shape)
+                               for p in lyr.parameters(
+                                   include_sublayers=False)))
+            rows.append((f"{type(lyr).__name__}-{len(rows) + 1}",
+                         name, shape, n_params))
+
+        return hook
+
+    for name, layer in net.named_sublayers():
+        if isinstance(layer, Layer):
+            handles.append(layer.register_forward_post_hook(
+                make_hook(name, layer)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        with autograd.no_grad():
+            net(*input)
+    finally:
+        for h in handles:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = int(sum(np.prod(p.shape) for _, p in net.named_parameters()))
+    trainable = int(sum(np.prod(p.shape)
+                        for _, p in net.named_parameters()
+                        if not p.stop_gradient))
+
+    w_name = max([len(r[0]) for r in rows] + [12])
+    w_shape = max([len(str(r[2])) for r in rows] + [14])
+    line = "-" * (w_name + w_shape + 30)
+    print(line)
+    print(f"{'Layer (type)':<{w_name}}  {'Output Shape':<{w_shape}}  "
+          f"{'Param #':>12}")
+    print("=" * (w_name + w_shape + 30))
+    for label, _, shape, n in rows:
+        print(f"{label:<{w_name}}  {str(shape):<{w_shape}}  {n:>12,}")
+    print("=" * (w_name + w_shape + 30))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}

@@ -5,3 +5,4 @@ models MoE).
 """
 from . import nn  # noqa: F401
 from . import autograd  # noqa: F401
+from .ema import ExponentialMovingAverage  # noqa: F401

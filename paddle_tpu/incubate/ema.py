@@ -1,0 +1,51 @@
+"""Exponential moving average of model parameters.
+
+Reference: ``python/paddle/static/nn/common.py`` ExponentialMovingAverage
+(static-graph formulation); here the eager/TPU-native form — shadow
+values live as device arrays, ``update()`` after each optimizer step,
+``apply()``/``restore()`` swap them in for evaluation.  Includes the
+reference's bias correction (thres_steps analog via step counting).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class ExponentialMovingAverage:
+    def __init__(self, parameters, decay=0.999, use_bias_correction=True):
+        self._params = list(parameters)
+        self._decay = float(decay)
+        self._bias_correction = use_bias_correction
+        self._step = 0
+        self._shadow = {id(p): jnp.asarray(p._data) for p in self._params}
+        self._backup = None
+
+    def update(self):
+        self._step += 1
+        d = self._decay
+        if self._bias_correction:
+            # effective decay ramps up from 0 (reference thres_steps
+            # behavior): d_t = min(decay, (1+t)/(10+t))
+            d = min(self._decay, (1.0 + self._step) / (10.0 + self._step))
+        for p in self._params:
+            s = self._shadow[id(p)]
+            self._shadow[id(p)] = d * s + (1.0 - d) * jnp.asarray(
+                p._data, s.dtype)
+
+    def apply(self):
+        """Swap EMA values into the parameters (for evaluation)."""
+        if self._backup is not None:
+            raise RuntimeError("apply() called twice without restore()")
+        self._backup = {id(p): p._data for p in self._params}
+        for p in self._params:
+            p._data = jnp.asarray(self._shadow[id(p)], p._data.dtype)
+
+    def restore(self):
+        if self._backup is None:
+            raise RuntimeError("restore() without a prior apply()")
+        for p in self._params:
+            p._data = self._backup[id(p)]
+        self._backup = None
+
+    def state_dict(self):
+        return {i: v for i, (k, v) in enumerate(self._shadow.items())}

@@ -52,6 +52,8 @@ from .linalg import (  # noqa: F401
     matmul, mm, bmm, inner, dot, outer, addmm, einsum, norm, dist,
     triangular_solve, cholesky, inverse, det, slogdet, solve, svd, qr, eigh,
     matrix_power, pinv, matrix_rank, cross, histogram, bincount,
+    lu, lu_unpack, cholesky_solve, eig, eigvals, eigvalsh, svdvals, cond,
+    corrcoef, cov, lstsq, matrix_exp, multi_dot,
 )
 from .creation import (  # noqa: F401
     zeros, ones, full, empty, zeros_like, ones_like, full_like, empty_like,

@@ -210,9 +210,11 @@ def rot90(x, k=1, axes=(0, 1), name=None):
 
 def _take_impl(x, index, mode="raise"):
     flat = x.reshape(-1)
-    if mode in ("raise", "clip"):
+    if mode == "raise":
         # negatives index from the end (python convention) — normalize
-        # BEFORE clipping or clip would send them to element 0.
+        # BEFORE clipping or clip would send them to element 0.  In
+        # explicit 'clip' mode the reference clips negatives to 0, so
+        # no normalization there.
         index = jnp.where(index < 0, index + flat.shape[0], index)
     return jnp.take(flat, index,
                     mode="clip" if mode == "raise" else mode)

@@ -310,3 +310,152 @@ def bincount(x, weights=None, minlength=0, name=None):
     arr = np.asarray(x._data if isinstance(x, Tensor) else x)
     w = np.asarray(weights._data) if isinstance(weights, Tensor) else weights
     return Tensor(jnp.asarray(np.bincount(arr, w, minlength)))
+
+
+# -- linalg tail (reference python/paddle/tensor/linalg.py) -----------------
+
+def _raw(x):
+    from ..core.tensor import Tensor
+
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """Packed LU + 1-based pivots (reference linalg.lu)."""
+    from ..core.tensor import Tensor
+
+    import jax
+
+    res = jax.lax.linalg.lu(_raw(x))
+    packed, piv = res[0], res[1]
+    out = (Tensor(packed), Tensor(piv.astype(jnp.int64) + 1))
+    if get_infos:
+        info = jnp.zeros(packed.shape[:-2], jnp.int64)
+        return out + (Tensor(info),)
+    return out
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """(P, L, U) from packed LU (reference linalg.lu_unpack)."""
+    from ..core.tensor import Tensor
+
+    import jax
+
+    a = _raw(lu_data)
+    piv = _raw(lu_pivots).astype(jnp.int32) - 1  # back to 0-based
+    m, n = a.shape[-2], a.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(a[..., :, :k], -1) + jnp.eye(m, k, dtype=a.dtype)
+    U = jnp.triu(a[..., :k, :])
+    # pivots -> permutation: apply row swaps to identity (batched)
+    batch = piv.shape[:-1]
+    n_piv = piv.shape[-1]
+
+    def apply_swaps(piv_row):
+        def body(i, pr):
+            j = piv_row[i]
+            pi, pj = pr[i], pr[j]
+            return pr.at[i].set(pj).at[j].set(pi)
+
+        return jax.lax.fori_loop(0, n_piv, body, jnp.arange(m))
+
+    if batch:
+        perm = jax.vmap(apply_swaps)(piv.reshape(-1, n_piv))
+        perm = perm.reshape(batch + (m,))
+    else:
+        perm = apply_swaps(piv)
+    P = jnp.swapaxes(jnp.eye(m, dtype=a.dtype)[perm], -1, -2)
+    return Tensor(P), Tensor(L), Tensor(U)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    """Solve A X = B given the Cholesky factor (reference
+    linalg.cholesky_solve)."""
+    from ..core.tensor import Tensor
+
+    import jax.scipy.linalg as jsl
+
+    return Tensor(jsl.cho_solve((_raw(y), not upper), _raw(x)))
+
+
+def eig(x, name=None):
+    """General (non-symmetric) eigendecomposition.  XLA has no TPU
+    kernel for general eig (CPU only in the reference's GPU build too —
+    phi eig kernel is CPU); computed host-side via LAPACK."""
+    from ..core.tensor import Tensor
+
+    import numpy as _np
+
+    w, v = _np.linalg.eig(_np.asarray(_raw(x)))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    from ..core.tensor import Tensor
+
+    import numpy as _np
+
+    return Tensor(jnp.asarray(_np.linalg.eigvals(_np.asarray(_raw(x)))))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    from ..core.tensor import Tensor
+
+    return Tensor(jnp.linalg.eigvalsh(_raw(x), UPLO=UPLO))
+
+
+def svdvals(x, name=None):
+    from ..core.tensor import Tensor
+
+    return Tensor(jnp.linalg.svd(_raw(x), compute_uv=False))
+
+
+def cond(x, p=None, name=None):
+    from ..core.tensor import Tensor
+
+    return Tensor(jnp.asarray(jnp.linalg.cond(_raw(x), p=p)))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    from ..core.tensor import Tensor
+
+    return Tensor(jnp.corrcoef(_raw(x), rowvar=rowvar))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None,
+        name=None):
+    from ..core.tensor import Tensor
+
+    fw = None if fweights is None else _raw(fweights)
+    aw = None if aweights is None else _raw(aweights)
+    return Tensor(jnp.cov(_raw(x), rowvar=rowvar,
+                          ddof=1 if ddof else 0, fweights=fw,
+                          aweights=aw))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    """Least squares (reference linalg.lstsq): returns (solution,
+    residuals, rank, singular_values)."""
+    from ..core.tensor import Tensor
+
+    sol, res, rank, sv = jnp.linalg.lstsq(_raw(x), _raw(y), rcond=rcond)
+    return (Tensor(sol), Tensor(res), Tensor(jnp.asarray(rank)),
+            Tensor(sv))
+
+
+def matrix_exp(x, name=None):
+    from ..core.tensor import Tensor
+
+    import jax.scipy.linalg as jsl
+
+    return Tensor(jsl.expm(_raw(x)))
+
+
+def multi_dot(tensors, name=None):
+    """Chain matmul with optimal-order association (jnp's dynamic
+    program picks the association)."""
+    from ..core.tensor import Tensor
+
+    datas = [_raw(t) for t in tensors]
+    return Tensor(jnp.linalg.multi_dot(datas))

@@ -48,7 +48,21 @@ class Generator:
     def next_key(self):
         with self._lock:
             self._ensure_key()
-            self._key, sub = jax.random.split(self._key)
+            new_key, sub = jax.random.split(self._key)
+            if isinstance(new_key, jax.core.Tracer):
+                # Under a jit trace, omnistaging stages the split and a
+                # TRACER would be written back as generator state —
+                # poisoning every later trace in the process
+                # (UnexpectedTracerError on key<fry>).  Advance the
+                # concrete state at trace time instead; the subkey is
+                # baked into the trace as a constant (sampling inside a
+                # compiled step is deterministic per compilation —
+                # thread explicit keys for per-step variation).
+                with jax.ensure_compile_time_eval():
+                    new_key, sub = jax.random.split(self._key)
+                if isinstance(new_key, jax.core.Tracer):
+                    return sub  # give up on advancing; never store it
+            self._key = new_key
             return sub
 
     def get_state(self):

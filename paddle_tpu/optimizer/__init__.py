@@ -1,5 +1,6 @@
 from . import lr  # noqa: F401
 from .optimizer import L1Decay, L2Decay, Optimizer  # noqa: F401
 from .optimizers import (  # noqa: F401
-    SGD, Adagrad, Adam, AdamW, Lamb, Momentum, RMSProp,
+    SGD, Adagrad, Adam, AdamW, Lamb, Lars, LarsMomentum, Momentum,
+    RMSProp,
 )

@@ -236,3 +236,47 @@ class Lamb(Optimizer):
         self._set_accumulator(p, "moment1", m)
         self._set_accumulator(p, "moment2", v)
         return new_p.astype(pd.dtype)
+
+
+@jax.jit
+def _lars_update(pd, gd, vel, lr, momentum, lars_coeff, lars_wd, eps):
+    p32 = pd.astype(jnp.float32)
+    g32 = gd.astype(jnp.float32)
+    p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+    g_norm = jnp.sqrt(jnp.sum(g32 * g32))
+    # layer-wise adaptive rate (LARS paper / reference lars_momentum op)
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lars_coeff * p_norm / (g_norm + lars_wd * p_norm + eps), 1.0)
+    scaled = (g32 + lars_wd * p32) * local_lr * lr
+    vel32 = momentum * vel.astype(jnp.float32) + scaled
+    return (p32 - vel32).astype(pd.dtype), vel32
+
+
+class LarsMomentum(Optimizer):
+    """LARS (layer-wise adaptive rate scaling) momentum — reference
+    ``lars_momentum`` kernel / paddle.incubate LarsMomentumOptimizer.
+    Large-batch vision training (the reference's ResNet ImageNet
+    recipes)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, epsilon=1e-9, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._epsilon = epsilon
+
+    def _update_param(self, p, pd, gd, lr, wd):
+        vel = self._get_accumulator(p, "velocity", dtype=jnp.float32)
+        new_p, vel = _lars_update(pd, gd, vel, lr, self._momentum,
+                                  self._lars_coeff, self._lars_wd,
+                                  self._epsilon)
+        self._set_accumulator(p, "velocity", vel)
+        return new_p
+
+
+Lars = LarsMomentum

@@ -1,0 +1,7 @@
+"""paddle.regularizer (reference: python/paddle/regularizer.py) —
+L1Decay/L2Decay, consumed by optimizers' ``weight_decay`` argument.
+The implementations live with the optimizer (optimizer/optimizer.py),
+which applies them inside the update."""
+from .optimizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

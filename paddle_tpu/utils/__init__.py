@@ -59,3 +59,31 @@ def run_check():
     print(f"paddle_tpu is installed and working on {dev.device_kind} "
           f"({jax.device_count()} device(s)).")
     return True
+
+
+class unique_name:  # noqa: N801 — namespace (reference utils/unique_name.py)
+    """Name generator: unique_name.generate('fc') -> 'fc_0', 'fc_1', ..."""
+
+    _counters: dict = {}
+
+    @classmethod
+    def generate(cls, key):
+        n = cls._counters.get(key, 0)
+        cls._counters[key] = n + 1
+        return f"{key}_{n}"
+
+    @classmethod
+    def guard(cls, new_generator=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _guard():
+            saved = dict(cls._counters)
+            cls._counters.clear()
+            try:
+                yield
+            finally:
+                cls._counters.clear()
+                cls._counters.update(saved)
+
+        return _guard()

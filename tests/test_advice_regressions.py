@@ -126,3 +126,30 @@ def test_amp_cast_cache_survives_backward_and_no_grad():
         assert float(np.abs(lin.weight.grad.numpy()).sum()) > 0
         lin.weight.clear_grad()
         lin.bias.clear_grad()
+
+
+def test_traced_dropout_does_not_poison_generator():
+    """A jit trace through dropout must not write a traced PRNG key
+    back into the global generator (r3 bench: BERT's traced dropout
+    made every LATER trace fail with UnexpectedTracerError)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.ops.random import default_generator
+
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 4),
+                               paddle.nn.Dropout(0.5))
+    net.train()
+
+    def step(x):
+        return net(paddle.Tensor(x))._data.sum()
+
+    out1 = jax.jit(step)(jnp.ones((2, 4), jnp.float32))
+    # generator state must remain concrete
+    assert not isinstance(default_generator._key, jax.core.Tracer)
+    # and a subsequent, unrelated trace must still work
+    out2 = jax.jit(lambda x: paddle.nn.functional.dropout(
+        paddle.Tensor(x), 0.5, training=True)._data.sum())(
+        jnp.ones((2, 4), jnp.float32))
+    assert jnp.isfinite(out1) and jnp.isfinite(out2)

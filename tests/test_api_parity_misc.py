@@ -1,0 +1,113 @@
+"""Round-3 API-parity additions: regularizer, Lars, EMA, summary,
+unique_name, callbacks alias.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_regularizer_namespace():
+    wd = paddle.regularizer.L2Decay(0.01)
+    assert wd.coeff == 0.01
+    m = nn.Linear(4, 4)
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, weight_decay=wd,
+                                    parameters=m.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    (m(x) ** 2).mean().backward()
+    opt.step()  # decay applied without error
+    # L1Decay drives small weights toward zero
+    paddle.seed(0)
+    m2 = nn.Linear(4, 4)
+    w0 = np.abs(m2.weight.numpy()).sum()
+    opt2 = paddle.optimizer.SGD(
+        learning_rate=0.1, weight_decay=paddle.regularizer.L1Decay(0.1),
+        parameters=m2.parameters())
+    for _ in range(3):
+        loss = (m2(x) * 0.0).sum()  # zero task grad: pure decay
+        loss.backward()
+        opt2.step()
+        opt2.clear_grad()
+    assert np.abs(m2.weight.numpy()).sum() < w0
+
+
+def test_lars_momentum_trains():
+    paddle.seed(1)
+    m = nn.Linear(8, 4)
+    opt = paddle.optimizer.Lars(learning_rate=0.1,
+                                parameters=m.parameters())
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype("float32"))
+    y = paddle.to_tensor(np.random.RandomState(1).randn(4, 4)
+                         .astype("float32"))
+    losses = []
+    for _ in range(5):
+        loss = ((m(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0], losses
+
+
+def test_ema_apply_restore():
+    paddle.seed(2)
+    m = nn.Linear(4, 4)
+    ema = paddle.incubate.ExponentialMovingAverage(m.parameters(),
+                                                   decay=0.5)
+    w_init = m.weight.numpy().copy()
+    m.weight._data = m.weight._data + 1.0
+    ema.update()
+    w_live = m.weight.numpy().copy()
+    ema.apply()
+    w_ema = m.weight.numpy().copy()
+    # bias-corrected decay at t=1 is min(0.5, 2/11) = 2/11
+    d = 2.0 / 11.0
+    np.testing.assert_allclose(w_ema, d * w_init + (1 - d) * w_live,
+                               rtol=1e-5)
+    ema.restore()
+    np.testing.assert_allclose(m.weight.numpy(), w_live, rtol=1e-6)
+
+
+def test_summary_counts_params(capsys):
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    info = paddle.summary(m, (1, 8))
+    want = 8 * 16 + 16 + 16 * 4 + 4
+    assert info["total_params"] == want
+    out = capsys.readouterr().out
+    assert "Total params" in out and "Linear" in out
+
+
+def test_unique_name():
+    from paddle_tpu.utils import unique_name
+
+    with unique_name.guard():
+        assert unique_name.generate("fc") == "fc_0"
+        assert unique_name.generate("fc") == "fc_1"
+        assert unique_name.generate("conv") == "conv_0"
+    with unique_name.guard():
+        assert unique_name.generate("fc") == "fc_0"  # reset under guard
+
+
+def test_callbacks_alias():
+    assert paddle.callbacks.EarlyStopping is not None
+    assert paddle.callbacks.ModelCheckpoint is not None
+
+
+def test_dtype_info():
+    assert paddle.finfo("bfloat16").bits == 16
+    assert paddle.finfo("float32").eps < 1e-6
+    assert paddle.iinfo("int8").max == 127
+    assert paddle.is_tensor(paddle.to_tensor([1.0]))
+    assert not paddle.is_tensor(np.ones(3))
+    assert paddle.is_floating_point(paddle.to_tensor([1.0]))
+    assert not paddle.is_complex(paddle.to_tensor([1.0]))
+
+
+def test_broadcast_tensors_and_rank():
+    a, b = paddle.broadcast_tensors(
+        [paddle.to_tensor(np.ones((1, 3), "float32")),
+         paddle.to_tensor(np.ones((2, 1), "float32"))])
+    assert tuple(a.shape) == (2, 3) and tuple(b.shape) == (2, 3)
+    assert int(paddle.rank(a).numpy()) == 2
+    assert paddle.version.full_version == paddle.__version__

@@ -1,0 +1,104 @@
+"""linalg tail ops vs NumPy/SciPy goldens (ops/linalg.py round-3
+additions; reference python/paddle/tensor/linalg.py).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def _r(*s, seed=0):
+    return np.random.RandomState(seed).randn(*s).astype("float32")
+
+
+def _spd(n, seed=0):
+    a = np.random.RandomState(seed).randn(n, n).astype("float32")
+    return a @ a.T + n * np.eye(n, dtype="float32")
+
+
+def test_lu_and_unpack_reconstruct():
+    a = _r(4, 4)
+    packed, piv = paddle.linalg.lu(_t(a))
+    P, L, U = paddle.linalg.lu_unpack(packed, piv)
+    rec = P.numpy() @ L.numpy() @ U.numpy()
+    np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-5)
+    assert piv.numpy().min() >= 1  # 1-based like the reference
+
+
+def test_lu_get_infos():
+    _, _, info = paddle.linalg.lu(_t(_r(3, 3)), get_infos=True)
+    assert info.numpy().sum() == 0
+
+
+def test_cholesky_solve():
+    A = _spd(4)
+    b = _r(4, 2, seed=1)
+    Lc = np.linalg.cholesky(A)
+    got = paddle.linalg.cholesky_solve(_t(b), _t(Lc), upper=False)
+    np.testing.assert_allclose(got.numpy(), np.linalg.solve(A, b),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_eig_family():
+    a = _r(4, 4)
+    w, v = paddle.linalg.eig(_t(a))
+    np.testing.assert_allclose(
+        np.sort_complex(w.numpy()), np.sort_complex(np.linalg.eigvals(a)),
+        rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        np.sort_complex(paddle.linalg.eigvals(_t(a)).numpy()),
+        np.sort_complex(np.linalg.eigvals(a)), rtol=1e-4, atol=1e-4)
+    s = _spd(4)
+    np.testing.assert_allclose(paddle.linalg.eigvalsh(_t(s)).numpy(),
+                               np.linalg.eigvalsh(s), rtol=1e-4)
+
+
+def test_svdvals_cond():
+    a = _r(4, 3)
+    np.testing.assert_allclose(paddle.linalg.svdvals(_t(a)).numpy(),
+                               np.linalg.svd(a, compute_uv=False),
+                               rtol=1e-4)
+    s = _spd(3)
+    np.testing.assert_allclose(float(paddle.linalg.cond(_t(s)).numpy()),
+                               np.linalg.cond(s), rtol=1e-3)
+
+
+def test_cov_corrcoef():
+    x = _r(3, 50)
+    np.testing.assert_allclose(paddle.linalg.cov(_t(x)).numpy(),
+                               np.cov(x), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(paddle.linalg.corrcoef(_t(x)).numpy(),
+                               np.corrcoef(x), rtol=1e-4, atol=1e-5)
+
+
+def test_lstsq_matrix_exp_multi_dot():
+    A = _r(6, 3)
+    b = _r(6, 2, seed=2)
+    sol, _, rank, sv = paddle.linalg.lstsq(_t(A), _t(b))
+    want, _, wrank, wsv = np.linalg.lstsq(A, b, rcond=None)
+    np.testing.assert_allclose(sol.numpy(), want, rtol=1e-3, atol=1e-4)
+    assert int(rank.numpy()) == wrank
+
+    m = 0.1 * _r(3, 3, seed=3)
+    from scipy.linalg import expm
+
+    np.testing.assert_allclose(paddle.linalg.matrix_exp(_t(m)).numpy(),
+                               expm(m), rtol=1e-4, atol=1e-5)
+
+    ms = [_r(2, 4), _r(4, 3, seed=4), _r(3, 5, seed=5)]
+    np.testing.assert_allclose(
+        paddle.linalg.multi_dot([_t(x) for x in ms]).numpy(),
+        np.linalg.multi_dot(ms), rtol=1e-4, atol=1e-4)
+
+
+def test_lu_unpack_batched():
+    """Batched matrices reconstruct too (review: the pivot loop only
+    handled unbatched input)."""
+    a = _r(2, 4, 4, seed=7)
+    packed, piv = paddle.linalg.lu(_t(a))
+    P, L, U = paddle.linalg.lu_unpack(packed, piv)
+    rec = P.numpy() @ L.numpy() @ U.numpy()
+    np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-5)

@@ -193,3 +193,12 @@ def test_take_negative_indices():
     got = paddle.take(_t(a), _t(np.array([-1, -12], "int64"))).numpy()
     np.testing.assert_allclose(got, [a.reshape(-1)[-1],
                                      a.reshape(-1)[0]], rtol=1e-6)
+
+
+def test_take_clip_mode_clips_negatives_to_zero():
+    """Reference clip-mode semantics: negatives clip to element 0, no
+    wrapping (review finding)."""
+    a = _r(3, 4)
+    got = paddle.take(_t(a), _t(np.array([-5], "int64")),
+                      mode="clip").numpy()
+    np.testing.assert_allclose(got, [a.reshape(-1)[0]], rtol=1e-6)
