@@ -279,8 +279,9 @@ def _bench_bert(jax):
 
     model = QATrain()
     model.train()
-    step = CompiledTrainStep(model, lr=3e-5, compute_dtype="bfloat16")
-    batch, seq = (int(os.environ.get("PT_BENCH_BERT_BATCH", "16")), 384)
+    step = CompiledTrainStep(model, lr=3e-5, compute_dtype="bfloat16",
+                             remat=True)
+    batch, seq = (int(os.environ.get("PT_BENCH_BERT_BATCH", "48")), 384)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     starts = rng.randint(0, seq, (batch,)).astype(np.int32)

@@ -48,4 +48,18 @@ class ExponentialMovingAverage:
         self._backup = None
 
     def state_dict(self):
-        return {i: v for i, (k, v) in enumerate(self._shadow.items())}
+        """Shadow values keyed by parameter ORDER (stable across
+        process restarts, unlike id()); includes the step counter."""
+        import numpy as np
+
+        out = {f"shadow_{i}": np.asarray(self._shadow[id(p)])
+               for i, p in enumerate(self._params)}
+        out["step"] = self._step
+        return out
+
+    def set_state_dict(self, state):
+        self._step = int(state.get("step", self._step))
+        for i, p in enumerate(self._params):
+            key = f"shadow_{i}"
+            if key in state:
+                self._shadow[id(p)] = jnp.asarray(state[key])

@@ -119,7 +119,8 @@ class CompiledTrainStep:
                  compute_dtype=None, no_decay_fn=_default_no_decay,
                  donate=True, moments_dtype="float32", update_fn=None,
                  loss_fn=None, n_labels=1, moments="mv",
-                 master_dtype="float32", state_device=None):
+                 master_dtype="float32", state_device=None,
+                 remat=False):
         """update_fn(master, grads, m, v, t, lr) -> (new_master, m, v)
         overrides the default AdamW update (grads arrive already clipped).
         loss_fn, when given, makes the step treat the last ``n_labels``
@@ -251,6 +252,11 @@ class CompiledTrainStep:
                 out = functional_call(model_ref, p, *batch)
                 return jnp.asarray(out)
 
+        if remat:
+            # Whole-forward rematerialization for models without their
+            # own recompute config (BERT/UNet/...): trades a second
+            # forward for activation memory, unlocking larger batches.
+            loss_of = jax.checkpoint(loss_of)
         self.loss_of = loss_of  # pure (params, *batch) -> scalar loss
 
         single_copy = self._single_copy

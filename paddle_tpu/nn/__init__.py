@@ -18,8 +18,16 @@ from .common import (  # noqa: F401
 from .conv import Conv1D, Conv2D, Conv2DTranspose  # noqa: F401
 from .layers import Layer  # noqa: F401
 from .loss import (  # noqa: F401
-    BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, KLDivLoss, L1Loss,
-    MSELoss, NLLLoss, SmoothL1Loss,
+    BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, CTCLoss,
+    CosineEmbeddingLoss, GaussianNLLLoss, HingeEmbeddingLoss, KLDivLoss,
+    L1Loss, MSELoss, MarginRankingLoss, MultiLabelSoftMarginLoss,
+    NLLLoss, PoissonNLLLoss, SmoothL1Loss, SoftMarginLoss,
+    TripletMarginLoss,
+)
+from .vision_layers import (  # noqa: F401
+    ChannelShuffle, CosineSimilarity, Fold, GridSampler,
+    PairwiseDistance, PixelShuffle, PixelUnshuffle, Unfold,
+    UpsamplingBilinear2D, UpsamplingNearest2D,
 )
 from .norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,

@@ -423,3 +423,12 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
     n = label.shape[-1]
     smoothed = ops.scale(label, scale=1 - epsilon, bias=epsilon / n)
     return smoothed
+
+from .extended import (  # noqa: F401,E402
+    affine_grid, channel_shuffle, cosine_embedding_loss,
+    cosine_similarity, ctc_loss, fold, gaussian_nll_loss, grid_sample,
+    gumbel_softmax, hinge_embedding_loss, margin_ranking_loss,
+    multi_label_soft_margin_loss, npair_loss, pairwise_distance,
+    pixel_shuffle, pixel_unshuffle, poisson_nll_loss, soft_margin_loss,
+    square_error_cost, triplet_margin_loss,
+)

@@ -1,0 +1,454 @@
+"""Extended functional surface: CTC, margin/metric losses, pixel ops,
+grid_sample/affine_grid, fold, gumbel_softmax.
+
+Reference: ``python/paddle/nn/functional/loss.py`` (ctc_loss:1486,
+margin_ranking_loss, triplet_margin_loss, cosine_embedding_loss, ...),
+``vision.py`` (grid_sample:244, affine_grid:24, pixel_shuffle:456),
+``common.py`` (fold, cosine_similarity).
+
+TPU-native: the CTC alpha recursion is a ``lax.scan`` over time (one
+compiled kernel, autodiff supplies the beta pass); grid_sample is
+gather + bilinear lerp (fusable); everything dispatches through the op
+registry.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops import registry as _registry
+
+_op = _registry.cached_apply
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+# -- CTC ----------------------------------------------------------------
+
+def _ctc_nll(log_probs, labels, input_lengths, label_lengths, blank):
+    """Negative log likelihood per batch item.
+
+    log_probs [T, B, C] (log-softmaxed), labels [B, L] int32,
+    lengths [B].  Standard extended-sequence alpha recursion
+    (blank,l1,blank,l2,...,blank — length 2L+1) as one lax.scan.
+    """
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    NEG = -1e30
+
+    # extended sequence: ext[b, 2i+1] = labels[b, i]; even slots = blank
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels.astype(jnp.int32))
+    # allowed skip: ext[s] != ext[s-2] (and s odd)
+    skip_ok = jnp.concatenate(
+        [jnp.zeros((B, 2), bool), ext[:, 2:] != ext[:, :-2]], axis=1)
+
+    def emit(lp_t):  # [B, C] -> [B, S] log p of each ext symbol at t
+        return jnp.take_along_axis(lp_t, ext, axis=1)
+
+    alpha0 = jnp.full((B, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(log_probs[0, jnp.arange(B), blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(L > 0, emit(log_probs[0])[:, 1], NEG))
+
+    def step(alpha, t):
+        lp = emit(log_probs[t])                       # [B, S]
+        stay = alpha
+        prev1 = jnp.concatenate(
+            [jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate(
+            [jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(skip_ok, prev2, NEG)
+        new = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2) + lp
+        # freeze past each sequence's input length
+        active = (t < input_lengths)[:, None]
+        return jnp.where(active, new, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    # ends: last blank (2*label_len) or last label (2*label_len - 1)
+    idx_last = 2 * label_lengths.astype(jnp.int32)
+    a_blank = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+    a_label = jnp.take_along_axis(
+        alpha, jnp.maximum(idx_last - 1, 0)[:, None], axis=1)[:, 0]
+    a_label = jnp.where(label_lengths > 0, a_label, NEG)
+    return -jnp.logaddexp(a_blank, a_label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC (reference loss.py ctc_loss; warpctc kernel).  ``log_probs``
+    [T, B, C] logits (log-softmax applied internally, matching the
+    reference).  ``norm_by_times`` divides each sample's loss by its
+    input length (warpctc's time normalization)."""
+
+    def fn(lp, lab, il, ll, blank, reduction, norm_by_times):
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        nll = _ctc_nll(lp, lab, il.astype(jnp.int32),
+                       ll.astype(jnp.int32), blank)
+        if norm_by_times:
+            nll = nll / jnp.maximum(il.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            # reference/warpctc convention: normalize by label length
+            return jnp.mean(nll / jnp.maximum(ll.astype(jnp.float32), 1.0))
+        return _reduce_loss(nll, reduction)
+
+    return _op("ctc_loss", fn, _t(log_probs), _t(labels),
+               _t(input_lengths), _t(label_lengths), blank=int(blank),
+               reduction=str(reduction), norm_by_times=bool(norm_by_times))
+
+
+# -- metric / margin losses --------------------------------------------
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def fn(a, b, axis, eps):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+
+    return _op("cosine_similarity", fn, _t(x1), _t(x2), axis=int(axis),
+               eps=float(eps))
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False,
+                      name=None):
+    def fn(a, b, p, eps, keepdim):
+        d = jnp.abs(a - b) + eps
+        return jnp.sum(d ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+    return _op("pairwise_distance", fn, _t(x), _t(y), p=float(p),
+               eps=float(epsilon), keepdim=bool(keepdim))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0,
+                        reduction="mean", name=None):
+    def fn(a, b, y, margin, reduction):
+        loss = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce_loss(loss, reduction)
+
+    return _op("margin_ranking_loss", fn, _t(input), _t(other),
+               _t(label), margin=float(margin), reduction=str(reduction))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    def fn(a, pos, neg, margin, p, eps, swap, reduction):
+        def dist(u, v):
+            return jnp.sum((jnp.abs(u - v) + eps) ** p,
+                           axis=-1) ** (1.0 / p)
+
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        return _reduce_loss(jnp.maximum(0.0, d_pos - d_neg + margin),
+                            reduction)
+
+    return _op("triplet_margin_loss", fn, _t(input), _t(positive),
+               _t(negative), margin=float(margin), p=float(p),
+               eps=float(epsilon), swap=bool(swap),
+               reduction=str(reduction))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    def fn(a, b, y, margin, reduction):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1),
+            1e-12)
+        loss = jnp.where(y == 1, 1.0 - cos,
+                         jnp.maximum(0.0, cos - margin))
+        return _reduce_loss(loss, reduction)
+
+    return _op("cosine_embedding_loss", fn, _t(input1), _t(input2),
+               _t(label), margin=float(margin), reduction=str(reduction))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def fn(x, y, margin, reduction):
+        loss = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+        return _reduce_loss(loss, reduction)
+
+    return _op("hinge_embedding_loss", fn, _t(input), _t(label),
+               margin=float(margin), reduction=str(reduction))
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def fn(x, y, reduction):
+        return _reduce_loss(jnp.log1p(jnp.exp(-y * x)), reduction)
+
+    return _op("soft_margin_loss", fn, _t(input), _t(label),
+               reduction=str(reduction))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    def fn(x, y, w, reduction):
+        loss = -(y * jax.nn.log_sigmoid(x)
+                 + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w is not None:
+            loss = loss * w
+        return _reduce_loss(jnp.mean(loss, axis=-1), reduction)
+
+    if weight is None:
+        return _op("multi_label_soft_margin_loss",
+                   lambda x, y, reduction: fn(x, y, None, reduction),
+                   _t(input), _t(label), reduction=str(reduction))
+    return _op("multi_label_soft_margin_loss_w", fn, _t(input),
+               _t(label), _t(weight), reduction=str(reduction))
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    def fn(x, y, log_input, full, eps, reduction):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + eps)
+        if full:
+            stirling = y * jnp.log(jnp.maximum(y, 1.0)) - y + \
+                0.5 * jnp.log(2 * np.pi * jnp.maximum(y, 1.0))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce_loss(loss, reduction)
+
+    return _op("poisson_nll_loss", fn, _t(input), _t(label),
+               log_input=bool(log_input), full=bool(full),
+               eps=float(epsilon), reduction=str(reduction))
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def fn(mu, y, var, full, eps, reduction):
+        var = jnp.maximum(var, eps)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * np.log(2 * np.pi)
+        return _reduce_loss(loss, reduction)
+
+    return _op("gaussian_nll_loss", fn, _t(input), _t(label),
+               _t(variance), full=bool(full), eps=float(epsilon),
+               reduction=str(reduction))
+
+
+def square_error_cost(input, label):
+    def fn(x, y):
+        return (x - y) ** 2
+
+    return _op("square_error_cost", fn, _t(input), _t(label))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def fn(a, p, y, l2):
+        logits = a @ p.T                       # [B, B]
+        same = (y[:, None] == y[None, :]).astype(logits.dtype)
+        targets = same / jnp.sum(same, axis=1, keepdims=True)
+        xent = jnp.mean(jnp.sum(
+            -targets * jax.nn.log_softmax(logits, axis=1), axis=1))
+        reg = l2 * 0.25 * (jnp.mean(jnp.sum(a * a, 1))
+                           + jnp.mean(jnp.sum(p * p, 1)))
+        return xent + reg
+
+    return _op("npair_loss", fn, _t(anchor), _t(positive), _t(labels),
+               l2=float(l2_reg))
+
+
+# -- pixel / grid ops ---------------------------------------------------
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    def fn(x, r, fmt):
+        if fmt == "NHWC":
+            x = jnp.transpose(x, (0, 3, 1, 2))
+        B, C, H, W = x.shape
+        out = x.reshape(B, C // (r * r), r, r, H, W)
+        out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+        out = out.reshape(B, C // (r * r), H * r, W * r)
+        if fmt == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return _op("pixel_shuffle", fn, _t(x), r=int(upscale_factor),
+               fmt=str(data_format))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    def fn(x, r, fmt):
+        if fmt == "NHWC":
+            x = jnp.transpose(x, (0, 3, 1, 2))
+        B, C, H, W = x.shape
+        out = x.reshape(B, C, H // r, r, W // r, r)
+        out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+        out = out.reshape(B, C * r * r, H // r, W // r)
+        if fmt == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return _op("pixel_unshuffle", fn, _t(x), r=int(downscale_factor),
+               fmt=str(data_format))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    from ...vision.models.shufflenetv2 import channel_shuffle as _cs
+
+    if data_format == "NHWC":
+        from ...ops import transpose
+
+        return transpose(_cs(transpose(_t(x), [0, 3, 1, 2]), groups),
+                         [0, 2, 3, 1])
+    return _cs(_t(x), groups)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta [B, 2, 3] -> sampling grid [B, H, W, 2] (reference
+    vision.py affine_grid)."""
+
+    def fn(theta, out_shape, align):
+        B, _, H, W = out_shape
+        if align:
+            ys = jnp.linspace(-1.0, 1.0, H)
+            xs = jnp.linspace(-1.0, 1.0, W)
+        else:
+            ys = (jnp.arange(H) * 2 + 1) / H - 1
+            xs = (jnp.arange(W) * 2 + 1) / W - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], -1)  # [H, W, 3]
+        return jnp.einsum("bij,hwj->bhwi", theta.astype(jnp.float32),
+                          base)
+
+    return _op("affine_grid", fn, _t(theta),
+               out_shape=tuple(int(s) for s in out_shape),
+               align=bool(align_corners))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """2-D grid sampling (reference vision.py grid_sample): x [B,C,H,W],
+    grid [B,Hg,Wg,2] in [-1,1] xy order -> [B,C,Hg,Wg]."""
+
+    def fn(x, grid, mode, pad, align):
+        B, C, H, W = x.shape
+        gx = grid[..., 0].astype(jnp.float32)
+        gy = grid[..., 1].astype(jnp.float32)
+        if align:
+            fx = (gx + 1) * (W - 1) / 2
+            fy = (gy + 1) * (H - 1) / 2
+        else:
+            fx = ((gx + 1) * W - 1) / 2
+            fy = ((gy + 1) * H - 1) / 2
+        if pad == "reflection":
+            # reflect coords into range before indexing (reference
+            # reflect-about-border semantics for align_corners=True)
+            def reflect(f, n):
+                if n == 1:
+                    return jnp.zeros_like(f)
+                period = 2 * (n - 1)
+                f = jnp.mod(jnp.abs(f), period)
+                return jnp.where(f > n - 1, period - f, f)
+
+            fx = reflect(fx, W)
+            fy = reflect(fy, H)
+
+        def gather(ix, iy):
+            inb = ((ix >= 0) & (ix < W) & (iy >= 0)
+                   & (iy < H))                       # [B, Hg, Wg]
+            ixc = jnp.clip(ix, 0, W - 1)
+            iyc = jnp.clip(iy, 0, H - 1)
+            vals = jax.vmap(
+                lambda img, jx, jy: img[:, jy, jx])(x, ixc, iyc)
+            # vals [B, C, Hg, Wg] via fancy indexing per batch
+            if pad == "zeros":
+                vals = vals * inb[:, None].astype(vals.dtype)
+            # 'border' and post-reflection coords: clipping IS the
+            # semantics
+            return vals
+
+        if mode == "nearest":
+            return gather(jnp.round(fx).astype(jnp.int32),
+                          jnp.round(fy).astype(jnp.int32))
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        wx = (fx - x0)[:, None]
+        wy = (fy - y0)[:, None]
+        v00 = gather(x0, y0)
+        v01 = gather(x0 + 1, y0)
+        v10 = gather(x0, y0 + 1)
+        v11 = gather(x0 + 1, y0 + 1)
+        top = v00 * (1 - wx) + v01 * wx
+        bot = v10 * (1 - wx) + v11 * wx
+        return (top * (1 - wy) + bot * wy).astype(x.dtype)
+
+    return _op("grid_sample", fn, _t(x), _t(grid), mode=str(mode),
+               pad=str(padding_mode), align=bool(align_corners))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1, name=None):
+    """Col2im (reference common.py fold): x [B, C*kh*kw, L] ->
+    [B, C, H, W] by summing overlapping patches."""
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    H, W = _pair(output_sizes)
+
+    def fn(x, H, W, kh, kw, sh, sw, ph, pw, dh, dw):
+        B = x.shape[0]
+        C = x.shape[1] // (kh * kw)
+        Hp, Wp = H + 2 * ph, W + 2 * pw
+        nh = (Hp - (dh * (kh - 1) + 1)) // sh + 1
+        nw = (Wp - (dw * (kw - 1) + 1)) // sw + 1
+        cols = x.reshape(B, C, kh, kw, nh, nw)
+        out = jnp.zeros((B, C, Hp, Wp), x.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                out = out.at[:, :,
+                             i * dh:i * dh + nh * sh:sh,
+                             j * dw:j * dw + nw * sw:sw].add(
+                    cols[:, :, i, j])
+        return out[:, :, ph:Hp - ph if ph else Hp,
+                   pw:Wp - pw if pw else Wp]
+
+    return _op("fold", fn, _t(x), H=H, W=W, kh=kh, kw=kw, sh=sh, sw=sw,
+               ph=ph, pw=pw, dh=dh, dw=dw)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...ops.random import default_generator
+
+    key_data = jax.random.key_data(default_generator.next_key())
+
+    def fn(x, key_data, temperature, hard, axis):
+        key = jax.random.wrap_key_data(key_data)
+        g = -jnp.log(-jnp.log(
+            jax.random.uniform(key, x.shape, jnp.float32, 1e-10, 1.0)))
+        y = jax.nn.softmax((x + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis)
+            onehot = jnp.moveaxis(
+                jax.nn.one_hot(idx, y.shape[axis], dtype=y.dtype),
+                -1, axis)
+            # straight-through estimator
+            y = jax.lax.stop_gradient(onehot - y) + y
+        return y
+
+    return _op("gumbel_softmax", fn, _t(x), Tensor(key_data),
+               temperature=float(temperature), hard=bool(hard),
+               axis=int(axis))

@@ -99,3 +99,109 @@ class KLDivLoss(Layer):
 
     def forward(self, input, label):
         return F.kl_div(input, label, self.reduction, self.log_target)
+
+
+class _FnLoss(Layer):
+    """Base for thin loss-layer wrappers over the functional form."""
+
+    def __init__(self, **kw):
+        super().__init__()
+        self._kw = kw
+
+
+class CTCLoss(_FnLoss):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__(blank=blank, reduction=reduction)
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        from .functional import ctc_loss
+
+        return ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                        norm_by_times=norm_by_times, **self._kw)
+
+
+class MarginRankingLoss(_FnLoss):
+    def __init__(self, margin=0.0, reduction="mean", name=None):
+        super().__init__(margin=margin, reduction=reduction)
+
+    def forward(self, input, other, label):
+        from .functional import margin_ranking_loss
+
+        return margin_ranking_loss(input, other, label, **self._kw)
+
+
+class TripletMarginLoss(_FnLoss):
+    def __init__(self, margin=1.0, p=2.0, epsilon=1e-6, swap=False,
+                 reduction="mean", name=None):
+        super().__init__(margin=margin, p=p, epsilon=epsilon, swap=swap,
+                         reduction=reduction)
+
+    def forward(self, input, positive, negative):
+        from .functional import triplet_margin_loss
+
+        return triplet_margin_loss(input, positive, negative,
+                                   **self._kw)
+
+
+class CosineEmbeddingLoss(_FnLoss):
+    def __init__(self, margin=0.0, reduction="mean", name=None):
+        super().__init__(margin=margin, reduction=reduction)
+
+    def forward(self, input1, input2, label):
+        from .functional import cosine_embedding_loss
+
+        return cosine_embedding_loss(input1, input2, label, **self._kw)
+
+
+class HingeEmbeddingLoss(_FnLoss):
+    def __init__(self, margin=1.0, reduction="mean", name=None):
+        super().__init__(margin=margin, reduction=reduction)
+
+    def forward(self, input, label):
+        from .functional import hinge_embedding_loss
+
+        return hinge_embedding_loss(input, label, **self._kw)
+
+
+class SoftMarginLoss(_FnLoss):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__(reduction=reduction)
+
+    def forward(self, input, label):
+        from .functional import soft_margin_loss
+
+        return soft_margin_loss(input, label, **self._kw)
+
+
+class MultiLabelSoftMarginLoss(_FnLoss):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__(weight=weight, reduction=reduction)
+
+    def forward(self, input, label):
+        from .functional import multi_label_soft_margin_loss
+
+        return multi_label_soft_margin_loss(input, label, **self._kw)
+
+
+class PoissonNLLLoss(_FnLoss):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__(log_input=log_input, full=full, epsilon=epsilon,
+                         reduction=reduction)
+
+    def forward(self, input, label):
+        from .functional import poisson_nll_loss
+
+        return poisson_nll_loss(input, label, **self._kw)
+
+
+class GaussianNLLLoss(_FnLoss):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__(full=full, epsilon=epsilon, reduction=reduction)
+
+    def forward(self, input, label, variance):
+        from .functional import gaussian_nll_loss
+
+        return gaussian_nll_loss(input, label, variance, **self._kw)

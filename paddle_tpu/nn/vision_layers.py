@@ -1,0 +1,138 @@
+"""Vision-shaped layers (reference python/paddle/nn/layer/vision.py,
+common.py): pixel/channel shuffles, grid sampler, fold/unfold,
+upsampling, metric layers."""
+from __future__ import annotations
+
+from .layers import Layer
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._r = upscale_factor
+        self._fmt = data_format
+
+    def forward(self, x):
+        from . import functional as F
+
+        return F.pixel_shuffle(x, self._r, self._fmt)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._r = downscale_factor
+        self._fmt = data_format
+
+    def forward(self, x):
+        from . import functional as F
+
+        return F.pixel_unshuffle(x, self._r, self._fmt)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self._groups = groups
+        self._fmt = data_format
+
+    def forward(self, x):
+        from . import functional as F
+
+        return F.channel_shuffle(x, self._groups, self._fmt)
+
+
+class GridSampler(Layer):
+    def __init__(self, mode="bilinear", padding_mode="zeros",
+                 align_corners=True, name=None):
+        super().__init__()
+        self._kw = dict(mode=mode, padding_mode=padding_mode,
+                        align_corners=align_corners)
+
+    def forward(self, x, grid):
+        from . import functional as F
+
+        return F.grid_sample(x, grid, **self._kw)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._args = (output_sizes, kernel_sizes, strides, paddings,
+                      dilations)
+
+    def forward(self, x):
+        from . import functional as F
+
+        return F.fold(x, *self._args)
+
+
+class Unfold(Layer):
+    """Im2col (reference Unfold layer; functional.unfold exists as the
+    conv-patch extractor in this repo's functional namespace)."""
+
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self._args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        from . import functional as F
+
+        if hasattr(F, "unfold"):
+            return F.unfold(x, *self._args)
+        raise NotImplementedError("functional.unfold missing")
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._size = size
+        self._scale = scale_factor
+
+    def forward(self, x):
+        from . import functional as F
+
+        return F.interpolate(x, size=self._size,
+                             scale_factor=self._scale, mode="nearest")
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._size = size
+        self._scale = scale_factor
+
+    def forward(self, x):
+        from . import functional as F
+
+        return F.interpolate(x, size=self._size,
+                             scale_factor=self._scale, mode="bilinear",
+                             align_corners=True)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self._axis = axis
+        self._eps = eps
+
+    def forward(self, x1, x2):
+        from . import functional as F
+
+        return F.cosine_similarity(x1, x2, axis=self._axis,
+                                   eps=self._eps)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self._kw = dict(p=p, epsilon=epsilon, keepdim=keepdim)
+
+    def forward(self, x, y):
+        from . import functional as F
+
+        return F.pairwise_distance(x, y, **self._kw)

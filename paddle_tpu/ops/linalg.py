@@ -346,8 +346,14 @@ def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
     piv = _raw(lu_pivots).astype(jnp.int32) - 1  # back to 0-based
     m, n = a.shape[-2], a.shape[-1]
     k = min(m, n)
-    L = jnp.tril(a[..., :, :k], -1) + jnp.eye(m, k, dtype=a.dtype)
-    U = jnp.triu(a[..., :k, :])
+    if unpack_ludata:
+        L = jnp.tril(a[..., :, :k], -1) + jnp.eye(m, k, dtype=a.dtype)
+        U = jnp.triu(a[..., :k, :])
+        L, U = Tensor(L), Tensor(U)
+    else:  # reference: disabled outputs are None, their work skipped
+        L = U = None
+    if not unpack_pivots:
+        return None, L, U
     # pivots -> permutation: apply row swaps to identity (batched)
     batch = piv.shape[:-1]
     n_piv = piv.shape[-1]
@@ -366,7 +372,7 @@ def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
     else:
         perm = apply_swaps(piv)
     P = jnp.swapaxes(jnp.eye(m, dtype=a.dtype)[perm], -1, -2)
-    return Tensor(P), Tensor(L), Tensor(U)
+    return Tensor(P), L, U
 
 
 def cholesky_solve(x, y, upper=False, name=None):

@@ -1,0 +1,267 @@
+"""Extended nn surface: CTC loss vs brute-force oracle, margin/metric
+losses vs closed forms, pixel/grid ops vs NumPy.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+F = nn.functional
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def _r(*s, seed=0):
+    return np.random.RandomState(seed).randn(*s).astype("float32")
+
+
+# -- CTC ----------------------------------------------------------------
+
+
+def _ctc_brute(log_probs, labels, T_len, L_len, blank=0):
+    """Sum over all alignments of length T whose collapse equals the
+    label sequence (exponential — tiny cases only)."""
+    C = log_probs.shape[1]
+    target = list(labels[:L_len])
+    total = -np.inf
+    for path in itertools.product(range(C), repeat=T_len):
+        # collapse: remove repeats then blanks
+        col = []
+        prev = None
+        for s in path:
+            if s != prev:
+                col.append(s)
+            prev = s
+        col = [s for s in col if s != blank]
+        if col == target:
+            lp = sum(log_probs[t, path[t]] for t in range(T_len))
+            total = np.logaddexp(total, lp)
+    return -total
+
+
+def test_ctc_loss_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    T, B, C, L = 4, 2, 3, 2
+    logits = rng.randn(T, B, C).astype(np.float32)
+    labels = np.array([[1, 2], [2, 1]], np.int32)
+    il = np.array([4, 3], np.int32)
+    ll = np.array([2, 1], np.int32)
+    got = F.ctc_loss(_t(logits), _t(labels), _t(il), _t(ll),
+                     reduction="none").numpy()
+    lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    for b in range(B):
+        want = _ctc_brute(lp[:, b], labels[b], il[b], ll[b])
+        np.testing.assert_allclose(got[b], want, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_differentiable():
+    logits = _t(_r(6, 2, 5))
+    logits.stop_gradient = False
+    loss = F.ctc_loss(logits, _t(np.array([[1, 2], [3, 4]], np.int32)),
+                      _t(np.array([6, 6], np.int32)),
+                      _t(np.array([2, 2], np.int32)))
+    loss.backward()
+    g = logits.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_ctc_loss_layer():
+    crit = nn.CTCLoss(blank=0)
+    loss = crit(_t(_r(5, 2, 4)),
+                _t(np.array([[1, 2], [3, 1]], np.int32)),
+                _t(np.array([5, 5], np.int32)),
+                _t(np.array([2, 2], np.int32)))
+    assert np.isfinite(float(loss.numpy()))
+
+
+# -- margin / metric losses --------------------------------------------
+
+
+def test_margin_losses_closed_forms():
+    a, b = _r(6), _r(6, seed=1)
+    y = np.array([1, -1, 1, -1, 1, -1], np.float32)
+    got = F.margin_ranking_loss(_t(a), _t(b), _t(y), margin=0.5,
+                                reduction="none").numpy()
+    np.testing.assert_allclose(
+        got, np.maximum(0, -y * (a - b) + 0.5), rtol=1e-5)
+
+    x1, x2 = _r(4, 8), _r(4, 8, seed=2)
+    lab = np.array([1, -1, 1, -1], np.float32)
+    got = F.cosine_embedding_loss(_t(x1), _t(x2), _t(lab),
+                                  reduction="none").numpy()
+    cos = (x1 * x2).sum(1) / (np.linalg.norm(x1, axis=1)
+                              * np.linalg.norm(x2, axis=1))
+    want = np.where(lab == 1, 1 - cos, np.maximum(0, cos))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    anc, pos, neg = _r(4, 8), _r(4, 8, seed=3), _r(4, 8, seed=4)
+    got = float(F.triplet_margin_loss(_t(anc), _t(pos), _t(neg)).numpy())
+    dp = np.linalg.norm(anc - pos + 1e-6, axis=1)
+    dn = np.linalg.norm(anc - neg + 1e-6, axis=1)
+    np.testing.assert_allclose(got, np.maximum(0, dp - dn + 1).mean(),
+                               rtol=1e-3)
+
+    x = _r(5)
+    yl = np.array([1, -1, 1, -1, 1], np.float32)
+    np.testing.assert_allclose(
+        F.soft_margin_loss(_t(x), _t(yl), reduction="none").numpy(),
+        np.log1p(np.exp(-yl * x)), rtol=1e-5)
+
+
+def test_distribution_losses():
+    mu, y = _r(8), np.abs(_r(8, seed=1)) + 1
+    var = np.abs(_r(8, seed=2)) + 0.5
+    got = F.gaussian_nll_loss(_t(mu), _t(y), _t(var),
+                              reduction="none").numpy()
+    np.testing.assert_allclose(
+        got, 0.5 * (np.log(var) + (y - mu) ** 2 / var), rtol=1e-4)
+    got = F.poisson_nll_loss(_t(mu), _t(y), reduction="none").numpy()
+    np.testing.assert_allclose(got, np.exp(mu) - y * mu, rtol=1e-4)
+
+
+def test_metric_functions():
+    a, b = _r(4, 8), _r(4, 8, seed=1)
+    np.testing.assert_allclose(
+        F.cosine_similarity(_t(a), _t(b), axis=1).numpy(),
+        (a * b).sum(1) / (np.linalg.norm(a, axis=1)
+                          * np.linalg.norm(b, axis=1)), rtol=1e-4)
+    got = F.pairwise_distance(_t(a), _t(b)).numpy()
+    np.testing.assert_allclose(
+        got, np.linalg.norm(np.abs(a - b) + 1e-6, axis=1), rtol=1e-4)
+    assert np.isfinite(float(F.npair_loss(
+        _t(a), _t(b), _t(np.array([0, 1, 0, 1]))).numpy()))
+
+
+# -- pixel / grid -------------------------------------------------------
+
+
+def test_pixel_shuffle_roundtrip():
+    x = _r(2, 8, 3, 3)
+    up = F.pixel_shuffle(_t(x), 2)
+    assert tuple(up.shape) == (2, 2, 6, 6)
+    back = F.pixel_unshuffle(up, 2)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+    cs = F.channel_shuffle(_t(x), 4)
+    assert tuple(cs.shape) == tuple(x.shape)
+
+
+def test_grid_sample_identity():
+    """Identity affine grid reproduces the input."""
+    x = _r(2, 3, 5, 7)
+    theta = np.tile(np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32),
+                    (2, 1, 1))
+    grid = F.affine_grid(_t(theta), (2, 3, 5, 7), align_corners=True)
+    out = F.grid_sample(_t(x), grid, align_corners=True)
+    np.testing.assert_allclose(out.numpy(), x, rtol=1e-4, atol=1e-4)
+
+
+def test_grid_sample_nearest_and_zeros_pad():
+    x = _r(1, 1, 4, 4)
+    # sample far outside: zeros padding
+    grid = np.full((1, 2, 2, 2), 3.0, np.float32)
+    out = F.grid_sample(_t(x), _t(grid), mode="nearest")
+    np.testing.assert_allclose(out.numpy(), 0.0)
+
+
+def test_fold_unfold_roundtrip():
+    """fold(unfold(x)) == x * patch-coverage counts."""
+    x = _r(1, 2, 6, 6)
+    cols = F.unfold(_t(x), 2, strides=2)  # non-overlapping
+    back = F.fold(cols, (6, 6), 2, strides=2)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-5)
+
+
+def test_gumbel_softmax():
+    paddle.seed(0)
+    x = _t(_r(4, 6))
+    y = F.gumbel_softmax(x, temperature=0.5)
+    np.testing.assert_allclose(y.numpy().sum(-1), 1.0, rtol=1e-4)
+    h = F.gumbel_softmax(x, hard=True)
+    hn = h.numpy()
+    assert bool(((hn == 0) | np.isclose(hn, 1)).all())
+    np.testing.assert_allclose(hn.sum(-1), 1.0, rtol=1e-5)
+
+
+def test_vision_layers():
+    x = _t(_r(2, 4, 4, 4))
+    assert tuple(nn.PixelShuffle(2)(x).shape) == (2, 1, 8, 8)
+    assert tuple(nn.ChannelShuffle(2)(x).shape) == (2, 4, 4, 4)
+    up = nn.UpsamplingNearest2D(scale_factor=2)(x)
+    assert tuple(up.shape) == (2, 4, 8, 8)
+    d = nn.PairwiseDistance()(_t(_r(3, 5)), _t(_r(3, 5, seed=1)))
+    assert tuple(d.shape) == (3,)
+    s = nn.CosineSimilarity(axis=1)(_t(_r(3, 5)), _t(_r(3, 5, seed=1)))
+    assert tuple(s.shape) == (3,)
+
+
+def test_multi_label_weight_applied():
+    x, y = _r(3, 4), (np.random.RandomState(1).rand(3, 4) > 0.5
+                      ).astype("float32")
+    w = np.array([2.0, 0.0, 1.0, 0.5], "float32")
+    got = float(F.multi_label_soft_margin_loss(
+        _t(x), _t(y), weight=_t(w)).numpy())
+    base = -(y * np.log(1 / (1 + np.exp(-x)))
+             + (1 - y) * np.log(1 - 1 / (1 + np.exp(-x))))
+    np.testing.assert_allclose(got, (base * w).mean(1).mean(), rtol=1e-3)
+
+
+def test_ctc_norm_by_times():
+    logits = _t(_r(6, 2, 5))
+    il = np.array([6, 3], np.int32)
+    plain = F.ctc_loss(logits, _t(np.array([[1], [2]], np.int32)),
+                       _t(il), _t(np.array([1, 1], np.int32)),
+                       reduction="none").numpy()
+    normed = F.ctc_loss(logits, _t(np.array([[1], [2]], np.int32)),
+                        _t(il), _t(np.array([1, 1], np.int32)),
+                        reduction="none", norm_by_times=True).numpy()
+    np.testing.assert_allclose(normed, plain / il, rtol=1e-5)
+
+
+def test_grid_sample_reflection():
+    x = _r(1, 1, 1, 4)
+    # x coords beyond +1 reflect back: 1.5 in grid space -> reflect
+    grid = np.zeros((1, 1, 3, 2), np.float32)
+    grid[0, 0, :, 0] = [0.99999, 1.6667, 3.0]
+    out = F.grid_sample(_t(x), _t(grid), padding_mode="reflection",
+                        align_corners=True).numpy()[0, 0, 0]
+    # grid 1.0 -> pixel 3; 1.6667 -> pixel 4 -> reflect to 2; 3.0 ->
+    # pixel 6 -> reflect to 0
+    np.testing.assert_allclose(
+        out, [x[0, 0, 0, 3], x[0, 0, 0, 2], x[0, 0, 0, 0]],
+        rtol=1e-3, atol=1e-4)
+
+
+def test_lu_unpack_flags():
+    a = _r(4, 4, seed=9)
+    packed, piv = paddle.linalg.lu(_t(a))
+    P, L, U = paddle.linalg.lu_unpack(packed, piv, unpack_ludata=False)
+    assert L is None and U is None and P is not None
+    P2, L2, U2 = paddle.linalg.lu_unpack(packed, piv,
+                                         unpack_pivots=False)
+    assert P2 is None and L2 is not None
+
+
+def test_ema_state_roundtrip():
+    paddle.seed(4)
+    m = nn.Linear(3, 3)
+    ema = paddle.incubate.ExponentialMovingAverage(m.parameters(),
+                                                   decay=0.9)
+    m.weight._data = m.weight._data + 1.0
+    ema.update()
+    sd = ema.state_dict()
+    paddle.seed(4)
+    m2 = nn.Linear(3, 3)
+    ema2 = paddle.incubate.ExponentialMovingAverage(m2.parameters(),
+                                                    decay=0.9)
+    ema2.set_state_dict(sd)
+    ema2.apply()
+    k = [kk for kk in sd if kk.startswith("shadow_")][0]
+    got = [p for p in ema2._params][0]._data
+    np.testing.assert_allclose(np.asarray(got), sd["shadow_0"],
+                               rtol=1e-6)
+    ema2.restore()
