@@ -16,6 +16,16 @@ import time
 import numpy as np
 
 
+
+def _enable_compile_cache():
+    from paddle_tpu.utils import enable_compile_cache
+
+    enable_compile_cache()
+
+
+_enable_compile_cache()
+
+
 def _peak_flops_per_chip():
     import jax
 

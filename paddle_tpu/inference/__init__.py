@@ -111,3 +111,9 @@ class Predictor:
 
 def create_predictor(config, model_builder=None):
     return Predictor(config, model_builder=model_builder)
+
+
+from .paged import (  # noqa: F401,E402
+    PagedKVCache, masked_multihead_attention, paged_decode_attention,
+)
+from .serving import PagedLlamaEngine  # noqa: F401,E402

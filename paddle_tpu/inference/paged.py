@@ -156,9 +156,10 @@ class PagedKVCache:
         self.k_pages = jnp.zeros(shape, dtype)
         self.v_pages = jnp.zeros(shape, dtype)
         self._free = list(range(num_pages - 1, -1, -1))
-        # page table: [max_seqs, max_pages_per_seq] int32 (0-padded)
-        self.page_table = np.zeros((max_seqs, self.max_pages_per_seq),
-                                   np.int32)
+        # page table: [max_seqs, max_pages_per_seq] int32; -1 = unset
+        # (page id 0 is valid, so 0 cannot double as the sentinel)
+        self.page_table = np.full((max_seqs, self.max_pages_per_seq),
+                                  -1, np.int32)
         self.lengths = np.zeros((max_seqs,), np.int32)
         self._active = [False] * max_seqs
 
@@ -175,28 +176,54 @@ class PagedKVCache:
                            "is full) — free() a finished sequence first")
 
     def free(self, seq: int) -> None:
-        """Return a sequence's pages to the pool."""
-        n_used = -(-int(self.lengths[seq]) // self.page_size)
-        for i in range(n_used):
-            self._free.append(int(self.page_table[seq, i]))
-        self.page_table[seq] = 0
+        """Return a sequence's pages to the pool — every ASSIGNED slot,
+        not just length-covered ones, so reserved-but-unwritten pages
+        (e.g. from a failed batch step) are recovered too."""
+        for pid in self.page_table[seq]:
+            if pid >= 0:
+                self._free.append(int(pid))
+        self.page_table[seq] = -1
         self.lengths[seq] = 0
         self._active[seq] = False
 
     def _ensure_capacity(self, seq: int, new_len: int) -> None:
-        have = -(-int(self.lengths[seq]) // self.page_size)
         need = -(-new_len // self.page_size)
         if need > self.max_pages_per_seq:
             raise RuntimeError(
                 f"sequence {seq} needs {need} pages > per-seq budget "
                 f"{self.max_pages_per_seq}")
-        # Check before popping: a partial allocation would leak pages
-        # (they'd sit in page_table but outside lengths, so free()
-        # would never return them).
-        if need - have > len(self._free):
+        # Idempotent by slot (-1 = unset): a retry after a failed batch
+        # never pops a second page for an already-assigned slot, and
+        # checking before popping keeps a failure side-effect free.
+        missing = [i for i in range(need) if self.page_table[seq, i] < 0]
+        if len(missing) > len(self._free):
             raise RuntimeError("KV page pool exhausted")
-        for i in range(have, need):
+        for i in missing:
             self.page_table[seq, i] = self._free.pop()
+
+    def reserve(self, seqs, extra_tokens=1) -> None:
+        """Batch-atomic capacity reservation: plan every sequence's
+        missing slots first, commit only if the WHOLE batch fits (a
+        per-sequence loop would leak the earlier sequences' pages on a
+        mid-batch failure)."""
+        plans = []
+        total = 0
+        for s in seqs:
+            need = -(-(int(self.lengths[s]) + extra_tokens)
+                     // self.page_size)
+            if need > self.max_pages_per_seq:
+                raise RuntimeError(
+                    f"sequence {s} needs {need} pages > per-seq budget "
+                    f"{self.max_pages_per_seq}")
+            missing = [i for i in range(need)
+                       if self.page_table[s, i] < 0]
+            total += len(missing)
+            plans.append((s, missing))
+        if total > len(self._free):
+            raise RuntimeError("KV page pool exhausted")
+        for s, missing in plans:
+            for i in missing:
+                self.page_table[s, i] = self._free.pop()
 
     # -- data plane (device) -------------------------------------------
 
@@ -234,24 +261,10 @@ class PagedKVCache:
         k = jnp.asarray(k, self.k_pages.dtype)
         v = jnp.asarray(v, self.v_pages.dtype)
         ps = self.page_size
-        plans = []
-        total_new = 0
+        self.reserve(seqs, extra_tokens=1)  # batch-atomic
+        pids, offs = [], []
         for s in seqs:
             pos = int(self.lengths[s])
-            have = -(-pos // ps)
-            need = -(-(pos + 1) // ps)
-            if need > self.max_pages_per_seq:
-                raise RuntimeError(
-                    f"sequence {s} needs {need} pages > per-seq budget "
-                    f"{self.max_pages_per_seq}")
-            total_new += need - have
-            plans.append((s, pos, need - have))
-        if total_new > len(self._free):
-            raise RuntimeError("KV page pool exhausted")
-        pids, offs = [], []
-        for s, pos, n_new in plans:
-            if n_new:
-                self.page_table[s, pos // ps] = self._free.pop()
             pids.append(int(self.page_table[s, pos // ps]))
             offs.append(pos % ps)
             self.lengths[s] = pos + 1
@@ -265,7 +278,10 @@ class PagedKVCache:
                pages_per_compute_block=4):
         """Decode attention for one layer: q [B, H, D] over the listed
         sequences' pages."""
-        table = jnp.asarray(self.page_table[seqs])
+        # clip -1 sentinels (unassigned slots beyond each length) to a
+        # valid page id — the length mask excludes them from attention,
+        # but gathers/kernel prefetch must stay in range
+        table = jnp.asarray(np.maximum(self.page_table[seqs], 0))
         lens = jnp.asarray(self.lengths[seqs])
         return paged_decode_attention(
             q, self.k_pages[layer], self.v_pages[layer], lens, table,

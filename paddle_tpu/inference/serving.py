@@ -1,0 +1,205 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+Reference: the Predictor's serving loop driven by
+``block_multi_head_attention`` (block-table KV) and
+``masked_multihead_attention`` (decode step) — the reference's
+continuous-batching inference stack.
+
+TPU-native: prefill computes the prompt's KV in one jitted forward and
+writes whole pages; each decode step is one jitted single-token forward
+whose attention runs ``paged_decode_attention`` (Pallas kernel on TPU)
+over the page pool.  Admission/eviction is a host-side control plane on
+the PagedKVCache block table; sequences of different lengths decode in
+one batch (per-sequence lengths mask the attention).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.nn_ops import _rms_norm_plain, _rope_plain
+from .paged import PagedKVCache, paged_decode_attention
+
+
+class PagedLlamaEngine:
+    """Greedy continuous-batching decoder for a LlamaForCausalLM.
+
+    engine = PagedLlamaEngine(model, max_seqs=4, page_size=16,
+                              max_len=256)
+    sid = engine.add_request(prompt_ids)           # prefill
+    out = engine.step()                            # {sid: next_token}
+    engine.finish(sid)                             # free pages
+    """
+
+    def __init__(self, model, max_seqs=4, page_size=16, max_len=256,
+                 dtype=jnp.float32):
+        from ..models.generation import _stack_layer_params
+        from ..models.llama import _rope_tables
+
+        cfg = model.config
+        self.config = cfg
+        state = {k: v._data for k, v in model.state_dict().items()}
+        self.layers = _stack_layer_params(state, cfg.num_hidden_layers)
+        self.embed = jnp.asarray(state["llama.embed_tokens.weight"])
+        self.norm_w = jnp.asarray(state["llama.norm.weight"])
+        self.head_w = (self.embed.T if cfg.tie_word_embeddings
+                       else jnp.asarray(state["lm_head.weight"]))
+        cos, sin = _rope_tables(cfg)
+        self.cos, self.sin = jnp.asarray(cos), jnp.asarray(sin)
+        pages_per_seq = -(-max_len // page_size)
+        self.cache = PagedKVCache(
+            n_layers=cfg.num_hidden_layers,
+            n_kv_heads=cfg.num_key_value_heads, head_dim=cfg.head_dim,
+            num_pages=max_seqs * pages_per_seq, page_size=page_size,
+            max_seqs=max_seqs, dtype=dtype)
+        self._last_token = {}
+        self._jit_prefill = jax.jit(self._prefill_fwd)
+        # donate the pools: step() immediately replaces them with the
+        # outputs, so XLA updates in place instead of copying GBs of KV
+        self._jit_decode = jax.jit(self._decode_fwd,
+                                   donate_argnums=(3, 4))
+
+    # -- pure forwards --------------------------------------------------
+
+    def _prefill_fwd(self, layers, ids):
+        """[1, S] prompt -> (last-token logits [V], k [L,KV,S,D],
+        v [L,KV,S,D]) — plain causal attention, KV returned for the
+        page writer."""
+        cfg = self.config
+        nh, nkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                      cfg.head_dim)
+        B, S = ids.shape
+        x = self.embed[ids]
+        pos = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
+        scale = 1.0 / np.sqrt(d)
+
+        def block(x, lp):
+            h = _rms_norm_plain(x, lp["input_layernorm.weight"],
+                                epsilon=cfg.rms_norm_eps)
+            q = (h @ lp["self_attn.q_proj.weight"]).reshape(B, S, nh, d)
+            k = (h @ lp["self_attn.k_proj.weight"]).reshape(B, S, nkv, d)
+            v = (h @ lp["self_attn.v_proj.weight"]).reshape(B, S, nkv, d)
+            q, k = _rope_plain(q, k, self.cos, self.sin,
+                               position_ids=pos)
+            g = nh // nkv
+            qt = jnp.swapaxes(q, 1, 2).reshape(B, nkv, g, S, d)
+            kt = jnp.swapaxes(k, 1, 2)
+            vt = jnp.swapaxes(v, 1, 2)
+            logits = jnp.einsum("bngqd,bnkd->bngqk", qt, kt) * scale
+            causal = jnp.tril(jnp.ones((S, S), bool))
+            logits = jnp.where(causal[None, None, None], logits,
+                               jnp.finfo(logits.dtype).min)
+            p = jax.nn.softmax(logits.astype(jnp.float32), -1) \
+                .astype(x.dtype)
+            o = jnp.einsum("bngqk,bnkd->bngqd", p, vt)
+            o = jnp.swapaxes(o.reshape(B, nh, S, d), 1, 2) \
+                .reshape(B, S, nh * d)
+            x = x + o @ lp["self_attn.o_proj.weight"]
+            h2 = _rms_norm_plain(x, lp["post_attention_layernorm.weight"],
+                                 epsilon=cfg.rms_norm_eps)
+            gate = h2 @ lp["mlp.gate_proj.weight"]
+            up = h2 @ lp["mlp.up_proj.weight"]
+            x = x + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
+            return x, (jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2))
+
+        x, (ks, vs) = jax.lax.scan(block, x, self.layers)
+        x = _rms_norm_plain(x, self.norm_w, epsilon=cfg.rms_norm_eps)
+        return (x[:, -1] @ self.head_w)[0], ks[:, 0], vs[:, 0]
+
+    def _decode_fwd(self, layers, ids, positions, k_pages, v_pages,
+                    lengths, page_tables):
+        """One token per active sequence: ids [B], positions [B] (the
+        token's position).  Each layer writes the new token's KV into
+        its page (write-then-attend, so the paged attention over
+        lengths+1 includes the self term), then attends over the pool.
+        Returns (logits [B, V], k_pages', v_pages')."""
+        cfg = self.config
+        nh, nkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                      cfg.head_dim)
+        ps = self.cache.page_size
+        B = ids.shape[0]
+        x = self.embed[ids][:, None]              # [B, 1, h]
+        pos = positions[:, None]
+        pids = page_tables[jnp.arange(B), positions // ps]  # [B]
+        offs = positions % ps
+
+        def block(x, lp_kv):
+            lp, kp, vp = lp_kv
+            h = _rms_norm_plain(x, lp["input_layernorm.weight"],
+                                epsilon=cfg.rms_norm_eps)
+            q = (h @ lp["self_attn.q_proj.weight"]).reshape(B, 1, nh, d)
+            k = (h @ lp["self_attn.k_proj.weight"]).reshape(B, 1, nkv, d)
+            v = (h @ lp["self_attn.v_proj.weight"]).reshape(B, 1, nkv, d)
+            q, k = _rope_plain(q, k, self.cos, self.sin,
+                               position_ids=pos)
+            kh = jnp.swapaxes(k, 1, 2)[:, :, 0]   # [B, nkv, d]
+            vh = jnp.swapaxes(v, 1, 2)[:, :, 0]
+            kp = kp.at[:, pids, offs].set(
+                jnp.swapaxes(kh, 0, 1).astype(kp.dtype))
+            vp = vp.at[:, pids, offs].set(
+                jnp.swapaxes(vh, 0, 1).astype(vp.dtype))
+            o = paged_decode_attention(
+                jnp.swapaxes(q, 1, 2)[:, :, 0], kp, vp, lengths + 1,
+                page_tables)                      # [B, nh, d]
+            o = o.reshape(B, 1, nh * d).astype(x.dtype)
+            x = x + o @ lp["self_attn.o_proj.weight"]
+            h2 = _rms_norm_plain(x, lp["post_attention_layernorm.weight"],
+                                 epsilon=cfg.rms_norm_eps)
+            gate = h2 @ lp["mlp.gate_proj.weight"]
+            up = h2 @ lp["mlp.up_proj.weight"]
+            x = x + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
+            return x, (kp, vp)
+
+        x, (kps, vps) = jax.lax.scan(
+            block, x, (self.layers, k_pages, v_pages))
+        x = _rms_norm_plain(x, self.norm_w, epsilon=cfg.rms_norm_eps)
+        return (x[:, 0] @ self.head_w), kps, vps
+
+    # -- control plane --------------------------------------------------
+
+    def add_request(self, prompt_ids) -> int:
+        """Prefill one prompt; returns the sequence slot id."""
+        sid = self.cache.allocate()
+        try:
+            ids = jnp.asarray(np.asarray(prompt_ids)[None], jnp.int32)
+            logits, k, v = self._jit_prefill(self.layers, ids)
+            self.cache.prefill(sid, k, v)
+        except BaseException:
+            self.cache.free(sid)  # don't strand the slot on failure
+            raise
+        self._last_token[sid] = int(jnp.argmax(logits))
+        return sid
+
+    def finish(self, sid: int):
+        self.cache.free(sid)
+        self._last_token.pop(sid, None)
+
+    def step(self):
+        """One greedy decode step over every active sequence."""
+        seqs = sorted(self._last_token)
+        if not seqs:
+            return {}
+        # batch-atomic page reservation BEFORE the jitted
+        # write-then-attend: a per-sequence loop would strand earlier
+        # sequences' fresh pages when a later one exhausts the pool
+        self.cache.reserve(seqs, extra_tokens=1)
+        ids = jnp.asarray([self._last_token[s] for s in seqs], jnp.int32)
+        positions = jnp.asarray([int(self.cache.lengths[s])
+                                 for s in seqs], jnp.int32)
+        tables = jnp.asarray(np.maximum(self.cache.page_table[seqs], 0))
+        lengths = jnp.asarray(self.cache.lengths[seqs])
+        logits, kps, vps = self._jit_decode(
+            self.layers, ids, positions, self.cache.k_pages,
+            self.cache.v_pages, lengths, tables)
+        self.cache.k_pages = kps
+        self.cache.v_pages = vps
+        for s in seqs:
+            self.cache.lengths[s] += 1
+        out = {}
+        for i, s in enumerate(seqs):
+            tok = int(jnp.argmax(logits[i]))
+            self._last_token[s] = tok
+            out[s] = tok
+        return out

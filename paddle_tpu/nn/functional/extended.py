@@ -350,14 +350,20 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
             fx = ((gx + 1) * W - 1) / 2
             fy = ((gy + 1) * H - 1) / 2
         if pad == "reflection":
-            # reflect coords into range before indexing (reference
-            # reflect-about-border semantics for align_corners=True)
+            # align_corners=True reflects about pixel CENTERS (period
+            # 2(n-1)); align_corners=False about pixel EDGES -0.5 and
+            # n-0.5 (period 2n) — the reference's two regimes.
             def reflect(f, n):
                 if n == 1:
                     return jnp.zeros_like(f)
-                period = 2 * (n - 1)
-                f = jnp.mod(jnp.abs(f), period)
-                return jnp.where(f > n - 1, period - f, f)
+                if align:
+                    period = 2 * (n - 1)
+                    f = jnp.mod(jnp.abs(f), period)
+                    return jnp.where(f > n - 1, period - f, f)
+                period = 2 * n
+                f = jnp.mod(jnp.abs(f + 0.5), period)
+                f = jnp.where(f > n, period - f, f) - 0.5
+                return jnp.clip(f, 0, n - 1)
 
             fx = reflect(fx, W)
             fy = reflect(fy, H)

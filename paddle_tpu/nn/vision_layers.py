@@ -91,12 +91,14 @@ class UpsamplingNearest2D(Layer):
         super().__init__()
         self._size = size
         self._scale = scale_factor
+        self._fmt = data_format
 
     def forward(self, x):
         from . import functional as F
 
         return F.interpolate(x, size=self._size,
-                             scale_factor=self._scale, mode="nearest")
+                             scale_factor=self._scale, mode="nearest",
+                             data_format=self._fmt)
 
 
 class UpsamplingBilinear2D(Layer):
@@ -105,13 +107,14 @@ class UpsamplingBilinear2D(Layer):
         super().__init__()
         self._size = size
         self._scale = scale_factor
+        self._fmt = data_format
 
     def forward(self, x):
         from . import functional as F
 
         return F.interpolate(x, size=self._size,
                              scale_factor=self._scale, mode="bilinear",
-                             align_corners=True)
+                             align_corners=True, data_format=self._fmt)
 
 
 class CosineSimilarity(Layer):

@@ -339,6 +339,21 @@ def _install_tensor_methods():
         softmax=softmax, sigmoid=sigmoid, relu=relu, gelu=gelu,
         one_hot=one_hot, bincount=bincount, histogram=histogram,
         nan_to_num=nan_to_num,
+        # long-tail (ops/extra.py + linalg tail), round 3
+        kron=kron, trace=trace, heaviside=heaviside, copysign=copysign,
+        hypot=hypot, deg2rad=deg2rad, rad2deg=rad2deg, diff=diff,
+        trapezoid=trapezoid, vander=vander, logcumsumexp=logcumsumexp,
+        renorm=renorm, cdist=cdist, tensordot=tensordot,
+        bucketize=bucketize, nanmedian=nanmedian, mode=mode,
+        kthvalue=kthvalue, rot90=rot90, take=take, index_add=index_add,
+        index_fill=index_fill, unfold=unfold, as_strided=as_strided,
+        select_scatter=select_scatter, slice_scatter=slice_scatter,
+        diagflat=diagflat, atleast_1d=atleast_1d, atleast_2d=atleast_2d,
+        atleast_3d=atleast_3d, tensor_split=tensor_split,
+        hsplit=hsplit, vsplit=vsplit, dsplit=dsplit, lu=lu,
+        eig=eig, eigvals=eigvals, eigvalsh=eigvalsh, svdvals=svdvals,
+        cond=cond, corrcoef=corrcoef, cov=cov, lstsq=lstsq,
+        matrix_exp=matrix_exp, cholesky_solve=cholesky_solve,
     )
     for name, fn in methods.items():
         if fn is None:

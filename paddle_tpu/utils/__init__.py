@@ -87,3 +87,24 @@ class unique_name:  # noqa: N801 — namespace (reference utils/unique_name.py)
                 cls._counters.update(saved)
 
         return _guard()
+
+
+def enable_compile_cache(cache_dir=None, min_compile_secs=5):
+    """Turn on jax's persistent XLA compilation cache (repo-local by
+    default) — a cold process otherwise pays minutes of compile for the
+    large bench/serving programs."""
+    import os
+
+    import jax
+
+    if cache_dir is None:
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), ".jax_cache")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_secs)
+    except Exception:
+        pass  # an optimization, never a requirement
