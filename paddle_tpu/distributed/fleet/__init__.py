@@ -28,6 +28,8 @@ from .sharding import (  # noqa: F401
 # REAL module (not a shadowing class) so both attribute access and
 # `import paddle_tpu.distributed.fleet.meta_parallel` agree.
 from . import meta_parallel  # noqa: F401
+from . import utils  # noqa: F401
+from .utils import recompute  # noqa: F401
 from . import mpu as _mpu  # noqa: F401
 
 meta_parallel.PipelineLayer = PipelineLayer

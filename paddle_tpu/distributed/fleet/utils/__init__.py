@@ -1,0 +1,45 @@
+"""fleet.utils (reference:
+python/paddle/distributed/fleet/utils/__init__.py) — recompute et al.
+"""
+from __future__ import annotations
+
+import weakref
+
+from ....nn.layers import Layer
+
+# plain functions (usually module-level, long-lived): weak-keyed so a
+# transient closure doesn't pin its StaticFunction forever
+_FN_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def recompute(function, *args, preserve_rng_state=True,
+              use_reentrant=True, **kwargs):
+    """Activation recomputation (reference fleet/utils recompute /
+    paddle.distributed.fleet.recompute): run ``function`` storing only
+    its INPUTS; the body reruns during backward.
+
+    TPU-native: the block is traced once and wrapped in
+    ``jax.checkpoint`` inside a jit (StaticFunction with remat=True) —
+    the eager tape sees one fused node whose vjp recomputes.
+    ``preserve_rng_state`` is inherent here: sampling keys are baked at
+    trace time, so forward and recompute draw identical randomness."""
+    from ....jit import StaticFunction
+
+    fn = function.forward if isinstance(function, Layer) else function
+    layer = function if isinstance(function, Layer) \
+        else getattr(function, "__self__", None)
+    layer = layer if isinstance(layer, Layer) else None
+    if layer is not None:
+        # cache ON the layer: dies with it (no global strong refs)
+        attr = f"_pt_recompute_sf_{id(getattr(fn, '__func__', fn))}"
+        sf = layer.__dict__.get(attr)
+        if sf is None:
+            sf = StaticFunction(fn, layer=layer, remat=True)
+            object.__setattr__(layer, attr, sf)
+        return sf(*args, **kwargs)
+    base = getattr(fn, "__func__", fn)
+    sf = _FN_CACHE.get(base)
+    if sf is None:
+        sf = StaticFunction(fn, layer=None, remat=True)
+        _FN_CACHE[base] = sf
+    return sf(*args, **kwargs)

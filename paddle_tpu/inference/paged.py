@@ -186,16 +186,20 @@ class PagedKVCache:
         self.lengths[seq] = 0
         self._active[seq] = False
 
-    def _ensure_capacity(self, seq: int, new_len: int) -> None:
+    def _plan_missing(self, seq: int, new_len: int):
+        """Slot-aware plan (-1 = unset): the list of page-table slots
+        that still need a page for ``seq`` to hold ``new_len`` tokens.
+        Idempotent across retries — already-assigned slots are never
+        re-popped."""
         need = -(-new_len // self.page_size)
         if need > self.max_pages_per_seq:
             raise RuntimeError(
                 f"sequence {seq} needs {need} pages > per-seq budget "
                 f"{self.max_pages_per_seq}")
-        # Idempotent by slot (-1 = unset): a retry after a failed batch
-        # never pops a second page for an already-assigned slot, and
-        # checking before popping keeps a failure side-effect free.
-        missing = [i for i in range(need) if self.page_table[seq, i] < 0]
+        return [i for i in range(need) if self.page_table[seq, i] < 0]
+
+    def _ensure_capacity(self, seq: int, new_len: int) -> None:
+        missing = self._plan_missing(seq, new_len)
         if len(missing) > len(self._free):
             raise RuntimeError("KV page pool exhausted")
         for i in missing:
@@ -206,20 +210,9 @@ class PagedKVCache:
         missing slots first, commit only if the WHOLE batch fits (a
         per-sequence loop would leak the earlier sequences' pages on a
         mid-batch failure)."""
-        plans = []
-        total = 0
-        for s in seqs:
-            need = -(-(int(self.lengths[s]) + extra_tokens)
-                     // self.page_size)
-            if need > self.max_pages_per_seq:
-                raise RuntimeError(
-                    f"sequence {s} needs {need} pages > per-seq budget "
-                    f"{self.max_pages_per_seq}")
-            missing = [i for i in range(need)
-                       if self.page_table[s, i] < 0]
-            total += len(missing)
-            plans.append((s, missing))
-        if total > len(self._free):
+        plans = [(s, self._plan_missing(
+            s, int(self.lengths[s]) + extra_tokens)) for s in seqs]
+        if sum(len(m) for _, m in plans) > len(self._free):
             raise RuntimeError("KV page pool exhausted")
         for s, missing in plans:
             for i in missing:

@@ -197,9 +197,11 @@ class PagedLlamaEngine:
         self.cache.v_pages = vps
         for s in seqs:
             self.cache.lengths[s] += 1
+        # single batched argmax + ONE host transfer for the whole step
+        toks = np.asarray(jnp.argmax(logits, axis=-1))
         out = {}
         for i, s in enumerate(seqs):
-            tok = int(jnp.argmax(logits[i]))
+            tok = int(toks[i])
             self._last_token[s] = tok
             out[s] = tok
         return out

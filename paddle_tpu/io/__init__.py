@@ -76,6 +76,37 @@ class ChainDataset(IterableDataset):
             yield from d
 
 
+class ConcatDataset(Dataset):
+    """Concatenation of map-style datasets (reference io ConcatDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ConcatDataset needs at least one dataset")
+        self._offsets = []
+        total = 0
+        for d in self.datasets:
+            total += len(d)
+            self._offsets.append(total)
+
+    def __len__(self):
+        return self._offsets[-1]
+
+    def __getitem__(self, idx):
+        n = len(self)
+        if idx < 0:
+            idx += n
+        if not 0 <= idx < n:
+            raise IndexError(
+                f"ConcatDataset index out of range: {idx - n} for "
+                f"length {n}")
+        import bisect
+
+        di = bisect.bisect_right(self._offsets, idx)
+        prev = 0 if di == 0 else self._offsets[di - 1]
+        return self.datasets[di][idx - prev]
+
+
 class Subset(Dataset):
     def __init__(self, dataset, indices):
         self.dataset = dataset

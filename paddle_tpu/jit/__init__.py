@@ -76,11 +76,12 @@ _FALLBACK = object()  # cache sentinel: this guard key runs eagerly
 
 class StaticFunction:
     def __init__(self, function, layer=None, input_spec=None,
-                 full_graph=True):
+                 full_graph=True, remat=False):
         self._fn = function
         self._layer = layer
         self._input_spec = input_spec
         self._full_graph = full_graph
+        self._remat = remat  # jax.checkpoint the traced body
         self._cache = {}
         self._warned_break = False
         functools.update_wrapper(self, function)
@@ -228,6 +229,10 @@ class StaticFunction:
                     t._data = d
             return out_datas, new_state
 
+        if self._remat:
+            # recompute semantics: only the inputs are saved; the body
+            # reruns in the backward (fleet.utils.recompute rides this)
+            return jax.jit(jax.checkpoint(pure))
         return jax.jit(pure)
 
     # Reference API parity.

@@ -3,6 +3,13 @@ from __future__ import annotations
 
 import numpy as np
 
+def _is_chw(arr):
+    """Channel-first heuristic shared by every transform: 3-d with a
+    small leading channel count and a non-channel trailing dim."""
+    return (arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+            and arr.shape[-1] not in (1, 3, 4))
+
+
 
 class Compose:
     def __init__(self, transforms):
@@ -47,7 +54,7 @@ class Resize:
         import jax
 
         arr = np.asarray(img, dtype=np.float32)
-        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        chw = _is_chw(arr)
         if chw:
             out_shape = (arr.shape[0],) + self.size
         else:
@@ -72,9 +79,155 @@ class CenterCrop:
 
     def __call__(self, img):
         arr = np.asarray(img)
-        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        chw = _is_chw(arr)
         h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
         th, tw = self.size
         i, j = (h - th) // 2, (w - tw) // 2
         return arr[:, i:i + th, j:j + tw] if chw else arr[i:i + th,
                                                           j:j + tw]
+
+
+class Pad:
+    """Pad all sides (reference transforms.Pad); HWC or CHW arrays."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding = (padding,) * 4 if isinstance(padding, int) else (
+            tuple(padding) * 2 if len(padding) == 2 else tuple(padding))
+        self.fill = fill
+        self.mode = padding_mode
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        left, top, right, bottom = self.padding
+        chw = _is_chw(arr)
+        if chw:
+            pads = [(0, 0), (top, bottom), (left, right)]
+        elif arr.ndim == 3:
+            pads = [(top, bottom), (left, right), (0, 0)]
+        else:
+            pads = [(top, bottom), (left, right)]
+        if self.mode == "constant":
+            return np.pad(arr, pads, mode="constant",
+                          constant_values=self.fill)
+        return np.pad(arr, pads, mode=self.mode)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if self.padding is not None:
+            arr = Pad(self.padding)(arr)
+        chw = _is_chw(arr)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        th, tw = self.size
+        if self.pad_if_needed and (h < th or w < tw):
+            ph, pw = max(th - h, 0), max(tw - w, 0)
+            arr = Pad((pw, ph, pw, ph))(arr)
+            h, w = h + 2 * ph, w + 2 * pw
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return arr[:, i:i + th, j:j + tw] if chw \
+            else arr[i:i + th, j:j + tw]
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        chw = _is_chw(arr)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                crop = arr[:, i:i + ch, j:j + cw] if chw \
+                    else arr[i:i + ch, j:j + cw]
+                return Resize(self.size)(crop)
+        return Resize(self.size)(CenterCrop(min(h, w))(arr))
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1):
+        self.n = num_output_channels
+
+    def __call__(self, img):
+        arr = np.asarray(img).astype(np.float32)
+        chw = _is_chw(arr)
+        wts = np.array([0.299, 0.587, 0.114], np.float32)
+        if chw:
+            g = np.tensordot(wts, arr[:3], 1)[None]
+            return np.repeat(g, self.n, 0) if self.n > 1 else g
+        g = arr[..., :3] @ wts
+        g = g[..., None]
+        return np.repeat(g, self.n, -1) if self.n > 1 else g
+
+
+class RandomRotation:
+    """Rotation by a uniform angle (nearest-neighbor resample — host
+    numpy; augmentations run in the input pipeline, not on device)."""
+
+    def __init__(self, degrees, fill=0):
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.fill = fill
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        angle = np.deg2rad(np.random.uniform(*self.degrees))
+        chw = _is_chw(arr)
+        a = arr if not chw else np.moveaxis(arr, 0, -1)
+        h, w = a.shape[:2]
+        cy, cx = (h - 1) / 2, (w - 1) / 2
+        ys, xs = np.mgrid[0:h, 0:w]
+        c, s = np.cos(angle), np.sin(angle)
+        sy = cy + (ys - cy) * c - (xs - cx) * s
+        sx = cx + (ys - cy) * s + (xs - cx) * c
+        syi = np.round(sy).astype(int)
+        sxi = np.round(sx).astype(int)
+        valid = (syi >= 0) & (syi < h) & (sxi >= 0) & (sxi < w)
+        out = np.full_like(a, self.fill)
+        out[valid] = a[syi[valid], sxi[valid]]
+        return np.moveaxis(out, -1, 0) if chw else out
+
+
+class ColorJitter:
+    """Brightness/contrast/saturation jitter (hue omitted — documented
+    subset; reference transforms.ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+
+    def __call__(self, img):
+        arr = np.asarray(img).astype(np.float32)
+        if self.brightness:
+            arr = arr * np.random.uniform(max(0, 1 - self.brightness),
+                                          1 + self.brightness)
+        if self.contrast:
+            f = np.random.uniform(max(0, 1 - self.contrast),
+                                  1 + self.contrast)
+            arr = (arr - arr.mean()) * f + arr.mean()
+        if self.saturation:
+            f = np.random.uniform(max(0, 1 - self.saturation),
+                                  1 + self.saturation)
+            chw = _is_chw(arr)
+            axis = 0 if chw else -1
+            gray = arr.mean(axis=axis, keepdims=True)
+            arr = gray + (arr - gray) * f
+        return arr

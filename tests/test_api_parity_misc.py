@@ -111,3 +111,80 @@ def test_broadcast_tensors_and_rank():
     assert tuple(a.shape) == (2, 3) and tuple(b.shape) == (2, 3)
     assert int(paddle.rank(a).numpy()) == 2
     assert paddle.version.full_version == paddle.__version__
+
+
+def test_concat_dataset_and_transforms():
+    from paddle_tpu.io import ConcatDataset, Dataset
+    from paddle_tpu.vision import transforms as T
+
+    class Rng(Dataset):
+        def __init__(self, lo, hi):
+            self.vals = list(range(lo, hi))
+
+        def __len__(self):
+            return len(self.vals)
+
+        def __getitem__(self, i):
+            return self.vals[i]
+
+    d = ConcatDataset([Rng(0, 3), Rng(10, 12)])
+    assert len(d) == 5 and d[3] == 10 and d[-1] == 11
+
+    np.random.seed(0)
+    img = np.random.rand(3, 8, 8).astype("float32")
+    assert T.Pad(2)(img).shape == (3, 12, 12)
+    assert T.RandomCrop(4)(img).shape == (3, 4, 4)
+    assert T.RandomResizedCrop(4)(img).shape == (3, 4, 4)
+    assert T.Grayscale()(img).shape == (1, 8, 8)
+    assert T.Grayscale(3)(img).shape == (3, 8, 8)
+    assert T.RandomRotation(30)(img).shape == (3, 8, 8)
+    assert T.ColorJitter(0.2, 0.2, 0.2)(img).shape == (3, 8, 8)
+
+
+def test_fleet_recompute():
+    """fleet.utils.recompute: same numerics and grads as the plain
+    call (only inputs saved; body reruns in backward)."""
+    from paddle_tpu.distributed.fleet import recompute
+
+    paddle.seed(5)
+    blk = nn.Sequential(nn.Linear(6, 12), nn.GELU(), nn.Linear(12, 6))
+    x = paddle.to_tensor(np.random.RandomState(0).randn(3, 6)
+                         .astype("float32"))
+    x.stop_gradient = False
+    out = recompute(blk, x)
+    loss = (out ** 2).mean()
+    loss.backward()
+    g_rc = x.grad.numpy().copy()
+    gw_rc = blk[0].weight.grad.numpy().copy()
+
+    x2 = paddle.to_tensor(x.numpy())
+    x2.stop_gradient = False
+    blk.clear_gradients() if hasattr(blk, "clear_gradients") else None
+    for p in blk.parameters():
+        p.clear_grad() if hasattr(p, "clear_grad") else None
+    out2 = blk(x2)
+    ((out2 ** 2).mean()).backward()
+    np.testing.assert_allclose(g_rc, x2.grad.numpy(), rtol=1e-4,
+                               atol=1e-6)
+    np.testing.assert_allclose(gw_rc, blk[0].weight.grad.numpy(),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_concat_dataset_oob_raises():
+    import pytest
+
+    from paddle_tpu.io import ConcatDataset, Dataset
+
+    class Rng(Dataset):
+        def __len__(self):
+            return 2
+
+        def __getitem__(self, i):
+            return i
+
+    d = ConcatDataset([Rng(), Rng()])
+    with pytest.raises(IndexError):
+        d[4]
+    with pytest.raises(IndexError):
+        d[-5]
+    assert d[-1] == 1
