@@ -3,13 +3,7 @@ python/paddle/distributed/fleet/utils/__init__.py) — recompute et al.
 """
 from __future__ import annotations
 
-import weakref
-
 from ....nn.layers import Layer
-
-# plain functions (usually module-level, long-lived): weak-keyed so a
-# transient closure doesn't pin its StaticFunction forever
-_FN_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 def recompute(function, *args, preserve_rng_state=True,
@@ -29,17 +23,19 @@ def recompute(function, *args, preserve_rng_state=True,
     layer = function if isinstance(function, Layer) \
         else getattr(function, "__self__", None)
     layer = layer if isinstance(layer, Layer) else None
-    if layer is not None:
-        # cache ON the layer: dies with it (no global strong refs)
-        attr = f"_pt_recompute_sf_{id(getattr(fn, '__func__', fn))}"
-        sf = layer.__dict__.get(attr)
-        if sf is None:
-            sf = StaticFunction(fn, layer=layer, remat=True)
-            object.__setattr__(layer, attr, sf)
-        return sf(*args, **kwargs)
-    base = getattr(fn, "__func__", fn)
-    sf = _FN_CACHE.get(base)
+    # Cache ON the owning object (layer > bound instance > the function
+    # itself), never in a module-global: the StaticFunction dies with
+    # its owner, so transient closures/models stay collectable (a
+    # global cache — even weak-keyed — is pinned by the value's own
+    # reference back to the key).
+    owner = layer if layer is not None \
+        else getattr(fn, "__self__", None) or fn
+    attr = f"_pt_recompute_sf_{id(getattr(fn, '__func__', fn))}"
+    sf = owner.__dict__.get(attr) if hasattr(owner, "__dict__") else None
     if sf is None:
-        sf = StaticFunction(fn, layer=None, remat=True)
-        _FN_CACHE[base] = sf
+        sf = StaticFunction(fn, layer=layer, remat=True)
+        try:
+            object.__setattr__(owner, attr, sf)
+        except (AttributeError, TypeError):
+            pass  # uncacheable owner: recompile per call (correct, slow)
     return sf(*args, **kwargs)

@@ -94,11 +94,12 @@ class ConcatDataset(Dataset):
 
     def __getitem__(self, idx):
         n = len(self)
+        orig = idx
         if idx < 0:
             idx += n
         if not 0 <= idx < n:
             raise IndexError(
-                f"ConcatDataset index out of range: {idx - n} for "
+                f"ConcatDataset index out of range: {orig} for "
                 f"length {n}")
         import bisect
 

@@ -265,3 +265,44 @@ def test_ema_state_roundtrip():
     np.testing.assert_allclose(np.asarray(got), sd["shadow_0"],
                                rtol=1e-6)
     ema2.restore()
+
+
+def test_fused_transformer_layers_parity():
+    """incubate Fused{MultiHeadAttention,FeedForward,EncoderLayer}
+    match the unfused nn.TransformerEncoderLayer numerics when weights
+    are copied (reference incubate/nn/layer/fused_transformer.py)."""
+    from paddle_tpu.incubate.nn import FusedTransformerEncoderLayer
+
+    d, heads, ffn = 16, 4, 32
+    paddle.seed(6)
+    ref = nn.TransformerEncoderLayer(d, heads, ffn, dropout=0.0,
+                                     attn_dropout=0.0, act_dropout=0.0)
+    fused = FusedTransformerEncoderLayer(d, heads, ffn, dropout_rate=0.0)
+    # copy weights: fused qkv = concat of ref q/k/v along output dim
+    ref.eval()
+    fused.eval()
+    qw = ref.self_attn.q_proj.weight.numpy()
+    kw = ref.self_attn.k_proj.weight.numpy()
+    vw = ref.self_attn.v_proj.weight.numpy()
+    qb = ref.self_attn.q_proj.bias.numpy()
+    kb = ref.self_attn.k_proj.bias.numpy()
+    vb = ref.self_attn.v_proj.bias.numpy()
+    # fused reshapes [B,S,3,H,hd]: interleave per (3) slot
+    fused.fused_attn.qkv_proj.weight.set_value(
+        _t(np.concatenate([qw, kw, vw], axis=1)))
+    fused.fused_attn.qkv_proj.bias.set_value(
+        _t(np.concatenate([qb, kb, vb])))
+    fused.fused_attn.out_proj.weight.set_value(ref.self_attn.out_proj.weight)
+    fused.fused_attn.out_proj.bias.set_value(ref.self_attn.out_proj.bias)
+    fused.fused_attn.norm.weight.set_value(ref.norm1.weight)
+    fused.fused_attn.norm.bias.set_value(ref.norm1.bias)
+    fused.ffn.linear1.weight.set_value(ref.linear1.weight)
+    fused.ffn.linear1.bias.set_value(ref.linear1.bias)
+    fused.ffn.linear2.weight.set_value(ref.linear2.weight)
+    fused.ffn.linear2.bias.set_value(ref.linear2.bias)
+    fused.ffn.norm.weight.set_value(ref.norm2.weight)
+    fused.ffn.norm.bias.set_value(ref.norm2.bias)
+
+    x = _t(_r(2, 6, d, seed=7))
+    np.testing.assert_allclose(fused(x).numpy(), ref(x).numpy(),
+                               rtol=1e-4, atol=1e-5)
