@@ -45,3 +45,6 @@ from .transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder,
     TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
 )
+from .rnn import (  # noqa: F401,E402
+    RNN, BiRNN, GRU, GRUCell, LSTM, LSTMCell, SimpleRNN, SimpleRNNCell,
+)

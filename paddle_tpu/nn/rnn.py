@@ -1,0 +1,379 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py —
+SimpleRNNCell:741, LSTMCell:918, GRUCell:1144, RNN, BiRNN, and the
+multi-layer SimpleRNN/LSTM/GRU).
+
+TPU-native: each sequence pass is ONE ``lax.scan`` program through the
+op registry (jit-cached, differentiable) — the time loop lives in the
+compiled program, not Python.  Gate semantics match the reference
+exactly: LSTM chunks (i, f, c, o); GRU chunks (r, z, c) with
+``h = (h_prev - c) * z + c``; candidate reset applied AFTER the
+recurrent matmul.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import registry as _registry
+from . import initializer as I
+from .layers import Layer
+
+_op = _registry.cached_apply
+
+
+def _sig(x):
+    return jax.nn.sigmoid(x)
+
+
+# -- fused sequence kernels (one lax.scan each) -------------------------
+
+def _simple_scan(x, h0, w_ih, w_hh, b_ih, b_hh, activation):
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+    def step(h, xt):
+        h = act(xt @ w_ih.T + b_ih + h @ w_hh.T + b_hh)
+        return h, h
+
+    h, ys = jax.lax.scan(step, h0, jnp.swapaxes(x, 0, 1))
+    return jnp.swapaxes(ys, 0, 1), h
+
+
+def _lstm_scan(x, h0, c0, w_ih, w_hh, b_ih, b_hh):
+    def step(carry, xt):
+        h, c = carry
+        gates = xt @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = _sig(f) * c + _sig(i) * jnp.tanh(g)
+        h = _sig(o) * jnp.tanh(c)
+        return (h, c), h
+
+    (h, c), ys = jax.lax.scan(step, (h0, c0), jnp.swapaxes(x, 0, 1))
+    return jnp.swapaxes(ys, 0, 1), h, c
+
+
+def _gru_scan(x, h0, w_ih, w_hh, b_ih, b_hh):
+    def step(h, xt):
+        xg = xt @ w_ih.T + b_ih
+        hg = h @ w_hh.T + b_hh
+        x_r, x_z, x_c = jnp.split(xg, 3, axis=-1)
+        h_r, h_z, h_c = jnp.split(hg, 3, axis=-1)
+        r = _sig(x_r + h_r)
+        z = _sig(x_z + h_z)
+        c = jnp.tanh(x_c + r * h_c)  # reset AFTER the recurrent matmul
+        h = (h - c) * z + c
+        return h, h
+
+    h, ys = jax.lax.scan(step, h0, jnp.swapaxes(x, 0, 1))
+    return jnp.swapaxes(ys, 0, 1), h
+
+
+# -- cells --------------------------------------------------------------
+
+class RNNCellBase(Layer):
+    def _make_weights(self, gates, input_size, hidden_size,
+                      weight_ih_attr=None, weight_hh_attr=None,
+                      bias_ih_attr=None, bias_hh_attr=None):
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [gates * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [gates * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [gates * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [gates * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def _bias(self, b, gates):
+        """attr=False biases are None — substitute zeros (bias-free)."""
+        from ..core.tensor import Tensor
+
+        if b is not None:
+            return b
+        return Tensor(jnp.zeros(gates * self.hidden_size, jnp.float32))
+
+    def _zeros(self, inputs, n=1):
+        from ..core.tensor import Tensor
+
+        B = inputs.shape[0]
+        z = Tensor(jnp.zeros((B, self.hidden_size),
+                             inputs._data.dtype))
+        return z if n == 1 else tuple(
+            Tensor(jnp.zeros((B, self.hidden_size), inputs._data.dtype))
+            for _ in range(n))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation must be tanh or relu")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        self._make_weights(1, input_size, hidden_size, weight_ih_attr,
+                           weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        h = self._zeros(inputs) if states is None else states
+        out = _op("simple_rnn_cell",
+                  lambda xt, h, wi, wh, bi, bh, act: (
+                      jnp.tanh if act == "tanh" else jax.nn.relu)(
+                      xt @ wi.T + bi + h @ wh.T + bh),
+                  inputs, h, self.weight_ih, self.weight_hh,
+                  self._bias(self.bias_ih, 1),
+                  self._bias(self.bias_hh, 1), act=self.activation)
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, proj_size=0, name=None):
+        super().__init__()
+        if proj_size:
+            raise NotImplementedError(
+                "LSTMCell proj_size != 0 is not implemented")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self._make_weights(4, input_size, hidden_size, weight_ih_attr,
+                           weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        h, c = self._zeros(inputs, 2) if states is None else states
+
+        def fn(xt, h, c, wi, wh, bi, bh):
+            gates = xt @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c = _sig(f) * c + _sig(i) * jnp.tanh(g)
+            return _sig(o) * jnp.tanh(c), c
+
+        h2, c2 = _op("lstm_cell", fn, inputs, h, c, self.weight_ih,
+                     self.weight_hh, self._bias(self.bias_ih, 4),
+                     self._bias(self.bias_hh, 4), n_outputs=2)
+        return h2, (h2, c2)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self._make_weights(3, input_size, hidden_size, weight_ih_attr,
+                           weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        h = self._zeros(inputs) if states is None else states
+
+        def fn(xt, h, wi, wh, bi, bh):
+            xg = xt @ wi.T + bi
+            hg = h @ wh.T + bh
+            x_r, x_z, x_c = jnp.split(xg, 3, axis=-1)
+            h_r, h_z, h_c = jnp.split(hg, 3, axis=-1)
+            r = _sig(x_r + h_r)
+            z = _sig(x_z + h_z)
+            c = jnp.tanh(x_c + r * h_c)
+            return (h - c) * z + c
+
+        h2 = _op("gru_cell", fn, inputs, h, self.weight_ih,
+                 self.weight_hh, self._bias(self.bias_ih, 3),
+                 self._bias(self.bias_hh, 3))
+        return h2, h2
+
+
+# -- sequence wrappers --------------------------------------------------
+
+class RNN(Layer):
+    """Run any cell over a sequence (reference rnn.py RNN): generic
+    eager loop so custom cells keep their python semantics."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None):
+        from .. import ops
+
+        x = inputs if not self.time_major else ops.transpose(
+            inputs, [1, 0, 2])
+        T = x.shape[1]
+        idx = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = [None] * T
+        for t in idx:
+            o, states = self.cell(x[:, t], states)
+            outs[t] = o
+        out = ops.stack(outs, axis=1)
+        if self.time_major:
+            out = ops.transpose(out, [1, 0, 2])
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False,
+                          time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True,
+                          time_major=time_major)
+
+    def forward(self, inputs, initial_states=None):
+        from .. import ops
+
+        fw_states, bw_states = (initial_states if initial_states
+                                is not None else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, fw_states)
+        out_bw, st_bw = self.rnn_bw(inputs, bw_states)
+        return ops.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _StackedRNN(Layer):
+    """Multi-layer (optionally bidirectional) fused-scan runner shared
+    by SimpleRNN/LSTM/GRU."""
+
+    MODE = "simple"
+    GATES = {"simple": 1, "lstm": 4, "gru": 3}
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(f"unknown direction {direction!r}")
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation must be tanh or relu")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bidirectional = direction != "forward"
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        gates = self.GATES[self.MODE]
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        dirs = 2 if self.bidirectional else 1
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * dirs
+            for d in range(dirs):
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                for name, shape, attr in [
+                        (f"weight_ih{sfx}", [gates * hidden_size, in_sz],
+                         weight_ih_attr),
+                        (f"weight_hh{sfx}",
+                         [gates * hidden_size, hidden_size],
+                         weight_hh_attr),
+                        (f"bias_ih{sfx}", [gates * hidden_size],
+                         bias_ih_attr),
+                        (f"bias_hh{sfx}", [gates * hidden_size],
+                         bias_hh_attr)]:
+                    setattr(self, name, self.create_parameter(
+                        shape, attr=attr, is_bias="bias" in name,
+                        default_initializer=init))
+
+    def _run_single(self, x, h0, c0, layer, reverse):
+        """One (layer, direction) pass via the fused scan op."""
+        from ..core.tensor import Tensor
+
+        sfx = f"_l{layer}" + ("_reverse" if reverse else "")
+        gates = self.GATES[self.MODE]
+        zeros = Tensor(jnp.zeros(gates * self.hidden_size, jnp.float32))
+        wi = getattr(self, f"weight_ih{sfx}")
+        wh = getattr(self, f"weight_hh{sfx}")
+        bi = getattr(self, f"bias_ih{sfx}")
+        bh = getattr(self, f"bias_hh{sfx}")
+        bi = zeros if bi is None else bi  # attr=False -> no bias param
+        bh = zeros if bh is None else bh
+        mode = self.MODE
+
+        def fn(x, h0, c0, wi, wh, bi, bh, mode, reverse, act):
+            xx = jnp.flip(x, 1) if reverse else x
+            if mode == "lstm":
+                ys, h, c = _lstm_scan(xx, h0, c0, wi, wh, bi, bh)
+            elif mode == "gru":
+                ys, h = _gru_scan(xx, h0, wi, wh, bi, bh)
+                c = c0
+            else:
+                ys, h = _simple_scan(xx, h0, wi, wh, bi, bh, act)
+                c = c0
+            if reverse:
+                ys = jnp.flip(ys, 1)
+            return ys, h, c
+
+        return _op(f"rnn_{mode}_scan", fn, x, h0, c0, wi, wh, bi, bh,
+                   n_outputs=3, mode=mode, reverse=bool(reverse),
+                   act=self.activation)
+
+    def forward(self, inputs, initial_states=None):
+        from .. import ops
+        from ..core.tensor import Tensor
+
+        x = inputs if not self.time_major else ops.transpose(
+            inputs, [1, 0, 2])
+        B = x.shape[0]
+        dirs = 2 if self.bidirectional else 1
+        L = self.num_layers
+        dt = x._data.dtype
+        if initial_states is None:
+            zeros = lambda: Tensor(jnp.zeros((L * dirs, B,  # noqa: E731
+                                              self.hidden_size), dt))
+            if self.MODE == "lstm":
+                initial_states = (zeros(), zeros())
+            else:
+                initial_states = zeros()
+        if self.MODE == "lstm":
+            h0_all, c0_all = initial_states
+        else:
+            h0_all = initial_states
+            c0_all = Tensor(jnp.zeros_like(h0_all._data))
+
+        hs, cs = [], []
+        out = x
+        for layer in range(L):
+            outs_dir = []
+            for d in range(dirs):
+                i = layer * dirs + d
+                ys, h, c = self._run_single(out, h0_all[i], c0_all[i],
+                                            layer, d == 1)
+                outs_dir.append(ys)
+                hs.append(h)
+                cs.append(c)
+            out = outs_dir[0] if dirs == 1 else ops.concat(
+                outs_dir, axis=-1)
+            if self.dropout and layer < L - 1 and self.training:
+                from . import functional as F
+
+                out = F.dropout(out, self.dropout, training=True)
+        h_final = ops.stack(hs, axis=0)
+        if self.time_major:
+            out = ops.transpose(out, [1, 0, 2])
+        if self.MODE == "lstm":
+            return out, (h_final, ops.stack(cs, axis=0))
+        return out, h_final
+
+
+class SimpleRNN(_StackedRNN):
+    MODE = "simple"
+
+
+class LSTM(_StackedRNN):
+    MODE = "lstm"
+
+
+class GRU(_StackedRNN):
+    MODE = "gru"

@@ -95,19 +95,22 @@ def get_op(name: str) -> OpDef:
 _DYN_OPS: dict = {}
 
 
-def cached_apply(name, fn, *args, **attrs):
+def cached_apply(name, fn, *args, n_outputs=1, **attrs):
     """Dispatch ``fn`` through a cached ad-hoc OpDef (full dispatch
     semantics: jit cache, NaN checks, eager tape) without entering the
     global registry sweep.  The OpDef is rebuilt whenever the attr-key
-    set changes so ``static_argnames`` never goes stale.  Shared by the
-    domain namespaces (sparse/audio/geometric/...)."""
+    set (or output arity) changes so ``static_argnames`` never goes
+    stale.  Shared by the domain namespaces (sparse/audio/geometric/
+    rnn/...)."""
     # Key on the code object too: per-call closures share one compiled
     # OpDef, but two modules reusing an op name with different bodies
     # get distinct entries instead of silently running the first fn.
     key = (name, getattr(fn, "__code__", fn))
     op = _DYN_OPS.get(key)
-    if op is None or set(op.static_argnames) != set(attrs.keys()):
-        op = OpDef(name, fn, static_argnames=tuple(attrs.keys()))
+    if op is None or set(op.static_argnames) != set(attrs.keys()) \
+            or op.n_outputs != n_outputs:
+        op = OpDef(name, fn, n_outputs=n_outputs,
+                   static_argnames=tuple(attrs.keys()))
         _DYN_OPS[key] = op
     return apply(op, *args, **attrs)
 

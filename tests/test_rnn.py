@@ -1,0 +1,166 @@
+"""RNN family vs NumPy step-by-step oracles (reference gate semantics:
+LSTM chunks (i,f,c,o); GRU chunks (r,z,c) with h = (h_prev-c)*z + c,
+reset applied after the recurrent matmul — nn/layer/rnn.py:741/918/1144).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _r(*s, seed=0):
+    return np.random.RandomState(seed).randn(*s).astype("float32")
+
+
+def _sig(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _lstm_oracle(x, wi, wh, bi, bh, h, c):
+    T = x.shape[1]
+    ys = []
+    for t in range(T):
+        g = x[:, t] @ wi.T + bi + h @ wh.T + bh
+        i, f, gg, o = np.split(g, 4, -1)
+        c = _sig(f) * c + _sig(i) * np.tanh(gg)
+        h = _sig(o) * np.tanh(c)
+        ys.append(h)
+    return np.stack(ys, 1), h, c
+
+
+def _gru_oracle(x, wi, wh, bi, bh, h):
+    T = x.shape[1]
+    ys = []
+    for t in range(T):
+        xg = x[:, t] @ wi.T + bi
+        hg = h @ wh.T + bh
+        xr, xz, xc = np.split(xg, 3, -1)
+        hr, hz, hc = np.split(hg, 3, -1)
+        r = _sig(xr + hr)
+        z = _sig(xz + hz)
+        cand = np.tanh(xc + r * hc)
+        h = (h - cand) * z + cand
+        ys.append(h)
+    return np.stack(ys, 1), h
+
+
+def test_lstm_matches_oracle():
+    paddle.seed(0)
+    m = nn.LSTM(4, 6)
+    x = _r(2, 5, 4)
+    out, (hf, cf) = m(paddle.to_tensor(x))
+    wy, wh_, wc = _lstm_oracle(
+        x, m.weight_ih_l0.numpy(), m.weight_hh_l0.numpy(),
+        m.bias_ih_l0.numpy(), m.bias_hh_l0.numpy(),
+        np.zeros((2, 6), "float32"), np.zeros((2, 6), "float32"))
+    np.testing.assert_allclose(out.numpy(), wy, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(hf.numpy()[0], wh_, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(cf.numpy()[0], wc, rtol=1e-4, atol=1e-5)
+
+
+def test_gru_matches_oracle():
+    paddle.seed(1)
+    m = nn.GRU(4, 6)
+    x = _r(2, 5, 4, seed=2)
+    out, hf = m(paddle.to_tensor(x))
+    wy, wh_ = _gru_oracle(
+        x, m.weight_ih_l0.numpy(), m.weight_hh_l0.numpy(),
+        m.bias_ih_l0.numpy(), m.bias_hh_l0.numpy(),
+        np.zeros((2, 6), "float32"))
+    np.testing.assert_allclose(out.numpy(), wy, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(hf.numpy()[0], wh_, rtol=1e-4, atol=1e-5)
+
+
+def test_simple_rnn_matches_oracle():
+    paddle.seed(2)
+    m = nn.SimpleRNN(3, 5)
+    x = _r(2, 4, 3, seed=3)
+    out, hf = m(paddle.to_tensor(x))
+    h = np.zeros((2, 5), "float32")
+    wi, wh = m.weight_ih_l0.numpy(), m.weight_hh_l0.numpy()
+    bi, bh = m.bias_ih_l0.numpy(), m.bias_hh_l0.numpy()
+    for t in range(4):
+        h = np.tanh(x[:, t] @ wi.T + bi + h @ wh.T + bh)
+    np.testing.assert_allclose(out.numpy()[:, -1], h, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_cells_match_stacked_runners():
+    """The standalone cells implement the same step as the fused scan."""
+    paddle.seed(3)
+    m = nn.GRU(4, 6)
+    cell = nn.GRUCell(4, 6)
+    cell.weight_ih.set_value(m.weight_ih_l0)
+    cell.weight_hh.set_value(m.weight_hh_l0)
+    cell.bias_ih.set_value(m.bias_ih_l0)
+    cell.bias_hh.set_value(m.bias_hh_l0)
+    x = _r(2, 3, 4, seed=4)
+    out, _ = m(paddle.to_tensor(x))
+    wrapped = nn.RNN(cell)
+    out2, _ = wrapped(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), out2.numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_bidirectional_and_reverse():
+    paddle.seed(4)
+    m = nn.LSTM(4, 6, direction="bidirect")
+    x = _r(2, 5, 4, seed=5)
+    out, (hf, cf) = m(paddle.to_tensor(x))
+    assert tuple(out.shape) == (2, 5, 12)
+    assert tuple(hf.shape) == (2, 2, 6)
+    # the reverse direction on a reversed input equals the forward
+    # direction's output reversed
+    wy, _, _ = _lstm_oracle(
+        x[:, ::-1], m.weight_ih_l0_reverse.numpy(),
+        m.weight_hh_l0_reverse.numpy(), m.bias_ih_l0_reverse.numpy(),
+        m.bias_hh_l0_reverse.numpy(),
+        np.zeros((2, 6), "float32"), np.zeros((2, 6), "float32"))
+    np.testing.assert_allclose(out.numpy()[:, :, 6:], wy[:, ::-1],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_multilayer_time_major_and_training():
+    paddle.seed(5)
+    m = nn.GRU(4, 8, num_layers=2, time_major=True)
+    x = paddle.to_tensor(_r(5, 2, 4, seed=6))  # [T, B, I]
+    out, hf = m(x)
+    assert tuple(out.shape) == (5, 2, 8)
+    assert tuple(hf.shape) == (2, 2, 8)
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=m.parameters())
+    losses = []
+    for _ in range(4):
+        out, _ = m(x)
+        loss = (out ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0], losses
+
+
+def test_birnn_wrapper():
+    paddle.seed(6)
+    bi = nn.BiRNN(nn.SimpleRNNCell(3, 4), nn.SimpleRNNCell(3, 4))
+    out, (sf, sb) = bi(paddle.to_tensor(_r(2, 5, 3, seed=7)))
+    assert tuple(out.shape) == (2, 5, 8)
+
+
+def test_rnn_attr_and_validation():
+    import pytest
+
+    with pytest.raises(ValueError, match="tanh or relu"):
+        nn.SimpleRNN(3, 4, activation="sigmoid")
+    with pytest.raises(NotImplementedError, match="proj_size"):
+        nn.LSTMCell(3, 4, proj_size=2)
+    # bias_ih_attr=False: no bias parameters, forward still works
+    cell = nn.GRUCell(3, 4, bias_ih_attr=False, bias_hh_attr=False)
+    assert cell.bias_ih is None and cell.bias_hh is None
+    h, _ = cell(paddle.to_tensor(_r(2, 3, seed=8)))
+    assert tuple(h.shape) == (2, 4)
+    m = nn.GRU(3, 4, bias_ih_attr=False, bias_hh_attr=False)
+    assert m.bias_ih_l0 is None
+    out, _ = m(paddle.to_tensor(_r(2, 5, 3, seed=9)))
+    assert np.isfinite(out.numpy()).all()
