@@ -10,7 +10,7 @@ from .clip import (  # noqa: F401
 from .common import (  # noqa: F401
     CELU, ELU, GELU, Dropout, Dropout2D, Embedding, Flatten, Hardshrink,
     Hardsigmoid, Hardswish, Hardtanh, Identity, LayerDict, LayerList,
-    LeakyReLU, Linear, LogSigmoid, LogSoftmax, Mish, Pad2D, ParameterList,
+    LeakyReLU, Linear, LogSigmoid, LogSoftmax, Mish, ParameterList,
     PReLU, ReLU, ReLU6, SELU, Sequential, Sigmoid, Silu, Softmax, Softplus,
     Softshrink, Softsign, Swish, Tanh, Tanhshrink, ThresholdedReLU,
     Upsample,
@@ -48,3 +48,8 @@ from .transformer import (  # noqa: F401
 from .rnn import (  # noqa: F401,E402
     RNN, BiRNN, GRU, GRUCell, LSTM, LSTMCell, SimpleRNN, SimpleRNNCell,
 )
+from .misc_layers import (  # noqa: F401,E402
+    GLU, AlphaDropout, Bilinear, Dropout3D, Pad1D, Pad2D, Pad3D, RReLU,
+    Unflatten, ZeroPad2D,
+)
+from . import utils  # noqa: F401,E402

@@ -104,18 +104,6 @@ class Upsample(Layer):
                              data_format=self.data_format)
 
 
-class Pad2D(Layer):
-    def __init__(self, padding, mode="constant", value=0.0,
-                 data_format="NCHW", name=None):
-        super().__init__()
-        self.padding = padding
-        self.mode = mode
-        self.value = value
-
-    def forward(self, x):
-        return F.pad(x, self.padding, mode=self.mode, value=self.value)
-
-
 # -- activation layers ------------------------------------------------------
 
 def _act_layer(name, fn, **default_kwargs):

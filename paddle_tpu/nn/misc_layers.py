@@ -1,0 +1,214 @@
+"""Misc layers rounding out the reference surface
+(python/paddle/nn/layer/common.py): Bilinear, AlphaDropout, RReLU, GLU,
+Dropout3D, pad layers, Unflatten.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import registry as _registry
+from . import initializer as I
+from .layers import Layer
+
+_op = _registry.cached_apply
+
+
+class Bilinear(Layer):
+    """out = x1 @ W @ x2 + b per output feature (common.py Bilinear)."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        bound = 1.0 / math.sqrt(in1_features)
+        self.weight = self.create_parameter(
+            [out_features, in1_features, in2_features], attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound))
+        self.bias = self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True,
+            default_initializer=I.Uniform(-bound, bound))
+
+    def forward(self, x1, x2):
+        out = _op("bilinear",
+                  lambda a, b, w: jnp.einsum("bi,oij,bj->bo", a, w, b),
+                  x1, x2, self.weight)
+        return out if self.bias is None else out + self.bias
+
+
+class GLU(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        from .. import ops
+
+        return ops.glu(x, axis=self.axis)
+
+
+class AlphaDropout(Layer):
+    """SELU-consistent dropout (common.py AlphaDropout): keeps
+    self-normalizing mean/variance by dropping to alpha' with an affine
+    correction."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        if not 0 <= p <= 1:
+            raise ValueError("p must be in [0, 1]")
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or self.p == 0:
+            return x
+        from ..ops.random import default_generator
+
+        key = jax.random.key_data(default_generator.next_key())
+
+        def fn(x, key, p):
+            alpha = 1.6732632423543772
+            scale = 1.0507009873554805
+            alpha_p = -alpha * scale
+            k = jax.random.wrap_key_data(key)
+            keep = jax.random.bernoulli(k, 1 - p, x.shape)
+            a = (1 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5)
+            b = -a * alpha_p * p
+            return a * jnp.where(keep, x, alpha_p) + b
+
+        from ..core.tensor import Tensor
+
+        return _op("alpha_dropout", fn, x, Tensor(key),
+                   p=float(self.p))
+
+
+class RReLU(Layer):
+    """Randomized leaky ReLU (activation.py RReLU): train samples the
+    negative slope per element in [lower, upper]; eval uses the mean."""
+
+    def __init__(self, lower=1.0 / 8, upper=1.0 / 3, name=None):
+        super().__init__()
+        self.lower = lower
+        self.upper = upper
+
+    def forward(self, x):
+        if not self.training:
+            def fn(x, slope):
+                return jnp.where(x >= 0, x, slope * x)
+
+            return _op("rrelu_eval", fn, x,
+                       slope=float((self.lower + self.upper) / 2))
+        from ..core.tensor import Tensor
+        from ..ops.random import default_generator
+
+        key = jax.random.key_data(default_generator.next_key())
+
+        def fn(x, key, lo, hi):
+            k = jax.random.wrap_key_data(key)
+            slope = jax.random.uniform(k, x.shape, jnp.float32, lo, hi)
+            return jnp.where(x >= 0, x, slope.astype(x.dtype) * x)
+
+        return _op("rrelu_train", fn, x, Tensor(key),
+                   lo=float(self.lower), hi=float(self.upper))
+
+
+class Dropout3D(Layer):
+    """Channel-wise dropout for [N, C, D, H, W] (common.py Dropout3D)."""
+
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        if not self.training or self.p == 0:
+            return x
+        from ..core.tensor import Tensor
+        from ..ops.random import default_generator
+
+        key = jax.random.key_data(default_generator.next_key())
+
+        def fn(x, key, p, fmt):
+            k = jax.random.wrap_key_data(key)
+            shape = ((x.shape[0], x.shape[1]) + (1,) * (x.ndim - 2)
+                     if fmt == "NCDHW"
+                     else (x.shape[0],) + (1,) * (x.ndim - 2)
+                     + (x.shape[-1],))
+            keep = jax.random.bernoulli(k, 1 - p, shape)
+            return jnp.where(keep, x / (1 - p), 0.0).astype(x.dtype)
+
+        return _op("dropout3d", fn, x, Tensor(key), p=float(self.p),
+                   fmt=str(self.data_format))
+
+
+class _PadND(Layer):
+    SPATIAL = 2
+
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format=None, name=None):
+        super().__init__()
+        n = self.SPATIAL
+        if isinstance(padding, int):
+            padding = [padding] * (2 * n)
+        if len(padding) != 2 * n:
+            raise ValueError(f"padding must have {2 * n} values")
+        self.padding = [int(p) for p in padding]
+        self.mode = mode
+        self.value = value
+        self.data_format = data_format or ("NCL" if n == 1 else
+                                           "NCHW" if n == 2 else "NCDHW")
+
+    def forward(self, x):
+        def fn(x, pad, mode, value, fmt):
+            n = len(pad) // 2
+            # paddle order: (left, right[, top, bottom[, front, back]])
+            # innermost (last) spatial dim first
+            spatial = [(pad[2 * i], pad[2 * i + 1]) for i in range(n)]
+            spatial = spatial[::-1]  # outermost dim first for jnp.pad
+            if fmt.startswith("NC"):
+                pads = [(0, 0), (0, 0)] + spatial
+            else:
+                pads = [(0, 0)] + spatial + [(0, 0)]
+            jmode = {"constant": "constant", "reflect": "reflect",
+                     "replicate": "edge", "circular": "wrap"}[mode]
+            if jmode == "constant":
+                return jnp.pad(x, pads, mode=jmode,
+                               constant_values=value)
+            return jnp.pad(x, pads, mode=jmode)
+
+        return _op(f"pad{self.SPATIAL}d", fn, x,
+                   pad=tuple(self.padding), mode=str(self.mode),
+                   value=float(self.value), fmt=str(self.data_format))
+
+
+class Pad1D(_PadND):
+    SPATIAL = 1
+
+
+class Pad2D(_PadND):
+    SPATIAL = 2
+
+
+class Pad3D(_PadND):
+    SPATIAL = 3
+
+
+class ZeroPad2D(Pad2D):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__(padding, mode="constant", value=0.0,
+                         data_format=data_format)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = list(shape)
+
+    def forward(self, x):
+        from .. import ops
+
+        axis = self.axis % x.ndim
+        new_shape = (list(x.shape[:axis]) + self.shape
+                     + list(x.shape[axis + 1:]))
+        return ops.reshape(x, new_shape)

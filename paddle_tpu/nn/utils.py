@@ -1,0 +1,174 @@
+"""nn.utils (reference: python/paddle/nn/utils/) — weight_norm,
+spectral_norm, gradient clipping helpers, parameter flattening.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .layers import Layer
+
+
+def _norm_except(v_data, dim):
+    axes = tuple(i for i in range(v_data.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v_data * v_data, axis=axes, keepdims=True))
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0):
+    """Reparameterize ``layer.<name>`` as g * v/||v|| (reference
+    nn/utils/weight_norm_hook.py).  g and v become the trainable
+    parameters; the effective weight is recomputed before every
+    forward, so grads flow to g/v through the eager tape."""
+    from .. import ops
+
+    w = getattr(layer, name)
+    dim = dim % w._data.ndim
+    del layer._parameters[name]
+    g0 = np.asarray(_norm_except(w._data, dim))
+    v = layer.create_parameter(list(w.shape))
+    v.set_value(w)
+    g = layer.create_parameter(list(g0.shape))
+    g.set_value(Tensor(jnp.asarray(g0)))
+    setattr(layer, f"{name}_v", v)
+    setattr(layer, f"{name}_g", g)
+    layer._weight_norm_cfg = (name, dim)
+
+    def pre_hook(lyr, inputs):
+        vv = getattr(lyr, f"{name}_v")
+        gg = getattr(lyr, f"{name}_g")
+        axes = tuple(i for i in range(vv._data.ndim) if i != dim)
+        norm = ops.sqrt((vv * vv).sum(axis=list(axes), keepdim=True))
+        lyr.__dict__[name] = gg * vv / norm
+        return None
+
+    handle = layer.register_forward_pre_hook(pre_hook)
+    layer._weight_norm_handle = handle
+    pre_hook(layer, ())  # weight usable before the first forward too
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight"):
+    """Bake the current effective weight back into a plain parameter."""
+    from .. import ops
+
+    if not hasattr(layer, "_weight_norm_handle"):
+        raise ValueError("layer has no weight_norm applied")
+    layer._weight_norm_handle.remove()
+    # recompute from the CURRENT g/v — the cached __dict__ entry is
+    # stale if the optimizer stepped since the last forward
+    _, dim = layer._weight_norm_cfg
+    vv = getattr(layer, f"{name}_v")
+    gg = getattr(layer, f"{name}_g")
+    axes = [i for i in range(vv._data.ndim) if i != dim]
+    norm = ops.sqrt((vv * vv).sum(axis=axes, keepdim=True))
+    w_eff = gg * vv / norm
+    layer.__dict__.pop(name, None)
+    v = getattr(layer, f"{name}_v")
+    del layer._parameters[f"{name}_v"]
+    del layer._parameters[f"{name}_g"]
+    w = layer.create_parameter(list(v.shape))
+    w.set_value(w_eff)
+    setattr(layer, name, w)
+    del layer._weight_norm_handle
+    return layer
+
+
+def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations=1,
+                  eps: float = 1e-12, dim: int = 0):
+    """Spectral normalization (reference nn/utils/spectral_norm_hook.py):
+    weight / sigma_max, sigma estimated by power iteration on
+    non-trainable u/v buffers updated each forward."""
+    w = getattr(layer, name)
+    dim = dim % w._data.ndim
+    mat = jnp.moveaxis(w._data, dim, 0).reshape(w._data.shape[dim], -1)
+    h, wd = mat.shape
+    rng = np.random.RandomState(0)
+    u = jnp.asarray(rng.randn(h), jnp.float32)
+    u = u / (jnp.linalg.norm(u) + eps)
+    vv = jnp.asarray(rng.randn(wd), jnp.float32)
+    vv = vv / (jnp.linalg.norm(vv) + eps)
+    del layer._parameters[name]
+    orig = layer.create_parameter(list(w.shape))
+    orig.set_value(w)
+    setattr(layer, f"{name}_orig", orig)
+    state = {"u": u, "v": vv}
+
+    def pre_hook(lyr, inputs):
+        from .. import ops
+
+        ww = getattr(lyr, f"{name}_orig")
+        m = jnp.moveaxis(ww._data, dim, 0).reshape(ww._data.shape[dim],
+                                                   -1)
+        uu, vvv = state["u"], state["v"]
+        for _ in range(n_power_iterations):
+            vvv = m.T @ uu
+            vvv = vvv / (jnp.linalg.norm(vvv) + eps)
+            uu = m @ vvv
+            uu = uu / (jnp.linalg.norm(uu) + eps)
+        state["u"], state["v"] = uu, vvv
+        # sigma = u^T W v DIFFERENTIATED through W (u, v stop-grad
+        # constants, matching the reference): build it with tape ops.
+        w2d = ops.reshape(
+            ops.moveaxis(ww, dim, 0) if dim != 0 else ww,
+            [ww._data.shape[dim], -1])
+        sigma = (Tensor(uu[None, :]) @ w2d @ Tensor(vvv[:, None]))
+        sigma = ops.reshape(sigma, [])
+        lyr.__dict__[name] = ww / sigma
+        return None
+
+    layer._spectral_norm_handle = layer.register_forward_pre_hook(
+        pre_hook)
+    pre_hook(layer, ())
+    return layer
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global-norm gradient clip (reference
+    nn/utils/clip_grad_norm_.py).  Returns the total norm."""
+    if not isinstance(parameters, (list, tuple)):
+        parameters = [parameters] if isinstance(parameters, Tensor) \
+            else list(parameters)  # generators are valid per reference
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.asarray(
+            [jnp.max(jnp.abs(p.grad._data)) for p in params]))
+    else:
+        total = jnp.sum(jnp.asarray(
+            [jnp.sum(jnp.abs(p.grad._data) ** norm_type)
+             for p in params])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not jnp.isfinite(total):
+        raise RuntimeError("non-finite gradient norm")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        p.grad._data = p.grad._data * scale
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if not isinstance(parameters, (list, tuple)):
+        parameters = [parameters] if isinstance(parameters, Tensor) \
+            else list(parameters)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -clip_value,
+                                    clip_value)
+
+
+def parameters_to_vector(parameters, name=None):
+    datas = [jnp.ravel(p._data) for p in parameters]
+    return Tensor(jnp.concatenate(datas))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    data = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        p.set_value(Tensor(data[offset:offset + n].reshape(
+            tuple(p.shape)).astype(p._data.dtype)))
+        offset += n

@@ -306,3 +306,148 @@ def test_fused_transformer_layers_parity():
     x = _t(_r(2, 6, d, seed=7))
     np.testing.assert_allclose(fused(x).numpy(), ref(x).numpy(),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_misc_layers():
+    paddle.seed(8)
+    bil = nn.Bilinear(3, 4, 5)
+    x1, x2 = _t(_r(2, 3)), _t(_r(2, 4, seed=1))
+    out = bil(x1, x2)
+    want = np.einsum("bi,oij,bj->bo", x1.numpy(), bil.weight.numpy(),
+                     x2.numpy()) + bil.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-5)
+
+    glu = nn.GLU()
+    g = glu(_t(_r(2, 6)))
+    a, b = np.split(_r(2, 6), 2, -1)
+    np.testing.assert_allclose(g.numpy(), a / (1 + np.exp(-b)),
+                               rtol=1e-4, atol=1e-5)
+
+    pad = nn.Pad2D([1, 2, 3, 4])
+    assert tuple(pad(_t(_r(1, 2, 5, 6))).shape) == (1, 2, 12, 9)
+    zp = nn.ZeroPad2D(2)
+    assert tuple(zp(_t(_r(1, 2, 4, 4))).shape) == (1, 2, 8, 8)
+    p1 = nn.Pad1D([1, 2])
+    assert tuple(p1(_t(_r(1, 2, 5))).shape) == (1, 2, 8)
+    p3 = nn.Pad3D(1)
+    assert tuple(p3(_t(_r(1, 2, 3, 3, 3))).shape) == (1, 2, 5, 5, 5)
+
+    unf = nn.Unflatten(1, [2, 3])
+    assert tuple(unf(_t(_r(4, 6))).shape) == (4, 2, 3)
+
+    paddle.seed(9)
+    ad = nn.AlphaDropout(0.4)
+    ad.train()
+    y = ad(_t(_r(200, 10)))
+    # self-normalizing: mean/std stay near the input's
+    assert abs(float(y.numpy().mean())) < 0.2
+    ad.eval()
+    x = _t(_r(3, 4))
+    np.testing.assert_allclose(ad(x).numpy(), x.numpy())
+
+    rr = nn.RReLU()
+    rr.eval()
+    xr = _t(np.array([-2.0, 3.0], "float32"))
+    np.testing.assert_allclose(
+        rr(xr).numpy(), [-2.0 * (1 / 8 + 1 / 3) / 2, 3.0], rtol=1e-5)
+    rr.train()
+    yt = rr(_t(-np.ones((100,), "float32"))).numpy()
+    assert (yt <= -1 / 8 + 1e-6).all() and (yt >= -1 / 3 - 1e-6).all()
+
+    d3 = nn.Dropout3D(0.5)
+    d3.train()
+    y3 = d3(_t(_r(2, 8, 2, 2, 2))).numpy()
+    per_channel = y3.reshape(2, 8, -1)
+    zero_ch = (per_channel == 0).all(-1)
+    assert zero_ch.any()  # whole channels dropped
+
+
+def test_nn_utils_weight_and_spectral_norm():
+    from paddle_tpu.nn.utils import (
+        clip_grad_norm_, clip_grad_value_, parameters_to_vector,
+        remove_weight_norm, spectral_norm, vector_to_parameters,
+        weight_norm,
+    )
+
+    paddle.seed(10)
+    lin = nn.Linear(4, 3)
+    w0 = lin.weight.numpy().copy()
+    x = _t(_r(2, 4))
+    y0 = lin(x).numpy()
+    weight_norm(lin)
+    assert "weight_g" in dict(lin.named_parameters()) or any(
+        "weight_g" in k for k, _ in lin.named_parameters())
+    np.testing.assert_allclose(lin(x).numpy(), y0, rtol=1e-4, atol=1e-5)
+    # grads reach g and v
+    (lin(x) ** 2).mean().backward()
+    assert lin.weight_g.grad is not None
+    assert lin.weight_v.grad is not None
+    remove_weight_norm(lin)
+    np.testing.assert_allclose(lin(x).numpy(), y0, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-4,
+                               atol=1e-5)
+
+    lin2 = nn.Linear(4, 3)
+    spectral_norm(lin2)
+    _ = lin2(x)
+    w = lin2.__dict__["weight"].numpy()
+    assert np.linalg.svd(w, compute_uv=False)[0] < 1.5  # ~unit sigma
+
+    # clipping + flatten helpers
+    m = nn.Linear(3, 2)
+    (m(_t(_r(4, 3))) ** 2).sum().backward()
+    total = clip_grad_norm_(list(m.parameters()), 1e-4)
+    gnorm = np.sqrt(sum((p.grad.numpy() ** 2).sum()
+                        for p in m.parameters()))
+    assert gnorm <= 1.01e-4
+    clip_grad_value_(list(m.parameters()), 1e-6)
+    assert all(np.abs(p.grad.numpy()).max() <= 1e-6 + 1e-12
+               for p in m.parameters())
+    vec = parameters_to_vector(list(m.parameters()))
+    assert tuple(vec.shape) == (3 * 2 + 2,)
+    vector_to_parameters(vec * 0 + 1.0, list(m.parameters()))
+    assert (m.weight.numpy() == 1.0).all()
+
+
+def test_spectral_norm_grad_flows_through_sigma():
+    """sigma = u^T W v is differentiated through W (review: float()
+    detached it) and remove_weight_norm bakes post-step values."""
+    from paddle_tpu.nn.utils import remove_weight_norm, spectral_norm, \
+        weight_norm
+
+    paddle.seed(11)
+    lin = nn.Linear(4, 3)
+    spectral_norm(lin)
+    x = _t(_r(2, 4))
+    (lin(x) ** 2).mean().backward()
+    g = lin.weight_orig.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+    # W/sigma(W) is invariant to scaling W, so the TRUE gradient is
+    # orthogonal to W; with sigma detached (the old bug) the directional
+    # derivative along W would equal the full positive loss term.
+    w0 = lin.weight_orig.numpy()
+    cos = abs((g * w0).sum()) / (np.linalg.norm(g)
+                                 * np.linalg.norm(w0) + 1e-12)
+    assert cos < 1e-4, cos
+
+    # remove_weight_norm uses CURRENT params even without a forward
+    paddle.seed(12)
+    lin2 = nn.Linear(4, 3)
+    weight_norm(lin2)
+    y0 = lin2(x).numpy()  # populates the cache
+    lin2.weight_g.set_value(lin2.weight_g * 2.0)  # "optimizer step"
+    remove_weight_norm(lin2)
+    np.testing.assert_allclose(lin2(x).numpy() - lin2.bias.numpy(),
+                               2.0 * (y0 - lin2.bias.numpy()),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_clip_helpers_accept_generators():
+    from paddle_tpu.nn.utils import clip_grad_norm_, clip_grad_value_
+
+    m = nn.Linear(3, 2)
+    (m(_t(_r(4, 3))) ** 2).sum().backward()
+    clip_grad_norm_((p for p in m.parameters()), 1.0)
+    clip_grad_value_((p for p in m.parameters()), 0.5)
+    assert all(np.abs(p.grad.numpy()).max() <= 0.5 + 1e-9
+               for p in m.parameters())
