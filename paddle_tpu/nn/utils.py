@@ -33,7 +33,6 @@ def weight_norm(layer: Layer, name: str = "weight", dim: int = 0):
     g.set_value(Tensor(jnp.asarray(g0)))
     setattr(layer, f"{name}_v", v)
     setattr(layer, f"{name}_g", g)
-    layer._weight_norm_cfg = (name, dim)
 
     def pre_hook(lyr, inputs):
         vv = getattr(lyr, f"{name}_v")
@@ -43,8 +42,13 @@ def weight_norm(layer: Layer, name: str = "weight", dim: int = 0):
         lyr.__dict__[name] = gg * vv / norm
         return None
 
-    handle = layer.register_forward_pre_hook(pre_hook)
-    layer._weight_norm_handle = handle
+    # per-name bookkeeping: a layer can weight-norm several params
+    if not hasattr(layer, "_weight_norm_handles"):
+        layer._weight_norm_handles = {}
+        layer._weight_norm_cfgs = {}
+    layer._weight_norm_handles[name] = \
+        layer.register_forward_pre_hook(pre_hook)
+    layer._weight_norm_cfgs[name] = dim
     pre_hook(layer, ())  # weight usable before the first forward too
     return layer
 
@@ -53,12 +57,12 @@ def remove_weight_norm(layer: Layer, name: str = "weight"):
     """Bake the current effective weight back into a plain parameter."""
     from .. import ops
 
-    if not hasattr(layer, "_weight_norm_handle"):
-        raise ValueError("layer has no weight_norm applied")
-    layer._weight_norm_handle.remove()
+    if name not in getattr(layer, "_weight_norm_handles", {}):
+        raise ValueError(f"layer has no weight_norm applied to {name!r}")
+    layer._weight_norm_handles.pop(name).remove()
     # recompute from the CURRENT g/v — the cached __dict__ entry is
     # stale if the optimizer stepped since the last forward
-    _, dim = layer._weight_norm_cfg
+    dim = layer._weight_norm_cfgs.pop(name)
     vv = getattr(layer, f"{name}_v")
     gg = getattr(layer, f"{name}_g")
     axes = [i for i in range(vv._data.ndim) if i != dim]
@@ -71,7 +75,6 @@ def remove_weight_norm(layer: Layer, name: str = "weight"):
     w = layer.create_parameter(list(v.shape))
     w.set_value(w_eff)
     setattr(layer, name, w)
-    del layer._weight_norm_handle
     return layer
 
 
@@ -102,12 +105,13 @@ def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations=1,
         m = jnp.moveaxis(ww._data, dim, 0).reshape(ww._data.shape[dim],
                                                    -1)
         uu, vvv = state["u"], state["v"]
-        for _ in range(n_power_iterations):
-            vvv = m.T @ uu
-            vvv = vvv / (jnp.linalg.norm(vvv) + eps)
-            uu = m @ vvv
-            uu = uu / (jnp.linalg.norm(uu) + eps)
-        state["u"], state["v"] = uu, vvv
+        if lyr.training:  # reference: power-iterate only in training
+            for _ in range(n_power_iterations):
+                vvv = m.T @ uu
+                vvv = vvv / (jnp.linalg.norm(vvv) + eps)
+                uu = m @ vvv
+                uu = uu / (jnp.linalg.norm(uu) + eps)
+            state["u"], state["v"] = uu, vvv
         # sigma = u^T W v DIFFERENTIATED through W (u, v stop-grad
         # constants, matching the reference): build it with tape ops.
         w2d = ops.reshape(

@@ -302,3 +302,143 @@ def box_coder(prior_box, prior_box_var, target_box,
                    norm=norm, axis=int(axis))
 
     raise ValueError(f"unknown code_type {code_type!r}")
+
+
+def _bilinear_sample(x, fy, fx):
+    """x [B, C, H, W]; fy/fx [B, ...] float coords -> [B, C, ...]
+    bilinear samples, zeros outside."""
+    import jax
+
+    B, C, H, W = x.shape
+
+    def gather(iy, ix):
+        inb = ((iy >= 0) & (iy < H) & (ix >= 0) & (ix < W))
+        iyc = jnp.clip(iy, 0, H - 1)
+        ixc = jnp.clip(ix, 0, W - 1)
+        vals = jax.vmap(lambda img, jy, jx: img[:, jy, jx])(x, iyc, ixc)
+        return vals * inb[:, None].astype(x.dtype)
+
+    y0 = jnp.floor(fy).astype(jnp.int32)
+    x0 = jnp.floor(fx).astype(jnp.int32)
+    wy = (fy - y0)[:, None].astype(x.dtype)
+    wx = (fx - x0)[:, None].astype(x.dtype)
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    top = v00 * (1 - wx) + v01 * wx
+    bot = v10 * (1 - wx) + v11 * wx
+    return top * (1 - wy) + bot * wy
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference vision/ops.py
+    deform_conv2d; phi deformable_conv kernel).
+
+    x [B, Cin, H, W]; offset [B, 2*dg*Kh*Kw, Ho, Wo] as (dy, dx) pairs
+    per tap; mask [B, dg*Kh*Kw, Ho, Wo] (v2 modulation) or None (v1).
+
+    TPU-native: each kernel tap is a bilinear gather at its offset
+    position; the taps stack into [B, Cin*Kh*Kw, Ho, Wo] and ONE einsum
+    against the weight does the contraction on the MXU.
+    """
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) \
+        else dilation
+
+    def fn(x, offset, weight, mask, sh, sw, ph, pw, dh, dw, dg, groups,
+           has_mask):
+        B, Cin, H, W = x.shape
+        Cout, Cin_g, Kh, Kw = weight.shape
+        Ho = (H + 2 * ph - (dh * (Kh - 1) + 1)) // sh + 1
+        Wo = (W + 2 * pw - (dw * (Kw - 1) + 1)) // sw + 1
+        K = Kh * Kw
+        off = offset.reshape(B, dg, K, 2, Ho, Wo)
+        base_y = (jnp.arange(Ho) * sh - ph)[None, :, None]
+        base_x = (jnp.arange(Wo) * sw - pw)[None, None, :]
+        ky = (jnp.arange(Kh) * dh)[:, None].repeat(Kw, 1).reshape(K)
+        kx = (jnp.arange(Kw) * dw)[None, :].repeat(Kh, 0).reshape(K)
+        cg = Cin // dg
+        samples = []
+        for g in range(dg):
+            fy = (base_y + ky[:, None, None]
+                  + off[:, g, :, 0])                   # [B, K, Ho, Wo]
+            fx = base_x + kx[:, None, None] + off[:, g, :, 1]
+            xs = x[:, g * cg:(g + 1) * cg]
+            s = _bilinear_sample(
+                xs, fy.reshape(B, -1), fx.reshape(B, -1)).reshape(
+                B, cg, K, Ho, Wo)
+            if has_mask:
+                s = s * mask.reshape(B, dg, K, Ho, Wo)[:, g][:, None]
+            samples.append(s)
+        sampled = jnp.concatenate(samples, axis=1)  # [B, Cin, K, Ho, Wo]
+        # grouped contraction: [B, Cin, K, Ho, Wo] x [Cout, Cin/g, K]
+        w2 = weight.reshape(Cout, Cin_g, K)
+        if groups == 1:
+            out = jnp.einsum("bckhw,ock->bohw", sampled, w2)
+        else:
+            co_g = Cout // groups
+            outs = []
+            for g in range(groups):
+                outs.append(jnp.einsum(
+                    "bckhw,ock->bohw",
+                    sampled[:, g * Cin_g:(g + 1) * Cin_g],
+                    w2[g * co_g:(g + 1) * co_g]))
+            out = jnp.concatenate(outs, axis=1)
+        return out
+
+    out = _op("deform_conv2d", fn, _t_in(x), _t_in(offset), _t_in(weight),
+              _t_in(mask) if mask is not None else _t_in(
+                  jnp.zeros((1,), jnp.float32)),
+              sh=int(sh), sw=int(sw), ph=int(ph), pw=int(pw),
+              dh=int(dh), dw=int(dw), dg=int(deformable_groups),
+              groups=int(groups), has_mask=mask is not None)
+    if bias is not None:
+        from ..ops import reshape as _rs
+
+        out = out + _rs(_t_in(bias), [1, -1, 1, 1])
+    return out
+
+
+def _t_in(v):
+    from ..core.tensor import Tensor
+
+    return v if isinstance(v, Tensor) else Tensor(jnp.asarray(v))
+
+
+from ..nn import initializer as _I  # noqa: E402
+from ..nn.layers import Layer as _Layer  # noqa: E402
+
+
+class DeformConv2D(_Layer):
+    """Layer form (reference vision/ops.py DeformConv2D): the caller
+    supplies offset (and mask for v2) at forward time."""
+
+    def __init__(self, in_channels, out_channels, kernel_size,
+                 stride=1, padding=0, dilation=1,
+                 deformable_groups=1, groups=1, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        kh, kw = (kernel_size, kernel_size) \
+            if isinstance(kernel_size, int) else kernel_size
+        self._cfg = (stride, padding, dilation, deformable_groups,
+                     groups)
+        bound = 1.0 / math.sqrt(in_channels * kh * kw)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, kh, kw],
+            attr=weight_attr,
+            default_initializer=_I.Uniform(-bound, bound))
+        self.bias = self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True,
+            default_initializer=_I.Uniform(-bound, bound))
+
+    def forward(self, x, offset, mask=None):
+        stride, padding, dilation, dg, groups = self._cfg
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             stride=stride, padding=padding,
+                             dilation=dilation,
+                             deformable_groups=dg, groups=groups,
+                             mask=mask)

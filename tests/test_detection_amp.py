@@ -96,3 +96,73 @@ def test_detection_eval_nms_pipeline():
     assert k.ndim == 1 and len(k) >= 1
     # kept indices are sorted by descending score
     assert (np.diff(scores[k]) <= 1e-6).all()
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    """Zero offsets + unit mask reduce deformable conv to plain conv —
+    the strongest oracle (reference deformable_conv kernel)."""
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.vision.ops import deform_conv2d
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 4, 9, 9).astype("float32"))
+    w = paddle.to_tensor(rng.randn(6, 4, 3, 3).astype("float32"))
+    off = paddle.to_tensor(np.zeros((2, 2 * 9, 7, 7), "float32"))
+    got = deform_conv2d(x, off, w, stride=1, padding=0)
+    want = F.conv2d(x, w, stride=1, padding=0)
+    np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=2e-4,
+                               atol=2e-4)
+    # with stride/padding/dilation
+    off2 = paddle.to_tensor(np.zeros((2, 18, 5, 5), "float32"))
+    got2 = deform_conv2d(x, off2, w, stride=2, padding=1, dilation=1)
+    want2 = F.conv2d(x, w, stride=2, padding=1)
+    np.testing.assert_allclose(got2.numpy(), want2.numpy(), rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_deform_conv2d_integer_offset_shifts():
+    """A constant integer offset samples the shifted input exactly."""
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.vision.ops import deform_conv2d
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 8, 8).astype("float32")
+    w = rng.randn(3, 2, 3, 3).astype("float32")
+    off = np.zeros((1, 18, 6, 6), "float32")
+    off[:, 1::2] = 1.0  # dx = +1 for every tap
+    got = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                        paddle.to_tensor(w))
+    # equivalent: conv over x shifted left by 1 (sampling col+1),
+    # restricted to windows whose samples stay in-bounds
+    want = F.conv2d(paddle.to_tensor(x[:, :, :, 1:]),
+                    paddle.to_tensor(w))
+    np.testing.assert_allclose(got.numpy()[:, :, :, :5],
+                               want.numpy()[:, :, :, :5],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_deform_conv2d_mask_and_layer():
+    from paddle_tpu.vision.ops import DeformConv2D, deform_conv2d
+
+    rng = np.random.RandomState(2)
+    x = paddle.to_tensor(rng.randn(1, 4, 6, 6).astype("float32"))
+    w = paddle.to_tensor(rng.randn(5, 4, 3, 3).astype("float32"))
+    off = paddle.to_tensor(np.zeros((1, 18, 4, 4), "float32"))
+    half = paddle.to_tensor(np.full((1, 9, 4, 4), 0.5, "float32"))
+    full = deform_conv2d(x, off, w)
+    halved = deform_conv2d(x, off, w, mask=half)
+    np.testing.assert_allclose(halved.numpy(), 0.5 * full.numpy(),
+                               rtol=2e-4, atol=2e-4)
+
+    paddle.seed(3)
+    layer = DeformConv2D(4, 5, 3)
+    out = layer(x, off)
+    assert tuple(out.shape) == (1, 5, 4, 4)
+    (out ** 2).mean().backward()
+    assert layer.weight.grad is not None
+    # offsets are differentiable too
+    off2 = paddle.to_tensor(np.zeros((1, 18, 4, 4), "float32") + 0.3)
+    off2.stop_gradient = False
+    (deform_conv2d(x, off2, w) ** 2).mean().backward()
+    assert off2.grad is not None
+    assert np.abs(off2.grad.numpy()).sum() > 0

@@ -451,3 +451,42 @@ def test_clip_helpers_accept_generators():
     clip_grad_value_((p for p in m.parameters()), 0.5)
     assert all(np.abs(p.grad.numpy()).max() <= 0.5 + 1e-9
                for p in m.parameters())
+
+
+def test_weight_norm_two_params_one_layer():
+    """weight_norm on two parameters of one layer: independent removal
+    (review: single-handle state clobbered the first application)."""
+    from paddle_tpu.nn.utils import remove_weight_norm, weight_norm
+
+    paddle.seed(13)
+    cell = nn.GRUCell(3, 4)
+    x = _t(_r(2, 3))
+    y0, _ = cell(x)
+    weight_norm(cell, "weight_ih")
+    weight_norm(cell, "weight_hh")
+    y1, _ = cell(x)
+    np.testing.assert_allclose(y1.numpy(), y0.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    remove_weight_norm(cell, "weight_ih")
+    y2, _ = cell(x)  # hh hook still live, ih baked back
+    np.testing.assert_allclose(y2.numpy(), y0.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    remove_weight_norm(cell, "weight_hh")
+    y3, _ = cell(x)
+    np.testing.assert_allclose(y3.numpy(), y0.numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_spectral_norm_eval_deterministic():
+    """Power iteration is frozen in eval mode (review: u/v drifted per
+    eval forward)."""
+    from paddle_tpu.nn.utils import spectral_norm
+
+    paddle.seed(14)
+    lin = nn.Linear(4, 3)
+    spectral_norm(lin)
+    lin.eval()
+    x = _t(_r(2, 4))
+    y1 = lin(x).numpy()
+    y2 = lin(x).numpy()
+    np.testing.assert_array_equal(y1, y2)
