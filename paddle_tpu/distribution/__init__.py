@@ -481,7 +481,22 @@ def register_kl(p_cls, q_cls):
 
 
 def kl_divergence(p, q):
+    # Most-specific-superclass dispatch (reference kl.py dispatch): an
+    # exact match wins; otherwise the closest registered (P, Q) pair in
+    # MRO order — so Chi2 resolves to the (Gamma, Gamma) rule.
     fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        best = None
+        for pc in type(p).__mro__:
+            for qc in type(q).__mro__:
+                cand = _KL_REGISTRY.get((pc, qc))
+                if cand is not None:
+                    rank = (type(p).__mro__.index(pc),
+                            type(q).__mro__.index(qc))
+                    if best is None or rank < best[0]:
+                        best = (rank, cand)
+        if best is not None:
+            fn = best[1]
     if fn is None:
         raise NotImplementedError(
             f"kl_divergence({type(p).__name__}, {type(q).__name__})")
@@ -550,3 +565,97 @@ def _kl_beta(p, q):
                 + (a2 - a1 + b2 - b1) * dg(a1 + b1))
 
     return _op("kl_beta_beta", fn, p.alpha, p.beta, q.alpha, q.beta)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    def fn(a1, b1, a2, b2):
+        g = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        return ((a1 - a2) * dg(a1) - g(a1) + g(a2)
+                + a2 * (jnp.log(b1) - jnp.log(b2))
+                + a1 * (b2 / b1 - 1.0))
+
+    return _op("kl_gamma_gamma", fn, p.concentration, p.rate,
+               q.concentration, q.rate)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    def fn(l1, s1, l2, s2):
+        d = jnp.abs(l1 - l2)
+        return (jnp.log(s2) - jnp.log(s1)
+                + (s1 * jnp.exp(-d / s1) + d) / s2 - 1.0)
+
+    return _op("kl_laplace_laplace", fn, p.loc, p.scale, q.loc, q.scale)
+
+
+# -- long tail: transforms + wrappers + extra distributions ------------------
+# (imported last: they subclass Distribution/Gamma defined above)
+from .transform import (  # noqa: E402,F401
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform,
+    SigmoidTransform, SoftmaxTransform, StackTransform,
+    StickBreakingTransform, TanhTransform, Transform,
+)
+from .transformed_distribution import (  # noqa: E402,F401
+    Independent, TransformedDistribution,
+)
+from .more import (  # noqa: E402,F401
+    Binomial, Cauchy, Chi2, ContinuousBernoulli, ExponentialFamily,
+    Geometric, Multinomial, MultivariateNormal, Poisson, StudentT,
+)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    def fn(l1, lt1, l2, lt2):
+        d = l1.shape[-1]
+        # broadcast every operand to the common batch shape first —
+        # solve_triangular requires matching batch ranks.
+        batch = jnp.broadcast_shapes(l1.shape[:-1], l2.shape[:-1],
+                                     lt1.shape[:-2], lt2.shape[:-2])
+        l1 = jnp.broadcast_to(l1, batch + (d,))
+        l2 = jnp.broadcast_to(l2, batch + (d,))
+        lt1 = jnp.broadcast_to(lt1, batch + (d, d))
+        lt2 = jnp.broadcast_to(lt2, batch + (d, d))
+        diff = l2 - l1
+        sol_mean = jax.scipy.linalg.solve_triangular(
+            lt2, diff[..., None], lower=True)[..., 0]
+        sol_cov = jax.scipy.linalg.solve_triangular(
+            lt2, lt1, lower=True)
+        tr = jnp.sum(sol_cov * sol_cov, axis=(-2, -1))
+        logdet1 = jnp.sum(jnp.log(jnp.diagonal(lt1, axis1=-2,
+                                               axis2=-1)), -1)
+        logdet2 = jnp.sum(jnp.log(jnp.diagonal(lt2, axis1=-2,
+                                               axis2=-1)), -1)
+        return 0.5 * (tr + jnp.sum(sol_mean * sol_mean, -1) - d) \
+            + logdet2 - logdet1
+
+    return _op("kl_mvn_mvn", fn, p.loc, p.scale_tril, q.loc,
+               q.scale_tril)
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_reg(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Binomial, Binomial)
+def _kl_binomial_reg(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_reg(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy_reg(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(ContinuousBernoulli, ContinuousBernoulli)
+def _kl_cb_reg(p, q):
+    return p.kl_divergence(q)

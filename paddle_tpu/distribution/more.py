@@ -1,0 +1,665 @@
+"""Distribution long tail: StudentT, MultivariateNormal, Poisson,
+Binomial, Multinomial, Geometric, Cauchy, Chi2, ContinuousBernoulli,
+ExponentialFamily.
+
+Reference: ``python/paddle/distribution/{student_t,multivariate_normal,
+poisson,binomial,multinomial,geometric,cauchy,chi2,
+continuous_bernoulli,exponential_family}.py``.  Densities are
+closed-form jnp expressions through the op registry (differentiable on
+the eager tape); sampling draws from the global Generator key stream.
+Discrete entropies enumerate bounded support exactly like the
+reference (poisson.py:146, binomial.py:157).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops import registry as _registry
+from ..ops.random import default_generator
+
+_op = _registry.cached_apply
+_gammaln = jax.scipy.special.gammaln
+_digamma = jax.scipy.special.digamma
+
+
+def _host(x):
+    return np.asarray(x._data if isinstance(x, Tensor) else x)
+
+
+from . import Distribution, Gamma, _raw, _shape, _t  # noqa: E402
+
+
+class StudentT(Distribution):
+    """Student's t (reference student_t.py)."""
+
+    def __init__(self, df, loc, scale, name=None):
+        self.df = _t(df)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(
+            tuple(self.df.shape), tuple(self.loc.shape),
+            tuple(self.scale.shape)))
+
+    @property
+    def mean(self):
+        def fn(df, loc, shape):
+            return jnp.broadcast_to(
+                jnp.where(df > 1, loc, jnp.nan), shape)
+
+        return _op("student_t_mean", fn, self.df, self.loc,
+                   shape=self.batch_shape)
+
+    @property
+    def variance(self):
+        def fn(df, sc, shape):
+            var = jnp.where(
+                df > 2, sc * sc * df / (df - 2),
+                jnp.where(df > 1, jnp.inf, jnp.nan))
+            return jnp.broadcast_to(var, shape)
+
+        return _op("student_t_variance", fn, self.df, self.scale,
+                   shape=self.batch_shape)
+
+    def sample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        e = jax.random.t(default_generator.next_key(), _raw(self.df),
+                         s, jnp.float32)
+        return Tensor(e * _raw(self.scale) + _raw(self.loc))
+
+    def log_prob(self, value):
+        def fn(df, loc, sc, v):
+            z = (v - loc) / sc
+            return (_gammaln((df + 1) / 2) - _gammaln(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(sc)
+                    - (df + 1) / 2 * jnp.log1p(z * z / df))
+
+        return _op("student_t_log_prob", fn, self.df, self.loc,
+                   self.scale, _t(value))
+
+    def entropy(self):
+        def fn(df, sc, shape):
+            h = (jnp.log(sc) + (df + 1) / 2
+                 * (_digamma((df + 1) / 2) - _digamma(df / 2))
+                 + 0.5 * jnp.log(df) + _gammaln(df / 2)
+                 + _gammaln(0.5) - _gammaln((df + 1) / 2))
+            return jnp.broadcast_to(h, shape)
+
+        return _op("student_t_entropy", fn, self.df, self.scale,
+                   shape=self.batch_shape)
+
+
+class MultivariateNormal(Distribution):
+    """Multivariate normal over the last axis (reference
+    multivariate_normal.py) parameterized by exactly one of
+    covariance_matrix / precision_matrix / scale_tril."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        given = [m is not None for m in
+                 (covariance_matrix, precision_matrix, scale_tril)]
+        if sum(given) != 1:
+            raise ValueError(
+                "exactly one of covariance_matrix, precision_matrix, "
+                "scale_tril must be specified")
+        self.loc = _t(loc)
+        if scale_tril is not None:
+            self.scale_tril = _t(scale_tril)
+        elif covariance_matrix is not None:
+            self.covariance_matrix = _t(covariance_matrix)
+            self.scale_tril = _op(
+                "mvn_chol", lambda c: jnp.linalg.cholesky(c),
+                self.covariance_matrix)
+        else:
+            self.precision_matrix = _t(precision_matrix)
+
+            def fn(p):
+                # cov = P^-1; stable via cholesky of the flipped matrix
+                # (torch/paddle trick): chol(P^-1) from chol(P).
+                lp = jnp.linalg.cholesky(p)
+                eye = jnp.broadcast_to(
+                    jnp.eye(p.shape[-1], dtype=p.dtype), p.shape)
+                linv = jax.scipy.linalg.solve_triangular(
+                    lp, eye, lower=True)
+                return jnp.linalg.cholesky(
+                    jnp.swapaxes(linv, -1, -2) @ linv)
+
+            self.scale_tril = _op("mvn_prec_chol", fn,
+                                  self.precision_matrix)
+        event = tuple(self.loc.shape)[-1:]
+        batch = jnp.broadcast_shapes(tuple(self.loc.shape)[:-1],
+                                     tuple(self.scale_tril.shape)[:-2])
+        super().__init__(batch, event)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return _op("mvn_variance",
+                   lambda lt: jnp.sum(lt * lt, axis=-1),
+                   self.scale_tril)
+
+    def rsample(self, shape=()):
+        s = (_shape(shape) + self.batch_shape + self.event_shape)
+        eps = jax.random.normal(default_generator.next_key(), s,
+                                jnp.float32)
+
+        def fn(loc, lt, e):
+            return loc + jnp.einsum("...ij,...j->...i", lt, e)
+
+        return _op("mvn_rsample", fn, self.loc, self.scale_tril,
+                   Tensor(eps))
+
+    def log_prob(self, value):
+        def fn(loc, lt, v):
+            diff = v - loc
+            sol = jax.scipy.linalg.solve_triangular(
+                jnp.broadcast_to(
+                    lt, diff.shape[:-1] + lt.shape[-2:]),
+                diff[..., None], lower=True)[..., 0]
+            m = jnp.sum(sol * sol, -1)
+            half_logdet = jnp.sum(jnp.log(
+                jnp.diagonal(lt, axis1=-2, axis2=-1)), -1)
+            d = v.shape[-1]
+            return (-0.5 * (m + d * math.log(2 * math.pi))
+                    - half_logdet)
+
+        return _op("mvn_log_prob", fn, self.loc, self.scale_tril,
+                   _t(value))
+
+    def entropy(self):
+        def fn(lt, shape):
+            d = lt.shape[-1]
+            half_logdet = jnp.sum(jnp.log(
+                jnp.diagonal(lt, axis1=-2, axis2=-1)), -1)
+            h = 0.5 * d * (1 + math.log(2 * math.pi)) + half_logdet
+            return jnp.broadcast_to(h, shape)
+
+        return _op("mvn_entropy", fn, self.scale_tril,
+                   shape=self.batch_shape)
+
+    def kl_divergence(self, other):
+        from . import kl_divergence as _kl
+
+        return _kl(self, other)
+
+
+class Poisson(Distribution):
+    """Poisson(rate) (reference poisson.py)."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        out = jax.random.poisson(default_generator.next_key(),
+                                 _raw(self.rate), s)
+        return Tensor(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        def fn(r, v):
+            return v * jnp.log(r) - r - _gammaln(v + 1)
+
+        return _op("poisson_log_prob", fn, self.rate, _t(value))
+
+    def _support_upper(self):
+        # reference poisson.py _enumerate_bounded_support: rate + 30
+        # stddevs covers the mass to fp32 precision.
+        r = float(np.max(_host(self.rate)))
+        return max(int(r + 30 * math.sqrt(max(r, 1.0))), 30)
+
+    def entropy(self):
+        upper = self._support_upper()
+
+        def fn(r, upper):
+            v = jnp.arange(upper, dtype=r.dtype).reshape(
+                (-1,) + (1,) * r.ndim)
+            lp = v * jnp.log(r) - r - _gammaln(v + 1)
+            ent = -jnp.sum(jnp.exp(lp) * lp, 0)
+            return jnp.where(r != 0, ent, 0.0)
+
+        return _op("poisson_entropy", fn, self.rate, upper=upper)
+
+    def kl_divergence(self, other):
+        def fn(r1, r2):
+            return r1 * (jnp.log(r1) - jnp.log(r2)) - r1 + r2
+
+        return _op("kl_poisson_poisson", fn, self.rate, other.rate)
+
+
+class Binomial(Distribution):
+    """Binomial(total_count, probs) (reference binomial.py)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _t(total_count)
+        self.probs = _t(probs)
+        super().__init__(jnp.broadcast_shapes(
+            tuple(self.total_count.shape), tuple(self.probs.shape)))
+
+    @property
+    def mean(self):
+        return _op("binomial_mean", lambda n, p: n * p,
+                   self.total_count, self.probs)
+
+    @property
+    def variance(self):
+        return _op("binomial_variance", lambda n, p: n * p * (1 - p),
+                   self.total_count, self.probs)
+
+    def sample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        n = _raw(self.total_count)
+        p = _raw(self.probs)
+        out = jax.random.binomial(default_generator.next_key(), n, p, s)
+        return Tensor(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        def fn(n, p, v):
+            eps = 1e-7
+            pc = jnp.clip(p, eps, 1 - eps)
+            return (_gammaln(n + 1) - _gammaln(v + 1)
+                    - _gammaln(n - v + 1) + v * jnp.log(pc)
+                    + (n - v) * jnp.log1p(-pc))
+
+        return _op("binomial_log_prob", fn, self.total_count,
+                   self.probs, _t(value))
+
+    def entropy(self):
+        upper = int(np.max(_host(self.total_count))) + 1
+
+        def fn(n, p, upper):
+            eps = 1e-7
+            pc = jnp.clip(p, eps, 1 - eps)
+            v = jnp.arange(upper, dtype=p.dtype).reshape(
+                (-1,) + (1,) * jnp.broadcast_shapes(
+                    jnp.shape(n), jnp.shape(p)).__len__())
+            lp = (_gammaln(n + 1) - _gammaln(v + 1)
+                  - _gammaln(n - v + 1) + v * jnp.log(pc)
+                  + (n - v) * jnp.log1p(-pc))
+            lp = jnp.where(v <= n, lp, -jnp.inf)
+            pmf = jnp.exp(lp)
+            return -jnp.sum(pmf * jnp.where(jnp.isfinite(lp), lp, 0.0),
+                            0)
+
+        return _op("binomial_entropy", fn, self.total_count, self.probs,
+                   upper=upper)
+
+    def kl_divergence(self, other):
+        def fn(n, p1, p2):
+            eps = 1e-7
+            a = jnp.clip(p1, eps, 1 - eps)
+            b = jnp.clip(p2, eps, 1 - eps)
+            return n * (a * (jnp.log(a) - jnp.log(b))
+                        + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+
+        return _op("kl_binomial_binomial", fn, self.total_count,
+                   self.probs, other.probs)
+
+
+class Multinomial(Distribution):
+    """Multinomial(total_count, probs) over the last axis (reference
+    multinomial.py)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        shp = tuple(self.probs.shape)
+        super().__init__(shp[:-1], shp[-1:])
+
+    @property
+    def mean(self):
+        n = self.total_count
+
+        return _op("multinomial_mean",
+                   lambda p, n: n * (p / jnp.sum(p, -1, keepdims=True)),
+                   self.probs, n=n)
+
+    @property
+    def variance(self):
+        n = self.total_count
+
+        def fn(p, n):
+            pn = p / jnp.sum(p, -1, keepdims=True)
+            return n * pn * (1 - pn)
+
+        return _op("multinomial_variance", fn, self.probs, n=n)
+
+    def sample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        p = _raw(self.probs)
+        p = p / jnp.sum(p, -1, keepdims=True)
+        k = p.shape[-1]
+        draws = jax.random.categorical(
+            default_generator.next_key(), jnp.log(p),
+            shape=(self.total_count,) + s)
+        counts = jax.nn.one_hot(draws, k, dtype=jnp.float32).sum(0)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        n = self.total_count
+
+        def fn(p, v, n):
+            pn = p / jnp.sum(p, -1, keepdims=True)
+            logits = jnp.log(jnp.clip(pn, 1e-12))
+            return (_gammaln(jnp.asarray(n + 1.0))
+                    - jnp.sum(_gammaln(v + 1), -1)
+                    + jnp.sum(v * logits, -1))
+
+        return _op("multinomial_log_prob", fn, self.probs, _t(value),
+                   n=n)
+
+    def entropy(self):
+        n = self.total_count
+
+        def fn(p, n):
+            pn = p / jnp.sum(p, -1, keepdims=True)
+            logits = jnp.log(jnp.clip(pn, 1e-12))
+            cat_ent = -jnp.sum(pn * logits, -1)
+            # reference multinomial.py:173 — n*H(cat) - lgamma(n+1)
+            # + sum_k E[lgamma(x_k + 1)] via binomial marginals.
+            support = jnp.arange(1, n + 1, dtype=p.dtype).reshape(
+                (-1,) + (1,) * pn.ndim)
+            nn = jnp.asarray(float(n), p.dtype)
+            lp = (_gammaln(nn + 1) - _gammaln(support + 1)
+                  - _gammaln(nn - support + 1)
+                  + support * logits
+                  + (nn - support) * jnp.log1p(-jnp.clip(pn, 0, 1 - 1e-7)))
+            binom_pmf = jnp.exp(lp)
+            return (nn * cat_ent - _gammaln(nn + 1)
+                    + jnp.sum(binom_pmf * _gammaln(support + 1),
+                              axis=(0, -1)))
+
+        return _op("multinomial_entropy", fn, self.probs, n=n)
+
+
+class Geometric(Distribution):
+    """Geometric(probs): pmf (1-p)^k p on k = 0, 1, ... (reference
+    geometric.py)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return _op("geometric_mean", lambda p: 1.0 / p - 1.0,
+                   self.probs)
+
+    @property
+    def variance(self):
+        return _op("geometric_variance",
+                   lambda p: (1.0 / p - 1.0) / p, self.probs)
+
+    @property
+    def stddev(self):
+        from .. import ops
+
+        return ops.sqrt(self.variance)
+
+    def pmf(self, k):
+        from .. import ops
+
+        return ops.exp(self.log_pmf(k))
+
+    def log_pmf(self, k):
+        def fn(p, k):
+            return k * jnp.log1p(-p) + jnp.log(p)
+
+        return _op("geometric_log_pmf", fn, self.probs, _t(k))
+
+    log_prob = log_pmf
+
+    def rsample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(default_generator.next_key(), s,
+                               jnp.float32, 1e-7, 1.0)
+        return _op("geometric_rsample",
+                   lambda p, u: jnp.floor(jnp.log(u) / jnp.log1p(-p)),
+                   self.probs, Tensor(u))
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def entropy(self):
+        def fn(p):
+            return -((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p
+
+        return _op("geometric_entropy", fn, self.probs)
+
+    def cdf(self, k):
+        def fn(p, k):
+            return 1 - jnp.power(1 - p, k + 1)
+
+        return _op("geometric_cdf", fn, self.probs, _t(k))
+
+    def kl_divergence(self, other):
+        def fn(p1, p2):
+            return (jnp.log(p1) - jnp.log(p2)
+                    + (1 - p1) / p1
+                    * (jnp.log1p(-p1) - jnp.log1p(-p2)))
+
+        return _op("kl_geometric_geometric", fn, self.probs,
+                   other.probs)
+
+
+class Cauchy(Distribution):
+    """Cauchy(loc, scale) (reference cauchy.py).  mean/variance are
+    undefined and raise, matching the reference."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(tuple(self.loc.shape),
+                                              tuple(self.scale.shape)))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean.")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance.")
+
+    @property
+    def stddev(self):
+        raise ValueError("Cauchy distribution has no stddev.")
+
+    def rsample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(default_generator.next_key(), s,
+                               jnp.float32, 1e-7, 1.0 - 1e-7)
+        return _op("cauchy_rsample",
+                   lambda l, sc, u: l + sc * jnp.tan(
+                       math.pi * (u - 0.5)),
+                   self.loc, self.scale, Tensor(u))
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        def fn(l, sc, v):
+            z = (v - l) / sc
+            return (-math.log(math.pi) - jnp.log(sc)
+                    - jnp.log1p(z * z))
+
+        return _op("cauchy_log_prob", fn, self.loc, self.scale,
+                   _t(value))
+
+    def cdf(self, value):
+        def fn(l, sc, v):
+            return jnp.arctan((v - l) / sc) / math.pi + 0.5
+
+        return _op("cauchy_cdf", fn, self.loc, self.scale, _t(value))
+
+    def entropy(self):
+        def fn(sc, shape):
+            return jnp.broadcast_to(
+                jnp.log(4 * math.pi * sc), shape)
+
+        return _op("cauchy_entropy", fn, self.scale,
+                   shape=self.batch_shape)
+
+    def kl_divergence(self, other):
+        # closed form (Chyzak & Nielsen 2019), as the reference cites.
+        def fn(l1, s1, l2, s2):
+            t1 = jnp.square(s1 + s2) + jnp.square(l1 - l2)
+            return jnp.log(t1 / (4 * s1 * s2))
+
+        return _op("kl_cauchy_cauchy", fn, self.loc, self.scale,
+                   other.loc, other.scale)
+
+
+class Chi2(Gamma):
+    """Chi-squared = Gamma(df/2, rate=1/2) (reference chi2.py)."""
+
+    def __init__(self, df, name=None):
+        df_t = _t(df)
+        from .. import ops
+
+        half = Tensor(jnp.full(tuple(df_t.shape) or (), 0.5,
+                               jnp.float32))
+        super().__init__(ops.scale(df_t, 0.5), half)
+        self.df = df_t
+
+
+def _cb_cut(p, lims):
+    return (p < lims[0]) | (p > lims[1])
+
+
+def _cb_log_norm(p, lims):
+    # log C(p); taylor-expand near p=0.5 like the reference.
+    cut = _cb_cut(p, lims)
+    safe = jnp.where(cut, p, 0.499)
+    log_c = jnp.log(jnp.abs(2 * jnp.arctanh(1 - 2 * safe))
+                    / jnp.abs(1 - 2 * safe))
+    x = p - 0.5
+    taylor = math.log(2.0) + (4.0 / 3.0 + 104.0 / 45.0 * x * x) * x * x
+    return jnp.where(cut, log_c, taylor)
+
+
+class ContinuousBernoulli(Distribution):
+    """Continuous Bernoulli (reference continuous_bernoulli.py):
+    density p^x (1-p)^(1-x) C(p) on [0, 1].  The lims window selects
+    the taylor expansion of the normalizer near p=0.5.
+
+    (``cached_apply`` shares one OpDef per code object, so the math
+    helpers take ``lims`` as a static attr instead of closing over
+    ``self`` — a closure would bake the first instance's lims into the
+    shared op.)"""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _t(probs)
+        self._lims = tuple(float(v) for v in lims)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        def fn(p, lims):
+            cut = _cb_cut(p, lims)
+            safe = jnp.where(cut, p, 0.499)
+            m = safe / (2 * safe - 1) + 1 / (
+                2 * jnp.arctanh(1 - 2 * safe))
+            x = p - 0.5
+            taylor = 0.5 + (1.0 / 3.0 + 16.0 / 45.0 * x * x) * x
+            return jnp.where(cut, m, taylor)
+
+        return _op("cb_mean", fn, self.probs, lims=self._lims)
+
+    @property
+    def variance(self):
+        def fn(p, lims):
+            cut = _cb_cut(p, lims)
+            safe = jnp.where(cut, p, 0.499)
+            t = jnp.square((1 - 2 * safe) * jnp.arctanh(1 - 2 * safe))
+            v = safe * (safe - 1) / jnp.square(1 - 2 * safe) + 1 / t
+            x = p - 0.5
+            taylor = 1.0 / 12.0 - (1.0 / 15.0 - 128.0 / 945.0 * x * x) \
+                * x * x
+            return jnp.where(cut, v, taylor)
+
+        return _op("cb_variance", fn, self.probs, lims=self._lims)
+
+    def rsample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(default_generator.next_key(), s,
+                               jnp.float32, 1e-6, 1.0 - 1e-6)
+
+        def fn(p, u, lims):
+            cut = _cb_cut(p, lims)
+            safe = jnp.where(cut, p, 0.499)
+            smp = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+                   / (jnp.log(safe) - jnp.log1p(-safe)))
+            return jnp.where(cut, smp, u)
+
+        return _op("cb_rsample", fn, self.probs, Tensor(u),
+                   lims=self._lims)
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        def fn(p, v, lims):
+            eps = 1e-7
+            pc = jnp.clip(p, eps, 1 - eps)
+            return (v * jnp.log(pc) + (1 - v) * jnp.log1p(-pc)
+                    + _cb_log_norm(pc, lims))
+
+        return _op("cb_log_prob", fn, self.probs, _t(value),
+                   lims=self._lims)
+
+    def cdf(self, value):
+        def fn(p, v, lims):
+            cut = _cb_cut(p, lims)
+            safe = jnp.where(cut, p, 0.499)
+            c = ((jnp.power(safe, v) * jnp.power(1 - safe, 1 - v)
+                  + safe - 1) / (2 * safe - 1))
+            out = jnp.where(cut, c, v)
+            return jnp.clip(out, 0.0, 1.0)
+
+        return _op("cb_cdf", fn, self.probs, _t(value),
+                   lims=self._lims)
+
+    def entropy(self):
+        # H = -E[log p(X)] = -(E[X] log p + (1-E[X]) log(1-p) + log C)
+        def fn(p, m, lims):
+            eps = 1e-7
+            pc = jnp.clip(p, eps, 1 - eps)
+            return -(m * jnp.log(pc) + (1 - m) * jnp.log1p(-pc)
+                     + _cb_log_norm(pc, lims))
+
+        return _op("cb_entropy", fn, self.probs, self.mean,
+                   lims=self._lims)
+
+    def kl_divergence(self, other):
+        def fn(p1, p2, m, lims):
+            eps = 1e-7
+            a = jnp.clip(p1, eps, 1 - eps)
+            b = jnp.clip(p2, eps, 1 - eps)
+            return (m * (jnp.log(a) - jnp.log(b))
+                    + (1 - m) * (jnp.log1p(-a) - jnp.log1p(-b))
+                    + _cb_log_norm(a, lims) - _cb_log_norm(b, lims))
+
+        return _op("kl_cb_cb", fn, self.probs, other.probs, self.mean,
+                   lims=self._lims)
+
+
+class ExponentialFamily(Distribution):
+    """Base class marking exponential-family distributions (reference
+    exponential_family.py); entropy via the Bregman divergence of the
+    log-normalizer is provided by subclasses' closed forms here."""

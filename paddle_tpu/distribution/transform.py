@@ -1,0 +1,619 @@
+"""Transform machinery + TransformedDistribution + Independent.
+
+Reference: ``python/paddle/distribution/transform.py`` (Transform and
+the 13 concrete transforms), ``transformed_distribution.py:27``,
+``independent.py:25``, ``variable.py`` (domain/codomain descriptors).
+
+jax-native: forward/inverse/log-det are closed-form jnp expressions
+dispatched through the op registry (same pattern as the distributions
+module), so they are differentiable under the eager tape and traceable
+under jit.
+"""
+from __future__ import annotations
+
+import enum
+import math
+import operator
+from functools import reduce
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops import registry as _registry
+
+_op = _registry.cached_apply
+
+
+def _t(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, jnp.float32))
+
+
+# -- variable.py: domain/codomain descriptors --------------------------------
+
+
+class Variable:
+    """Reference variable.py:27 — domain descriptor of a transform."""
+
+    def __init__(self, is_discrete=False, event_rank=0):
+        self._is_discrete = is_discrete
+        self._event_rank = event_rank
+
+    @property
+    def is_discrete(self):
+        return self._is_discrete
+
+    @property
+    def event_rank(self):
+        return self._event_rank
+
+    def constraint(self, x):
+        raise NotImplementedError
+
+
+class Real(Variable):
+    def __init__(self, event_rank=0):
+        super().__init__(False, event_rank)
+
+    def constraint(self, x):
+        return _op("variable_real", lambda v: jnp.isfinite(v), _t(x))
+
+
+class Positive(Variable):
+    def __init__(self, event_rank=0):
+        super().__init__(False, event_rank)
+
+    def constraint(self, x):
+        return _op("variable_positive", lambda v: v > 0, _t(x))
+
+
+class Independent(Variable):
+    """Reinterprets the rightmost ``reinterpreted_batch_rank`` dims of a
+    base variable as event dims (variable.py:70)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self._base = base
+        self._reinterpreted_batch_rank = reinterpreted_batch_rank
+        super().__init__(base.is_discrete,
+                         base.event_rank + reinterpreted_batch_rank)
+
+    def constraint(self, x):
+        ok = self._base.constraint(x)
+        axes = tuple(range(-self._reinterpreted_batch_rank, 0))
+        return _op("variable_independent",
+                   lambda v, axes: jnp.all(v, axis=axes), ok, axes=axes)
+
+
+class Stack(Variable):
+    def __init__(self, vars, axis=0):
+        self._vars = list(vars)
+        self._axis = axis
+        super().__init__(any(v.is_discrete for v in self._vars),
+                         max(v.event_rank for v in self._vars))
+
+
+real = Real()
+positive = Positive()
+
+
+# -- Transform base ----------------------------------------------------------
+
+
+class _Type(enum.Enum):
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+    @classmethod
+    def is_injective(cls, t):
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+class Transform:
+    r"""Base class for invertible transforms y = f(x) with log|det J|
+    (reference transform.py:70)."""
+
+    _type = _Type.INJECTION
+
+    @classmethod
+    def _is_injective(cls):
+        return _Type.is_injective(cls._type)
+
+    def __call__(self, input):
+        if isinstance(input, Transform):
+            return ChainTransform([self, input])
+        from . import Distribution
+
+        if isinstance(input, Distribution):
+            from .transformed_distribution import TransformedDistribution
+
+            return TransformedDistribution(input, [self])
+        return self.forward(input)
+
+    def forward(self, x):
+        return self._forward(_t(x))
+
+    def inverse(self, y):
+        return self._inverse(_t(y))
+
+    def forward_log_det_jacobian(self, x):
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return self._forward_log_det_jacobian(_t(x))
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return -self._inverse_log_det_jacobian(self.forward(_t(x)))
+        raise NotImplementedError(
+            f"{type(self).__name__} has no log-det-jacobian")
+
+    def inverse_log_det_jacobian(self, y):
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return self._inverse_log_det_jacobian(_t(y))
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return -self._forward_log_det_jacobian(self.inverse(_t(y)))
+        raise NotImplementedError(
+            f"{type(self).__name__} has no log-det-jacobian")
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    @property
+    def _domain(self):
+        return real
+
+    @property
+    def _codomain(self):
+        return real
+
+
+# -- concrete transforms -----------------------------------------------------
+
+
+class AbsTransform(Transform):
+    """y = |x| (surjective; inverse picks the positive branch).
+    Reference transform.py:374."""
+
+    _type = _Type.SURJECTION
+
+    def _forward(self, x):
+        return _op("abs_t_fwd", lambda v: jnp.abs(v), x)
+
+    def _inverse(self, y):
+        return _op("abs_t_inv", lambda v: v, y)
+
+    @property
+    def _codomain(self):
+        return positive
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x.  Reference transform.py:447."""
+
+    _type = _Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self._loc = _t(loc)
+        self._scale = _t(scale)
+
+    @property
+    def loc(self):
+        return self._loc
+
+    @property
+    def scale(self):
+        return self._scale
+
+    def _forward(self, x):
+        return _op("affine_t_fwd", lambda l, s, v: l + s * v,
+                   self._loc, self._scale, x)
+
+    def _inverse(self, y):
+        return _op("affine_t_inv", lambda l, s, v: (v - l) / s,
+                   self._loc, self._scale, y)
+
+    def _forward_log_det_jacobian(self, x):
+        return _op("affine_t_ldj",
+                   lambda s, v: jnp.broadcast_to(
+                       jnp.log(jnp.abs(s)), jnp.broadcast_shapes(
+                           jnp.shape(s), jnp.shape(v))),
+                   self._scale, x)
+
+    def forward_shape(self, shape):
+        return tuple(jnp.broadcast_shapes(
+            tuple(shape), tuple(self._loc.shape),
+            tuple(self._scale.shape)))
+
+    inverse_shape = forward_shape
+
+
+class ExpTransform(Transform):
+    """y = exp(x).  Reference transform.py:659."""
+
+    _type = _Type.BIJECTION
+
+    def _forward(self, x):
+        return _op("exp_t_fwd", lambda v: jnp.exp(v), x)
+
+    def _inverse(self, y):
+        return _op("exp_t_inv", lambda v: jnp.log(v), y)
+
+    def _forward_log_det_jacobian(self, x):
+        return _op("exp_t_ldj", lambda v: v, x)
+
+    @property
+    def _codomain(self):
+        return positive
+
+
+class PowerTransform(Transform):
+    """y = x ** power (x > 0).  Reference transform.py:804."""
+
+    _type = _Type.BIJECTION
+
+    def __init__(self, power):
+        self._power = _t(power)
+
+    @property
+    def power(self):
+        return self._power
+
+    def _forward(self, x):
+        return _op("power_t_fwd", lambda p, v: jnp.power(v, p),
+                   self._power, x)
+
+    def _inverse(self, y):
+        return _op("power_t_inv", lambda p, v: jnp.power(v, 1.0 / p),
+                   self._power, y)
+
+    def _forward_log_det_jacobian(self, x):
+        return _op("power_t_ldj",
+                   lambda p, v: jnp.log(jnp.abs(p * jnp.power(v, p - 1))),
+                   self._power, x)
+
+    def forward_shape(self, shape):
+        return tuple(jnp.broadcast_shapes(tuple(shape),
+                                          tuple(self._power.shape)))
+
+    inverse_shape = forward_shape
+
+    @property
+    def _domain(self):
+        return positive
+
+    @property
+    def _codomain(self):
+        return positive
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x).  Reference transform.py:997."""
+
+    _type = _Type.BIJECTION
+
+    def _forward(self, x):
+        return _op("sigmoid_t_fwd", lambda v: jax.nn.sigmoid(v), x)
+
+    def _inverse(self, y):
+        return _op("sigmoid_t_inv",
+                   lambda v: jnp.log(v) - jnp.log1p(-v), y)
+
+    def _forward_log_det_jacobian(self, x):
+        return _op("sigmoid_t_ldj",
+                   lambda v: -jax.nn.softplus(-v) - jax.nn.softplus(v),
+                   x)
+
+    @property
+    def _codomain(self):
+        return Variable(False, 0)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x).  Reference transform.py:1283."""
+
+    _type = _Type.BIJECTION
+
+    def _forward(self, x):
+        return _op("tanh_t_fwd", lambda v: jnp.tanh(v), x)
+
+    def _inverse(self, y):
+        return _op("tanh_t_inv", lambda v: jnp.arctanh(v), y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh(x)^2) = 2 (log 2 - x - softplus(-2x)), the
+        # numerically-stable form the reference uses.
+        return _op("tanh_t_ldj",
+                   lambda v: 2.0 * (math.log(2.0) - v
+                                    - jax.nn.softplus(-2.0 * v)), x)
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis (not a bijection; inverse is
+    log up to an additive constant).  Reference transform.py:1040."""
+
+    _type = _Type.OTHER
+
+    def _forward(self, x):
+        return _op("softmax_t_fwd",
+                   lambda v: jax.nn.softmax(v, axis=-1), x)
+
+    def _inverse(self, y):
+        return _op("softmax_t_inv", lambda v: jnp.log(v), y)
+
+    @property
+    def _domain(self):
+        return Independent(real, 1)
+
+    @property
+    def _codomain(self):
+        return Independent(Variable(False, 0), 1)
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^{K-1} -> K-simplex via stick-breaking.
+    Reference transform.py:1217."""
+
+    _type = _Type.BIJECTION
+
+    def _forward(self, x):
+        def fn(v):
+            offset = v.shape[-1] - jnp.arange(v.shape[-1], dtype=v.dtype)
+            z = jax.nn.sigmoid(v - jnp.log(offset))
+            zp = jnp.concatenate(
+                [jnp.zeros_like(z[..., :1]), z], -1)
+            cum = jnp.cumprod(1 - zp, -1)
+            z1 = jnp.concatenate([z, jnp.ones_like(z[..., :1])], -1)
+            return z1 * cum
+
+        return _op("stickbreak_t_fwd", fn, x)
+
+    def _inverse(self, y):
+        def fn(v):
+            cum = jnp.cumsum(v[..., :-1], -1)
+            rem = 1.0 - jnp.concatenate(
+                [jnp.zeros_like(cum[..., :1]), cum[..., :-1]], -1)
+            z = v[..., :-1] / rem
+            offset = (v.shape[-1] - 1
+                      - jnp.arange(v.shape[-1] - 1, dtype=v.dtype))
+            return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+        return _op("stickbreak_t_inv", fn, y)
+
+    def _forward_log_det_jacobian(self, x):
+        def fn(v):
+            offset = v.shape[-1] - jnp.arange(v.shape[-1], dtype=v.dtype)
+            z = jax.nn.sigmoid(v - jnp.log(offset))
+            # log|det J| = sum_i log(sigmoid'(.) * remaining stick)
+            return jnp.sum(jnp.log(z * (1 - z)) + jnp.log(
+                jnp.cumprod(jnp.concatenate(
+                    [jnp.ones_like(z[..., :1]), 1 - z[..., :-1]], -1),
+                    -1)), -1)
+
+        return _op("stickbreak_t_ldj", fn, x)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+    @property
+    def _domain(self):
+        return Independent(real, 1)
+
+    @property
+    def _codomain(self):
+        return Independent(Variable(False, 0), 1)
+
+
+class ReshapeTransform(Transform):
+    """Reshape trailing event dims.  Reference transform.py:871."""
+
+    _type = _Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self._in = tuple(int(d) for d in in_event_shape)
+        self._out = tuple(int(d) for d in out_event_shape)
+        if reduce(operator.mul, self._in, 1) != \
+                reduce(operator.mul, self._out, 1):
+            raise ValueError("in_event_shape and out_event_shape must "
+                             "have the same number of elements")
+
+    @property
+    def in_event_shape(self):
+        return self._in
+
+    @property
+    def out_event_shape(self):
+        return self._out
+
+    def _forward(self, x):
+        out = self._out
+
+        def fn(v):
+            batch = v.shape[:v.ndim - len(self._in)]
+            return v.reshape(batch + out)
+
+        return _op("reshape_t_fwd_%s_%s" % (self._in, self._out), fn, x)
+
+    def _inverse(self, y):
+        inn = self._in
+
+        def fn(v):
+            batch = v.shape[:v.ndim - len(self._out)]
+            return v.reshape(batch + inn)
+
+        return _op("reshape_t_inv_%s_%s" % (self._in, self._out), fn, y)
+
+    def _forward_log_det_jacobian(self, x):
+        n = len(self._in)
+
+        def fn(v):
+            return jnp.zeros(v.shape[:v.ndim - n], v.dtype)
+
+        return _op("reshape_t_ldj_%d" % n, fn, x)
+
+    def forward_shape(self, shape):
+        if tuple(shape[len(shape) - len(self._in):]) != self._in:
+            raise ValueError(f"shape {shape} does not end in {self._in}")
+        return tuple(shape[:len(shape) - len(self._in)]) + self._out
+
+    def inverse_shape(self, shape):
+        if tuple(shape[len(shape) - len(self._out):]) != self._out:
+            raise ValueError(f"shape {shape} does not end in {self._out}")
+        return tuple(shape[:len(shape) - len(self._out)]) + self._in
+
+    @property
+    def _domain(self):
+        return Independent(real, len(self._in))
+
+    @property
+    def _codomain(self):
+        return Independent(real, len(self._out))
+
+
+class IndependentTransform(Transform):
+    """Promote the rightmost ``reinterpreted_batch_rank`` batch dims of
+    a base transform to event dims (sums the log-det over them).
+    Reference transform.py:709."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        if reinterpreted_batch_rank <= 0:
+            raise ValueError("reinterpreted_batch_rank must be positive")
+        self._base = base
+        self._rank = int(reinterpreted_batch_rank)
+
+    @classmethod
+    def _is_injective(cls):
+        return True
+
+    def _forward(self, x):
+        return self._base.forward(x)
+
+    def _inverse(self, y):
+        return self._base.inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ldj = self._base.forward_log_det_jacobian(x)
+        axes = tuple(range(-self._rank, 0))
+        return _op("indep_t_sum", lambda v, axes: jnp.sum(v, axis=axes),
+                   ldj, axes=axes)
+
+    def forward_shape(self, shape):
+        return self._base.forward_shape(shape)
+
+    def inverse_shape(self, shape):
+        return self._base.inverse_shape(shape)
+
+    @property
+    def _domain(self):
+        return Independent(self._base._domain, self._rank)
+
+    @property
+    def _codomain(self):
+        return Independent(self._base._codomain, self._rank)
+
+
+class ChainTransform(Transform):
+    """Composition f_n ∘ ... ∘ f_1 (applied left to right on forward).
+    Reference transform.py:534."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    @classmethod
+    def _is_injective(cls):
+        return True
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        event_rank = self._domain.event_rank
+        total = None
+        for t in self.transforms:
+            ldj = t.forward_log_det_jacobian(x)
+            extra = event_rank - t._domain.event_rank
+            if extra > 0:
+                axes = tuple(range(-extra, 0))
+                ldj = _op("chain_t_sum",
+                          lambda v, axes: jnp.sum(v, axis=axes),
+                          ldj, axes=axes)
+            total = ldj if total is None else total + ldj
+            x = t.forward(x)
+            event_rank += (t._codomain.event_rank
+                           - t._domain.event_rank)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return tuple(shape)
+
+    @property
+    def _domain(self):
+        rank = max((t._domain.event_rank for t in self.transforms),
+                   default=0)
+        return Independent(real, rank) if rank else real
+
+    @property
+    def _codomain(self):
+        rank = max((t._codomain.event_rank for t in self.transforms),
+                   default=0)
+        return Independent(real, rank) if rank else real
+
+
+class StackTransform(Transform):
+    """Apply a list of transforms to slices along ``axis``.
+    Reference transform.py:1097."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self._axis = int(axis)
+
+    @property
+    def axis(self):
+        return self._axis
+
+    def _map(self, value, method):
+        from .. import ops
+
+        parts = []
+        for i, t in enumerate(self.transforms):
+            sl = ops.squeeze(
+                ops.slice(value, [self._axis], [i], [i + 1]),
+                axis=self._axis)
+            parts.append(getattr(t, method)(sl))
+        return ops.stack(parts, axis=self._axis)
+
+    def _forward(self, x):
+        return self._map(x, "forward")
+
+    def _inverse(self, y):
+        return self._map(y, "inverse")
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map(x, "forward_log_det_jacobian")
+
+    @property
+    def _domain(self):
+        return Stack([t._domain for t in self.transforms], self._axis)
+
+    @property
+    def _codomain(self):
+        return Stack([t._codomain for t in self.transforms], self._axis)

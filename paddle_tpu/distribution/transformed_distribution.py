@@ -1,0 +1,160 @@
+"""TransformedDistribution + Independent distribution wrappers.
+
+Reference: ``python/paddle/distribution/transformed_distribution.py:27``
+and ``independent.py:25``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops import registry as _registry
+
+_op = _registry.cached_apply
+
+
+class TransformedDistribution:
+    """Distribution of y = f_k(...f_1(x)) for x ~ base.
+
+    log_prob(y) = base.log_prob(f^-1(y)) - log|det J_f(f^-1(y))|,
+    summed over transform-introduced event dims.
+    """
+
+    def __init__(self, base, transforms):
+        from .transform import ChainTransform, Transform
+
+        if not isinstance(transforms, (list, tuple)):
+            raise TypeError("transforms must be a list/tuple of "
+                            "Transform")
+        for t in transforms:
+            if not isinstance(t, Transform):
+                raise TypeError(f"not a Transform: {t!r}")
+        self.base = base
+        self.transforms = list(transforms)
+        chain = ChainTransform(self.transforms)
+        base_event = tuple(base.event_shape)
+        shape = tuple(base.batch_shape) + base_event
+        out_shape = chain.forward_shape(shape)
+        extra = chain._codomain.event_rank - len(base_event)
+        event_rank = max(len(base_event) + max(extra, 0),
+                         chain._codomain.event_rank)
+        cut = len(out_shape) - event_rank
+        self._batch_shape = tuple(out_shape[:cut])
+        self._event_shape = tuple(out_shape[cut:])
+        # a broadcasting transform (e.g. vector loc over a scalar base)
+        # widens the output; base draws must carry those extra leading
+        # dims so sample shapes compose (code-review r4).
+        base_own = tuple(base.batch_shape) + base_event
+        inv = tuple(chain.inverse_shape(out_shape))
+        self._base_extra = inv[:len(inv) - len(base_own)]
+        self._chain = chain
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        x = self.base.sample(tuple(shape) + self._base_extra)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x.detach() if hasattr(x, "detach") else x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(tuple(shape) + self._base_extra)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        from . import _t
+
+        y = _t(value)
+        lp = None
+        event_rank = (len(self._event_shape)
+                      or self._chain._codomain.event_rank)
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ldj = t.forward_log_det_jacobian(x)
+            extra = event_rank - t._codomain.event_rank
+            if extra > 0:
+                axes = tuple(range(-extra, 0))
+                ldj = _op("tdist_sum",
+                          lambda v, axes: jnp.sum(v, axis=axes),
+                          ldj, axes=axes)
+            lp = (-ldj) if lp is None else lp - ldj
+            event_rank += t._domain.event_rank - t._codomain.event_rank
+            y = x
+        base_lp = self.base.log_prob(y)
+        extra = event_rank - len(tuple(self.base.event_shape))
+        if extra > 0:
+            axes = tuple(range(-extra, 0))
+            base_lp = _op("tdist_sum",
+                          lambda v, axes: jnp.sum(v, axis=axes),
+                          base_lp, axes=axes)
+        return base_lp if lp is None else base_lp + lp
+
+    def prob(self, value):
+        from .. import ops
+
+        return ops.exp(self.log_prob(value))
+
+
+class Independent:
+    """Reinterpret the rightmost ``reinterpreted_batch_rank`` batch dims
+    of a base distribution as event dims (reference independent.py:25):
+    log_prob sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        rank = int(reinterpreted_batch_rank)
+        if not 0 < rank <= len(tuple(base.batch_shape)):
+            raise ValueError(
+                f"reinterpreted_batch_rank must be in (0, "
+                f"{len(tuple(base.batch_shape))}], got {rank}")
+        self.base = base
+        self._rank = rank
+        shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        cut = len(tuple(base.batch_shape)) - rank
+        self._batch_shape = shape[:cut]
+        self._event_shape = shape[cut:]
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        axes = tuple(range(-self._rank, 0))
+        return _op("indep_lp_sum",
+                   lambda v, axes: jnp.sum(v, axis=axes), lp, axes=axes)
+
+    def prob(self, value):
+        from .. import ops
+
+        return ops.exp(self.log_prob(value))
+
+    def entropy(self):
+        ent = self.base.entropy()
+        axes = tuple(range(-self._rank, 0))
+        return _op("indep_ent_sum",
+                   lambda v, axes: jnp.sum(v, axis=axes), ent, axes=axes)

@@ -24,6 +24,11 @@ def linear(x, weight, bias=None, name=None):
     """y = x @ W + b (W is [in, out] like the reference, ops.yaml `linear`)."""
     out = ops.matmul(x, weight)
     if bias is not None:
+        # The reference `linear` op adds bias in the matmul's compute
+        # dtype; without this, an fp32 bias would promote an autocast
+        # bf16 matmul back to fp32.
+        if bias.dtype != out.dtype:
+            bias = ops.cast(bias, out.dtype)
         out = ops.add(out, bias)
     return out
 

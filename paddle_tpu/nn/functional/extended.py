@@ -126,7 +126,9 @@ def cosine_similarity(x1, x2, axis=1, eps=1e-8):
 def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False,
                       name=None):
     def fn(a, b, p, eps, keepdim):
-        d = jnp.abs(a - b) + eps
+        # epsilon joins the SIGNED difference before the norm (reference
+        # pairwise_distance adds it to x - y, not |x - y|) — ADVICE r3.
+        d = jnp.abs(a - b + eps)
         return jnp.sum(d ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
 
     return _op("pairwise_distance", fn, _t(x), _t(y), p=float(p),
@@ -148,7 +150,7 @@ def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
                         name=None):
     def fn(a, pos, neg, margin, p, eps, swap, reduction):
         def dist(u, v):
-            return jnp.sum((jnp.abs(u - v) + eps) ** p,
+            return jnp.sum(jnp.abs(u - v + eps) ** p,
                            axis=-1) ** (1.0 / p)
 
         d_pos = dist(a, pos)

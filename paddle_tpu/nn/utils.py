@@ -12,7 +12,9 @@ from .layers import Layer
 
 
 def _norm_except(v_data, dim):
-    axes = tuple(i for i in range(v_data.ndim) if i != dim)
+    # dim=None (reference weight_norm_hook): norm over the whole tensor.
+    axes = tuple(i for i in range(v_data.ndim)
+                 if dim is None or i != dim)
     return jnp.sqrt(jnp.sum(v_data * v_data, axis=axes, keepdims=True))
 
 
@@ -24,7 +26,8 @@ def weight_norm(layer: Layer, name: str = "weight", dim: int = 0):
     from .. import ops
 
     w = getattr(layer, name)
-    dim = dim % w._data.ndim
+    if dim is not None:
+        dim = dim % w._data.ndim
     del layer._parameters[name]
     g0 = np.asarray(_norm_except(w._data, dim))
     v = layer.create_parameter(list(w.shape))
@@ -37,7 +40,8 @@ def weight_norm(layer: Layer, name: str = "weight", dim: int = 0):
     def pre_hook(lyr, inputs):
         vv = getattr(lyr, f"{name}_v")
         gg = getattr(lyr, f"{name}_g")
-        axes = tuple(i for i in range(vv._data.ndim) if i != dim)
+        axes = tuple(i for i in range(vv._data.ndim)
+                     if dim is None or i != dim)
         norm = ops.sqrt((vv * vv).sum(axis=list(axes), keepdim=True))
         lyr.__dict__[name] = gg * vv / norm
         return None
@@ -96,7 +100,11 @@ def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations=1,
     orig = layer.create_parameter(list(w.shape))
     orig.set_value(w)
     setattr(layer, f"{name}_orig", orig)
-    state = {"u": u, "v": vv}
+    # u/v live as non-trainable buffers (reference spectral_norm_hook
+    # registers '<name>_u'/'<name>_v') so state_dict round-trips the
+    # power-iteration state — ADVICE r3.
+    layer.register_buffer(f"{name}_u", Tensor(u))
+    layer.register_buffer(f"{name}_v", Tensor(vv))
 
     def pre_hook(lyr, inputs):
         from .. import ops
@@ -104,14 +112,16 @@ def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations=1,
         ww = getattr(lyr, f"{name}_orig")
         m = jnp.moveaxis(ww._data, dim, 0).reshape(ww._data.shape[dim],
                                                    -1)
-        uu, vvv = state["u"], state["v"]
+        uu = lyr._buffers[f"{name}_u"]._data
+        vvv = lyr._buffers[f"{name}_v"]._data
         if lyr.training:  # reference: power-iterate only in training
             for _ in range(n_power_iterations):
                 vvv = m.T @ uu
                 vvv = vvv / (jnp.linalg.norm(vvv) + eps)
                 uu = m @ vvv
                 uu = uu / (jnp.linalg.norm(uu) + eps)
-            state["u"], state["v"] = uu, vvv
+            lyr._buffers[f"{name}_u"]._data = uu
+            lyr._buffers[f"{name}_v"]._data = vvv
         # sigma = u^T W v DIFFERENTIATED through W (u, v stop-grad
         # constants, matching the reference): build it with tape ops.
         w2d = ops.reshape(

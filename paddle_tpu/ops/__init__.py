@@ -72,7 +72,20 @@ from .random import (  # noqa: F401
     seed, get_rng_state, set_rng_state, randn, standard_normal, normal,
     gaussian, rand, uniform, randint, randint_like, randperm, bernoulli,
     poisson, multinomial, normal_, uniform_, exponential_, Generator,
-    default_generator,
+    default_generator, bernoulli_, cauchy_, geometric_, log_normal_,
+    log_normal, standard_gamma, binomial,
+)
+from .tail import (  # noqa: F401
+    real, imag, conj, angle, isreal, isneginf, isposinf, signbit, sinc,
+    nextafter, polar, sgn, logit, round, gammaln, gammainc, gammaincc,
+    multigammaln, i0e, i1, i1e, polygamma, hstack, vstack, block_diag,
+    add_n, cartesian_prod, combinations, reverse, crop, unflatten,
+    view_as, strided_slice, scatter_nd, diagonal_scatter,
+    masked_scatter, index_sample, multiplex, shard_index, reduce_as,
+    isin, tril_indices, triu_indices, shape, is_empty, is_integer,
+    is_complex, is_floating_point, nanquantile, pdist, histogramdd,
+    cumulative_trapezoid, mv, vecdot, householder_product, geqrf,
+    ormqr, cholesky_inverse,
 )
 
 import builtins as _bi  # noqa: E402
@@ -306,7 +319,7 @@ def _install_tensor_methods():
         floor_divide=floor_divide,
         exp=exp, log=log, log2=log2, log10=log10, log1p=log1p, sqrt=sqrt,
         rsqrt=rsqrt, square=square, abs=abs, sign=sign, floor=floor,
-        ceil=ceil, round=round_, trunc=trunc, reciprocal=reciprocal,
+        ceil=ceil, round=round, trunc=trunc, reciprocal=reciprocal,
         sin=sin, cos=cos, tan=tan, asin=asin, acos=acos, atan=atan,
         sinh=sinh, cosh=cosh, tanh=tanh, erf=erf, lgamma=lgamma,
         digamma=digamma, neg=neg, clip=clip, scale=scale, lerp=lerp,
@@ -354,6 +367,20 @@ def _install_tensor_methods():
         eig=eig, eigvals=eigvals, eigvalsh=eigvalsh, svdvals=svdvals,
         cond=cond, corrcoef=corrcoef, cov=cov, lstsq=lstsq,
         matrix_exp=matrix_exp, cholesky_solve=cholesky_solve,
+        # long-tail (ops/tail.py), round 4
+        real=real, imag=imag, conj=conj, angle=angle, isreal=isreal,
+        isneginf=isneginf, isposinf=isposinf, signbit=signbit,
+        sinc=sinc, nextafter=nextafter, polar=None, sgn=sgn,
+        logit=logit, gammaln=gammaln, gammainc=gammainc,
+        gammaincc=gammaincc, multigammaln=multigammaln, i0e=i0e, i1=i1,
+        i1e=i1e, polygamma=polygamma, unflatten=unflatten,
+        view_as=view_as, strided_slice=strided_slice,
+        diagonal_scatter=diagonal_scatter, masked_scatter=masked_scatter,
+        index_sample=index_sample, reduce_as=reduce_as, isin=isin,
+        is_empty=is_empty, nanquantile=nanquantile, pdist=None,
+        cumulative_trapezoid=cumulative_trapezoid, mv=mv, vecdot=vecdot,
+        householder_product=householder_product,
+        cholesky_inverse=cholesky_inverse, crop=crop,
     )
     for name, fn in methods.items():
         if fn is None:
@@ -410,3 +437,28 @@ def _install_tensor_methods():
 
 
 _install_tensor_methods()
+
+
+# --- generated in-place variants (ops/inplace.py), round 4 -----------------
+def _install_inplace_variants():
+    import sys
+
+    from . import inplace as _inplace_mod
+
+    mod = sys.modules[__name__]
+    created = _inplace_mod.install(mod)
+    # math.py's round_ is the decimal-less FUNCTIONAL round kept for
+    # internal use; the public paddle.round_ must be the in-place
+    # variant, so it is explicitly overridden below.
+    force = {"round_"}
+    for name, fn in created.items():
+        # don't clobber hand-written variants (add_/clip_/... above or
+        # the random in-place fills like normal_)
+        if name in force or not hasattr(mod, name):
+            setattr(mod, name, fn)
+        if name in force or not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+    return sorted(created)
+
+
+_INPLACE_VARIANTS = _install_inplace_variants()

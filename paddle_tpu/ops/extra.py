@@ -241,16 +241,19 @@ def take(x, index, mode="raise", name=None):
                     f"take: index out of range for tensor of {size} "
                     f"elements (got [{arr.min()}, {arr.max()}])")
     return apply(_take_op, x, index, mode=str(mode))
+# Positional order matches the reference signatures
+# index_add(x, index, axis, value) / index_fill(x, index, axis, value)
+# (python/paddle/tensor/manipulation.py) — ADVICE r3.
 index_add = _simple(
     "index_add",
-    lambda x, index, value, axis=0: _index_put(x, index, value, axis,
-                                               add=True),
+    lambda x, index, axis, value: _index_put(x, index, value, axis,
+                                             add=True),
     static=("axis",))
 index_fill = _simple(
     "index_fill",
-    lambda x, index, fill_value, axis=0: _index_fill_impl(
-        x, index, fill_value, axis),
-    static=("axis", "fill_value"))
+    lambda x, index, axis, value: _index_fill_impl(
+        x, index, value, axis),
+    static=("axis",))
 
 
 def _index_put(x, index, value, axis, add):

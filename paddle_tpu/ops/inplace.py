@@ -1,0 +1,98 @@
+"""In-place op variants (``x.abs_()`` / ``paddle.abs_(x)``).
+
+Reference: the ``*_`` entries in ``python/paddle/tensor/__init__.py``
+(generated inplace kernels).  Under jax arrays are immutable, so
+"in-place" here means paddle's *observable* contract: compute the
+result, rebind it as the tensor's value (same Tensor object returned),
+and keep version counting / tape semantics via ``set_value``.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+# (inplace name, functional source module attr) — bound lazily so this
+# module can import before the functional namespace is assembled.
+_UNARY = [
+    "abs", "acos", "asin", "atan", "asinh", "acosh", "atanh", "ceil",
+    "cos", "cosh", "digamma", "erf", "exp", "expm1", "floor", "frac",
+    "lgamma", "log", "log10", "log1p", "log2", "logical_not", "neg",
+    "reciprocal", "rsqrt", "sigmoid", "sin", "sinh", "sqrt", "square",
+    "tan", "tanh", "trunc", "i0", "sinc", "logit", "nan_to_num",
+    "bitwise_not", "gammaln", "sgn",
+]
+_BINARY = [
+    "add", "subtract", "multiply", "divide", "remainder", "mod",
+    "floor_divide", "floor_mod", "pow", "bitwise_and", "bitwise_or",
+    "bitwise_xor", "logical_and", "logical_or", "logical_xor",
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "gcd", "lcm", "copysign", "hypot", "ldexp",
+    "nextafter", "gammainc", "gammaincc", "atan2", "fmax", "fmin",
+    "maximum", "minimum",
+]
+_OTHER = [
+    # (name, functional name) with pass-through args
+    ("clip", "clip"), ("scale", "scale"), ("lerp", "lerp"),
+    ("cumsum", "cumsum"), ("cumprod", "cumprod"),
+    ("renorm", "renorm"), ("round", "round"),
+    ("masked_fill", "masked_fill"), ("masked_scatter",
+                                     "masked_scatter"),
+    ("index_add", "index_add"), ("index_fill", "index_fill"),
+    ("scatter", "scatter"), ("put_along_axis", "put_along_axis"),
+    ("tril", "tril"), ("triu", "triu"), ("reshape", "reshape"),
+    ("flatten", "flatten"), ("squeeze", "squeeze"),
+    ("unsqueeze", "unsqueeze"), ("transpose", "transpose"),
+    ("t", "t"), ("cast", "cast"), ("multigammaln", "multigammaln"),
+    ("polygamma", "polygamma"), ("multiply", "multiply"),
+    ("addmm", "addmm"), ("erfinv", "erfinv"),
+]
+# where_ is NOT generated: paddle.where_(cond, x, y) writes into x
+# (the 2nd argument), not cond — it gets a hand-written wrapper.
+
+
+def _make_inplace(func_name):
+    def _inplace(x, *args, **kwargs):
+        from .. import ops
+        from .manipulation import _autograd_proxy
+
+        if not isinstance(x, Tensor):
+            raise TypeError(
+                f"{func_name}_ requires a paddle Tensor, got {type(x)}")
+        # Route through the autograd proxy so the recorded edge keeps
+        # pointing at the OLD producer (no self-loop after rebind) —
+        # same contract as Tensor.add_ in ops/__init__.
+        out = getattr(ops, func_name)(_autograd_proxy(x), *args,
+                                      **kwargs)
+        x._data = out._data
+        x._grad_node = out._grad_node
+        x._out_slot = out._out_slot
+        x.stop_gradient = out.stop_gradient and x.stop_gradient
+        return x
+
+    _inplace.__name__ = func_name + "_"
+    _inplace.__doc__ = (f"In-place variant of ``{func_name}`` "
+                        f"(reference tensor inplace API).")
+    return _inplace
+
+
+def where_(condition, x, y, name=None):
+    """reference paddle.where_: writes the where result into ``x``."""
+    from .. import ops
+    from .manipulation import _autograd_proxy
+
+    out = ops.where(condition, _autograd_proxy(x), y)
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._out_slot = out._out_slot
+    x.stop_gradient = out.stop_gradient and x.stop_gradient
+    return x
+
+
+def install(namespace):
+    """Create every ``<op>_`` wrapper whose functional op exists in
+    ``namespace`` (the assembled paddle_tpu.ops module)."""
+    created = {"where_": where_}
+    for name in set(_UNARY) | set(_BINARY) | {o[1] for o in _OTHER}:
+        if hasattr(namespace, name):
+            wrapper = _make_inplace(name)
+            created[name + "_"] = wrapper
+    return created

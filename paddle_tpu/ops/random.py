@@ -224,3 +224,76 @@ def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
     u = _jit_uniform(default_generator.next_key(), tuple(x.shape), x.dtype)
     x.set_value(u * (max - min) + min)
     return x
+
+
+def bernoulli_(x, p=0.5, name=None):
+    """reference tensor/random.bernoulli_: in-place bernoulli fill."""
+    u = jax.random.bernoulli(default_generator.next_key(), p,
+                             jnp.shape(x._data))
+    x.set_value(u.astype(x.dtype))
+    return x
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """reference tensor/random.cauchy_."""
+    import math as _m
+
+    u = jax.random.uniform(default_generator.next_key(),
+                           jnp.shape(x._data), jnp.float32,
+                           1e-7, 1.0 - 1e-7)
+    x.set_value((loc + scale * jnp.tan(_m.pi * (u - 0.5)))
+                .astype(x.dtype))
+    return x
+
+
+def geometric_(x, probs, name=None):
+    """reference tensor/random.geometric_ (counts trials, support
+    1, 2, ...)."""
+    u = jax.random.uniform(default_generator.next_key(),
+                           jnp.shape(x._data), jnp.float32,
+                           1e-7, 1.0 - 1e-7)
+    x.set_value(jnp.ceil(jnp.log(u) / jnp.log1p(-probs))
+                .astype(x.dtype))
+    return x
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """reference tensor/random.log_normal_."""
+    z = _jit_normal(default_generator.next_key(), tuple(x.shape),
+                    jnp.float32)
+    x.set_value(jnp.exp(mean + std * z).astype(x.dtype))
+    return x
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    """reference tensor/random.log_normal."""
+    from ..core.tensor import Tensor
+
+    if shape is None:
+        shape = getattr(mean, "shape", ())
+    z = _jit_normal(default_generator.next_key(),
+                    tuple(int(d) for d in shape), jnp.float32)
+    m = mean._data if hasattr(mean, "_data") else mean
+    s = std._data if hasattr(std, "_data") else std
+    return Tensor(jnp.exp(m + s * z))
+
+
+def standard_gamma(alpha, name=None):
+    """reference tensor/random.standard_gamma."""
+    from ..core.tensor import Tensor
+
+    a = alpha._data if hasattr(alpha, "_data") else jnp.asarray(alpha)
+    out = jax.random.gamma(default_generator.next_key(), a)
+    return Tensor(out)
+
+
+def binomial(count, prob, name=None):
+    """reference tensor/random.binomial (elementwise draws)."""
+    from ..core.tensor import Tensor
+
+    n = count._data if hasattr(count, "_data") else jnp.asarray(count)
+    p = prob._data if hasattr(prob, "_data") else jnp.asarray(prob)
+    out = jax.random.binomial(default_generator.next_key(),
+                              n.astype(jnp.float32),
+                              p.astype(jnp.float32))
+    return Tensor(out.astype(jnp.int64))

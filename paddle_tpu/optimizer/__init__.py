@@ -4,3 +4,4 @@ from .optimizers import (  # noqa: F401
     SGD, Adagrad, Adam, AdamW, Lamb, Lars, LarsMomentum, Momentum,
     RMSProp,
 )
+from .lbfgs import LBFGS  # noqa: F401

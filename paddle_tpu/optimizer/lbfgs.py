@@ -1,0 +1,334 @@
+"""L-BFGS optimizer with strong-Wolfe line search.
+
+Reference: ``python/paddle/optimizer/lbfgs.py:120`` (LBFGS; Nocedal &
+Wright Algorithm 7.5 two-loop recursion, strong-Wolfe cubic line
+search).
+
+TPU-native split: the *closure* (loss + grads) runs on device through
+the normal eager/compiled path; the curvature bookkeeping — two-loop
+recursion over the (s, y) history, Wolfe bracketing — is tiny
+O(history * n) vector math, driven host-side exactly like the
+reference's dygraph implementation (it is inherently sequential, with
+data-dependent termination that cannot usefully live under jit).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .optimizer import Optimizer
+
+
+def _gather_flat(params, attr):
+    outs = []
+    for p in params:
+        if attr == "data":
+            outs.append(np.asarray(p._data, np.float64).ravel())
+        else:
+            g = p.grad
+            outs.append(np.zeros(int(np.prod(p.shape)))
+                        if g is None
+                        else np.asarray(g._data, np.float64).ravel())
+    return np.concatenate(outs) if outs else np.zeros(0)
+
+
+def _cubic_interpolate(x1, f1, g1, x2, f2, g2, bounds=None):
+    # reference lbfgs.py _cubic_interpolate (same formula both repos
+    # cite from Nocedal & Wright eq. 3.59).
+    if bounds is not None:
+        xmin_bound, xmax_bound = bounds
+    else:
+        xmin_bound, xmax_bound = (x1, x2) if x1 <= x2 else (x2, x1)
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    d2_square = d1 * d1 - g1 * g2
+    if d2_square >= 0:
+        d2 = np.sqrt(d2_square)
+        if x1 <= x2:
+            min_pos = x2 - (x2 - x1) * ((g2 + d2 - d1)
+                                        / (g2 - g1 + 2 * d2))
+        else:
+            min_pos = x1 - (x1 - x2) * ((g1 + d2 - d1)
+                                        / (g1 - g2 + 2 * d2))
+        return min(max(min_pos, xmin_bound), xmax_bound)
+    return (xmin_bound + xmax_bound) / 2.0
+
+
+class LBFGS(Optimizer):
+    """L-BFGS (reference optimizer/lbfgs.py:120).  ``step`` takes a
+    closure re-evaluating the loss with gradients."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        if max_eval is None:
+            max_eval = max_iter * 5 // 4
+        super().__init__(learning_rate=learning_rate,
+                         parameters=parameters,
+                         weight_decay=weight_decay, grad_clip=grad_clip,
+                         name=name)
+        self.max_iter = int(max_iter)
+        self.max_eval = int(max_eval)
+        self.tolerance_grad = float(tolerance_grad)
+        self.tolerance_change = float(tolerance_change)
+        self.history_size = int(history_size)
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("only 'strong_wolfe' is supported")
+        self.line_search_fn = line_search_fn
+        self._state = {"func_evals": 0, "n_iter": 0,
+                       "old_sks": [], "old_yks": [], "ro": [],
+                       "d": None, "t": None, "prev_flat_grad": None,
+                       "H_diag": 1.0}
+
+    # -- flat param io -----------------------------------------------------
+    def _set_flat(self, flat):
+        offset = 0
+        for p in self._parameter_list():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            chunk = flat[offset:offset + n].reshape(tuple(p.shape))
+            p._data = jnp.asarray(chunk, p._data.dtype)
+            offset += n
+
+    def _directional_evaluate(self, closure, x, t, d):
+        self._set_flat(x + t * d)
+        loss = float(closure())
+        flat_grad = _gather_flat(self._parameter_list(), "grad")
+        self._state["func_evals"] += 1
+        return loss, flat_grad
+
+    # -- strong wolfe (reference _strong_wolfe) ----------------------------
+    def _strong_wolfe(self, closure, x, t, d, f, g, gtd,
+                      c1=1e-4, c2=0.9, tolerance_change=1e-9,
+                      max_ls=25):
+        d_norm = np.abs(d).max() if d.size else 0.0
+        g = g.copy()
+        f_new, g_new = self._directional_evaluate(closure, x, t, d)
+        ls_func_evals = 1
+        gtd_new = float(g_new @ d)
+
+        t_prev, f_prev, g_prev, gtd_prev = 0.0, f, g, gtd
+        done = False
+        ls_iter = 0
+        while ls_iter < max_ls:
+            if f_new > (f + c1 * t * gtd) or \
+                    (ls_iter > 1 and f_new >= f_prev):
+                bracket = [t_prev, t]
+                bracket_f = [f_prev, f_new]
+                bracket_g = [g_prev, g_new.copy()]
+                bracket_gtd = [gtd_prev, gtd_new]
+                break
+            if abs(gtd_new) <= -c2 * gtd:
+                bracket = [t, t]
+                bracket_f = [f_new, f_new]
+                bracket_g = [g_new, g_new]
+                bracket_gtd = [gtd_new, gtd_new]
+                done = True
+                break
+            if gtd_new >= 0:
+                bracket = [t_prev, t]
+                bracket_f = [f_prev, f_new]
+                bracket_g = [g_prev, g_new.copy()]
+                bracket_gtd = [gtd_prev, gtd_new]
+                break
+
+            min_step = t + 0.01 * (t - t_prev)
+            max_step = t * 10
+            tmp = t
+            t = _cubic_interpolate(t_prev, f_prev, gtd_prev, t, f_new,
+                                   gtd_new, bounds=(min_step, max_step))
+            t_prev, f_prev, g_prev, gtd_prev = \
+                tmp, f_new, g_new.copy(), gtd_new
+            f_new, g_new = self._directional_evaluate(closure, x, t, d)
+            ls_func_evals += 1
+            gtd_new = float(g_new @ d)
+            ls_iter += 1
+        else:
+            bracket = [0, t]
+            bracket_f = [f, f_new]
+            bracket_g = [g, g_new]
+            bracket_gtd = [gtd, gtd_new]
+
+        insuf_progress = False
+        low_pos, high_pos = (0, 1) if bracket_f[0] <= bracket_f[-1] \
+            else (1, 0)
+        while not done and ls_iter < max_ls:
+            if abs(bracket[1] - bracket[0]) * d_norm < tolerance_change:
+                break
+            t = _cubic_interpolate(bracket[0], bracket_f[0],
+                                   bracket_gtd[0], bracket[1],
+                                   bracket_f[1], bracket_gtd[1])
+            eps = 0.1 * (max(bracket) - min(bracket))
+            if min(max(bracket) - t, t - min(bracket)) < eps:
+                if insuf_progress or t >= max(bracket) or \
+                        t <= min(bracket):
+                    if abs(t - max(bracket)) < abs(t - min(bracket)):
+                        t = max(bracket) - eps
+                    else:
+                        t = min(bracket) + eps
+                    insuf_progress = False
+                else:
+                    insuf_progress = True
+            else:
+                insuf_progress = False
+
+            f_new, g_new = self._directional_evaluate(closure, x, t, d)
+            ls_func_evals += 1
+            gtd_new = float(g_new @ d)
+            ls_iter += 1
+
+            if f_new > (f + c1 * t * gtd) or f_new >= bracket_f[low_pos]:
+                bracket[high_pos] = t
+                bracket_f[high_pos] = f_new
+                bracket_g[high_pos] = g_new.copy()
+                bracket_gtd[high_pos] = gtd_new
+                low_pos, high_pos = (0, 1) \
+                    if bracket_f[0] <= bracket_f[1] else (1, 0)
+            else:
+                if abs(gtd_new) <= -c2 * gtd:
+                    done = True
+                elif gtd_new * (bracket[high_pos]
+                                - bracket[low_pos]) >= 0:
+                    bracket[high_pos] = bracket[low_pos]
+                    bracket_f[high_pos] = bracket_f[low_pos]
+                    bracket_g[high_pos] = bracket_g[low_pos]
+                    bracket_gtd[high_pos] = bracket_gtd[low_pos]
+                bracket[low_pos] = t
+                bracket_f[low_pos] = f_new
+                bracket_g[low_pos] = g_new.copy()
+                bracket_gtd[low_pos] = gtd_new
+
+        t = bracket[low_pos]
+        f_new = bracket_f[low_pos]
+        g_new = bracket_g[low_pos]
+        return f_new, g_new, t, ls_func_evals
+
+    # -- main step ---------------------------------------------------------
+    def step(self, closure=None):
+        if closure is None:
+            raise RuntimeError("LBFGS.step requires a closure that "
+                               "re-evaluates the model and returns "
+                               "the loss")
+        state = self._state
+        lr = self.get_lr()
+
+        orig_loss = closure()
+        loss = float(orig_loss)
+        state["func_evals"] += 1
+        current_evals = 1
+
+        params = self._parameter_list()
+        flat_grad = _gather_flat(params, "grad")
+        if float(np.abs(flat_grad).max() if flat_grad.size else 0.0) \
+                <= self.tolerance_grad:
+            return orig_loss
+
+        n_iter = 0
+        while n_iter < self.max_iter:
+            n_iter += 1
+            state["n_iter"] += 1
+
+            if state["n_iter"] == 1:
+                d = -flat_grad
+                state["old_sks"], state["old_yks"], state["ro"] = \
+                    [], [], []
+                H_diag = 1.0
+            else:
+                y = flat_grad - state["prev_flat_grad"]
+                s = state["d"] * state["t"]
+                ys = float(y @ s)
+                if ys > 1e-10:
+                    if len(state["old_sks"]) == self.history_size:
+                        state["old_sks"].pop(0)
+                        state["old_yks"].pop(0)
+                        state["ro"].pop(0)
+                    state["old_sks"].append(s)
+                    state["old_yks"].append(y)
+                    state["ro"].append(1.0 / ys)
+                    H_diag = ys / float(y @ y)
+                else:
+                    H_diag = state["H_diag"]
+
+                # two-loop recursion
+                num_old = len(state["old_sks"])
+                al = [0.0] * num_old
+                q = -flat_grad
+                for i in range(num_old - 1, -1, -1):
+                    al[i] = float(state["old_sks"][i] @ q) \
+                        * state["ro"][i]
+                    q = q - al[i] * state["old_yks"][i]
+                d = q * H_diag
+                for i in range(num_old):
+                    be_i = float(state["old_yks"][i] @ d) \
+                        * state["ro"][i]
+                    d = d + state["old_sks"][i] * (al[i] - be_i)
+
+            state["H_diag"] = H_diag
+            state["prev_flat_grad"] = flat_grad.copy()
+            prev_loss = loss
+
+            gtd = float(flat_grad @ d)
+            if gtd > -self.tolerance_change:
+                break
+
+            if state["n_iter"] == 1:
+                t = min(1.0, 1.0 / float(np.abs(flat_grad).sum())) * lr
+            else:
+                t = lr
+
+            x0 = _gather_flat(params, "data")
+            if self.line_search_fn == "strong_wolfe":
+                loss, flat_grad, t, ls_evals = self._strong_wolfe(
+                    closure, x0, t, d, loss, flat_grad, gtd)
+                self._set_flat(x0 + t * d)
+                current_evals += ls_evals
+            else:
+                self._set_flat(x0 + t * d)
+                loss = float(closure())
+                flat_grad = _gather_flat(params, "grad")
+                current_evals += 1
+                state["func_evals"] += 1
+
+            state["d"], state["t"] = d, t
+
+            if current_evals >= self.max_eval:
+                break
+            if float(np.abs(flat_grad).max()) <= self.tolerance_grad:
+                break
+            if float(np.abs(d * t).max()) <= self.tolerance_change:
+                break
+            if abs(loss - prev_loss) < self.tolerance_change:
+                break
+
+        return Tensor(jnp.asarray(loss, jnp.float32))
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list():
+            p.clear_grad()
+
+    def state_dict(self):
+        s = self._state
+        return {
+            "func_evals": s["func_evals"], "n_iter": s["n_iter"],
+            "old_sks": [np.asarray(v) for v in s["old_sks"]],
+            "old_yks": [np.asarray(v) for v in s["old_yks"]],
+            "ro": list(s["ro"]), "H_diag": s["H_diag"],
+            "d": None if s["d"] is None else np.asarray(s["d"]),
+            "t": s["t"],
+            "prev_flat_grad": None if s["prev_flat_grad"] is None
+            else np.asarray(s["prev_flat_grad"]),
+        }
+
+    def set_state_dict(self, state):
+        s = self._state
+        for k in ("func_evals", "n_iter", "ro", "H_diag", "t"):
+            if k in state:
+                s[k] = state[k]
+        for k in ("old_sks", "old_yks"):
+            if k in state:
+                s[k] = [np.asarray(v, np.float64) for v in state[k]]
+        for k in ("d", "prev_flat_grad"):
+            if k in state and state[k] is not None:
+                s[k] = np.asarray(state[k], np.float64)

@@ -92,6 +92,16 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
     n_fft, num_frames] complex."""
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
+    x_data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    w_probe = window._data if isinstance(window, Tensor) else window
+    if onesided and (jnp.iscomplexobj(x_data) or
+                     (w_probe is not None and
+                      jnp.iscomplexobj(w_probe))):
+        # Reference stft asserts onesided must be False for complex
+        # inputs; silently returning n_fft bins broke callers (ADVICE r3).
+        raise ValueError(
+            "stft: onesided is not supported for complex input or "
+            "complex window; pass onesided=False")
     if window is not None:
         w = window._data if isinstance(window, Tensor) else \
             jnp.asarray(window)

@@ -92,7 +92,8 @@ class unique_name:  # noqa: N801 — namespace (reference utils/unique_name.py)
 def enable_compile_cache(cache_dir=None, min_compile_secs=5):
     """Turn on jax's persistent XLA compilation cache (repo-local by
     default) — a cold process otherwise pays minutes of compile for the
-    large bench/serving programs."""
+    large bench/serving programs.  Returns the cache dir in use (None if
+    enabling failed), so callers can report hit/miss growth."""
     import os
 
     import jax
@@ -107,4 +108,5 @@ def enable_compile_cache(cache_dir=None, min_compile_secs=5):
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           min_compile_secs)
     except Exception:
-        pass  # an optimization, never a requirement
+        return None  # an optimization, never a requirement
+    return cache_dir

@@ -153,3 +153,101 @@ def test_traced_dropout_does_not_poison_generator():
         paddle.Tensor(x), 0.5, training=True)._data.sum())(
         jnp.ones((2, 4), jnp.float32))
     assert jnp.isfinite(out1) and jnp.isfinite(out2)
+
+
+# ---- round-4 ADVICE regressions -------------------------------------
+
+
+def test_index_add_fill_reference_arg_order():
+    """index_add/index_fill take (x, index, axis, value) positionally,
+    matching python/paddle/tensor/manipulation.py (ADVICE r3 medium)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.zeros((4, 3), np.float32))
+    idx = paddle.to_tensor(np.array([1, 2], np.int64))
+    v = paddle.to_tensor(np.ones((2, 3), np.float32))
+    out = paddle.index_add(x, idx, 0, v)
+    expect = np.zeros((4, 3), np.float32)
+    expect[[1, 2]] += 1.0
+    np.testing.assert_allclose(out.numpy(), expect)
+
+    filled = paddle.index_fill(x, idx, 0, -1.0)
+    expect = np.zeros((4, 3), np.float32)
+    expect[[1, 2]] = -1.0
+    np.testing.assert_allclose(filled.numpy(), expect)
+
+
+def test_spectral_norm_state_dict_roundtrip():
+    """u/v power-iteration buffers persist through state_dict as
+    '<name>_u'/'<name>_v' (reference spectral_norm_hook; ADVICE r3)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.utils import spectral_norm
+
+    lin = spectral_norm(paddle.nn.Linear(6, 5))
+    lin.train()
+    lin(paddle.to_tensor(np.ones((2, 6), np.float32)))  # power-iterate
+    sd = lin.state_dict()
+    assert "weight_u" in sd and "weight_v" in sd
+
+    lin2 = spectral_norm(paddle.nn.Linear(6, 5))
+    lin2.set_state_dict(sd)
+    np.testing.assert_allclose(lin2._buffers["weight_u"].numpy(),
+                               sd["weight_u"].numpy())
+    lin2.eval()
+    out2 = lin2(paddle.to_tensor(np.ones((2, 6), np.float32)))
+    lin.eval()
+    out1 = lin(paddle.to_tensor(np.ones((2, 6), np.float32)))
+    np.testing.assert_allclose(out1.numpy(), out2.numpy(), rtol=1e-5)
+
+
+def test_weight_norm_dim_none():
+    """dim=None normalizes over the whole tensor (reference
+    weight_norm_hook; ADVICE r3)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.utils import remove_weight_norm, weight_norm
+
+    lin = weight_norm(paddle.nn.Linear(4, 3), dim=None)
+    assert tuple(lin.weight_g.shape) == (1, 1)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    y1 = lin(x).numpy()
+    remove_weight_norm(lin)
+    y2 = lin(x).numpy()
+    np.testing.assert_allclose(y1, y2, rtol=1e-5)
+
+
+def test_stft_complex_onesided_raises():
+    """Complex input (or window) with onesided=True must raise, not
+    silently return n_fft bins (reference stft check; ADVICE r3)."""
+    import numpy as np
+    import pytest
+
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor((np.random.randn(64) +
+                          1j * np.random.randn(64)).astype(np.complex64))
+    with pytest.raises(ValueError):
+        paddle.signal.stft(x, n_fft=16)
+    out = paddle.signal.stft(x, n_fft=16, onesided=False)
+    assert out.shape[0] == 16
+
+
+def test_pairwise_distance_epsilon_sign():
+    """epsilon joins the signed difference before the norm (reference
+    pairwise_distance; ADVICE r3)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    a = np.array([[0.0, 1.0]], np.float32)
+    b = np.array([[1.0, 0.0]], np.float32)
+    eps = 1e-3
+    out = paddle.nn.functional.pairwise_distance(
+        paddle.to_tensor(a), paddle.to_tensor(b), epsilon=eps)
+    expect = np.sum(np.abs(a - b + eps) ** 2.0, -1) ** 0.5
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-6)

