@@ -1,0 +1,89 @@
+"""Profile the BERT bench step: device-op breakdown by category."""
+import glob, gzip, json, os, re, sys, time
+from collections import defaultdict
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from paddle_tpu.utils import enable_compile_cache
+enable_compile_cache()
+import jax
+
+
+def main():
+    from paddle_tpu import nn
+    from paddle_tpu.models.bert import BertConfig, BertForQuestionAnswering
+    from paddle_tpu.models.training import CompiledTrainStep
+
+    cfg = BertConfig.base()
+
+    class QATrain(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.qa = BertForQuestionAnswering(cfg)
+
+        def forward(self, ids, starts, ends):
+            return self.qa(ids, start_positions=starts, end_positions=ends)
+
+    model = QATrain()
+    model.train()
+    step = CompiledTrainStep(model, lr=3e-5, compute_dtype="bfloat16",
+                             remat=os.environ.get("REMAT", "1") == "1")
+    batch, seq = int(os.environ.get("B", "48")), 384
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    starts = rng.randint(0, seq, (batch,)).astype(np.int32)
+    ends = rng.randint(0, seq, (batch,)).astype(np.int32)
+
+    loss = step.step(ids, starts, ends)
+    jax.block_until_ready(getattr(loss, "_data", loss))
+    t0 = time.perf_counter()
+    loss = step.multi_step(10, ids, starts, ends)
+    jax.block_until_ready(getattr(loss, "_data", loss))
+    print(f"multi compile+run {time.perf_counter()-t0:.1f}s", flush=True)
+    t0 = time.perf_counter()
+    loss = step.multi_step(10, ids, starts, ends)
+    jax.block_until_ready(getattr(loss, "_data", loss))
+    dt = (time.perf_counter() - t0) / 10
+    print(f"step {dt*1e3:.1f} ms, {batch/dt:.1f} seq/s", flush=True)
+
+    logdir = "/tmp/bert_trace"
+    os.system(f"rm -rf {logdir}")
+    with jax.profiler.trace(logdir):
+        loss = step.multi_step(10, ids, starts, ends)
+        jax.block_until_ready(getattr(loss, "_data", loss))
+
+    paths = glob.glob(f"{logdir}/**/*.trace.json.gz", recursive=True)
+    if not paths:
+        print("no trace captured", flush=True)
+        return
+    with gzip.open(paths[0], "rt") as f:
+        trace = json.load(f)
+    pid_names = {e["pid"]: e["args"].get("name", "")
+                 for e in trace.get("traceEvents", [])
+                 if e.get("ph") == "M" and e.get("name") == "process_name"
+                 and "args" in e}
+    dev_pids = {p for p, n in pid_names.items() if "TPU" in n}
+    events = [e for e in trace["traceEvents"]
+              if e.get("ph") == "X" and e.get("dur")
+              and e.get("pid") in dev_pids
+              and "bytes_accessed" in e.get("args", {})]
+    agg = defaultdict(lambda: [0.0, 0, 0])
+    for e in events:
+        cat = e["args"].get("hlo_category", "?")
+        agg[cat][0] += e["dur"]; agg[cat][1] += 1
+        agg[cat][2] += int(e["args"]["bytes_accessed"])
+    print("category breakdown over 10 steps:")
+    for cat, (us, c, b) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
+        print(f"  {us/10000:8.2f} ms/step x{c//10:4d} {b/10/1e9:6.2f} GB  {cat}")
+    big = sorted(events, key=lambda e: -e["dur"])[:12]
+    seen = set()
+    for e in big:
+        n = e["name"]
+        if n in seen: continue
+        seen.add(n)
+        print(f"{e['dur']/1000:7.2f} ms {n[:40]} :: {e['args'].get('long_name','')[:160]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Profile the ResNet-50 bench step on the chip: capture an xprof trace
+and print the device-op time breakdown by category.
+"""
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+from paddle_tpu.utils import enable_compile_cache
+
+enable_compile_cache()
+
+import jax  # noqa: E402
+
+
+def main():
+    from paddle_tpu.models.training import CompiledTrainStep
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.vision.models import resnet50
+    import jax.numpy as jnp
+
+    model = resnet50(num_classes=1000)
+    model.train()
+    step = CompiledTrainStep(model, lr=0.1, compute_dtype="bfloat16",
+                             loss_fn=F.cross_entropy)
+    batch = int(os.environ.get("B", "256"))
+    rng = np.random.RandomState(0)
+    imgs = jnp.asarray(rng.randn(batch, 3, 224, 224), jnp.bfloat16)
+    labels = rng.randint(0, 1000, (batch,)).astype(np.int32)
+
+    loss = step.step(imgs, labels)
+    jax.block_until_ready(getattr(loss, "_data", loss))
+    loss = step.step(imgs, labels)
+    jax.block_until_ready(getattr(loss, "_data", loss))
+
+    t0 = time.perf_counter()
+    for _ in range(10):
+        loss = step.step(imgs, labels)
+    jax.block_until_ready(getattr(loss, "_data", loss))
+    dt = (time.perf_counter() - t0) / 10
+    print(f"step {dt*1e3:.1f} ms, {batch/dt:.0f} imgs/s", flush=True)
+
+    logdir = "/tmp/resnet_trace"
+    os.system(f"rm -rf {logdir}")
+    with jax.profiler.trace(logdir):
+        for _ in range(3):
+            loss = step.step(imgs, labels)
+        jax.block_until_ready(getattr(loss, "_data", loss))
+
+    # find trace.json.gz and aggregate device events
+    paths = glob.glob(f"{logdir}/**/*.trace.json.gz", recursive=True)
+    if not paths:
+        print("no trace captured", flush=True)
+        return
+    with gzip.open(paths[0], "rt") as f:
+        trace = json.load(f)
+    events = [e for e in trace.get("traceEvents", [])
+              if e.get("ph") == "X" and e.get("dur")]
+    # device events live on TPU pids; find pids whose name mentions TPU
+    pid_names = {e["pid"]: e["args"].get("name", "")
+                 for e in trace.get("traceEvents", [])
+                 if e.get("ph") == "M" and e.get("name") == "process_name"
+                 and "args" in e}
+    dev_pids = {p for p, n in pid_names.items()
+                if "TPU" in n or "tpu" in n or "/device" in n}
+    agg = defaultdict(float)
+    for e in events:
+        if dev_pids and e["pid"] not in dev_pids:
+            continue
+        name = e.get("name", "?")
+        agg[name] += e["dur"]
+    top = sorted(agg.items(), key=lambda kv: -kv[1])[:40]
+    total = sum(agg.values())
+    print(f"total device us over 3 steps: {total:.0f}")
+    for name, us in top:
+        print(f"{us/3000:9.2f} ms/step  {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -221,8 +221,7 @@ def _bench_detection(jax):
     box_t = rng.randn(batch, 4, 10, 10).astype(np.float32)
     cls_t = (rng.rand(batch, 80, 10, 10) > 0.95).astype(np.float32)
     print("detection: compiling...", file=sys.stderr)
-    dt, loss = _time_steps(step.step, (imgs, box_t, cls_t), 5,
-                           "detection")
+    dt, loss = _time_multi(step, (imgs, box_t, cls_t), 10, "detection")
     imgs_s = batch / dt
     print(f"detection: step {dt * 1e3:.1f} ms, {imgs_s:.0f} imgs/s",
           file=sys.stderr)
@@ -270,7 +269,7 @@ def _bench_unet(jax):
     ctx = rng.randn(batch, 77, 768).astype(np.float32)
     noise = rng.randn(batch, 4, 32, 32).astype(np.float32)
     print("unet: compiling (~810M params)...", file=sys.stderr)
-    dt, loss = _time_steps(step.step, (lat, t, ctx, noise), 5, "unet")
+    dt, loss = _time_multi(step, (lat, t, ctx, noise), 5, "unet")
     samples_s = batch / dt
     print(f"unet: step {dt * 1e3:.1f} ms, {samples_s:.1f} samples/s",
           file=sys.stderr)
@@ -303,15 +302,18 @@ def _bench_bert(jax):
 
     model = QATrain()
     model.train()
+    # remat off: B=48 activations fit HBM once attention probs stay in
+    # VMEM (short_attention kernel), and the refwd was ~25% of the step.
     step = CompiledTrainStep(model, lr=3e-5, compute_dtype="bfloat16",
-                             remat=True)
+                             remat=os.environ.get(
+                                 "PT_BENCH_BERT_REMAT", "0") == "1")
     batch, seq = (int(os.environ.get("PT_BENCH_BERT_BATCH", "48")), 384)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     starts = rng.randint(0, seq, (batch,)).astype(np.int32)
     ends = rng.randint(0, seq, (batch,)).astype(np.int32)
     print("bert: compiling...", file=sys.stderr)
-    dt, loss = _time_steps(step.step, (ids, starts, ends), 5, "bert")
+    dt, loss = _time_multi(step, (ids, starts, ends), 5, "bert")
     seqs_s = batch / dt
     tok_s = batch * seq / dt
     mfu = tok_s * model.qa.bert.flops_per_token(seq) / \
@@ -346,7 +348,7 @@ def _bench_resnet(jax):
     imgs = jnp.asarray(rng.randn(batch, 3, 224, 224), jnp.bfloat16)
     labels = rng.randint(0, 1000, (batch,)).astype(np.int32)
     print("resnet50: compiling...", file=sys.stderr)
-    dt, loss = _time_steps(step.step, (imgs, labels), 5, "resnet50")
+    dt, loss = _time_multi(step, (imgs, labels), 10, "resnet50")
     imgs_s = batch / dt
     # ~4.1 GFLOP fwd per 224x224 image; train ~= 3x fwd.
     mfu = imgs_s * 3 * 4.1e9 / _peak_flops_per_chip()
@@ -381,6 +383,27 @@ def _time_steps(step_fn, args, steps, tag):
         loss = step_fn(*args)
     # steps chain through the (donated) param state, so the last loss
     # being ready implies the whole sequence executed on device.
+    _sync(loss)
+    return (time.perf_counter() - t0) / steps, loss
+
+
+def _time_multi(step, args, steps, tag):
+    """Timed via CompiledTrainStep.multi_step: ``steps`` optimizer steps
+    per dispatched program (lax.scan), so per-dispatch tunnel latency
+    (~20 ms on this setup) doesn't tax short-step models.  Single-step
+    warmup first so the step body itself is cache-warm."""
+    t0 = time.perf_counter()
+    loss = step.step(*args)
+    _sync(loss)
+    print(f"{tag}: first step {time.perf_counter() - t0:.1f}s, "
+          f"loss {float(loss):.3f}", file=sys.stderr)
+    t0 = time.perf_counter()
+    loss = step.multi_step(steps, *args)
+    _sync(loss)
+    print(f"{tag}: multi-step compile+run {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+    t0 = time.perf_counter()
+    loss = step.multi_step(steps, *args)
     _sync(loss)
     return (time.perf_counter() - t0) / steps, loss
 

@@ -139,34 +139,50 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     return registry.apply(nn_ops.rms_norm_op, x, epsilon=float(epsilon))
 
 
+def _bn_running_update(running_mean, running_var, mean_t, var_t,
+                       momentum):
+    """Update running stats in place (reference batch_norm semantics).
+    NOT under a jit trace: storing a tracer into the persistent buffer
+    would leak it (UnexpectedTracerError on any later use) and the
+    "update" would never really happen.  Compiled train steps
+    (CompiledTrainStep) therefore train with batch stats and leave
+    running stats at their last eager value — functionalized buffer
+    updates ride the to_static path (jit/__init__.py), which returns
+    new buffer values explicitly."""
+    import jax as _jax
+
+    if running_mean is not None and not isinstance(
+            mean_t._data, _jax.core.Tracer):
+        m = momentum
+        running_mean.set_value(
+            m * running_mean._data + (1 - m) * mean_t._data)
+        running_var.set_value(
+            m * running_var._data + (1 - m) * var_t._data)
+
+
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training=False, momentum=0.9, epsilon=1e-5,
                data_format="NCHW", use_global_stats=None, name=None):
+    weight_a, bias_a = _norm_affine_pair(weight, bias)
+    if training and not use_global_stats and weight_a is not None \
+            and bias_a is not None:
+        # fused train-mode op: one stats pass + hand-written 2-pass VJP
+        # (see nn_ops._bn_train_fwd; r4 ResNet profile)
+        out, mean_t, var_t = registry.apply(
+            nn_ops.batch_norm_train_op, x, weight_a, bias_a,
+            epsilon=float(epsilon), data_format=data_format)
+        _bn_running_update(running_mean, running_var, mean_t, var_t,
+                           momentum)
+        return out
     if training and not use_global_stats:
         mean_t, var_t = registry.apply(nn_ops.batch_norm_stats_op, x,
                                        data_format=data_format)
-        # Update running stats in place (reference batch_norm semantics).
-        # NOT under a jit trace: storing a tracer into the persistent
-        # buffer would leak it (UnexpectedTracerError on any later use)
-        # and the "update" would never really happen.  Compiled train
-        # steps (CompiledTrainStep) therefore train with batch stats and
-        # leave running stats at their last eager value — functionalized
-        # buffer updates ride the to_static path (jit/__init__.py),
-        # which returns new buffer values explicitly.
-        import jax as _jax
-
-        if running_mean is not None and not isinstance(
-                mean_t._data, _jax.core.Tracer):
-            m = momentum
-            running_mean.set_value(
-                m * running_mean._data + (1 - m) * mean_t._data)
-            running_var.set_value(
-                m * running_var._data + (1 - m) * var_t._data)
+        _bn_running_update(running_mean, running_var, mean_t, var_t,
+                           momentum)
         use_mean, use_var = mean_t, var_t
     else:
         use_mean, use_var = running_mean, running_var
-    weight, bias = _norm_affine_pair(weight, bias)
-    args = [x, use_mean, use_var] + [a for a in (weight, bias)
+    args = [x, use_mean, use_var] + [a for a in (weight_a, bias_a)
                                      if a is not None]
     return registry.apply(nn_ops.batch_norm_infer_op, *args,
                           epsilon=float(epsilon), data_format=data_format)
@@ -349,7 +365,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     if dropout_p > 0.0 and training:
         from ...ops.random import default_generator
 
-        drop_key = default_generator.next_key()
+        drop_key = default_generator.next_fast_key()
     return registry.apply(nn_ops.sdpa_op, query, key, value, attn_mask,
                           drop_key, dropout=float(dropout_p),
                           causal=bool(is_causal), impl=impl,

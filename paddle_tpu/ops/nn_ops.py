@@ -303,6 +303,67 @@ batch_norm_stats_op = register_op(
     static_argnames=("data_format",))
 
 
+def _bn_axes_shape(ndim, data_format):
+    if data_format == "NCHW" and ndim == 4:
+        return (0, 2, 3), (1, -1, 1, 1)
+    if ndim == 2:
+        return (0,), (1, -1)
+    return tuple(range(ndim - 1)), (1,) * (ndim - 1) + (-1,)
+
+
+def _bn_train_fwd(x, w, b, epsilon=1e-5, data_format="NCHW"):
+    """Fused training-mode batch norm (reference batch_norm_kernel.cu
+    role).  One fp32 sum/sumsq pass for the stats (E[x²]−E[x]², a
+    single multi-output XLA fusion) instead of jnp.mean + jnp.var's
+    separate passes — profiled r4: reduction fusions were 52% of the
+    ResNet step."""
+    axes, shape = _bn_axes_shape(x.ndim, data_format)
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    xf = x.astype(jnp.float32)
+    s = jnp.sum(xf, axis=axes)
+    ss = jnp.sum(xf * xf, axis=axes)
+    mean = s / n
+    var = jnp.maximum(ss / n - mean * mean, 0.0)
+    inv = jax.lax.rsqrt(var + epsilon)
+    dt = x.dtype
+    xhat = (x - mean.astype(dt).reshape(shape)) \
+        * inv.astype(dt).reshape(shape)
+    y = xhat * w.astype(dt).reshape(shape) + b.astype(dt).reshape(shape)
+    return (y, mean, var), (x, w, mean, inv)
+
+
+def _bn_train_bwd(saved, g, epsilon=1e-5, data_format="NCHW"):
+    """2-pass BN backward: one fused (Σgy, Σgy·x̂) reduction + one
+    elementwise dx pass — replaces autodiff's per-term reductions."""
+    x, w, mean, inv = saved
+    gy = g[0] if isinstance(g, (tuple, list)) else g
+    axes, shape = _bn_axes_shape(x.ndim, data_format)
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    dt = x.dtype
+    xhat = (x - mean.astype(dt).reshape(shape)) \
+        * inv.astype(dt).reshape(shape)
+    gyf = gy.astype(jnp.float32)
+    dbeta = jnp.sum(gyf, axis=axes)
+    dgamma = jnp.sum(gyf * xhat.astype(jnp.float32), axis=axes)
+    wi = (w.astype(jnp.float32) * inv).astype(dt).reshape(shape)
+    dx = wi * (gy
+               - (dbeta / n).astype(dt).reshape(shape)
+               - xhat * (dgamma / n).astype(dt).reshape(shape))
+    return (dx, dgamma.astype(w.dtype), dbeta.astype(w.dtype))
+
+
+batch_norm_train_op = register_op(
+    "batch_norm_train",
+    lambda x, w, b, epsilon=1e-5, data_format="NCHW":
+    _bn_train_fwd(x, w, b, epsilon, data_format)[0],
+    fwd=_bn_train_fwd, bwd=_bn_train_bwd, n_outputs=3,
+    static_argnames=("epsilon", "data_format"))
+
+
 def _group_norm_plain(x, weight=None, bias=None, epsilon=1e-5, groups=32,
                       data_format="NCHW"):
     if data_format != "NCHW":
@@ -546,7 +607,8 @@ class _DropoutOp:
 
     @staticmethod
     def fwd(x, p=0.5, mode="upscale_in_train"):
-        return _dropout_jit(x, default_generator.next_key(), p=p, mode=mode)
+        return _dropout_jit(x, default_generator.next_fast_key(), p=p,
+                            mode=mode)
 
 
 dropout_op = _DropoutOp()
@@ -678,10 +740,16 @@ def _sdpa_plain(q, k, v, mask=None, key=None, dropout=0.0, causal=False,
     this from its head-broadcast support; repeat_interleave would burn
     HBM bandwidth).
 
-    impl: "einsum" = XLA fused softmax-attention; "flash" = Pallas TPU
-    flash kernel (requires TPU, no mask/dropout, Sq==Sk, D%128==0);
-    "auto" = einsum, with flash reserved for long sequences where the
-    O(S^2) logits no longer fit the einsum path's HBM budget.
+    impl: "einsum" = XLA fused softmax-attention; "short" = the
+    self-authored VMEM-resident Pallas kernel (TPU, no mask, Sq==Sk,
+    S<=1024, S%128==0, D in {64, 128}, no GQA; supports in-kernel
+    dropout); "flash" = stock Pallas flash kernel (TPU, no
+    mask/dropout, Sq==Sk, D%128==0, S%512==0); "auto" picks short
+    where its whole-[S,S]-in-VMEM regime applies, flash for long
+    causal sequences (S>=1024), einsum otherwise.  The Pallas paths
+    round differently from einsum (bf16 MXU accumulation) and the
+    short kernel's dropout mask comes from its in-kernel counter hash,
+    not the host key stream.
     """
     B, Sq, H, D = q.shape
     Hkv, Sk = k.shape[2], k.shape[1]
@@ -690,9 +758,39 @@ def _sdpa_plain(q, k, v, mask=None, key=None, dropout=0.0, causal=False,
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
 
+    on_tpu = jax.devices()[0].platform == "tpu"
+    # Self-authored short-sequence kernel (pallas_kernels/short_attention):
+    # whole [S,S] scores VMEM-resident, in-kernel hardware-PRNG dropout.
+    # Wins whenever one head's scores fit VMEM (S <= 1024); at those
+    # sizes the einsum path's HBM round-trips of [B,H,S,S] probs (and
+    # dropout masks) dominate (r4 BERT profile).
+    short_ok = (mask is None and Sq == Sk and Sq <= 1024
+                and Sq % 128 == 0 and D % 64 == 0 and D <= 128
+                and Hkv == H and on_tpu)
+    use_short = short_ok and (impl == "auto" or impl == "short")
+    if impl == "short" and not short_ok:
+        raise ValueError(
+            "impl='short' requires: TPU, no attn_mask, Sq == Sk <= "
+            f"1024, seq % 128 == 0, head_dim % 64 == 0, no GQA; got "
+            f"Sq={Sq} Sk={Sk} D={D} H={H} Hkv={Hkv} "
+            f"mask={mask is not None}")
+    if use_short:
+        from .pallas_kernels import short_attention
+
+        if key is not None:
+            seed = jax.random.key_data(key).ravel()[-1].astype(jnp.int32)
+            p_drop = float(dropout)
+        else:
+            seed = jnp.zeros((), jnp.int32)
+            p_drop = 0.0
+        with jax.enable_x64(False):
+            out = short_attention(qt, kt, vt, seed, float(scale),
+                                  p_drop, bool(causal))
+        return jnp.swapaxes(out, 1, 2)
+
     flash_ok = (mask is None and key is None and Sq == Sk
                 and D % 128 == 0 and Sq % 512 == 0
-                and jax.devices()[0].platform == "tpu")
+                and on_tpu)
     if impl == "flash" and not flash_ok:
         raise ValueError(
             "impl='flash' requires: TPU backend, no attn_mask, no dropout, "

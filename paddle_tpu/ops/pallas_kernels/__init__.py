@@ -1,0 +1,12 @@
+"""Self-authored Pallas TPU kernels (the repo's analog of the
+reference's hand-written fusion kernels, paddle/phi/kernels/fusion/).
+
+Unlike ``jax.experimental.pallas.ops.tpu`` stock kernels, these are
+designed for this framework's hot paths and profiles:
+
+- ``short_attention``: fused attention + softmax + DROPOUT for short
+  sequences (BERT-class S<=1024), where materializing [B,H,S,S] probs
+  and their dropout masks in HBM dominated the step (r4 profile:
+  ~60 ms of a 180 ms BERT step).
+"""
+from .short_attention import short_attention  # noqa: F401

@@ -44,6 +44,33 @@ class Generator:
     def _ensure_key(self):
         if self._key is None:
             self._key = jax.random.key(self._seed)
+            self._fast_key = None
+
+    def next_fast_key(self):
+        """Key for mask-class randomness (dropout): on TPU uses the
+        ``rbg`` generator (hardware PRNG; measured ~2x cheaper per
+        [B,H,S,S] mask than threefry, which is generated THREE times
+        per mask under remat).  Statistical quality is ample for
+        dropout; user-facing sampling keeps the threefry stream, so
+        paddle.seed reproducibility of tensors is unchanged."""
+        with self._lock:
+            self._ensure_key()
+            if getattr(self, "_fast_key", None) is None:
+                # concrete even when first touched inside a jit trace
+                with jax.ensure_compile_time_eval():
+                    try:
+                        self._fast_key = jax.random.key(self._seed,
+                                                        impl="rbg")
+                    except Exception:  # backend without rbg support
+                        self._fast_key = jax.random.key(self._seed)
+            new_key, sub = jax.random.split(self._fast_key)
+            if isinstance(new_key, jax.core.Tracer):
+                with jax.ensure_compile_time_eval():
+                    new_key, sub = jax.random.split(self._fast_key)
+                if isinstance(new_key, jax.core.Tracer):
+                    return jax.random.fold_in(self._fast_key, 0)
+            self._fast_key = new_key
+            return sub
 
     def next_key(self):
         with self._lock:

@@ -33,3 +33,5 @@ save_inference_model = _subsumed("save_inference_model",
                                  "paddle_tpu.jit.save")
 load_inference_model = _subsumed("load_inference_model",
                                  "paddle_tpu.jit.load")
+
+from . import nn  # noqa: E402,F401  (compiled control flow, r4)

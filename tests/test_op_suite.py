@@ -754,6 +754,10 @@ op("cholesky_inverse",
    atol=1e-4, bf16=False)
 op("histogramdd_", lambda: None, [], None, grad=False, bf16=False,
    covers=("histogramdd",))
+op("batch_norm_train",
+   lambda x, w, b: F.batch_norm(x, None, None, w, b, training=True),
+   [fa(4, 3, 5, 5), fpos(3), fa(3)], None, grad_inputs=[0, 1, 2],
+   atol=1e-5)
 
 # ---------------------------------------------------------------------------
 
