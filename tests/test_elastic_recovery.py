@@ -1,0 +1,171 @@
+"""Elastic recovery (VERDICT r3 missing #5 / next-round #10):
+
+1. kill-and-recover: a worker crashes mid-train; the launch watcher
+   restarts it (--max_restart) and training RESUMES from its last
+   checkpoint rather than step 0.
+2. --max_restart exhaustion fails the job.
+3. ElasticManager scale semantics within nnodes=min:max — losing a
+   node above min triggers RESTART at the smaller world; falling
+   below min HOLDs then ERRORs after elastic_timeout.
+
+Reference: fleet/elastic/manager.py:124 (membership/scale),
+launch controllers' restart loop.
+"""
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TRAIN_SCRIPT = r"""
+import json, os, sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+work = sys.argv[1]
+total_steps = int(sys.argv[2])
+crash_at = int(sys.argv[3])  # rank 1 dies here on its FIRST life
+
+ckpt = os.path.join(work, f"ckpt_rank{rank}.pdparams")
+marker = os.path.join(work, f"crashed_rank{rank}")
+
+net = paddle.nn.Linear(4, 4)
+opt = paddle.optimizer.SGD(learning_rate=0.1,
+                           parameters=net.parameters())
+start = 0
+if os.path.exists(ckpt):
+    state = paddle.load(ckpt)
+    net.set_state_dict(state["net"])
+    start = int(state["step"])
+    with open(os.path.join(work, f"resumed_rank{rank}"), "w") as f:
+        f.write(str(start))
+
+rng = np.random.RandomState(0)
+x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+for step in range(start, total_steps):
+    loss = (net(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    paddle.save({"net": net.state_dict(), "step": step + 1}, ckpt)
+    if rank == 1 and step + 1 == crash_at and not os.path.exists(marker):
+        open(marker, "w").write("x")
+        os._exit(17)
+
+with open(os.path.join(work, f"done_rank{rank}"), "w") as f:
+    f.write(str(total_steps))
+"""
+
+
+def _run_launch(work, max_restart, total_steps=6, crash_at=3,
+                timeout=180):
+    script = os.path.join(work, "train.py")
+    with open(script, "w") as f:
+        f.write(TRAIN_SCRIPT)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", "2", "--max_restart", str(max_restart),
+           "--log_dir", os.path.join(work, "logs"),
+           script, work, str(total_steps), str(crash_at)]
+    return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def test_killed_worker_restarts_from_checkpoint():
+    with tempfile.TemporaryDirectory() as work:
+        res = _run_launch(work, max_restart=2)
+        assert res.returncode == 0, res.stderr[-2000:]
+        assert "restart 1/2" in res.stderr
+        # rank 1 actually crashed once, then resumed from its checkpoint
+        assert os.path.exists(os.path.join(work, "crashed_rank1"))
+        resumed = os.path.join(work, "resumed_rank1")
+        assert os.path.exists(resumed), "restart did not resume"
+        assert int(open(resumed).read()) == 3  # continued at crash step
+        for r in (0, 1):
+            assert os.path.exists(os.path.join(work, f"done_rank{r}"))
+
+
+def test_max_restart_exhaustion_fails_job():
+    with tempfile.TemporaryDirectory() as work:
+        # crash_at == every life: marker per incarnation prevents that,
+        # so instead allow 0 restarts — the single crash kills the job.
+        res = _run_launch(work, max_restart=0)
+        assert res.returncode == 17
+        assert "giving up" in res.stderr
+
+
+class _FakeKV:
+    """In-memory stand-in for the launch HTTP master's KV store."""
+
+    def __init__(self):
+        self.d = {}
+
+    def put(self, k, v):
+        self.d[k] = v
+
+    def delete(self, k):
+        self.d.pop(k, None)
+
+    def get_prefix(self, scope):
+        return {k: v for k, v in self.d.items()
+                if k.startswith(scope)}
+
+
+def test_elastic_manager_scale_within_range():
+    from paddle_tpu.distributed.fleet.elastic import (
+        ElasticManager, ElasticStatus)
+
+    managers = []
+    kv = _FakeKV()
+    for rank in range(3):
+        em = ElasticManager("unused", "job1", np="2:4",
+                            host=f"h{rank}", rank=rank,
+                            heartbeat_interval=0.1, lease_ttl=0.5,
+                            elastic_timeout=1.0)
+        em.kv = kv
+        em.register()
+        managers.append(em)
+    watcher = managers[0]
+    assert watcher.enable  # 2:4 is elastic
+    assert watcher.watch() == ElasticStatus.HOLD  # baseline snapshot
+    assert sorted(watcher.alive_nodes()) == [0, 1, 2]
+
+    # node 2 dies (stop its heartbeat; lease expires)
+    managers[2]._stop.set()
+    kv.delete(managers[2]._lease_key())
+    time.sleep(0.2)
+    # alive (2) >= min (2): coordinated restart at the smaller world
+    assert watcher.watch() == ElasticStatus.RESTART
+    assert sorted(watcher.alive_nodes()) == [0, 1]
+    assert watcher.watch() == ElasticStatus.HOLD  # stable again
+
+    # node 1 dies too -> below min: HOLD, then ERROR after timeout
+    managers[1]._stop.set()
+    kv.delete(managers[1]._lease_key())
+    assert watcher.watch() == ElasticStatus.HOLD
+    time.sleep(1.2)
+    assert watcher.watch() == ElasticStatus.ERROR
+
+    # a scale-UP within max: two new nodes join
+    for rank in (1, 2):
+        em = ElasticManager("unused", "job1", np="2:4",
+                            host=f"h{rank}b", rank=rank,
+                            heartbeat_interval=0.1, lease_ttl=0.5)
+        em.kv = kv
+        em.register()
+        managers.append(em)
+    time.sleep(0.2)
+    assert watcher.watch() == ElasticStatus.RESTART
+    assert sorted(watcher.alive_nodes()) == [0, 1, 2]
+    for em in managers:
+        em._stop.set()
