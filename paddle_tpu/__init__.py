@@ -31,6 +31,16 @@ from .core.flags import get_flags, set_flags  # noqa: F401
 
 # Ops: the flat tensor-op namespace (paddle.add, paddle.matmul, ...).
 from .ops import *  # noqa: F401,F403
+from .core.dtype import (  # noqa: F401
+    dtype, float8_e4m3fn, float8_e5m2, bool_ as bool,  # noqa: A004
+)
+from .nn.param_attr import ParamAttr  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
+from ._misc_api import (  # noqa: F401
+    tolist, create_parameter, batch, LazyGuard, disable_signal_handler,
+    check_shape, get_cuda_rng_state, set_cuda_rng_state,
+)
+
 from .ops import (  # noqa: F401
     abs, all, any, max, min, pow, sum,  # shadow builtins intentionally
 )

@@ -76,3 +76,105 @@ def broadcast_tensors(inputs, name=None):
              for x in inputs]
     shape = jnp.broadcast_shapes(*[d.shape for d in datas])
     return [Tensor(jnp.broadcast_to(d, shape)) for d in datas]
+
+
+# -- round-4 top-level tail (closing the reference __all__ gap) -------------
+
+def tolist(x):
+    """reference tensor.tolist."""
+    import numpy as _np
+
+    return _np.asarray(getattr(x, "_data", x)).tolist()
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """reference tensor/creation.create_parameter: a free-standing
+    trainable Tensor (parameter outside a Layer)."""
+    import numpy as _np
+
+    import jax.numpy as _jnp
+
+    from .core import dtype as _dt
+    from .core.tensor import Tensor
+
+    dt = _dt.convert_dtype(dtype)
+    if default_initializer is not None:
+        t = Tensor(_jnp.zeros(tuple(int(s) for s in shape), dt))
+        default_initializer(t)
+    else:
+        fan_in = int(_np.prod(shape[:-1])) if len(shape) > 1 else 1
+        bound = float(_np.sqrt(6.0 / max(fan_in + int(shape[-1]), 1))) \
+            if not is_bias else 0.0
+        from .ops.random import default_generator
+
+        import jax as _jax
+
+        if bound > 0:
+            val = _jax.random.uniform(
+                default_generator.next_key(),
+                tuple(int(s) for s in shape), _jnp.float32,
+                -bound, bound).astype(dt)
+        else:
+            val = _jnp.zeros(tuple(int(s) for s in shape), dt)
+        t = Tensor(val)
+    t.stop_gradient = False
+    return t
+
+
+def batch(reader, batch_size, drop_last=False):
+    """reference paddle.batch: wrap a sample reader into a batch
+    reader."""
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
+
+
+class LazyGuard:
+    """reference paddle.LazyGuard: delay parameter materialization
+    inside the guard.  Layers here already initialize lazily per-call
+    cost-free (jax arrays are cheap until used), so the guard is a
+    scoping no-op kept for API compatibility."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def disable_signal_handler():
+    """reference paddle.disable_signal_handler: the C++ runtime's
+    signal interception doesn't exist here — nothing to disable."""
+
+
+def check_shape(shape):
+    """reference paddle.check_shape (shape sanity for static ops)."""
+    if shape is None:
+        raise ValueError("shape must not be None")
+    for s in (shape if isinstance(shape, (list, tuple)) else [shape]):
+        if not isinstance(s, int) and s is not None:
+            raise TypeError(f"shape entries must be int, got {type(s)}")
+    return True
+
+
+def get_cuda_rng_state():
+    """CUDA-compat alias of the device RNG state (reference
+    get_cuda_rng_state; one key stream serves all devices here)."""
+    from .ops.random import get_rng_state
+
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    from .ops.random import set_rng_state
+
+    set_rng_state(state)

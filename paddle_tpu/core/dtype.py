@@ -112,3 +112,8 @@ def set_default_dtype(d):
 
 def get_default_dtype():
     return _default_dtype
+
+
+# paddle.dtype — the dtype TYPE itself (reference framework/dtype.py
+# exposes `paddle.dtype` as the class of dtype objects).
+dtype = jnp.dtype

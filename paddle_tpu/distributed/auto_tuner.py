@@ -153,3 +153,86 @@ class AutoTuner:
             if tp is not None and tp > best_tp:
                 best, best_tp = c, tp
         return best, self.history
+
+
+    # -- trial-job orchestration (reference tuner.py relaunch loop) --------
+    def tune_with_relaunch(self, trial_script, max_trials=8,
+                           n_devices=None, timeout=600,
+                           python=None, extra_env=None):
+        """Run each trial as a RELAUNCHED subprocess (the reference
+        auto_tuner's job-relaunch semantics): an OOM/compile crash
+        kills only that trial, and each trial sees a fresh runtime.
+
+        ``trial_script`` is a python file that reads the candidate
+        config from the PT_TUNER_CONFIG env var (JSON) and prints
+        ``PT_TUNER_THROUGHPUT=<float>`` on success.  ``n_devices``
+        forces the virtual CPU mesh for device-free tuning (the
+        dryrun pattern)."""
+        import json as _json
+        import os as _os
+        import subprocess as _sp
+        import sys as _sys
+
+        kept, _ = self.prune()
+        kept.sort(key=self.estimate_cost)
+        self.history = []
+        best, best_tp = None, -1.0
+        for c in kept[:max_trials]:
+            env = dict(_os.environ)
+            env["PT_TUNER_CONFIG"] = _json.dumps(c.as_dict())
+            if n_devices:
+                env["JAX_PLATFORMS"] = "cpu"
+                env["XLA_FLAGS"] = (
+                    env.get("XLA_FLAGS", "")
+                    + f" --xla_force_host_platform_device_count="
+                      f"{n_devices}").strip()
+            if extra_env:
+                env.update(extra_env)
+            try:
+                res = _sp.run([python or _sys.executable,
+                               trial_script], env=env,
+                              capture_output=True, text=True,
+                              timeout=timeout)
+            except _sp.TimeoutExpired:
+                self.history.append({"config": c.as_dict(),
+                                     "error": "timeout"})
+                continue
+            tp = None
+            for line in res.stdout.splitlines():
+                if line.startswith("PT_TUNER_THROUGHPUT="):
+                    tp = float(line.split("=", 1)[1])
+            if res.returncode != 0 or tp is None:
+                self.history.append({
+                    "config": c.as_dict(), "rc": res.returncode,
+                    "error": (res.stderr or res.stdout)[-200:]})
+                continue
+            self.history.append({"config": c.as_dict(),
+                                 "throughput": tp})
+            if tp > best_tp:
+                best, best_tp = c, tp
+        return best, self.history
+
+    # -- recorder (reference recorder.py) ----------------------------------
+    def save_history(self, path):
+        """History -> CSV sorted best-first (reference
+        recorder.py History_recorder.store_history)."""
+        import csv
+
+        def _key(h):
+            tp = h.get("throughput")
+            return -tp if tp is not None else 1.0  # failures last
+
+        rows = sorted(self.history, key=_key)
+        cols = ["dp_degree", "mp_degree", "pp_degree",
+                "sharding_degree", "micro_batch_size", "throughput",
+                "est_cost", "error"]
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(cols)
+            for h in rows:
+                cfg = h.get("config", {})
+                w.writerow([cfg.get(k, "") for k in cols[:5]]
+                           + [h.get("throughput", ""),
+                              h.get("est_cost", ""),
+                              h.get("error", "")])
+        return path

@@ -31,14 +31,69 @@ class Config:
                 f"(got params_path={params_path!r})")
         self._device = None
 
-    def enable_use_gpu(self, *a, **k):  # compat no-op: device is jax's
-        pass
+    def enable_use_gpu(self, memory_pool_init_size_mb=100,
+                       device_id=0, *a, **k):
+        """Device binding (reference Config::EnableUseGpu).  Maps onto
+        the accelerator jax exposes; device_id selects among local
+        devices."""
+        self._device = ("accel", int(device_id))
 
     def disable_gpu(self):
-        pass
+        self._device = ("cpu", 0)
 
     def set_cpu_math_library_num_threads(self, n):
+        self._cpu_threads = int(n)
+
+    # -- analysis/optimization toggles (analysis_predictor.h:105) ------
+    # XLA always runs its own pass pipeline; these record the
+    # reference's knobs and steer the pieces that exist here.
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = bool(flag)
+
+    def enable_memory_optim(self, flag=True):
+        """Reference memory-optim pass -> jax buffer donation on run()
+        inputs (the analog: reuse input buffers for activations)."""
+        self._memory_optim = bool(flag)
+
+    def memory_optim_enabled(self):
+        return getattr(self, "_memory_optim", False)
+
+    def enable_mkldnn(self):
+        pass  # x86-only backend knob; XLA:CPU handles vectorization
+
+    def enable_tensorrt_engine(self, *a, **k):
+        raise NotImplementedError(
+            "TensorRT is a CUDA engine; the XLA pipeline is always on "
+            "— precision is controlled via enable_low_precision()")
+
+    def enable_low_precision(self, dtype="bfloat16"):
+        """Serve in low precision (the EnableTensorRtEngine precision
+        analog on TPU): weights+compute cast at load."""
+        self._low_precision = str(dtype)
+
+    def switch_use_feed_fetch_ops(self, flag=False):
         pass
+
+    def switch_specify_input_names(self, flag=True):
+        pass
+
+    def set_model(self, model_path, params_path=None):
+        self.model_path = model_path
+
+    def model_dir(self):
+        return self.model_path
+
+    def summary(self):
+        rows = [("model_path", str(self.model_path)),
+                ("device", str(getattr(self, "_device", None))),
+                ("ir_optim", str(getattr(self, "_ir_optim", True))),
+                ("memory_optim",
+                 str(getattr(self, "_memory_optim", False))),
+                ("low_precision",
+                 str(getattr(self, "_low_precision", None)))]
+        w = max(len(k) for k, _ in rows) + 2
+        return "\n".join(f"{k:<{w}}{v}" for k, v in rows)
 
 
 class Predictor:
@@ -72,6 +127,7 @@ class Predictor:
         else:
             raise TypeError(f"Predictor expects Config or Layer, got "
                             f"{type(source)}")
+        self._config = source if isinstance(source, Config) else None
         self.layer.eval()
         self._jitted = None
 
@@ -82,6 +138,23 @@ class Predictor:
 
         layer = self.layer
         self._params = param_tree(layer, trainable_only=False)
+        cfg = self._config
+        if cfg is not None and getattr(cfg, "_low_precision", None):
+            import jax.numpy as jnp
+
+            from ..core import dtype as _dt
+
+            lp = _dt.convert_dtype(cfg._low_precision)
+            self._params = {
+                k: (v.astype(lp)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                for k, v in self._params.items()}
+        if cfg is not None and getattr(cfg, "_device", None):
+            kind, idx = cfg._device
+            devs = (jax.devices("cpu") if kind == "cpu"
+                    else jax.devices())
+            dev = devs[min(idx, len(devs) - 1)]
+            self._params = jax.device_put(self._params, dev)
 
         def fwd(params, *inputs):
             return functional_call(layer, params, *inputs)

@@ -53,3 +53,17 @@ from .misc_layers import (  # noqa: F401,E402
     Unflatten, ZeroPad2D,
 )
 from . import utils  # noqa: F401,E402
+
+from .norm import InstanceNorm1D, InstanceNorm3D  # noqa: F401,E402
+from .rnn import RNNCellBase  # noqa: F401,E402
+from .layers_nd import (  # noqa: F401,E402
+    AdaptiveAvgPool1D, AdaptiveAvgPool3D, AdaptiveLogSoftmaxWithLoss,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D,
+    AvgPool3D, BeamSearchDecoder, Conv1DTranspose, Conv3D,
+    Conv3DTranspose, FeatureAlphaDropout, FractionalMaxPool2D,
+    FractionalMaxPool3D, HSigmoidLoss, LPPool1D, LPPool2D, MaxPool1D,
+    MaxPool3D, MaxUnPool1D, MaxUnPool2D, MaxUnPool3D, Maxout,
+    MultiMarginLoss, RNNTLoss, Softmax2D, SpectralNorm,
+    TripletMarginWithDistanceLoss, ZeroPad1D, ZeroPad3D,
+    dynamic_decode,
+)

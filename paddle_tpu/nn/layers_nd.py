@@ -1,0 +1,533 @@
+"""Layer classes for the round-4 functional tail (N-d conv/pool,
+dropout variants, loss layers, beam-search decoding).
+
+Reference: ``python/paddle/nn/layer/{conv,pooling,common,loss,norm}.py``
+and ``python/paddle/nn/decode.py`` (BeamSearchDecoder:138,
+dynamic_decode:996).  Thin class wrappers over ``nn.functional``; the
+decode machinery drives any RNNCellBase with a beam-expanded state.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import functional as F
+from . import initializer as I
+from .layers import Layer
+
+
+def _ntuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+# -- conv --------------------------------------------------------------------
+
+class _ConvNd(Layer):
+    def __init__(self, nd, transpose, in_channels, out_channels,
+                 kernel_size, stride, padding, output_padding, dilation,
+                 groups, weight_attr, bias_attr, data_format):
+        super().__init__()
+        k = _ntuple(kernel_size, nd)
+        self._nd = nd
+        self._transpose = transpose
+        self._stride = stride
+        self._padding = padding
+        self._output_padding = output_padding
+        self._dilation = dilation
+        self._groups = groups
+        fan_in = in_channels * int(np.prod(k)) // groups
+        if transpose:
+            shape = [in_channels, out_channels // groups, *k]
+        else:
+            shape = [out_channels, in_channels // groups, *k]
+        self.weight = self.create_parameter(
+            shape=shape, attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in))
+        bound = 1.0 / np.sqrt(fan_in)
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                shape=[out_channels], attr=bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
+        else:
+            self.bias = None
+
+
+class Conv3D(_ConvNd):
+    """reference nn/layer/conv.py Conv3D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(3, False, in_channels, out_channels,
+                         kernel_size, stride, padding, 0, dilation,
+                         groups, weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups)
+
+
+class Conv1DTranspose(_ConvNd):
+    """reference nn/layer/conv.py Conv1DTranspose."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(1, True, in_channels, out_channels,
+                         kernel_size, stride, padding, output_padding,
+                         dilation, groups, weight_attr, bias_attr,
+                         data_format)
+
+    def forward(self, x, output_size=None):
+        return F.conv1d_transpose(
+            x, self.weight, self.bias, self._stride, self._padding,
+            self._output_padding, self._groups, self._dilation)
+
+
+class Conv3DTranspose(_ConvNd):
+    """reference nn/layer/conv.py Conv3DTranspose."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(3, True, in_channels, out_channels,
+                         kernel_size, stride, padding, output_padding,
+                         dilation, groups, weight_attr, bias_attr,
+                         data_format)
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(
+            x, self.weight, self.bias, self._stride, self._padding,
+            self._output_padding, self._groups, self._dilation)
+
+
+# -- pooling -----------------------------------------------------------------
+
+def _pool_layer(fn_name, n, has_exclusive=False, lp=False):
+    class _Pool(Layer):
+        def __init__(self, kernel_size=None, stride=None, padding=0,
+                     ceil_mode=False, exclusive=True, return_mask=False,
+                     norm_type=None, data_format=None, name=None):
+            super().__init__()
+            if lp:
+                # LPPool signature: (norm_type, kernel_size, ...)
+                norm_type, kernel_size = kernel_size, stride
+                stride = None
+            self.kernel_size = kernel_size
+            self.stride = stride
+            self.padding = padding
+            self.ceil_mode = ceil_mode
+            self.exclusive = exclusive
+            self.return_mask = return_mask
+            self.norm_type = norm_type
+
+        def forward(self, x):
+            fn = getattr(F, fn_name)
+            if lp:
+                return fn(x, self.norm_type, self.kernel_size,
+                          self.stride, self.padding, self.ceil_mode)
+            kw = {}
+            if "max" in fn_name:
+                kw["return_mask"] = self.return_mask
+            elif has_exclusive:
+                kw["exclusive"] = self.exclusive
+            return fn(x, self.kernel_size, self.stride, self.padding,
+                      **kw)
+
+    _Pool.__name__ = fn_name.title().replace("_", "")
+    return _Pool
+
+
+MaxPool1D = _pool_layer("max_pool1d", 1)
+MaxPool3D = _pool_layer("max_pool3d", 3)
+AvgPool1D = _pool_layer("avg_pool1d", 1, has_exclusive=True)
+AvgPool3D = _pool_layer("avg_pool3d", 3, has_exclusive=True)
+
+
+class LPPool1D(Layer):
+    """reference nn/layer/pooling.py LPPool1D."""
+
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.norm_type = norm_type
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+
+    def forward(self, x):
+        return F.lp_pool1d(x, self.norm_type, self.kernel_size,
+                           self.stride, self.padding, self.ceil_mode)
+
+
+class LPPool2D(LPPool1D):
+    """reference nn/layer/pooling.py LPPool2D."""
+
+    def forward(self, x):
+        return F.lp_pool2d(x, self.norm_type, self.kernel_size,
+                           self.stride, self.padding, self.ceil_mode)
+
+
+def _adaptive_layer(fn_name):
+    class _Adaptive(Layer):
+        def __init__(self, output_size, return_mask=False,
+                     data_format=None, name=None):
+            super().__init__()
+            self.output_size = output_size
+            self.return_mask = return_mask
+
+        def forward(self, x):
+            fn = getattr(F, fn_name)
+            if "max" in fn_name:
+                return fn(x, self.output_size,
+                          return_mask=self.return_mask)
+            return fn(x, self.output_size)
+
+    _Adaptive.__name__ = fn_name.title().replace("_", "")
+    return _Adaptive
+
+
+AdaptiveAvgPool1D = _adaptive_layer("adaptive_avg_pool1d")
+AdaptiveAvgPool3D = _adaptive_layer("adaptive_avg_pool3d")
+AdaptiveMaxPool1D = _adaptive_layer("adaptive_max_pool1d")
+AdaptiveMaxPool2D = _adaptive_layer("adaptive_max_pool2d")
+AdaptiveMaxPool3D = _adaptive_layer("adaptive_max_pool3d")
+
+
+def _unpool_layer(fn_name):
+    class _Unpool(Layer):
+        def __init__(self, kernel_size, stride=None, padding=0,
+                     data_format=None, output_size=None, name=None):
+            super().__init__()
+            self.kernel_size = kernel_size
+            self.stride = stride
+            self.padding = padding
+            self.output_size = output_size
+
+        def forward(self, x, indices):
+            return getattr(F, fn_name)(
+                x, indices, self.kernel_size, self.stride,
+                self.padding, output_size=self.output_size)
+
+    _Unpool.__name__ = fn_name.title().replace("_", "")
+    return _Unpool
+
+
+MaxUnPool1D = _unpool_layer("max_unpool1d")
+MaxUnPool2D = _unpool_layer("max_unpool2d")
+MaxUnPool3D = _unpool_layer("max_unpool3d")
+
+
+class FractionalMaxPool2D(Layer):
+    """reference nn/layer/pooling.py FractionalMaxPool2D."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.random_u = random_u
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, self.output_size,
+                                       random_u=self.random_u)
+
+
+class FractionalMaxPool3D(FractionalMaxPool2D):
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, self.output_size,
+                                       random_u=self.random_u)
+
+
+# -- misc layers -------------------------------------------------------------
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self.groups = groups
+        self.axis = axis
+
+    def forward(self, x):
+        return F.maxout(x, self.groups, self.axis)
+
+
+class Softmax2D(Layer):
+    """softmax over channel dim of NCHW (reference activation
+    Softmax2D)."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p,
+                                       training=self.training)
+
+
+class ZeroPad1D(Layer):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        p = padding if isinstance(padding, (list, tuple)) \
+            else [padding] * 2
+        self.padding = [int(v) for v in p]
+
+    def forward(self, x):
+        return F.pad(x, self.padding)
+
+
+class ZeroPad3D(Layer):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        p = padding if isinstance(padding, (list, tuple)) \
+            else [padding] * 6
+        self.padding = [int(v) for v in p]
+
+    def forward(self, x):
+        return F.pad(x, self.padding)
+
+
+class SpectralNorm(Layer):
+    """Standalone spectral-norm layer (reference nn/layer/norm.py
+    SpectralNorm): forward(weight) -> weight / sigma_max."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        h = int(weight_shape[dim])
+        w = int(np.prod(weight_shape)) // h
+        rng = np.random.RandomState(0)
+        self.register_buffer("weight_u", Tensor(jnp.asarray(
+            rng.randn(h), jnp.float32)))
+        self.register_buffer("weight_v", Tensor(jnp.asarray(
+            rng.randn(w), jnp.float32)))
+
+    def forward(self, weight):
+        import jax.numpy as jnp
+
+        from .. import ops
+        from ..core.tensor import Tensor
+
+        m = jnp.moveaxis(weight._data, self.dim, 0)
+        mat = m.reshape(m.shape[0], -1)
+        u = self.weight_u._data
+        v = self.weight_v._data
+        for _ in range(self.power_iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + self.eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + self.eps)
+        if self.training:
+            self.weight_u._data = u
+            self.weight_v._data = v
+        w2d = ops.reshape(ops.moveaxis(weight, self.dim, 0)
+                          if self.dim != 0 else weight,
+                          [mat.shape[0], -1])
+        sigma = ops.reshape(
+            Tensor(u[None, :]) @ w2d @ Tensor(v[:, None]), [])
+        return weight / sigma
+
+
+# -- loss layers -------------------------------------------------------------
+
+class HSigmoidLoss(Layer):
+    """reference nn/layer/loss.py HSigmoidLoss."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = self.create_parameter(
+            [num_classes - 1], attr=bias_attr, is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        b = self.bias
+        return F.hsigmoid_loss(input, label, self.num_classes,
+                               self.weight,
+                               b if b is None else b.reshape([-1]))
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin = p, margin
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin, self.swap = margin, swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function,
+            self.margin, self.swap, self.reduction)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           self.blank, reduction=self.reduction)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """reference nn/layer/loss.py AdaptiveLogSoftmaxWithLoss."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        self.cutoffs = list(cutoffs) + [n_classes]
+        self.shortlist = self.cutoffs[0]
+        n_clusters = len(self.cutoffs) - 1
+        self.head_weight = self.create_parameter(
+            [self.shortlist + n_clusters, in_features])
+        self.head_bias = self.create_parameter(
+            [self.shortlist + n_clusters], is_bias=True) \
+            if head_bias else None
+        self.tail_weights = []
+        for i in range(n_clusters):
+            hsz = max(int(in_features / (div_value ** (i + 1))), 1)
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            proj = self.create_parameter([hsz, in_features])
+            emb = self.create_parameter([osz, hsz])
+            setattr(self, f"tail_proj_{i}", proj)
+            setattr(self, f"tail_emb_{i}", emb)
+            self.tail_weights.append([proj, emb])
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs, self.head_bias)
+
+
+# -- decoding (reference nn/decode.py) ---------------------------------------
+
+class BeamSearchDecoder:
+    """Greedy/beam decoding driver over an RNN cell (reference
+    decode.py BeamSearchDecoder:138).  Works with any cell whose
+    ``__call__(inputs, states)`` returns (output, new_states); the
+    output is projected to vocab logits via ``output_fn`` (or an
+    embedding-tied projection)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def _logits(self, out):
+        return self.output_fn(out) if self.output_fn is not None \
+            else out
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32,
+                   batch_size=1, **kwargs):
+    """reference decode.py dynamic_decode:996 — run the decoder until
+    every beam emits end_token or max_step_num.  Host-driven loop
+    (decode is inherently sequential); each step's cell call is a
+    cached compiled program.  Returns (token ids [B, beam, T],
+    per-beam log-prob scores)."""
+    import jax.numpy as jnp
+
+    from .. import ops
+    from ..core.tensor import Tensor
+
+    cell = decoder.cell
+    K = decoder.beam_size
+    B = batch_size
+    # replicate initial state across beams: [B*K, ...]
+    def rep(t):
+        d = t._data if isinstance(t, Tensor) else jnp.asarray(t)
+        return Tensor(jnp.repeat(d, K, axis=0))
+
+    if inits is None:
+        states = None
+    elif isinstance(inits, (tuple, list)):
+        states = type(inits)(rep(s) for s in inits)
+    else:
+        states = rep(inits)
+
+    tokens = np.full((B, K), decoder.start_token, np.int64)
+    scores = np.zeros((B, K), np.float64)
+    scores[:, 1:] = -1e9  # beams start identical: keep one alive
+    finished = np.zeros((B, K), bool)
+    out_tokens = []
+
+    for _ in range(max_step_num):
+        inp = Tensor(jnp.asarray(tokens.reshape(-1)))
+        if decoder.embedding_fn is not None:
+            inp = decoder.embedding_fn(inp)
+        out, states = cell(inp, states)
+        logits = decoder._logits(out)
+        logp = np.asarray(ops.log_softmax(logits, axis=-1)._data
+                          ).reshape(B, K, -1).astype(np.float64)
+        V = logp.shape[-1]
+        # finished beams only extend with end_token at score 0
+        logp = np.where(finished[:, :, None],
+                        np.where(np.arange(V)[None, None, :]
+                                 == decoder.end_token, 0.0, -1e9),
+                        logp)
+        total = scores[:, :, None] + logp           # [B, K, V]
+        flat = total.reshape(B, -1)
+        top = np.argsort(-flat, axis=1)[:, :K]
+        scores = np.take_along_axis(flat, top, 1)
+        beam_idx = top // V
+        tok = top % V
+        # reorder states along the beam axis
+        def reorder(t):
+            d = t._data if isinstance(t, Tensor) else jnp.asarray(t)
+            d = d.reshape((B, K) + d.shape[1:])
+            gathered = jnp.take_along_axis(
+                d, jnp.asarray(beam_idx).reshape(
+                    (B, K) + (1,) * (d.ndim - 2)), axis=1)
+            return Tensor(gathered.reshape((B * K,) + d.shape[2:]))
+
+        if isinstance(states, (tuple, list)):
+            states = type(states)(reorder(s) for s in states)
+        elif states is not None:
+            states = reorder(states)
+        finished = np.take_along_axis(finished, beam_idx, 1) | (
+            tok == decoder.end_token)
+        for t_ in out_tokens:
+            t_[:] = np.take_along_axis(t_, beam_idx, 1)
+        out_tokens.append(tok.copy())
+        tokens = tok
+        if finished.all():
+            break
+
+    ids = np.stack(out_tokens, axis=-1)             # [B, K, T]
+    return (Tensor(jnp.asarray(ids)),
+            Tensor(jnp.asarray(scores.astype(np.float32))))

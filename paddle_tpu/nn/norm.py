@@ -174,3 +174,11 @@ class LocalResponseNorm(Layer):
         acc = sum(padded[:, i:i + d.shape[1]] for i in range(self.size))
         denom = (self.k + self.alpha * acc) ** self.beta
         return Tensor(d / denom)
+
+
+class InstanceNorm1D(InstanceNorm2D):
+    """reference nn/layer/norm.py InstanceNorm1D ([N, C, L])."""
+
+
+class InstanceNorm3D(InstanceNorm2D):
+    """reference nn/layer/norm.py InstanceNorm3D ([N, C, D, H, W])."""

@@ -19,6 +19,7 @@ from . import creation  # noqa: E402
 from . import random  # noqa: E402
 from . import activation as activation_ops  # noqa: E402
 from . import nn_ops  # noqa: E402
+from . import nn_ops_nd  # noqa: E402
 
 # --- re-export the flat functional namespace ------------------------------
 from .math import (  # noqa: F401
@@ -85,7 +86,8 @@ from .tail import (  # noqa: F401
     isin, tril_indices, triu_indices, shape, is_empty, is_integer,
     is_complex, is_floating_point, nanquantile, pdist, histogramdd,
     cumulative_trapezoid, mv, vecdot, householder_product, geqrf,
-    ormqr, cholesky_inverse,
+    ormqr, cholesky_inverse, frexp, bitwise_left_shift,
+    bitwise_right_shift,
 )
 
 import builtins as _bi  # noqa: E402

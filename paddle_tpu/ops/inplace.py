@@ -27,7 +27,7 @@ _BINARY = [
     "equal", "not_equal", "greater_than", "greater_equal", "less_than",
     "less_equal", "gcd", "lcm", "copysign", "hypot", "ldexp",
     "nextafter", "gammainc", "gammaincc", "atan2", "fmax", "fmin",
-    "maximum", "minimum",
+    "maximum", "minimum", "bitwise_left_shift", "bitwise_right_shift",
 ]
 _OTHER = [
     # (name, functional name) with pass-through args

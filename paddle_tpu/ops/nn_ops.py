@@ -114,14 +114,22 @@ def _conv2d_transpose_plain(x, w, stride=(1, 1), padding=(0, 0),
                             output_padding=(0, 0), dilation=(1, 1), groups=1,
                             data_format="NCHW"):
     w = _conv_dtype(x, w)
+    # Transposed conv = lhs-dilated conv with the kernel spatially
+    # MIRRORED (the gradient-of-conv identity); without the flip only
+    # symmetric kernels came out right (r4 torch-parity fix).  The
+    # spatial axes depend on the weight layout: IOHW -> (-2, -1),
+    # HWIO -> (0, 1).
+    w = jnp.flip(w, axis=(-2, -1) if data_format == "NCHW" else (0, 1))
     dn = jax.lax.conv_dimension_numbers(
         x.shape, w.shape,
         ("NCHW", "IOHW", "NCHW") if data_format == "NCHW"
         else ("NHWC", "HWIO", "NHWC"))
-    pad = [(dilation[0] * (w.shape[2] - 1) - padding[0],
-            dilation[0] * (w.shape[2] - 1) - padding[0] + output_padding[0]),
-           (dilation[1] * (w.shape[3] - 1) - padding[1],
-            dilation[1] * (w.shape[3] - 1) - padding[1] + output_padding[1])]
+    kh, kw = ((w.shape[2], w.shape[3]) if data_format == "NCHW"
+              else (w.shape[0], w.shape[1]))
+    pad = [(dilation[0] * (kh - 1) - padding[0],
+            dilation[0] * (kh - 1) - padding[0] + output_padding[0]),
+           (dilation[1] * (kw - 1) - padding[1],
+            dilation[1] * (kw - 1) - padding[1] + output_padding[1])]
     return jax.lax.conv_general_dilated(
         x, w, window_strides=(1, 1), padding=pad,
         lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,

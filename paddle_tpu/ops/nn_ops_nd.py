@@ -1,0 +1,328 @@
+"""N-dimensional conv/pool op family (1d/3d + adaptive/unpool/lp/
+fractional variants), round 4 breadth sprint.
+
+Reference: ``python/paddle/nn/functional/{conv,pooling}.py`` — conv1d_
+transpose:693, conv3d:1260, conv3d_transpose:1468, the pooling file's
+{max,avg,lp}_pool{1,2,3}d, adaptive_*_pool*, max_unpool*,
+fractional_max_pool* (phi kernels pool_kernel.cc/unpool_kernel.cc).
+Each lowers to one ``lax.reduce_window``/``conv_general_dilated``
+program; channel-first layouts throughout (NCL/NCHW/NCDHW like the
+reference defaults).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _tup(v, n):
+    if isinstance(v, (list, tuple)):
+        assert len(v) == n, (v, n)
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+# -- conv tail ---------------------------------------------------------------
+
+def _conv1d_transpose_plain(x, w, stride=1, padding=0, output_padding=0,
+                            dilation=1, groups=1):
+    # [N, C, L] x [Cin, Cout/g, K]
+    k = w.shape[2]
+    pad = [(dilation * (k - 1) - padding,
+            dilation * (k - 1) - padding + output_padding)]
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NCH", "IOH", "NCH"))
+    if w.dtype != x.dtype:
+        w = w.astype(x.dtype)
+    w = jnp.flip(w, axis=-1)  # transposed conv mirrors the kernel
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(1,), padding=pad, lhs_dilation=(stride,),
+        rhs_dilation=(dilation,), dimension_numbers=dn,
+        feature_group_count=groups)
+
+
+conv1d_transpose_op = register_op(
+    "conv1d_transpose", _conv1d_transpose_plain,
+    static_argnames=("stride", "padding", "output_padding", "dilation",
+                     "groups"))
+
+
+def _conv3d_plain(x, w, stride=(1, 1, 1), padding=(0, 0, 0),
+                  dilation=(1, 1, 1), groups=1):
+    if w.dtype != x.dtype:
+        w = w.astype(x.dtype)
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape, ("NCDHW", "OIDHW", "NCDHW"))
+    pad = [(p, p) for p in padding]
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=pad, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups)
+
+
+conv3d_op = register_op(
+    "conv3d", _conv3d_plain,
+    static_argnames=("stride", "padding", "dilation", "groups"))
+
+
+def _conv3d_transpose_plain(x, w, stride=(1, 1, 1), padding=(0, 0, 0),
+                            output_padding=(0, 0, 0),
+                            dilation=(1, 1, 1), groups=1):
+    if w.dtype != x.dtype:
+        w = w.astype(x.dtype)
+    w = jnp.flip(w, axis=(-3, -2, -1))  # mirrored kernel (see 2d)
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape, ("NCDHW", "IODHW", "NCDHW"))
+    pad = [(dilation[i] * (w.shape[2 + i] - 1) - padding[i],
+            dilation[i] * (w.shape[2 + i] - 1) - padding[i]
+            + output_padding[i]) for i in range(3)]
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1, 1), padding=pad,
+        lhs_dilation=stride, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups)
+
+
+conv3d_transpose_op = register_op(
+    "conv3d_transpose", _conv3d_transpose_plain,
+    static_argnames=("stride", "padding", "output_padding", "dilation",
+                     "groups"))
+
+
+# -- generic channel-first pooling ------------------------------------------
+
+def _pool_nd(x, kernel, stride, padding, nd, op, exclusive=True):
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in padding)
+    if op == "max":
+        neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+               else jnp.iinfo(x.dtype).min)
+        return jax.lax.reduce_window(x, neg, jax.lax.max, window,
+                                     strides, pads)
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides,
+                                   pads)
+    if exclusive and any(padding):
+        counts = jax.lax.reduce_window(jnp.ones_like(x), 0.0,
+                                       jax.lax.add, window, strides,
+                                       pads)
+        return summed / counts
+    return summed / float(np.prod(kernel))
+
+
+def _mk_pool(name, nd, op):
+    def plain(x, kernel_size, stride, padding, ceil_mode=False,
+              exclusive=True):
+        return _pool_nd(x, kernel_size, stride, padding, nd, op,
+                        exclusive)
+
+    return register_op(name, plain, static_argnames=(
+        "kernel_size", "stride", "padding", "ceil_mode", "exclusive"))
+
+
+max_pool1d_op = _mk_pool("max_pool1d", 1, "max")
+max_pool3d_op = _mk_pool("max_pool3d", 3, "max")
+avg_pool1d_op = _mk_pool("avg_pool1d", 1, "avg")
+avg_pool3d_op = _mk_pool("avg_pool3d", 3, "avg")
+
+
+def _lp_pool_nd(x, kernel_size, stride, padding, norm_type):
+    window = (1, 1) + kernel_size
+    strides = (1, 1) + stride
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in padding)
+    if norm_type == float("inf"):
+        neg = -jnp.inf
+        return jax.lax.reduce_window(jnp.abs(x), neg, jax.lax.max,
+                                     window, strides, pads)
+    powed = jnp.abs(x) ** norm_type
+    s = jax.lax.reduce_window(powed, 0.0, jax.lax.add, window, strides,
+                              pads)
+    return s ** (1.0 / norm_type)
+
+
+lp_pool1d_op = register_op(
+    "lp_pool1d",
+    lambda x, kernel_size, stride, padding, norm_type: _lp_pool_nd(
+        x, kernel_size, stride, padding, norm_type),
+    static_argnames=("kernel_size", "stride", "padding", "norm_type"))
+lp_pool2d_op = register_op(
+    "lp_pool2d",
+    lambda x, kernel_size, stride, padding, norm_type: _lp_pool_nd(
+        x, kernel_size, stride, padding, norm_type),
+    static_argnames=("kernel_size", "stride", "padding", "norm_type"))
+
+
+# -- adaptive pooling --------------------------------------------------------
+
+def _adaptive_regions(in_size, out_size):
+    starts = (np.arange(out_size) * in_size) // out_size
+    ends = -(-(np.arange(1, out_size + 1) * in_size) // out_size)
+    return starts, ends
+
+
+def _adaptive_pool_nd(x, output_size, op):
+    """Adaptive pooling via per-output-region slicing (regions are
+    host-computed from static shapes; the reference kernel's
+    start/end index formula, pooling.py AdaptiveAvgPool)."""
+    spatial = x.shape[2:]
+    nd = len(spatial)
+    out = x
+    # pool one axis at a time: axis k of the output indexes regions
+    for axis in range(nd):
+        in_size, out_size = out.shape[2 + axis], output_size[axis]
+        starts, ends = _adaptive_regions(in_size, out_size)
+        cols = []
+        for s, e in zip(starts, ends):
+            sl = [slice(None)] * out.ndim
+            sl[2 + axis] = slice(int(s), int(e))
+            region = out[tuple(sl)]
+            red = (jnp.max if op == "max" else jnp.mean)(
+                region, axis=2 + axis, keepdims=True)
+            cols.append(red)
+        out = jnp.concatenate(cols, axis=2 + axis)
+    return out
+
+
+def _mk_adaptive(name, op):
+    def plain(x, output_size):
+        return _adaptive_pool_nd(x, output_size, op)
+
+    return register_op(name, plain, static_argnames=("output_size",))
+
+
+adaptive_avg_pool1d_op = _mk_adaptive("adaptive_avg_pool1d", "avg")
+adaptive_avg_pool3d_op = _mk_adaptive("adaptive_avg_pool3d", "avg")
+adaptive_max_pool1d_op = _mk_adaptive("adaptive_max_pool1d", "max")
+adaptive_max_pool2d_op = _mk_adaptive("adaptive_max_pool2d", "max")
+adaptive_max_pool3d_op = _mk_adaptive("adaptive_max_pool3d", "max")
+
+
+# -- max pooling with indices + unpool --------------------------------------
+
+def _max_pool_with_index_nd(x, kernel_size, stride, padding):
+    """Returns (pooled, flat_indices) — indices over the flattened
+    spatial dims, matching the reference unpool contract."""
+    spatial = x.shape[2:]
+    nd = len(spatial)
+    flat_spatial = int(np.prod(spatial))
+    idx = jnp.arange(flat_spatial).reshape(spatial)
+    idx = jnp.broadcast_to(idx, x.shape).astype(jnp.int32)
+    window = (1, 1) + kernel_size
+    strides = (1, 1) + stride
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in padding)
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+        jnp.iinfo(x.dtype).min
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = (bv > av) | ((bv == av) & (bi < ai))
+        return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+
+    vals, idxs = jax.lax.reduce_window(
+        (x, idx), (jnp.asarray(neg, x.dtype), jnp.asarray(
+            flat_spatial, jnp.int32)),
+        reducer, window, strides, pads)
+    return vals, idxs
+
+
+def _max_pool_with_index_fwd(x, kernel_size, stride, padding):
+    vals, idxs = _max_pool_with_index_nd(x, kernel_size, stride,
+                                         padding)
+    # residuals must be arrays (jit rejects dtype objects) and shapes
+    # crossing the jit boundary become tracers — carry a zeros template
+    # with x's shape+dtype instead
+    return (vals, idxs), (idxs, jnp.zeros(x.shape, x.dtype))
+
+
+def _max_pool_with_index_bwd(saved, g, kernel_size=None, stride=None,
+                             padding=None):
+    # variadic reduce_window has no JAX transpose rule; the argmax
+    # indices ARE the backward routing: scatter-add dvals there.
+    idxs, proto = saved
+    x_shape = proto.shape
+    gv = g[0] if isinstance(g, (tuple, list)) else g
+    N, C = x_shape[:2]
+    flat = int(np.prod(x_shape[2:]))
+    out = jnp.zeros((N, C, flat), gv.dtype)
+    out = jax.vmap(jax.vmap(lambda o, vv, ii: o.at[ii].add(vv)))(
+        out, gv.reshape(N, C, -1),
+        idxs.reshape(N, C, -1).astype(jnp.int32))
+    return (out.reshape(x_shape).astype(proto.dtype),)
+
+
+max_pool_with_index_op = register_op(
+    "max_pool_with_index", _max_pool_with_index_nd, n_outputs=2,
+    fwd=_max_pool_with_index_fwd, bwd=_max_pool_with_index_bwd,
+    static_argnames=("kernel_size", "stride", "padding"))
+
+
+def _max_unpool_nd(pooled, indices, out_spatial):
+    """Scatter pooled values back to their argmax positions."""
+    N, C = pooled.shape[:2]
+    flat_out = int(np.prod(out_spatial))
+    p = pooled.reshape(N, C, -1)
+    i = indices.reshape(N, C, -1).astype(jnp.int32)
+    out = jnp.zeros((N, C, flat_out), pooled.dtype)
+    out = jax.vmap(jax.vmap(
+        lambda o, vv, ii: o.at[ii].set(vv)))(out, p, i)
+    return out.reshape((N, C) + tuple(out_spatial))
+
+
+def _max_unpool_fwd(pooled, indices, out_spatial):
+    return _max_unpool_nd(pooled, indices, out_spatial), (indices,)
+
+
+def _max_unpool_bwd(saved, g, out_spatial=None):
+    (indices,) = saved  # indices.shape == pooled.shape (static)
+    p_shape = indices.shape
+    N, C = p_shape[:2]
+    gf = g.reshape(N, C, -1)
+    ii = indices.reshape(N, C, -1).astype(jnp.int32)
+    dp = jax.vmap(jax.vmap(lambda gg, jj: gg[jj]))(gf, ii)
+    return (dp.reshape(p_shape), None)
+
+
+max_unpool_op = register_op(
+    "max_unpool", _max_unpool_nd, fwd=_max_unpool_fwd,
+    bwd=_max_unpool_bwd, static_argnames=("out_spatial",))
+
+
+# -- fractional max pooling --------------------------------------------------
+
+def _fractional_regions(in_size, out_size, u):
+    """Pseudo-random region boundaries (reference
+    fractional_max_pool: alpha = in/out, b_i = ceil(alpha*(i+u)))."""
+    alpha = in_size / out_size
+    idx = np.arange(out_size + 1, dtype=np.float64)
+    bounds = np.ceil(alpha * (idx + u)).astype(np.int64) - \
+        int(np.ceil(alpha * u))
+    bounds = np.clip(bounds, 0, in_size)
+    bounds[-1] = in_size
+    return bounds
+
+
+def _fractional_max_pool_nd(x, output_size, us):
+    spatial = x.shape[2:]
+    nd = len(spatial)
+    out = x
+    for axis in range(nd):
+        in_size, out_size = out.shape[2 + axis], output_size[axis]
+        bounds = _fractional_regions(in_size, out_size, us[axis])
+        cols = []
+        for i in range(out_size):
+            sl = [slice(None)] * out.ndim
+            s, e = int(bounds[i]), max(int(bounds[i + 1]),
+                                       int(bounds[i]) + 1)
+            sl[2 + axis] = slice(s, min(e, in_size))
+            cols.append(jnp.max(out[tuple(sl)], axis=2 + axis,
+                                keepdims=True))
+        out = jnp.concatenate(cols, axis=2 + axis)
+    return out
+
+
+fractional_max_pool_op = register_op(
+    "fractional_max_pool", _fractional_max_pool_nd,
+    static_argnames=("output_size", "us"))

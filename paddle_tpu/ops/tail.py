@@ -499,3 +499,46 @@ cholesky_inverse = _simple(
     "cholesky_inverse",
     lambda x, upper=False: _cholesky_inverse(x, upper),
     static=("upper",))
+
+
+def _frexp(x):
+    m, e = jnp.frexp(x)
+    return m, e.astype(jnp.int32)
+
+
+_frexp_op = register_op("frexp", _frexp, n_outputs=2)
+
+
+def frexp(x, name=None):
+    """reference math.frexp -> (mantissa, exponent)."""
+    return apply(_frexp_op, x)
+
+
+def _logical_rshift(a, b):
+    u = a.astype(jnp.uint32 if a.dtype.itemsize == 4 else jnp.uint64) \
+        if jnp.issubdtype(a.dtype, jnp.signedinteger) else a
+    out = jax.lax.shift_right_logical(u, u.dtype.type(0) + b.astype(
+        u.dtype))
+    return out.astype(a.dtype)
+
+
+_logical_rshift_op = register_op("bitwise_right_shift_logical",
+                                 _logical_rshift)
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
+    """reference math.bitwise_left_shift (left shift is identical in
+    arithmetic and logical modes)."""
+    from . import left_shift
+
+    return left_shift(x, y)
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
+    """reference math.bitwise_right_shift; is_arithmetic=False is a
+    logical shift (zero-fill) via an unsigned reinterpret."""
+    from . import right_shift
+
+    if is_arithmetic:
+        return right_shift(x, y)
+    return apply(_logical_rshift_op, x, y)

@@ -105,6 +105,7 @@ class Profiler:
         self._dir = getattr(on_trace_ready, "_dir", "./profiler_log")
         self._step = 0
         self._recording = False
+        self._recorded_dir = None
         self._step_times = []
         self._last = None
 
@@ -126,6 +127,7 @@ class Profiler:
             try:
                 jax.profiler.start_trace(self._dir)
                 self._recording = True
+                self._recorded_dir = self._dir
             except Exception:
                 self._recording = False
 
@@ -187,6 +189,12 @@ class Profiler:
                     f"{name:<{w}}{cnt:>8}{tot * 1e3:>12.3f}"
                     f"{tot / cnt * 1e3:>12.3f}{mn * 1e3:>12.3f}"
                     f"{mx * 1e3:>12.3f}")
+        if self._recorded_dir is not None:
+            from .statistics import format_tables
+
+            dev = format_tables(self._recorded_dir)
+            if dev:
+                lines.append(dev)
         out = "\n".join(lines) if lines else self.step_info()
         print(out)
         return out
@@ -197,3 +205,8 @@ class Profiler:
 
 def load_profiler_result(path):
     return None
+
+
+from .statistics import (  # noqa: E402,F401
+    category_table, device_op_table, memory_summary,
+)

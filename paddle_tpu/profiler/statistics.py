@@ -1,0 +1,115 @@
+"""Device-op statistics tables from a captured trace.
+
+Reference: ``python/paddle/profiler/profiler_statistic.py`` (the
+summary tables `paddle.profiler` prints: per-op device time, kernel
+category breakdown, memory).  The data source here is the xprof trace
+the Profiler already captures (trace.json.gz under the log dir); this
+module aggregates device events into the same table shapes.
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import re
+from collections import defaultdict
+
+
+def _load_trace(logdir):
+    paths = sorted(glob.glob(f"{logdir}/**/*.trace.json.gz",
+                             recursive=True))
+    if not paths:
+        return None
+    with gzip.open(paths[-1], "rt") as f:
+        return json.load(f)
+
+
+def _device_events(trace):
+    pid_names = {e["pid"]: e["args"].get("name", "")
+                 for e in trace.get("traceEvents", [])
+                 if e.get("ph") == "M"
+                 and e.get("name") == "process_name" and "args" in e}
+    dev_pids = {p for p, n in pid_names.items()
+                if "TPU" in n or "/device" in n.lower()}
+    return [e for e in trace.get("traceEvents", [])
+            if e.get("ph") == "X" and e.get("dur")
+            and e.get("pid") in dev_pids]
+
+
+def device_op_table(logdir, top=30):
+    """Per-op aggregated device time (profiler_statistic.py op summary
+    analog): rows of (name, calls, total_ms, avg_ms, bytes_GB, category),
+    sorted by total time."""
+    trace = _load_trace(logdir)
+    if trace is None:
+        return []
+    agg = defaultdict(lambda: [0.0, 0, 0, ""])
+    for e in _device_events(trace):
+        name = re.sub(r"[.\d]+$", "", e.get("name", "?"))
+        a = agg[name]
+        a[0] += e["dur"]
+        a[1] += 1
+        a[2] += int(e.get("args", {}).get("bytes_accessed", 0))
+        a[3] = e.get("args", {}).get("hlo_category", "")
+    rows = [(name, cnt, us / 1e3, us / cnt / 1e3, b / 1e9, cat)
+            for name, (us, cnt, b, cat) in agg.items()]
+    rows.sort(key=lambda r: -r[2])
+    return rows[:top]
+
+
+def category_table(logdir):
+    """Device time grouped by HLO category (kernel summary analog)."""
+    trace = _load_trace(logdir)
+    if trace is None:
+        return []
+    agg = defaultdict(lambda: [0.0, 0, 0])
+    for e in _device_events(trace):
+        cat = e.get("args", {}).get("hlo_category", "other")
+        agg[cat][0] += e["dur"]
+        agg[cat][1] += 1
+        agg[cat][2] += int(e.get("args", {}).get("bytes_accessed", 0))
+    rows = [(cat, cnt, us / 1e3, b / 1e9)
+            for cat, (us, cnt, b) in agg.items()]
+    rows.sort(key=lambda r: -r[2])
+    return rows
+
+
+def format_tables(logdir, top=30):
+    """The printable report (what ``Profiler.summary`` appends when a
+    device trace was captured)."""
+    cats = category_table(logdir)
+    ops = device_op_table(logdir, top)
+    if not cats and not ops:
+        return ""
+    lines = ["", "-- Device kernel summary (by HLO category) --",
+             f"{'Category':<26}{'Calls':>8}{'Total(ms)':>12}"
+             f"{'GB':>9}"]
+    for cat, cnt, ms, gb in cats:
+        lines.append(f"{cat[:25]:<26}{cnt:>8}{ms:>12.3f}{gb:>9.2f}")
+    lines += ["", f"-- Top {top} device ops --",
+              f"{'Name':<38}{'Calls':>7}{'Total(ms)':>12}"
+              f"{'Avg(ms)':>10}{'GB':>8}  Category"]
+    for name, cnt, ms, avg, gb, cat in ops:
+        lines.append(f"{name[:37]:<38}{cnt:>7}{ms:>12.3f}{avg:>10.4f}"
+                     f"{gb:>8.2f}  {cat[:20]}")
+    return "\n".join(lines)
+
+
+def memory_summary():
+    """Device memory stats table (reference memory summary analog;
+    backed by PJRT memory_stats where the backend exposes them)."""
+    import jax
+
+    lines = [f"{'Device':<14}{'In use(MB)':>12}{'Peak(MB)':>12}"
+             f"{'Limit(MB)':>12}"]
+    for d in jax.devices():
+        try:
+            s = d.memory_stats() or {}
+        except Exception:
+            s = {}
+        mb = 1024 * 1024
+        lines.append(
+            f"{str(d):<14}{s.get('bytes_in_use', 0) / mb:>12.1f}"
+            f"{s.get('peak_bytes_in_use', 0) / mb:>12.1f}"
+            f"{s.get('bytes_limit', 0) / mb:>12.1f}")
+    return "\n".join(lines)

@@ -759,6 +759,55 @@ op("batch_norm_train",
    [fa(4, 3, 5, 5), fpos(3), fa(3)], None, grad_inputs=[0, 1, 2],
    atol=1e-5)
 
+
+# --- N-d conv/pool family (ops/nn_ops_nd.py, round 4) -----------------------
+
+op("bitwise_right_shift_logical",
+   lambda: ops.bitwise_right_shift(
+       paddle.to_tensor(np.array([-8, 16], np.int32)),
+       paddle.to_tensor(np.array([1, 2], np.int32)),
+       is_arithmetic=False),
+   [], lambda: np.array([2147483644, 4]), grad=False, bf16=False)
+op("frexp", lambda x: ops.frexp(x), [fpos(3, 4)],
+   lambda x: np.frexp(x)[0], out_index=0, grad=False, bf16=False)
+op("conv1d_transpose",
+   lambda x, w: F.conv1d_transpose(x, w, stride=2, padding=1),
+   [fa(2, 3, 8), fa(3, 4, 3)], None, gtol=5e-2)
+op("conv3d", lambda x, w: F.conv3d(x, w, stride=2),
+   [fa(1, 2, 4, 4, 4), fa(3, 2, 2, 2, 2)], None, gtol=5e-2)
+op("conv3d_transpose",
+   lambda x, w: F.conv3d_transpose(x, w, stride=2),
+   [fa(1, 2, 3, 3, 3), fa(2, 3, 2, 2, 2)], None, gtol=5e-2)
+op("max_pool1d", lambda x: F.max_pool1d(x, 2), [fa(2, 3, 8)], None)
+op("max_pool3d", lambda x: F.max_pool3d(x, 2),
+   [fa(1, 2, 4, 4, 4)], None)
+op("avg_pool1d", lambda x: F.avg_pool1d(x, 2), [fa(2, 3, 8)], None)
+op("avg_pool3d", lambda x: F.avg_pool3d(x, 2),
+   [fa(1, 2, 4, 4, 4)], None)
+op("lp_pool1d", lambda x: F.lp_pool1d(x, 2.0, 2),
+   [fpos(2, 3, 8)], None)
+op("lp_pool2d", lambda x: F.lp_pool2d(x, 2.0, 2),
+   [fpos(2, 3, 6, 6)], None)
+op("adaptive_avg_pool1d", lambda x: F.adaptive_avg_pool1d(x, 3),
+   [fa(2, 3, 9)], None)
+op("adaptive_avg_pool3d", lambda x: F.adaptive_avg_pool3d(x, 2),
+   [fa(1, 2, 4, 5, 6)], None)
+op("adaptive_max_pool1d", lambda x: F.adaptive_max_pool1d(x, 3),
+   [fa(2, 3, 9)], None)
+op("adaptive_max_pool2d", lambda x: F.adaptive_max_pool2d(x, 2),
+   [fa(1, 2, 5, 5)], None)
+op("adaptive_max_pool3d", lambda x: F.adaptive_max_pool3d(x, 2),
+   [fa(1, 2, 4, 4, 4)], None)
+op("max_pool_with_index",
+   lambda x: F.max_pool2d(x, 2, return_mask=True),
+   [fa(2, 3, 6, 6)], None, out_index=0)
+op("max_unpool",
+   lambda x: F.max_unpool2d(*F.max_pool2d(x, 2, return_mask=True), 2),
+   [fa(2, 3, 6, 6)], None, covers=("max_unpool",))
+op("fractional_max_pool",
+   lambda x: F.fractional_max_pool2d(x, 3, random_u=0.4),
+   [fa(1, 2, 8, 8)], None)
+
 # ---------------------------------------------------------------------------
 
 SKIP = {
