@@ -43,7 +43,8 @@ class LlamaConfig:
     dtype: str = "float32"
     recompute: bool = False  # remat decoder layers in compiled steps
     # (the reference's fleet recompute, fleet/recompute/recompute.py:109)
-    recompute_policy: str = "full"  # "full" = rematerialize everything in
+    recompute_policy: str = "full"  # "full" | "dots" | "save_attn"
+    # "full" = rematerialize everything in
     # backward; "dots" = save matmul outputs, recompute elementwise only
     # (jax.checkpoint_policies.checkpoint_dots) — the reference's selective
     # recompute (fleet recompute_hybrid granularity) done as an XLA policy
@@ -93,6 +94,21 @@ def _rope_tables(config: LlamaConfig):
     return np.cos(emb), np.sin(emb)
 
 
+def _remat_policy(name):
+    import jax as _jax
+
+    if name == "dots":
+        return _jax.checkpoint_policies.checkpoint_dots
+    if name == "save_attn":
+        return _jax.checkpoint_policies.save_only_these_names(
+            "attn_out")
+    if name not in (None, "full"):
+        raise ValueError(
+            f"unknown recompute_policy {name!r}; expected 'full', "
+            f"'dots' or 'save_attn'")
+    return None
+
+
 class LlamaAttention(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -124,6 +140,16 @@ class LlamaAttention(nn.Layer):
                 q, k, v, attn_mask=attn_mask, is_causal=True,
                 impl=cfg.attention_impl, flash_blocks=cfg.flash_blocks)
         out = ops.reshape(out, [B, S, cfg.hidden_size])
+        # named checkpoint site: recompute_policy="save_attn" saves
+        # this value so the remat refwd skips qkv projections + the
+        # attention kernel entirely (~670MB at the bench config; the
+        # r3 "cut the remat extra forward" lever, PERF.md).
+        import jax as _jax
+        from jax.ad_checkpoint import checkpoint_name as _ckpt_name
+
+        out = Tensor(_ckpt_name(out._data, "attn_out"),
+                     stop_gradient=out.stop_gradient) \
+            if isinstance(out._data, _jax.core.Tracer) else out
         return self.o_proj(out)
 
     def _context_parallel_attention(self, q, k, v, attn_mask=None):
@@ -221,8 +247,7 @@ class LlamaModel(nn.Layer):
         if self.config.scan_layers and tracing:
             return self.norm(self._scan_layers(x, cos, sin, attn_mask))
         remat = self.config.recompute and tracing
-        policy = (jax.checkpoint_policies.checkpoint_dots
-                  if self.config.recompute_policy == "dots" else None)
+        policy = _remat_policy(self.config.recompute_policy)
         for layer in self.layers:
             if remat:
                 # jax.checkpoint = recompute: activations of the layer are
@@ -262,8 +287,7 @@ class LlamaModel(nn.Layer):
         if self.config.recompute:
             # prevent_cse=False is safe (and required for performance)
             # under scan — jax's documented remat-in-scan pattern.
-            policy = (jax.checkpoint_policies.checkpoint_dots
-                      if self.config.recompute_policy == "dots" else None)
+            policy = _remat_policy(self.config.recompute_policy)
             body = jax.checkpoint(body, prevent_cse=False, policy=policy)
         xd, _ = jax.lax.scan(body, x._data, stacked)
         return Tensor(xd)

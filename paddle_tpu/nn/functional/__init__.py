@@ -225,10 +225,6 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
     return nn_ops.dropout_raw(x, p=p, training=training, mode=mode)
 
 
-def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
-    return nn_ops.dropout_raw(x, p=p, training=training)
-
-
 # -- losses -----------------------------------------------------------------
 
 def _reduce_loss(loss, reduction):
@@ -571,7 +567,8 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
 
     k, s, p = _pool_args(kernel_size, stride, padding, 1)
     return registry.apply(nd.avg_pool1d_op, x, kernel_size=k, stride=s,
-                          padding=p, exclusive=bool(exclusive))
+                          padding=p, ceil_mode=bool(ceil_mode),
+                          exclusive=bool(exclusive))
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -580,8 +577,15 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     from ...ops import nn_ops_nd as nd
 
     k, s, p = _pool_args(kernel_size, stride, padding, 3)
-    return registry.apply(nd.avg_pool3d_op, x, kernel_size=k, stride=s,
-                          padding=p, exclusive=bool(exclusive))
+    out = registry.apply(nd.avg_pool3d_op, x, kernel_size=k, stride=s,
+                         padding=p, ceil_mode=bool(ceil_mode),
+                         exclusive=bool(exclusive))
+    if divisor_override is not None:
+        import numpy as _np
+
+        out = ops.scale(out, float(_np.prod(k)) /
+                        float(divisor_override))
+    return out
 
 
 def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
@@ -734,7 +738,9 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
 
     keep = 1.0 - p
     key = default_generator.next_fast_key()
-    shape = (x.shape[0], x.shape[1], 1, 1, 1)
+    shape = ((x.shape[0], x.shape[1], 1, 1, 1)
+             if data_format == "NCDHW"
+             else (x.shape[0], 1, 1, 1, x.shape[-1]))
     mask = _jax.random.bernoulli(key, keep, shape)
 
     def fn(xd, mask, keep):
@@ -755,8 +761,9 @@ def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
 
     keep = 1.0 - p
     key = default_generator.next_fast_key()
-    mask = _jax.random.bernoulli(key, keep,
-                                 (x.shape[0], x.shape[1], 1, 1))
+    shape = ((x.shape[0], x.shape[1], 1, 1) if data_format == "NCHW"
+             else (x.shape[0], 1, 1, x.shape[-1]))
+    mask = _jax.random.bernoulli(key, keep, shape)
 
     def fn(xd, mask, keep):
         return _jnp.where(mask, xd / keep, _jnp.zeros_like(xd))
@@ -1249,10 +1256,14 @@ def _jnp_asarray(x):
 def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
               fastemit_lambda=0.001, reduction="mean", name=None):
     """reference loss.rnnt_loss — RNN-Transducer loss via the standard
-    log-domain alpha recursion (Graves 2012), lax.scan over time.
+    log-domain alpha recursion (Graves 2012).  FastEmit (Yu et al.
+    2021): lambda > 0 scales every emission arc's gradient by
+    (1 + lambda), implemented as the equivalent objective
+    L - lambda * sum(sg(gamma_emit) * emit_lp) with the emission-arc
+    posteriors gamma from a full alpha-beta pass.
     input: [B, T, U+1, V] joint log-probs (pre-softmax), label: [B, U].
     """
-    def fn(lg, y, t_len, u_len, blank, reduction):
+    def fn(lg, y, t_len, u_len, blank, reduction, fastemit):
         import jax
         import jax.numpy as _jnp
 
@@ -1298,6 +1309,45 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
             blank_lp, t_idx[:, None, None], 1)[:, 0],
             u_idx[:, None], 1)[:, 0]
         loss = -final
+        if fastemit > 0.0:
+            # beta recursion (mirror of alpha), per-sample lengths via
+            # masks: beta[t, u] = logaddexp(
+            #     blank[t, u] + beta[t+1, u],
+            #     emit[t, u] + beta[t, u+1]);
+            # at t == t_len-1 the blank arc terminates (only u==u_len).
+            t_rng = _jnp.arange(T)[None, :]
+            u_rng = _jnp.arange(U1)[None, :]
+            t_valid = t_rng < t_len[:, None]
+            u_valid = u_rng <= u_len[:, None]
+            is_final_u = u_rng == u_len[:, None]
+            NEGB = -1e30
+            betas = [None] * T
+            nxt = _jnp.full((B, U1), NEGB)
+            for t in range(T - 1, -1, -1):
+                final_t = (t_len - 1)[:, None] == t
+                blank_cont = _jnp.where(
+                    final_t, _jnp.where(is_final_u, 0.0, NEGB),
+                    nxt) + blank_lp[:, t, :]
+                vals = [None] * U1
+                vals[U1 - 1] = blank_cont[:, U1 - 1]
+                for u in range(U1 - 2, -1, -1):
+                    vals[u] = _jnp.logaddexp(
+                        blank_cont[:, u],
+                        vals[u + 1] + emit_lp[:, t, u])
+                cur = _jnp.stack(vals, 1)
+                cur = _jnp.where(t_valid[:, t:t + 1] & u_valid, cur,
+                                 NEGB)
+                betas[t] = cur
+                nxt = cur
+            beta = _jnp.stack(betas, 1)                   # [B, T, U+1]
+            beta_up = _jnp.concatenate(
+                [beta[:, :, 1:], _jnp.full((B, T, 1), NEGB)], 2)
+            gamma = _jnp.exp(alpha + emit_lp + beta_up
+                             - final[:, None, None])
+            gamma = jax.lax.stop_gradient(
+                _jnp.where(_jnp.isfinite(gamma), gamma, 0.0))
+            loss = loss - fastemit * _jnp.sum(gamma * emit_lp,
+                                              axis=(1, 2))
         if reduction == "mean":
             return _jnp.mean(loss)
         if reduction == "sum":
@@ -1306,7 +1356,8 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
 
     return registry.cached_apply(
         "rnnt_loss", fn, input, label, input_lengths, label_lengths,
-        blank=int(blank), reduction=str(reduction))
+        blank=int(blank), reduction=str(reduction),
+        fastemit=float(fastemit_lambda))
 
 
 # -- in-place activation variants + attention aliases ------------------------

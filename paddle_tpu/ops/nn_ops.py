@@ -767,6 +767,25 @@ def _sdpa_plain(q, k, v, mask=None, key=None, dropout=0.0, causal=False,
     vt = jnp.swapaxes(v, 1, 2)
 
     on_tpu = jax.devices()[0].platform == "tpu"
+    # Self-authored q-blocked kernel with VMEM-resident K/V
+    # (pallas_kernels/long_attention): measured 2.2x the stock flash
+    # kernel at the llama bench shape (S=2048 D=128 fwd+bwd 5.0ms vs
+    # 11.0ms) — at these S one head's K/V fits VMEM, so flash's
+    # K-block pipeline is pure overhead.  Falls back to the stock
+    # kernel via impl="flash" (e.g. S too large for resident K/V).
+    # S cap 2048: the bwd kernel holds ~4 [block_q, S] f32
+    # intermediates; past S=2048 they exceed scoped VMEM (and only
+    # S<=2048 is benchmarked) — longer sequences take the stock
+    # flash path below.
+    long_ok = (mask is None and key is None and Sq == Sk
+               and D % 128 == 0 and Sq % 256 == 0 and Sq <= 2048
+               and Hkv == H and on_tpu)
+    if impl == "auto" and long_ok and causal and Sq >= 1024:
+        from .pallas_kernels.long_attention import long_attention
+
+        out = long_attention(qt, kt, vt, float(scale), 256,
+                             bool(causal), None)
+        return jnp.swapaxes(out, 1, 2)
     # Self-authored short-sequence kernel (pallas_kernels/short_attention):
     # whole [S,S] scores VMEM-resident, in-kernel hardware-PRNG dropout.
     # Wins whenever one head's scores fit VMEM (S <= 1024); at those
@@ -806,9 +825,8 @@ def _sdpa_plain(q, k, v, mask=None, key=None, dropout=0.0, causal=False,
             f"Sq={Sq} Sk={Sk} D={D} mask={mask is not None} "
             f"dropout={key is not None} "
             f"platform={jax.devices()[0].platform}")
-    # auto: the Pallas kernel beats the einsum path from S>=1024 on v5e
-    # (measured: S=2048 fwd+bwd 17.4ms einsum vs ~12ms flash with tuned
-    # tiles) — the einsum path's O(S^2) logits round-trip HBM.
+    # stock flash kernel path (impl="flash", or auto shapes the
+    # resident-K/V kernel can't take)
     use_flash = impl == "flash" or (impl == "auto" and flash_ok
                                     and causal and Sq >= 1024)
     if use_flash:

@@ -93,10 +93,22 @@ conv3d_transpose_op = register_op(
 
 # -- generic channel-first pooling ------------------------------------------
 
-def _pool_nd(x, kernel, stride, padding, nd, op, exclusive=True):
+def _pool_nd(x, kernel, stride, padding, nd, op, exclusive=True,
+             ceil_mode=False):
     window = (1, 1) + kernel
     strides = (1, 1) + stride
-    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in padding)
+    hi = list(padding)
+    if ceil_mode:
+        # extend the high side so the last partial window is included
+        # (reduce_window pads with the init value: -inf for max, 0 for
+        # sum — and exclusive counts divide by the true element count)
+        for i in range(nd):
+            L = x.shape[2 + i]
+            rem = (L + 2 * padding[i] - kernel[i]) % stride[i]
+            if rem:
+                hi[i] = padding[i] + (stride[i] - rem)
+    pads = ((0, 0), (0, 0)) + tuple(
+        (p, h) for p, h in zip(padding, hi))
     if op == "max":
         neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
                else jnp.iinfo(x.dtype).min)
@@ -104,7 +116,7 @@ def _pool_nd(x, kernel, stride, padding, nd, op, exclusive=True):
                                      strides, pads)
     summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides,
                                    pads)
-    if exclusive and any(padding):
+    if (exclusive and any(padding)) or ceil_mode:
         counts = jax.lax.reduce_window(jnp.ones_like(x), 0.0,
                                        jax.lax.add, window, strides,
                                        pads)
@@ -116,7 +128,7 @@ def _mk_pool(name, nd, op):
     def plain(x, kernel_size, stride, padding, ceil_mode=False,
               exclusive=True):
         return _pool_nd(x, kernel_size, stride, padding, nd, op,
-                        exclusive)
+                        exclusive, ceil_mode)
 
     return register_op(name, plain, static_argnames=(
         "kernel_size", "stride", "padding", "ceil_mode", "exclusive"))

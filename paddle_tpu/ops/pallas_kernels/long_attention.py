@@ -1,0 +1,250 @@
+"""Q-blocked causal attention kernel with fused RoPE (self-authored).
+
+The llama-regime companion to ``short_attention``: at S ~ 2048-8192,
+D=128, one (batch, head)'s FULL K/V is only S*D*2*2 bytes (1 MB at
+S=2048 bf16) — it fits VMEM outright.  So instead of flash-attention's
+K-blocking + online-softmax machinery, each program holds K/V whole
+and computes one q block's ENTIRE score row [block_q, S] in VMEM:
+plain softmax, no running max/sum rescaling, one MXU pass per block.
+(PERF.md r3: the stock flash kernel ran ~3x off the attention
+roofline at this shape; its K-block pipeline is built for S where K/V
+can't be resident — pure overhead here.)
+
+RoPE is fused: q/k rotate INSIDE the kernel from an [S, D/2] cos/sin
+table (reference fused_rope kernel, phi/kernels/fusion/gpu/
+fused_rope); the rotated q/k never touch HBM, and the backward
+de-rotates dq/dk with the transpose rotation (RoPE is orthogonal:
+d(rope(x)) = rope^T(dout)).
+
+Backward: dV/dP need the probs; they are recomputed from the saved
+logsumexp per q block (same as fwd, one extra MXU pass).  dK/dV
+accumulate across q blocks by making the q-block axis the INNERMOST
+grid dimension and zero-initializing on its first step (TPU grids run
+sequentially, so += into the output block is well-defined).
+
+Layout: q/k/v [B, H, S, D]; causal only (the regime where this kernel
+is selected); lse saved as [B, H, 1, S] (tile-legal, cf.
+short_attention).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -2.3819763e38  # most-negative bf16-representable
+
+
+def _rope(x, cos, sin, sign=1.0):
+    """Rotate pairs (even, odd) of the last dim; sign=-1 applies the
+    transpose (inverse) rotation."""
+    d2 = x.shape[-1] // 2
+    x1 = x[..., :d2]
+    x2 = x[..., d2:]
+    return jnp.concatenate(
+        [x1 * cos - sign * x2 * sin, sign * x1 * sin + x2 * cos],
+        axis=-1)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, cos_ref, sin_ref, o_ref, lse_ref,
+                *, scale, block_q, causal, use_rope):
+    qi = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32)          # [S, D]
+    v = v_ref[0, 0].astype(jnp.float32)
+    q = q_ref[0, 0].astype(jnp.float32)          # [block_q, D]
+    if use_rope:
+        cos = cos_ref[0]                          # [S, D/2]
+        sin = sin_ref[0]
+        # block-row slice via ref indexing (Mosaic has no
+        # dynamic_slice primitive on loaded values)
+        q = _rope(q, cos_ref[0, pl.ds(qi * block_q, block_q)],
+                  sin_ref[0, pl.ds(qi * block_q, block_q)])
+        k = _rope(k, cos, sin)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        S = k.shape[0]
+        row = (jax.lax.broadcasted_iota(jnp.int32,
+                                        (block_q, S), 0)
+               + qi * block_q)
+        col = jax.lax.broadcasted_iota(jnp.int32, (block_q, S), 1)
+        s = jnp.where(col <= row, s, _NEG)
+    m = jnp.max(s, axis=1, keepdims=True)
+    e = jnp.exp(s - m)
+    l = jnp.sum(e, axis=1, keepdims=True)
+    p = e / l
+    lse_ref[0, 0, 0] = (m + jnp.log(l))[:, 0]
+    o = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    o_ref[0, 0] = o.astype(o_ref.dtype)
+
+
+def _bwd_kernel(q_ref, k_ref, v_ref, cos_ref, sin_ref, lse_ref, g_ref,
+                dq_ref, dk_ref, dv_ref, *, scale, block_q, causal,
+                use_rope):
+    qi = pl.program_id(2)
+    k0 = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    q = q_ref[0, 0].astype(jnp.float32)
+    if use_rope:
+        cos = cos_ref[0]
+        sin = sin_ref[0]
+        cos_q = cos_ref[0, pl.ds(qi * block_q, block_q)]
+        sin_q = sin_ref[0, pl.ds(qi * block_q, block_q)]
+        q = _rope(q, cos_q, sin_q)
+        k = _rope(k0, cos, sin)
+    else:
+        k = k0
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    S = k.shape[0]
+    if causal:
+        row = (jax.lax.broadcasted_iota(jnp.int32, (block_q, S), 0)
+               + qi * block_q)
+        col = jax.lax.broadcasted_iota(jnp.int32, (block_q, S), 1)
+        s = jnp.where(col <= row, s, _NEG)
+    p = jnp.exp(s - lse_ref[0, 0, 0][:, None])   # [block_q, S]
+    g = g_ref[0, 0].astype(jnp.float32)          # [block_q, D]
+
+    dv_blk = jax.lax.dot_general(p, g, (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - jnp.sum(dp * p, axis=1, keepdims=True)) * scale
+    dq = jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    dk_blk = jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    if use_rope:
+        # de-rotate: d(rope(x))/dx is the transpose rotation
+        dq = _rope(dq, cos_q, sin_q, sign=-1.0)
+        dk_blk = _rope(dk_blk, cos, sin, sign=-1.0)
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+    # accumulate dk/dv over the (innermost, sequential) q-block axis
+    @pl.when(qi == 0)
+    def _init():
+        dk_ref[0, 0] = jnp.zeros_like(dk_ref[0, 0])
+        dv_ref[0, 0] = jnp.zeros_like(dv_ref[0, 0])
+
+    dk_ref[0, 0] += dk_blk.astype(dk_ref.dtype)
+    dv_ref[0, 0] += dv_blk.astype(dv_ref.dtype)
+
+
+def _specs(S, D, block_q, d2):
+    qspec = pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, i: (b, h, i, 0))
+    kvspec = pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0))
+    rspec = pl.BlockSpec((1, S, d2), lambda b, h, i: (0, 0, 0))
+    lspec = pl.BlockSpec((1, 1, 1, block_q),
+                         lambda b, h, i: (b, h, 0, i))
+    return qspec, kvspec, rspec, lspec
+
+
+def _fwd_call(q, k, v, cos, sin, scale, block_q, causal, use_rope):
+    B, H, S, D = q.shape
+    nq = S // block_q
+    qspec, kvspec, rspec, lspec = _specs(S, D, block_q, D // 2)
+    kernel = functools.partial(_fwd_kernel, scale=scale,
+                               block_q=block_q, causal=causal,
+                               use_rope=use_rope)
+    with jax.enable_x64(False):
+        out, lse = pl.pallas_call(
+            kernel,
+            grid=(B, H, nq),
+            in_specs=[qspec, kvspec, kvspec, rspec, rspec],
+            out_specs=[qspec, lspec],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+                jax.ShapeDtypeStruct((B, H, 1, S), jnp.float32),
+            ],
+        )(q, k, v, cos, sin)
+    return out, lse
+
+
+def _bwd_call(q, k, v, cos, sin, lse, g, scale, block_q, causal,
+              use_rope):
+    # the bwd holds ~4 [block_q, S] f32 intermediates (s, p, dp, ds);
+    # a smaller block than the fwd keeps it inside scoped VMEM
+    block_q = min(block_q, 256)
+    B, H, S, D = q.shape
+    nq = S // block_q
+    qspec, kvspec, rspec, lspec = _specs(S, D, block_q, D // 2)
+    kernel = functools.partial(_bwd_kernel, scale=scale,
+                               block_q=block_q, causal=causal,
+                               use_rope=use_rope)
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+            kernel,
+            grid=(B, H, nq),
+            in_specs=[qspec, kvspec, kvspec, rspec, rspec, lspec,
+                      qspec],
+            out_specs=[qspec, kvspec, kvspec],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+                jax.ShapeDtypeStruct((B, H, S, D), jnp.float32),
+                jax.ShapeDtypeStruct((B, H, S, D), jnp.float32),
+            ],
+        )(q, k, v, cos, sin, lse, g)
+
+
+def _rope_tables(S, D, base, dtype):
+    inv = 1.0 / (base ** (jnp.arange(0, D // 2, dtype=jnp.float32)
+                          * 2.0 / D))
+    ang = jnp.arange(S, dtype=jnp.float32)[:, None] * inv[None, :]
+    return (jnp.cos(ang).astype(dtype)[None],
+            jnp.sin(ang).astype(dtype)[None])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def long_attention(q, k, v, scale=None, block_q=512, causal=True,
+                   rope_base=None):
+    """[B, H, S, D] causal attention, K/V VMEM-resident, optional
+    fused RoPE (rope_base=10000.0 enables it).  S % block_q == 0."""
+    out, _ = _fwd_impl(q, k, v, scale, block_q, causal, rope_base)
+    return out
+
+
+def _scale_of(scale, q):
+    import math
+
+    return float(scale) if scale is not None \
+        else 1.0 / math.sqrt(q.shape[-1])
+
+
+def _fwd_impl(q, k, v, scale, block_q, causal, rope_base):
+    B, H, S, D = q.shape
+    use_rope = rope_base is not None
+    if use_rope:
+        cos, sin = _rope_tables(S, D, float(rope_base), jnp.float32)
+    else:
+        cos = jnp.zeros((1, S, D // 2), jnp.float32)
+        sin = cos
+    return _fwd_call(q, k, v, cos, sin, _scale_of(scale, q),
+                     int(block_q), bool(causal), use_rope)
+
+
+def _vjp_fwd(q, k, v, scale, block_q, causal, rope_base):
+    out, lse = _fwd_impl(q, k, v, scale, block_q, causal, rope_base)
+    return out, (q, k, v, lse)
+
+
+def _vjp_bwd(scale, block_q, causal, rope_base, res, g):
+    q, k, v, lse = res
+    B, H, S, D = q.shape
+    use_rope = rope_base is not None
+    if use_rope:
+        cos, sin = _rope_tables(S, D, float(rope_base), jnp.float32)
+    else:
+        cos = jnp.zeros((1, S, D // 2), jnp.float32)
+        sin = cos
+    dq, dk, dv = _bwd_call(q, k, v, cos, sin, lse, g,
+                           _scale_of(scale, q), int(block_q),
+                           bool(causal), use_rope)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+long_attention.defvjp(_vjp_fwd, _vjp_bwd)

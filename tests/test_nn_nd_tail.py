@@ -217,6 +217,7 @@ def test_rnnt_loss_matches_torchaudio_formula():
     y = np.array([[1, 2]], np.int64)
     got = float(F.rnnt_loss(_t(lg), _t(y), _t(np.array([T], np.int64)),
                             _t(np.array([2], np.int64)),
+                            fastemit_lambda=0.0,
                             reduction="none").numpy()[0])
     # brute force: all monotone paths emitting y across T time steps
     lsm = torch.log_softmax(torch.tensor(lg[0]), -1).numpy()
@@ -344,3 +345,83 @@ def test_beam_search_decoder_finds_high_prob_sequence():
     assert scores.shape[0] == 2
     s = scores.numpy()
     assert (np.diff(s, axis=1) <= 1e-5).all()  # beams score-sorted
+
+
+def test_ceil_mode_pools_match_torch():
+    """ceil_mode on the 1d/3d pools (code-review r4: silently
+    ignored)."""
+    x = R.randn(2, 3, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        F.max_pool1d(_t(x), 2, 2, ceil_mode=True).numpy(),
+        tF.max_pool1d(torch.tensor(x), 2, 2, ceil_mode=True).numpy(),
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        F.avg_pool1d(_t(x), 2, 2, ceil_mode=True).numpy(),
+        tF.avg_pool1d(torch.tensor(x), 2, 2,
+                      ceil_mode=True).numpy(), rtol=1e-6)
+    x3 = R.randn(1, 2, 5, 5, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        F.max_pool3d(_t(x3), 2, 2, ceil_mode=True).numpy(),
+        tF.max_pool3d(torch.tensor(x3), 2, 2,
+                      ceil_mode=True).numpy(), rtol=1e-6)
+    np.testing.assert_allclose(
+        F.avg_pool3d(_t(x3), 2, 2, ceil_mode=True).numpy(),
+        tF.avg_pool3d(torch.tensor(x3), 2, 2,
+                      ceil_mode=True).numpy(), rtol=1e-5)
+    # divisor_override
+    np.testing.assert_allclose(
+        F.avg_pool3d(_t(x3), 2, 2, divisor_override=1).numpy(),
+        tF.avg_pool3d(torch.tensor(x3), 2, 2,
+                      divisor_override=1).numpy(), rtol=1e-6)
+
+
+def test_channel_dropout_data_format():
+    """dropout2d/3d honor NHWC/NDHWC (code-review r4)."""
+    x = _t(np.ones((4, 6, 6, 16), np.float32))
+    out = F.dropout2d(x, 0.5, data_format="NHWC").numpy()
+    per_chan = out.transpose(0, 3, 1, 2).reshape(4 * 16, -1)
+    assert all(np.all(c == 0) or np.all(c == 2.0) for c in per_chan)
+    x3 = _t(np.ones((2, 3, 3, 3, 8), np.float32))
+    out3 = F.dropout3d(x3, 0.5, data_format="NDHWC").numpy()
+    per3 = out3.transpose(0, 4, 1, 2, 3).reshape(2 * 8, -1)
+    assert all(np.all(c == 0) or np.all(c == 2.0) for c in per3)
+
+
+def test_rnnt_fastemit_scales_emission_grads():
+    """fastemit_lambda boosts emission-arc gradients by (1+lambda)
+    (code-review r4: the arg was silently ignored)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    lg = rng.randn(1, 4, 3, 5).astype(np.float32)
+    lab = _t(np.array([[1, 2]], np.int64))
+    tl = _t(np.array([4], np.int64))
+    ul = _t(np.array([2], np.int64))
+
+    def loss_at(lmbda):
+        t = _t(lg)
+        t.stop_gradient = False
+        out = F.rnnt_loss(t, lab, tl, ul, fastemit_lambda=lmbda)
+        out.backward()
+        return float(out), t.grad.numpy()
+
+    l0, g0 = loss_at(0.0)
+    l1, g1 = loss_at(0.5)
+    l2, g2 = loss_at(1.0)
+    assert l1 > l0 and l2 > l1  # monotone in lambda
+    assert not np.allclose(g0, g1)
+    # the added term is -lambda * sum(sg(gamma) * emit_lp): the loss
+    # delta scales linearly in lambda
+    np.testing.assert_allclose(l2 - l0, 2 * (l1 - l0), rtol=1e-4)
+
+
+def test_remat_policy_validation():
+    import pytest as _pytest
+
+    from paddle_tpu.models.llama import _remat_policy
+
+    assert _remat_policy("full") is None
+    assert _remat_policy("save_attn") is not None
+    with _pytest.raises(ValueError):
+        _remat_policy("save-attn")
