@@ -603,7 +603,8 @@ from .transformed_distribution import (  # noqa: E402,F401
 )
 from .more import (  # noqa: E402,F401
     Binomial, Cauchy, Chi2, ContinuousBernoulli, ExponentialFamily,
-    Geometric, Multinomial, MultivariateNormal, Poisson, StudentT,
+    Geometric, LKJCholesky, Multinomial, MultivariateNormal, Poisson,
+    StudentT,
 )
 
 

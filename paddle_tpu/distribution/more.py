@@ -663,3 +663,93 @@ class ExponentialFamily(Distribution):
     """Base class marking exponential-family distributions (reference
     exponential_family.py); entropy via the Bregman divergence of the
     log-normalizer is provided by subclasses' closed forms here."""
+
+
+class LKJCholesky(Distribution):
+    """LKJ distribution over Cholesky factors of correlation matrices
+    (reference lkj_cholesky.py; onion-method sampling)."""
+
+    def __init__(self, dim, concentration=1.0, sample_method="onion",
+                 name=None):
+        if dim < 2:
+            raise ValueError("dim must be >= 2")
+        if sample_method not in ("onion", "cvine"):
+            raise ValueError("sample_method must be 'onion' or 'cvine'")
+        self.dim = int(dim)
+        self.concentration = _t(concentration)
+        self.sample_method = sample_method
+        super().__init__(tuple(self.concentration.shape),
+                         (self.dim, self.dim))
+
+    def sample(self, shape=()):
+        if self.sample_method == "cvine":
+            return self._sample_cvine(shape)
+        return self._sample_onion(shape)
+
+    def _sample_onion(self, shape):
+        """Onion method (Ghosh & Henderson 2003): row i+1's direction
+        is uniform on the sphere with Beta-distributed radius."""
+        d = self.dim
+        s = _shape(shape) + self.batch_shape
+        eta = _raw(self.concentration)
+        key = default_generator.next_key()
+        k1, k2 = jax.random.split(key)
+        # beta samples: r_i^2 ~ Beta((i+1)/2, eta + (d - 2 - i)/2)
+        L = jnp.zeros(s + (d, d), jnp.float32).at[..., 0, 0].set(1.0)
+        for i in range(1, d):
+            ki = jax.random.fold_in(k1, i)
+            a = i / 2.0
+            b = eta + (d - 1 - i) / 2.0
+            r2 = jax.random.beta(ki, a, jnp.broadcast_to(b, s))
+            u = jax.random.normal(jax.random.fold_in(k2, i),
+                                  s + (i,), jnp.float32)
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(r2)[..., None] * u
+            L = L.at[..., i, :i].set(w)
+            L = L.at[..., i, i].set(jnp.sqrt(jnp.maximum(1.0 - r2,
+                                                         1e-12)))
+        return Tensor(L)
+
+    def _sample_cvine(self, shape):
+        """C-vine method (LKJ 2009): partial correlations
+        p_ij ~ 2 Beta(b_j, b_j) - 1 with b_j = eta + (d - 2 - j) / 2,
+        mapped to the Cholesky factor row-wise."""
+        d = self.dim
+        s = _shape(shape) + self.batch_shape
+        eta = _raw(self.concentration)
+        key = default_generator.next_key()
+        L = jnp.zeros(s + (d, d), jnp.float32).at[..., 0, 0].set(1.0)
+        for i in range(1, d):
+            rem = jnp.ones(s, jnp.float32)  # prod sqrt(1 - p^2) so far
+            for j in range(i):
+                kij = jax.random.fold_in(key, i * d + j)
+                b = eta + (d - 2 - j) / 2.0
+                bb = jnp.broadcast_to(b, s)
+                p = 2.0 * jax.random.beta(kij, bb, bb) - 1.0
+                L = L.at[..., i, j].set(p * rem)
+                rem = rem * jnp.sqrt(jnp.maximum(1.0 - p * p, 1e-12))
+            L = L.at[..., i, i].set(rem)
+        return Tensor(L)
+
+    def log_prob(self, value):
+        """Density over the diagonal (reference lkj_cholesky
+        log_prob): sum_i (d - i - 1 + 2(eta - 1)) log L_ii minus the
+        log normalizer (product of Beta functions)."""
+        d = self.dim
+
+        def fn(eta, L):
+            diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+            order = jnp.arange(2, d + 1, dtype=jnp.float32)
+            unnorm = jnp.sum(
+                (d - order + 2.0 * eta[..., None] - 2.0)
+                * jnp.log(diag), -1)
+            # log normalizer (Stan reference formulation)
+            i = jnp.arange(1, d, dtype=jnp.float32)
+            alpha = eta[..., None] + (d - 1 - i) / 2.0
+            lnorm = jnp.sum(
+                0.5 * i * jnp.log(jnp.pi)
+                + _gammaln(alpha)
+                - _gammaln(alpha + i / 2.0), -1)
+            return unnorm - lnorm
+
+        return _op("lkj_log_prob", fn, self.concentration, _t(value))

@@ -110,6 +110,21 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW",
                name=None):
     stride = stride if stride is not None else kernel_size
+    if ceil_mode or divisor_override is not None:
+        # exact ceil/divisor semantics live in the generic N-d op
+        from ...ops import nn_ops_nd as nd_ops
+
+        if data_format == "NHWC":
+            out = avg_pool2d(ops.transpose(x, [0, 3, 1, 2]),
+                             kernel_size, stride, padding, ceil_mode,
+                             exclusive, divisor_override)
+            return ops.transpose(out, [0, 2, 3, 1])
+        return registry.apply(
+            nd_ops.avg_pool2d_g_op, x, kernel_size=_pair(kernel_size),
+            stride=_pair(stride), padding=_pair(padding),
+            ceil_mode=bool(ceil_mode), exclusive=bool(exclusive),
+            divisor_override=None if divisor_override is None
+            else float(divisor_override))
     return registry.apply(nn_ops.avg_pool2d_op, x,
                           kernel_size=_pair(kernel_size),
                           stride=_pair(stride), padding=_pair(padding),
@@ -577,15 +592,11 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     from ...ops import nn_ops_nd as nd
 
     k, s, p = _pool_args(kernel_size, stride, padding, 3)
-    out = registry.apply(nd.avg_pool3d_op, x, kernel_size=k, stride=s,
-                         padding=p, ceil_mode=bool(ceil_mode),
-                         exclusive=bool(exclusive))
-    if divisor_override is not None:
-        import numpy as _np
-
-        out = ops.scale(out, float(_np.prod(k)) /
-                        float(divisor_override))
-    return out
+    return registry.apply(
+        nd.avg_pool3d_op, x, kernel_size=k, stride=s, padding=p,
+        ceil_mode=bool(ceil_mode), exclusive=bool(exclusive),
+        divisor_override=None if divisor_override is None
+        else float(divisor_override))
 
 
 def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,

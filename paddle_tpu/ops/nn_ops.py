@@ -787,10 +787,11 @@ def _sdpa_plain(q, k, v, mask=None, key=None, dropout=0.0, causal=False,
                              bool(causal), None)
         return jnp.swapaxes(out, 1, 2)
     # Self-authored short-sequence kernel (pallas_kernels/short_attention):
-    # whole [S,S] scores VMEM-resident, in-kernel hardware-PRNG dropout.
-    # Wins whenever one head's scores fit VMEM (S <= 1024); at those
-    # sizes the einsum path's HBM round-trips of [B,H,S,S] probs (and
-    # dropout masks) dominate (r4 BERT profile).
+    # whole [S,S] scores VMEM-resident, in-kernel counter-hash dropout.
+    # Beats einsum whenever one head's scores fit VMEM (S <= 1024) —
+    # there the einsum path's HBM round-trips of [B,H,S,S] probs (and
+    # dropout masks) dominate (r4 BERT profile).  Causal S == 1024 is
+    # preempted by long_attention above.
     short_ok = (mask is None and Sq == Sk and Sq <= 1024
                 and Sq % 128 == 0 and D % 64 == 0 and D <= 128
                 and Hkv == H and on_tpu)

@@ -93,51 +93,70 @@ conv3d_transpose_op = register_op(
 
 # -- generic channel-first pooling ------------------------------------------
 
+def _ceil_extension(L, k, s, p):
+    """High-side padding extension for ceil_mode, with the reference
+    rule that a window starting entirely inside the RIGHT padding is
+    dropped: extend only while the extra window's start < L."""
+    rem = (L + 2 * p - k) % s
+    if rem == 0:
+        return 0
+    floor_out = (L + 2 * p - k) // s + 1
+    start = floor_out * s - p  # start index of the candidate window
+    if start >= L:
+        return 0
+    return s - rem
+
+
 def _pool_nd(x, kernel, stride, padding, nd, op, exclusive=True,
-             ceil_mode=False):
+             ceil_mode=False, divisor_override=None):
     window = (1, 1) + kernel
     strides = (1, 1) + stride
-    hi = list(padding)
-    if ceil_mode:
-        # extend the high side so the last partial window is included
-        # (reduce_window pads with the init value: -inf for max, 0 for
-        # sum — and exclusive counts divide by the true element count)
-        for i in range(nd):
-            L = x.shape[2 + i]
-            rem = (L + 2 * padding[i] - kernel[i]) % stride[i]
-            if rem:
-                hi[i] = padding[i] + (stride[i] - rem)
-    pads = ((0, 0), (0, 0)) + tuple(
-        (p, h) for p, h in zip(padding, hi))
+    extra = [(_ceil_extension(x.shape[2 + i], kernel[i], stride[i],
+                              padding[i]) if ceil_mode else 0)
+             for i in range(nd)]
     if op == "max":
+        pads = ((0, 0), (0, 0)) + tuple(
+            (p, p + e) for p, e in zip(padding, extra))
         neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
                else jnp.iinfo(x.dtype).min)
         return jax.lax.reduce_window(x, neg, jax.lax.max, window,
                                      strides, pads)
-    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides,
-                                   pads)
-    if (exclusive and any(padding)) or ceil_mode:
-        counts = jax.lax.reduce_window(jnp.ones_like(x), 0.0,
-                                       jax.lax.add, window, strides,
-                                       pads)
-        return summed / counts
-    return summed / float(np.prod(kernel))
+    # avg: pad the data explicitly so the DIVISOR semantics are exact —
+    # exclusive=True counts real elements only; exclusive=False
+    # (count_include_pad) counts real + declared padding but NEVER the
+    # implicit ceil extension; divisor_override replaces the count.
+    widths = ((0, 0), (0, 0)) + tuple((p, p) for p in padding)
+    xp = jnp.pad(x, widths)
+    pads = ((0, 0), (0, 0)) + tuple((0, e) for e in extra)
+    summed = jax.lax.reduce_window(xp, 0.0, jax.lax.add, window,
+                                   strides, pads)
+    if divisor_override is not None:
+        return summed / float(divisor_override)
+    if exclusive:
+        mask = jnp.pad(jnp.ones_like(x), widths)
+    else:
+        mask = jnp.ones_like(xp)
+    counts = jax.lax.reduce_window(mask, 0.0, jax.lax.add, window,
+                                   strides, pads)
+    return summed / counts
 
 
 def _mk_pool(name, nd, op):
     def plain(x, kernel_size, stride, padding, ceil_mode=False,
-              exclusive=True):
+              exclusive=True, divisor_override=None):
         return _pool_nd(x, kernel_size, stride, padding, nd, op,
-                        exclusive, ceil_mode)
+                        exclusive, ceil_mode, divisor_override)
 
     return register_op(name, plain, static_argnames=(
-        "kernel_size", "stride", "padding", "ceil_mode", "exclusive"))
+        "kernel_size", "stride", "padding", "ceil_mode", "exclusive",
+        "divisor_override"))
 
 
 max_pool1d_op = _mk_pool("max_pool1d", 1, "max")
 max_pool3d_op = _mk_pool("max_pool3d", 3, "max")
 avg_pool1d_op = _mk_pool("avg_pool1d", 1, "avg")
 avg_pool3d_op = _mk_pool("avg_pool3d", 3, "avg")
+avg_pool2d_g_op = _mk_pool("avg_pool2d_g", 2, "avg")
 
 
 def _lp_pool_nd(x, kernel_size, stride, padding, norm_type):

@@ -368,11 +368,42 @@ def test_ceil_mode_pools_match_torch():
         F.avg_pool3d(_t(x3), 2, 2, ceil_mode=True).numpy(),
         tF.avg_pool3d(torch.tensor(x3), 2, 2,
                       ceil_mode=True).numpy(), rtol=1e-5)
-    # divisor_override
+    # divisor_override replaces the divisor on the RAW window sum
     np.testing.assert_allclose(
         F.avg_pool3d(_t(x3), 2, 2, divisor_override=1).numpy(),
         tF.avg_pool3d(torch.tensor(x3), 2, 2,
                       divisor_override=1).numpy(), rtol=1e-6)
+    ones3 = np.ones((1, 1, 4, 4, 4), np.float32)
+    np.testing.assert_allclose(
+        F.avg_pool3d(_t(ones3), 2, 2, padding=1,
+                     divisor_override=8).numpy(),
+        tF.avg_pool3d(torch.tensor(ones3), 2, 2, padding=1,
+                      divisor_override=8).numpy(), rtol=1e-6)
+    # padded windows: paddle exclusive == torch count_include_pad=False
+    xp = R.randn(1, 1, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        F.avg_pool1d(_t(xp), 2, 2, padding=1, ceil_mode=True).numpy(),
+        tF.avg_pool1d(torch.tensor(xp), 2, 2, padding=1,
+                      ceil_mode=True,
+                      count_include_pad=False).numpy(), rtol=1e-6)
+    np.testing.assert_allclose(
+        F.avg_pool3d(_t(ones3), 2, 2, padding=1, ceil_mode=True,
+                     exclusive=False).numpy(),
+        tF.avg_pool3d(torch.tensor(ones3), 2, 2, padding=1,
+                      ceil_mode=True,
+                      count_include_pad=True).numpy(), rtol=1e-6)
+    # a ceil window starting fully inside right padding is dropped
+    got = F.max_pool1d(_t(xp), 2, 2, padding=1, ceil_mode=True)
+    want = tF.max_pool1d(torch.tensor(xp), 2, 2, padding=1,
+                         ceil_mode=True)
+    assert tuple(got.shape) == tuple(want.shape)
+    np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-6)
+    # avg_pool2d gains exact ceil/divisor semantics via the N-d op
+    np.testing.assert_allclose(
+        F.avg_pool2d(_t(np.ones((1, 1, 5, 5), np.float32)), 2, 2,
+                     ceil_mode=True, divisor_override=3).numpy(),
+        tF.avg_pool2d(torch.ones(1, 1, 5, 5), 2, 2, ceil_mode=True,
+                      divisor_override=3).numpy(), rtol=1e-6)
 
 
 def test_channel_dropout_data_format():

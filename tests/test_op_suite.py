@@ -778,6 +778,9 @@ op("conv3d", lambda x, w: F.conv3d(x, w, stride=2),
 op("conv3d_transpose",
    lambda x, w: F.conv3d_transpose(x, w, stride=2),
    [fa(1, 2, 3, 3, 3), fa(2, 3, 2, 2, 2)], None, gtol=5e-2)
+op("avg_pool2d_g",
+   lambda x: F.avg_pool2d(x, 2, 2, ceil_mode=True),
+   [fa(1, 2, 5, 5)], None)
 op("max_pool1d", lambda x: F.max_pool1d(x, 2), [fa(2, 3, 8)], None)
 op("max_pool3d", lambda x: F.max_pool3d(x, 2),
    [fa(1, 2, 4, 4, 4)], None)
