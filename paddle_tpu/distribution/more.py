@@ -734,10 +734,12 @@ class LKJCholesky(Distribution):
     def log_prob(self, value):
         """Density over the diagonal (reference lkj_cholesky
         log_prob): sum_i (d - i - 1 + 2(eta - 1)) log L_ii minus the
-        log normalizer (product of Beta functions)."""
-        d = self.dim
+        log normalizer (product of Beta functions).  ``dim`` rides as
+        a static attr — cached_apply shares one OpDef per code object,
+        so a closure over self.dim would bake the first instance's
+        dimension into the shared op."""
 
-        def fn(eta, L):
+        def fn(eta, L, d):
             diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
             order = jnp.arange(2, d + 1, dtype=jnp.float32)
             unnorm = jnp.sum(
@@ -752,4 +754,5 @@ class LKJCholesky(Distribution):
                 - _gammaln(alpha + i / 2.0), -1)
             return unnorm - lnorm
 
-        return _op("lkj_log_prob", fn, self.concentration, _t(value))
+        return _op("lkj_log_prob", fn, self.concentration, _t(value),
+                   d=self.dim)

@@ -121,6 +121,14 @@ def _pool_nd(x, kernel, stride, padding, nd, op, exclusive=True,
                else jnp.iinfo(x.dtype).min)
         return jax.lax.reduce_window(x, neg, jax.lax.max, window,
                                      strides, pads)
+    # fast path: no padding/ceil/override -> the divisor is the
+    # compile-time constant prod(kernel); one reduce_window, no pad copy
+    if (divisor_override is None and not any(padding)
+            and not any(extra)):
+        pads0 = ((0, 0), (0, 0)) + ((0, 0),) * nd
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window,
+                                       strides, pads0)
+        return summed / float(np.prod(kernel))
     # avg: pad the data explicitly so the DIVISOR semantics are exact —
     # exclusive=True counts real elements only; exclusive=False
     # (count_include_pad) counts real + declared padding but NEVER the

@@ -26,6 +26,10 @@ from __future__ import annotations
 
 from ..ops import registry as _registry
 
+#: names registered through this module — OUT-OF-TREE ops, excluded
+#: from framework op inventories (e.g. the OpTest coverage gate).
+CUSTOM_OP_NAMES: set = set()
+
 
 class CustomOpHandle:
     """What ``register_custom_op`` returns: callable + introspection."""
@@ -100,6 +104,7 @@ def register_custom_op(name, fn=None, *, vjp=None, fwd=None,
         op = _registry.register_op(
             name, f, fwd=use_fwd, bwd=vjp, n_outputs=n_outputs,
             static_argnames=tuple(static_argnames))
+        CUSTOM_OP_NAMES.add(name)
         handle = CustomOpHandle(op, name)
         handle.spmd_rule = spmd_rule
         # surface on the functional namespace like built-ins

@@ -445,3 +445,16 @@ def test_lkj_cholesky():
     # (r -> row) map = 1 (L_11 determined); integrate exp(lp)
     total = np.trapezoid(np.exp(lps), rs)
     np.testing.assert_allclose(total, 1.0, rtol=5e-2)
+
+
+def test_lkj_log_prob_not_cached_across_dims():
+    """cached_apply shares OpDefs per code object: dim must ride as a
+    static attr, or a d=2 instance poisons later dims (code-review
+    r4)."""
+    from paddle_tpu.distribution import LKJCholesky
+
+    l2 = LKJCholesky(2, 3.0)
+    l2.log_prob(_t(np.array([[1.0, 0.0], [0.6, 0.8]], np.float32)))
+    l3 = LKJCholesky(3, 2.0)
+    v = float(l3.log_prob(_t(np.eye(3, dtype=np.float32))))
+    np.testing.assert_allclose(v, -0.6156, atol=1e-3)

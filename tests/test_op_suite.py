@@ -821,7 +821,11 @@ SKIP = {
 def test_coverage_complete():
     """Every registered op must be covered by a table row (or an explicit,
     justified SKIP)."""
-    registered = set(all_ops())
+    from paddle_tpu.utils.cpp_extension import CUSTOM_OP_NAMES
+
+    # out-of-tree ops (register_custom_op) are user code, not framework
+    # inventory — they may be registered by other test modules
+    registered = set(all_ops()) - set(CUSTOM_OP_NAMES)
     covered = set()
     for s in SPECS.values():
         covered.update(s.covers)
