@@ -15,11 +15,17 @@ import time
 
 import numpy as np
 
+_T0 = time.perf_counter()
 
 
 def _enable_compile_cache():
     from paddle_tpu.utils import enable_compile_cache
 
+    # enable_compile_cache defaults min_compile_secs=0 because the axon
+    # TPU tunnel compiles ASYNCHRONOUSLY: jax's client-side compile
+    # timer reads ~0s, so any positive threshold persisted nothing —
+    # every fresh process (including the driver's end-of-round run)
+    # recompiled every program, which produced rc:124 in rounds 3-4.
     cache_dir = enable_compile_cache()
     if cache_dir is None:
         print("compile cache: DISABLED (enable failed)", file=sys.stderr)
@@ -116,14 +122,16 @@ def main():
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
 
     print("compiling + warmup...", file=sys.stderr)
-    dt, loss = _time_steps(step.step, (ids, ids), steps, "llama")
-
     tokens_per_step = batch * seq
-    tok_s = tokens_per_step / dt
-    tok_s_chip = tok_s / n_devices
     # MFU convention: model FLOPs (6N + attn, fwd+bwd) / peak — remat's
     # extra forward is hardware overhead, not counted as useful FLOPs.
     flops_per_token = model.flops_per_token(seq)
+    dt, loss = _guarded(
+        lambda: _time_steps(step.step, (ids, ids), steps, "llama"),
+        flops_per_token * tokens_per_step / n_devices, "llama")
+
+    tok_s = tokens_per_step / dt
+    tok_s_chip = tok_s / n_devices
     mfu = tok_s_chip * flops_per_token / _peak_flops_per_chip()
     print(f"step {dt * 1e3:.1f} ms, loss {float(loss):.3f}, "
           f"tokens/s/chip {tok_s_chip:.0f}, MFU {mfu:.3f}",
@@ -149,8 +157,24 @@ def main():
     # always the freshest parseable result whatever the driver's budget.
     print(json.dumps(result), flush=True)
 
-    def _extend(key, skip_env, fn):
+    # Wall-clock budget for the whole bench process.  The driver kills us
+    # (rc 124 in rounds 3-4) at an unknown limit; rather than die
+    # mid-compile and lose the tail configs, skip any config whose
+    # worst-case (cold-cache) cost doesn't fit the remaining budget and
+    # record WHY in the artifact.
+    budget_s = float(os.environ.get("PT_BENCH_BUDGET_S", "1500"))
+
+    def _extend(key, skip_env, fn, est_cold_s):
         if on_cpu or os.environ.get(skip_env) == "1":
+            return
+        elapsed = time.perf_counter() - _T0
+        if elapsed + est_cold_s > budget_s:
+            print(f"{key}: SKIPPED (elapsed {elapsed:.0f}s + est "
+                  f"{est_cold_s}s > budget {budget_s:.0f}s)",
+                  file=sys.stderr)
+            result[key] = {"skipped": "budget",
+                           "elapsed_s": round(elapsed, 1)}
+            print(json.dumps(result), flush=True)
             return
         try:
             result[key] = fn(jax)
@@ -158,6 +182,8 @@ def main():
             print(f"{key}: FAILED: {e}", file=sys.stderr)
             result[key] = {"error": str(e)[:200]}
         _cache_report(key)
+        print(f"elapsed after {key}: "
+              f"{time.perf_counter() - _T0:.0f}s", file=sys.stderr)
         print(json.dumps(result), flush=True)
 
     if not on_cpu:
@@ -171,12 +197,14 @@ def main():
         gc.collect()
 
     # Cheapest-compile-first; the ~1.6B config (longest compile) goes last
-    # so a driver timeout can only ever cost the tail config.
-    _extend("resnet50", "PT_BENCH_SKIP_RESNET", _bench_resnet)
-    _extend("bert_base_squad", "PT_BENCH_SKIP_BERT", _bench_bert)
-    _extend("detection_amp_o2", "PT_BENCH_SKIP_DET", _bench_detection)
-    _extend("sd_unet", "PT_BENCH_SKIP_UNET", _bench_unet)
-    _extend("large", "PT_BENCH_SKIP_LARGE", _bench_large)
+    # so a budget skip can only ever cost the tail configs.  Cold-cost
+    # estimates from the r4 run (first-step + multi-step compiles).
+    _extend("resnet50", "PT_BENCH_SKIP_RESNET", _bench_resnet, 150)
+    _extend("bert_base_squad", "PT_BENCH_SKIP_BERT", _bench_bert, 200)
+    _extend("detection_amp_o2", "PT_BENCH_SKIP_DET", _bench_detection, 150)
+    _extend("serving", "PT_BENCH_SKIP_SERVING", _bench_serving, 120)
+    _extend("sd_unet", "PT_BENCH_SKIP_UNET", _bench_unet, 250)
+    _extend("large", "PT_BENCH_SKIP_LARGE", _bench_large, 500)
 
 
 def _bench_detection(jax):
@@ -221,7 +249,9 @@ def _bench_detection(jax):
     box_t = rng.randn(batch, 4, 10, 10).astype(np.float32)
     cls_t = (rng.rand(batch, 80, 10, 10) > 0.95).astype(np.float32)
     print("detection: compiling...", file=sys.stderr)
-    dt, loss = _time_multi(step, (imgs, box_t, cls_t), 10, "detection")
+    dt, loss = _guarded(
+        lambda: _time_multi(step, (imgs, box_t, cls_t), 10, "detection"),
+        None, "detection")
     imgs_s = batch / dt
     print(f"detection: step {dt * 1e3:.1f} ms, {imgs_s:.0f} imgs/s",
           file=sys.stderr)
@@ -269,7 +299,9 @@ def _bench_unet(jax):
     ctx = rng.randn(batch, 77, 768).astype(np.float32)
     noise = rng.randn(batch, 4, 32, 32).astype(np.float32)
     print("unet: compiling (~810M params)...", file=sys.stderr)
-    dt, loss = _time_multi(step, (lat, t, ctx, noise), 5, "unet")
+    dt, loss = _guarded(
+        lambda: _time_multi(step, (lat, t, ctx, noise), 5, "unet"),
+        None, "unet")
     samples_s = batch / dt
     print(f"unet: step {dt * 1e3:.1f} ms, {samples_s:.1f} samples/s",
           file=sys.stderr)
@@ -313,11 +345,13 @@ def _bench_bert(jax):
     starts = rng.randint(0, seq, (batch,)).astype(np.int32)
     ends = rng.randint(0, seq, (batch,)).astype(np.int32)
     print("bert: compiling...", file=sys.stderr)
-    dt, loss = _time_multi(step, (ids, starts, ends), 5, "bert")
+    flops_tok = model.qa.bert.flops_per_token(seq)
+    dt, loss = _guarded(
+        lambda: _time_multi(step, (ids, starts, ends), 5, "bert"),
+        flops_tok * batch * seq, "bert")
     seqs_s = batch / dt
     tok_s = batch * seq / dt
-    mfu = tok_s * model.qa.bert.flops_per_token(seq) / \
-        _peak_flops_per_chip()
+    mfu = tok_s * flops_tok / _peak_flops_per_chip()
     print(f"bert: step {dt * 1e3:.1f} ms, {seqs_s:.1f} seq/s, "
           f"MFU {mfu:.3f}", file=sys.stderr)
     return {"value": round(seqs_s, 1), "unit": "sequences/s/chip",
@@ -348,7 +382,9 @@ def _bench_resnet(jax):
     imgs = jnp.asarray(rng.randn(batch, 3, 224, 224), jnp.bfloat16)
     labels = rng.randint(0, 1000, (batch,)).astype(np.int32)
     print("resnet50: compiling...", file=sys.stderr)
-    dt, loss = _time_multi(step, (imgs, labels), 10, "resnet50")
+    dt, loss = _guarded(
+        lambda: _time_multi(step, (imgs, labels), 10, "resnet50"),
+        batch * 3 * 4.1e9, "resnet50")
     imgs_s = batch / dt
     # ~4.1 GFLOP fwd per 224x224 image; train ~= 3x fwd.
     mfu = imgs_s * 3 * 4.1e9 / _peak_flops_per_chip()
@@ -359,53 +395,166 @@ def _bench_resnet(jax):
 
 
 
-def _sync(x):
-    """Block on device completion.  The step returns a paddle Tensor —
-    an opaque pytree leaf jax.block_until_ready would silently skip
-    (it would then time only async dispatch) — so sync the raw array."""
+def _fetch(x):
+    """Force REAL device completion by pulling the value to host.
+
+    ``jax.block_until_ready`` is a silent no-op over the axon TPU tunnel
+    (verified live: a 200-step scanned program "synced" in 1.3 ms while
+    ``device_get`` on the same output took 48 s) — it is what let the
+    r4 artifact record a physically impossible BERT MFU of 61.  A
+    device→host transfer cannot complete before the value exists, so
+    every timed section below ends in a fetch."""
     import jax
 
-    jax.block_until_ready(getattr(x, "_data", x))
+    return float(jax.device_get(getattr(x, "_data", x)))
 
 
 def _time_steps(step_fn, args, steps, tag):
-    """Shared compile/warmup/timed-loop harness (one methodology for
-    every bench section)."""
+    """Shared timing harness: difference two fetched run lengths.
+
+    wall(n steps + fetch) − wall(1 step + fetch) = (n−1) step executions
+    + (n−1) dispatches (~20 ms each over the tunnel).  The differencing
+    cancels both the fetch round-trip (~100 ms) and any async-dispatch
+    undercount; dispatch overhead is real per-step cost for this path
+    and is reported as part of the step."""
     t0 = time.perf_counter()
     loss = step_fn(*args)
-    _sync(loss)
+    lv = _fetch(loss)
     print(f"{tag}: first step {time.perf_counter() - t0:.1f}s, "
-          f"loss {float(loss):.3f}", file=sys.stderr)
+          f"loss {lv:.3f}", file=sys.stderr)
+    # warm + baseline: one step, fetched
+    t0 = time.perf_counter()
     loss = step_fn(*args)
-    _sync(loss)
+    _fetch(loss)
+    t_one = time.perf_counter() - t0
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = step_fn(*args)
-    # steps chain through the (donated) param state, so the last loss
-    # being ready implies the whole sequence executed on device.
-    _sync(loss)
-    return (time.perf_counter() - t0) / steps, loss
+    lv = _fetch(loss)
+    t_n = time.perf_counter() - t0
+    dt = max(t_n - t_one, 1e-9) / max(steps - 1, 1)
+    return dt, lv
 
 
 def _time_multi(step, args, steps, tag):
     """Timed via CompiledTrainStep.multi_step: ``steps`` optimizer steps
     per dispatched program (lax.scan), so per-dispatch tunnel latency
-    (~20 ms on this setup) doesn't tax short-step models.  Single-step
-    warmup first so the step body itself is cache-warm."""
+    doesn't tax short-step models.  Methodology: difference one vs two
+    fetched multi_step dispatches — wall(2×multi_step(k) + fetch) −
+    wall(1×multi_step(k) + fetch) = k step executions + one ~20 ms
+    dispatch, cancelling the fetch round-trip."""
     t0 = time.perf_counter()
     loss = step.step(*args)
-    _sync(loss)
+    lv = _fetch(loss)
     print(f"{tag}: first step {time.perf_counter() - t0:.1f}s, "
-          f"loss {float(loss):.3f}", file=sys.stderr)
+          f"loss {lv:.3f}", file=sys.stderr)
     t0 = time.perf_counter()
     loss = step.multi_step(steps, *args)
-    _sync(loss)
+    _fetch(loss)
     print(f"{tag}: multi-step compile+run {time.perf_counter() - t0:.1f}s",
           file=sys.stderr)
     t0 = time.perf_counter()
     loss = step.multi_step(steps, *args)
-    _sync(loss)
-    return (time.perf_counter() - t0) / steps, loss
+    _fetch(loss)
+    t_one = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    loss = step.multi_step(steps, *args)
+    loss = step.multi_step(steps, *args)
+    lv = _fetch(loss)
+    t_two = time.perf_counter() - t0
+    dt = max(t_two - t_one, 1e-9) / steps
+    return dt, lv
+
+
+# Conservative absolute floor: no real train step of any bench config
+# dispatches + executes in under this on one chip.
+_STEP_FLOOR_S = 1e-3
+
+
+def _implausible(dt, flops_per_step=None):
+    """Reject physically impossible measurements instead of recording
+    them (VERDICT r4 weak #1: a 61.23 MFU made it into the artifact).
+    Returns a reason string, or None if the measurement is sane."""
+    if not (dt > 0):
+        return f"non-positive step time {dt}"
+    if dt < _STEP_FLOOR_S:
+        return f"step time {dt * 1e3:.3f} ms below {_STEP_FLOOR_S * 1e3} ms floor"
+    if flops_per_step is not None:
+        mfu = flops_per_step / dt / _peak_flops_per_chip()
+        if mfu > 1.0:
+            return f"MFU {mfu:.2f} > 1 (exceeds peak FLOPs)"
+    return None
+
+
+def _guarded(time_fn, flops_per_step, tag):
+    """Run a timing closure with the plausibility guard: re-measure once
+    on an implausible result, and raise (→ {"error": ...} in the
+    artifact) if it stays implausible."""
+    dt, lv = time_fn()
+    reason = _implausible(dt, flops_per_step)
+    if reason is not None:
+        print(f"{tag}: IMPLAUSIBLE ({reason}); re-measuring once",
+              file=sys.stderr)
+        dt, lv = time_fn()
+        reason = _implausible(dt, flops_per_step)
+        if reason is not None:
+            raise RuntimeError(f"implausible measurement: {reason}")
+    return dt, lv
+
+
+def _bench_serving(jax):
+    """Serving throughput (VERDICT r4 next-8): continuous-batching
+    greedy decode over the paged-KV engine — the Predictor/serving
+    stack's hot path (reference block_multi_head_attention loop).
+    Reports decode tokens/s at full batch occupancy."""
+    import gc
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.inference.serving import PagedLlamaEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    gc.collect()
+    # head_dim must be 128: the paged-attention Pallas kernel requires
+    # last-dim 128 blocks, and over the async tunnel a Mosaic lowering
+    # error surfaces as a HANG (compile never completes), not a raise.
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                      intermediate_size=2752, num_hidden_layers=8,
+                      num_attention_heads=8, num_key_value_heads=8,
+                      max_position_embeddings=512, dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    n_params = model.num_params()
+    max_seqs = int(os.environ.get("PT_BENCH_SERVE_SEQS", "8"))
+    eng = PagedLlamaEngine(model, max_seqs=max_seqs, page_size=16,
+                           max_len=512, dtype=jnp.bfloat16)
+    rng = np.random.RandomState(0)
+    print("serving: prefill + compiling decode...", file=sys.stderr)
+    for _ in range(max_seqs):
+        eng.add_request(rng.randint(0, cfg.vocab_size, (128,)))
+    eng.step()  # compile the decode program
+    # engine.step() ends in a host transfer of the sampled tokens, so
+    # wall time is honest; difference two loop lengths to cancel the
+    # per-step fetch.
+    k = 16
+    t0 = time.perf_counter()
+    for _ in range(k):
+        eng.step()
+    t_k = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(3 * k):
+        eng.step()
+    t_3k = time.perf_counter() - t0
+    dt = max(t_3k - t_k, 1e-9) / (2 * k)
+    reason = _implausible(dt)
+    if reason is not None:
+        raise RuntimeError(f"implausible measurement: {reason}")
+    tok_s = max_seqs / dt
+    print(f"serving: decode step {dt * 1e3:.2f} ms, {tok_s:.0f} tok/s "
+          f"(batch {max_seqs})", file=sys.stderr)
+    return {"value": round(tok_s, 1), "unit": "decode_tokens/s/chip",
+            "batch": max_seqs, "prompt": 128, "page_size": 16,
+            "model_params": n_params}
 
 
 def _bench_large(jax):
@@ -444,7 +593,9 @@ def _bench_large(jax):
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     print("large: compiling (~1.6B params)...", file=sys.stderr)
-    dt, loss = _time_steps(step.step, (ids, ids), steps, "large")
+    dt, loss = _guarded(
+        lambda: _time_steps(step.step, (ids, ids), steps, "large"),
+        flops_tok * batch * seq, "large")
 
     # The large config trains on exactly ONE chip (state_device above);
     # other local chips idle, so per-chip throughput divides by 1.

@@ -2,3 +2,15 @@ from . import state  # noqa: F401
 from .auto_cast import auto_cast, decorate, amp_guard  # noqa: F401
 from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
 from . import debugging  # noqa: F401
+
+
+def is_float16_supported(device=None):
+    """Reference python/paddle/amp/__init__.py:52.  TPUs compute fp16
+    via bf16 MXU passes; XLA supports the dtype on every backend."""
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    """Reference python/paddle/amp/__init__.py:79.  bf16 is the native
+    TPU matmul dtype (and XLA:CPU supports it for tests)."""
+    return True

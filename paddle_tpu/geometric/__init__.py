@@ -144,3 +144,149 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
 
     return _op("send_uv", fn, x, y, src_index, dst_index,
                msg=message_op)
+
+
+# --- sampling + reindex (reference python/paddle/geometric/
+# {sampling/neighbors.py:30,221, reindex.py:42}; incubate/operators/
+# graph_{sample_neighbors,reindex,khop_sampler}.py re-export these).
+# Host-side numpy: graph sampling is input-pipeline work, like the
+# reference's CPU kernels. ------------------------------------------------
+
+def _np1(t):
+    import numpy as _n
+
+    a = _n.asarray(t._data if hasattr(t, "_data") else t)
+    return a.reshape(-1)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Uniformly sample up to ``sample_size`` in-neighbors per input
+    node from a CSC graph (reference sampling/neighbors.py:30)."""
+    from ..core.tensor import Tensor
+    import jax.numpy as _jnp
+
+    rowv = _np1(row)
+    cp = _np1(colptr)
+    nodes = _np1(input_nodes)
+    ev = None if eids is None else _np1(eids)
+    out_n, out_cnt, out_e = [], [], []
+    for v in nodes:
+        beg, end = int(cp[v]), int(cp[v + 1])
+        neigh = rowv[beg:end]
+        eid = np.arange(beg, end)
+        if sample_size != -1 and neigh.size > sample_size:
+            pick = np.random.choice(neigh.size, sample_size,
+                                    replace=False)
+            neigh, eid = neigh[pick], eid[pick]
+        out_n.append(neigh)
+        out_e.append(eid if ev is None else ev[eid])
+        out_cnt.append(neigh.size)
+    out_neighbors = Tensor(_jnp.asarray(np.concatenate(out_n).astype(
+        rowv.dtype) if out_n else np.zeros(0, rowv.dtype)))
+    out_count = Tensor(_jnp.asarray(np.asarray(out_cnt, np.int32)))
+    if return_eids:
+        return out_neighbors, out_count, Tensor(_jnp.asarray(
+            np.concatenate(out_e).astype(np.int64) if out_e
+            else np.zeros(0, np.int64)))
+    return out_neighbors, out_count
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None,
+                              return_eids=False, name=None):
+    """Weight-proportional sampling without replacement (reference
+    sampling/neighbors.py:221)."""
+    from ..core.tensor import Tensor
+    import jax.numpy as _jnp
+
+    rowv = _np1(row)
+    cp = _np1(colptr)
+    wts = _np1(edge_weight).astype(np.float64)
+    nodes = _np1(input_nodes)
+    ev = None if eids is None else _np1(eids)
+    out_n, out_cnt, out_e = [], [], []
+    for v in nodes:
+        beg, end = int(cp[v]), int(cp[v + 1])
+        neigh = rowv[beg:end]
+        eid = np.arange(beg, end)
+        w = wts[beg:end]
+        if sample_size != -1 and neigh.size > sample_size:
+            p = w / w.sum()
+            pick = np.random.choice(neigh.size, sample_size,
+                                    replace=False, p=p)
+            neigh, eid = neigh[pick], eid[pick]
+        out_n.append(neigh)
+        out_e.append(eid if ev is None else ev[eid])
+        out_cnt.append(neigh.size)
+    out_neighbors = Tensor(_jnp.asarray(np.concatenate(out_n).astype(
+        rowv.dtype) if out_n else np.zeros(0, rowv.dtype)))
+    out_count = Tensor(_jnp.asarray(np.asarray(out_cnt, np.int32)))
+    if return_eids:
+        return out_neighbors, out_count, Tensor(_jnp.asarray(
+            np.concatenate(out_e).astype(np.int64) if out_e
+            else np.zeros(0, np.int64)))
+    return out_neighbors, out_count
+
+
+def _reindex(x, neighbors, count):
+    xv = _np1(x)
+    nb = _np1(neighbors)
+    cnt = _np1(count)
+    mapping = {}
+    out_nodes = []
+    for n in xv.tolist():
+        if n not in mapping:
+            mapping[n] = len(out_nodes)
+            out_nodes.append(n)
+    for n in nb.tolist():
+        if n not in mapping:
+            mapping[n] = len(out_nodes)
+            out_nodes.append(n)
+    src = np.asarray([mapping[n] for n in nb.tolist()], np.int64)
+    dst = np.repeat(np.arange(xv.size), cnt).astype(np.int64)
+    return src, dst, np.asarray(out_nodes, xv.dtype)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, name=None):
+    """Compact node ids to [0, n) with inputs first (reference
+    geometric/reindex.py:42; example contract in its docstring)."""
+    from ..core.tensor import Tensor
+    import jax.numpy as _jnp
+
+    src, dst, out_nodes = _reindex(x, neighbors, count)
+    return (Tensor(_jnp.asarray(src)), Tensor(_jnp.asarray(dst)),
+            Tensor(_jnp.asarray(out_nodes)))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: per-edge-type neighbor/count lists share
+    one id space (reference geometric/reindex.py:170)."""
+    from ..core.tensor import Tensor
+    import jax.numpy as _jnp
+
+    xv = _np1(x)
+    per_type = [(_np1(n), _np1(c)) for n, c in zip(neighbors, count)]
+    mapping = {}
+    out_nodes = []
+    for n in xv.tolist():
+        if n not in mapping:
+            mapping[n] = len(out_nodes)
+            out_nodes.append(n)
+    srcs, dsts = [], []
+    for nbt, cntt in per_type:
+        for v in nbt.tolist():
+            if v not in mapping:
+                mapping[v] = len(out_nodes)
+                out_nodes.append(v)
+        srcs.append(np.asarray([mapping[v] for v in nbt.tolist()],
+                               np.int64))
+        dsts.append(np.repeat(np.arange(xv.size), cntt).astype(np.int64))
+    from ..core.tensor import Tensor as _T
+
+    return (_T(_jnp.asarray(np.concatenate(srcs))),
+            _T(_jnp.asarray(np.concatenate(dsts))),
+            _T(_jnp.asarray(np.asarray(out_nodes, xv.dtype))))

@@ -6,3 +6,242 @@ models MoE).
 from . import nn  # noqa: F401
 from . import autograd  # noqa: F401
 from .ema import ExponentialMovingAverage  # noqa: F401
+
+# --- declared-__all__ re-exports + experimental optimizers/ops -------------
+# Reference: python/paddle/incubate/__init__.py __all__ (14 symbols).
+from ..geometric import (  # noqa: F401
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+from ..geometric import send_u_recv as _send_u_recv
+from ..geometric import (  # noqa: F401
+    reindex_graph as graph_reindex,
+    sample_neighbors as graph_sample_neighbors,
+)
+from .. import inference  # noqa: F401
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Legacy name for geometric.send_u_recv (reference
+    incubate/operators/graph_send_recv.py)."""
+    return _send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                        out_size=out_size)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop sampling + reindex (reference
+    incubate/operators/graph_khop_sampler.py:21): sample sample_sizes[i]
+    neighbors per frontier node per hop, then compact ids."""
+    from ..geometric import reindex_graph, sample_neighbors
+
+    cur = input_nodes
+    all_neigh, all_cnt, all_eids = [], [], []
+    import numpy as _np
+
+    import jax.numpy as _jnp
+
+    from ..core.tensor import Tensor
+
+    frontier = _np.asarray(
+        cur._data if hasattr(cur, "_data") else cur).reshape(-1)
+    seen = list(frontier.tolist())
+    per_hop_src = []
+    for size in sample_sizes:
+        res = sample_neighbors(row, colptr, Tensor(_jnp.asarray(frontier)),
+                               sample_size=size, eids=sorted_eids,
+                               return_eids=return_eids)
+        neigh, cnt = res[0], res[1]
+        if return_eids:
+            all_eids.append(res[2])
+        all_neigh.append(neigh)
+        all_cnt.append(cnt)
+        per_hop_src.append(frontier)
+        frontier = _np.unique(_np.asarray(neigh._data))
+        seen.extend(frontier.tolist())
+    # flatten hops into one neighbor/count list over the union frontier
+    srcs = _np.concatenate([_np.asarray(s) for s in per_hop_src])
+    neighs = _np.concatenate([_np.asarray(n._data) for n in all_neigh])
+    cnts = _np.concatenate([_np.asarray(c._data) for c in all_cnt])
+    edge_src, edge_dst, out_nodes = reindex_graph(
+        Tensor(_jnp.asarray(srcs)), Tensor(_jnp.asarray(neighs)),
+        Tensor(_jnp.asarray(cnts)))
+    sample_index = out_nodes
+    reindex_x = Tensor(_jnp.asarray(_np.arange(
+        _np.asarray(input_nodes._data if hasattr(input_nodes, "_data")
+                    else input_nodes).reshape(-1).size, _np.int64)))
+    if return_eids:
+        eids = Tensor(_jnp.concatenate(
+            [_jnp.asarray(e._data) for e in all_eids]))
+        return edge_src, edge_dst, sample_index, reindex_x, eids
+    return edge_src, edge_dst, sample_index, reindex_x
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) (reference incubate/operators/
+    softmax_mask_fuse.py; fused kernel phi/kernels/fusion/gpu/
+    fused_softmax_mask_kernel.cu).  XLA fuses the add into the softmax
+    on TPU — the fusion IS the default compilation."""
+    from ..ops import registry as _registry
+
+    import jax.numpy as _jnp
+
+    def _fn(x, mask):
+        import jax
+
+        return jax.nn.softmax(x + mask, axis=-1)
+
+    return _registry.cached_apply("softmax_mask_fuse", _fn, x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal (upper-triangular masked) softmax (reference
+    incubate/operators/softmax_mask_fuse_upper_triangle.py)."""
+    from ..ops import registry as _registry
+
+    def _fn(x):
+        import jax
+        import jax.numpy as _jnp
+
+        S = x.shape[-1]
+        causal = _jnp.tril(_jnp.ones((S, S), bool))
+        return jax.nn.softmax(
+            _jnp.where(causal, x, _jnp.finfo(x.dtype).min), axis=-1)
+
+    return _registry.cached_apply("softmax_mask_fuse_ut", _fn, x)
+
+
+def identity_loss(x, reduction="none"):
+    """Marks a loss for IPU-style backward entry (reference
+    incubate/nn/loss.py:36): returns x reduced by ``reduction``."""
+    from .. import ops
+
+    if reduction in ("none", 2):
+        return x
+    if reduction in ("sum", 1):
+        return ops.sum(x)
+    if reduction in ("mean", 0):
+        return ops.mean(x)
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+class LookAhead:
+    """Lookahead wrapper: every k fast steps, slow += alpha·(fast−slow),
+    fast = slow (reference incubate/optimizer/lookahead.py:27)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer cannot be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        self._slow = {}
+
+    def _params(self):
+        return self.inner_optimizer._parameter_list()
+
+    def step(self):
+        import jax.numpy as _jnp
+
+        for p in self._params():
+            if id(p) not in self._slow:
+                self._slow[id(p)] = _jnp.array(p._data)
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for p in self._params():
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (
+                    p._data.astype(slow.dtype) - slow)
+                self._slow[id(p)] = slow
+                p.set_value(slow.astype(p._data.dtype))
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        return {"inner": self.inner_optimizer.state_dict(),
+                "step": self._step_count}
+
+
+class ModelAverage:
+    """Windowed parameter averaging with apply()/restore() (reference
+    incubate/optimizer/modelaverage.py; two-window rolling sums —
+    sum_1 current + sum_2 previous — over the reference's three)."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.rate = float(average_window_rate)
+        self.min_w = int(min_average_window)
+        self.max_w = int(max_average_window)
+        self._params = list(parameters or [])
+        self._sum1 = {}
+        self._sum2 = {}
+        self._num = 0
+        self._old_num = 0
+        self._updates = 0
+        self._backup = None
+
+    def step(self):
+        import jax.numpy as _jnp
+
+        self._updates += 1
+        for p in self._params:
+            d = p._data.astype(_jnp.float32)
+            self._sum1[id(p)] = self._sum1.get(id(p), 0.0) + d
+        self._num += 1
+        window = min(self.max_w, int(self._updates * self.rate) or 1)
+        if self._num >= self.min_w and self._num >= window:
+            for p in self._params:
+                self._sum2[id(p)] = self._sum1[id(p)]
+                self._sum1[id(p)] = 0.0
+            self._old_num = self._num
+            self._num = 0
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap in averaged params (context-manager compatible)."""
+        import jax.numpy as _jnp
+
+        self._backup = {id(p): _jnp.array(p._data)
+                        for p in self._params}
+        denom = max(self._num + self._old_num, 1)
+        for p in self._params:
+            total = self._sum1.get(id(p), 0.0) + \
+                self._sum2.get(id(p), 0.0)
+            avg = total / denom if self._num + self._old_num else \
+                p._data.astype(_jnp.float32)
+            p.set_value(avg.astype(p._data.dtype))
+        self._need_restore = need_restore
+        return self
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p.set_value(self._backup[id(p)])
+        self._backup = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if getattr(self, "_need_restore", True):
+            self.restore()
+
+    def minimize(self, loss, **kw):
+        raise RuntimeError(
+            "ModelAverage wraps evaluation, not training: call step() "
+            "after the inner optimizer's step()")

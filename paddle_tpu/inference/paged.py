@@ -105,7 +105,11 @@ def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
     q = q._data if wrap else jnp.asarray(q)
     lengths = jnp.asarray(lengths, jnp.int32)
     page_indices = jnp.asarray(page_indices, jnp.int32)
-    if not _on_tpu():
+    # head_dim must tile to 128 lanes for the stock Pallas kernel; an
+    # incompatible shape must take the dense path — over the async
+    # tunnel a Mosaic lowering error surfaces as a compile HANG, not a
+    # raise, so guarding here is load-bearing.
+    if not _on_tpu() or q.shape[-1] % 128 != 0:
         out = _op("paged_decode_attention", _dense_paged_attention,
                   Tensor(q), Tensor(jnp.asarray(k_pages)),
                   Tensor(jnp.asarray(v_pages)), Tensor(lengths),

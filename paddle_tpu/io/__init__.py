@@ -170,6 +170,24 @@ class RandomSampler(Sampler):
         return self.num_samples
 
 
+class SubsetRandomSampler(Sampler):
+    """Random permutation over a fixed index subset (reference
+    python/paddle/io/dataloader/sampler.py:394)."""
+
+    def __init__(self, indices):
+        if len(indices) == 0:
+            raise ValueError(
+                "SubsetRandomSampler requires a non-empty indices")
+        self.indices = list(indices)
+
+    def __iter__(self):
+        order = np.random.permutation(len(self.indices))
+        return iter(self.indices[i] for i in order)
+
+    def __len__(self):
+        return len(self.indices)
+
+
 class WeightedRandomSampler(Sampler):
     def __init__(self, weights, num_samples, replacement=True):
         self.weights = np.asarray(weights, dtype=np.float64)

@@ -403,3 +403,39 @@ def load(path, **configs):
     with open(path + ".pdparams", "rb") as f:
         payload = pickle.load(f)
     return TranslatedLayer(payload)
+
+
+# --- dy2static logging / module-ignore surface -----------------------------
+# Reference: python/paddle/jit/api.py:144 (ignore_module),
+# python/paddle/jit/dy2static/logging_utils.py (set_code_level,
+# set_verbosity).  The ignore set is consulted by the AST control-flow
+# converter (jit/dy2static.py): functions defined in ignored modules are
+# never rewritten.
+_IGNORED_MODULES: set = set()
+_VERBOSITY = 0
+_CODE_LEVEL = -1
+
+
+def ignore_module(modules):
+    """Exempt ``modules`` (list of module objects) from dynamic-to-static
+    conversion (reference jit/api.py:144)."""
+    for m in modules:
+        _IGNORED_MODULES.add(getattr(m, "__name__", str(m)))
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Set dy2static log verbosity (reference
+    jit/dy2static/logging_utils.py)."""
+    global _VERBOSITY
+    _VERBOSITY = int(level)
+    import logging
+
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level > 0 else logging.WARNING)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Set which transformed-code stage gets logged (reference
+    jit/dy2static/logging_utils.py)."""
+    global _CODE_LEVEL
+    _CODE_LEVEL = int(level)

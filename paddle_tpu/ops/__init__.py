@@ -68,6 +68,7 @@ from .extra import (  # noqa: F401
     take, index_add, index_fill, unfold, as_strided, select_scatter,
     slice_scatter, atleast_1d, atleast_2d, atleast_3d, column_stack,
     row_stack, dstack, tensor_split, hsplit, vsplit, dsplit, diagflat,
+    index_put, index_put_,
 )
 from .random import (  # noqa: F401
     seed, get_rng_state, set_rng_state, randn, standard_normal, normal,
@@ -361,7 +362,8 @@ def _install_tensor_methods():
         renorm=renorm, cdist=cdist, tensordot=tensordot,
         bucketize=bucketize, nanmedian=nanmedian, mode=mode,
         kthvalue=kthvalue, rot90=rot90, take=take, index_add=index_add,
-        index_fill=index_fill, unfold=unfold, as_strided=as_strided,
+        index_fill=index_fill, index_put=index_put,
+        index_put_=index_put_, unfold=unfold, as_strided=as_strided,
         select_scatter=select_scatter, slice_scatter=slice_scatter,
         diagflat=diagflat, atleast_1d=atleast_1d, atleast_2d=atleast_2d,
         atleast_3d=atleast_3d, tensor_split=tensor_split,

@@ -457,3 +457,49 @@ _diagflat_op = register_op(
 
 def diagflat(x, offset=0, name=None):
     return apply(_diagflat_op, x, offset=int(offset))
+
+
+def _index_put_impl(x, value, *indices, accumulate):
+    idx = tuple(indices)
+    if len(idx) == 1 and idx[0].dtype == jnp.bool_:
+        # boolean-mask form: x[mask] = value.  Scalar values broadcast
+        # over the mask; vector values assign value[i] to the i-th True
+        # position (the reference kernel's contract).  The vector length
+        # is static (an input shape) even though the True count is not.
+        mask = idx[0]
+        suffix = x.shape[mask.ndim:]
+        if value.ndim <= len(suffix):  # scalar-per-masked-element
+            vb = jnp.broadcast_to(value, mask.shape + suffix)
+            m = mask.reshape(mask.shape + (1,) * len(suffix))
+            return jnp.where(m, x + vb if accumulate else vb, x)
+        k = int(value.shape[0])
+        flat_idx = jnp.nonzero(mask.reshape(-1), size=k,
+                               fill_value=mask.size)[0]
+        xf = x.reshape((-1,) + suffix)
+        out = xf.at[flat_idx].add(value, mode="drop") if accumulate \
+            else xf.at[flat_idx].set(value, mode="drop")
+        return out.reshape(x.shape)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+_index_put_op = register_op(
+    "index_put",
+    lambda x, value, *indices, accumulate=False: _index_put_impl(
+        x, value, *indices, accumulate=accumulate),
+    static_argnames=("accumulate",))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    """x[indices] = value (functional).  Reference:
+    python/paddle/tensor/manipulation.py:6610 (index_put_), :6659."""
+    indices = tuple(indices)
+    return apply(_index_put_op, x, value, *indices,
+                 accumulate=bool(accumulate))
+
+
+def index_put_(x, indices, value, accumulate=False, name=None):
+    out = index_put(x, indices, value, accumulate)
+    x.set_value(out)
+    return x

@@ -5,3 +5,6 @@ from .optimizers import (  # noqa: F401
     RMSProp,
 )
 from .lbfgs import LBFGS  # noqa: F401
+from .extra import (  # noqa: F401
+    ASGD, Adadelta, Adamax, NAdam, RAdam, Rprop,
+)

@@ -1,0 +1,279 @@
+"""The declared-``__all__`` optimizer tail: Adamax, NAdam, RAdam,
+Adadelta, Rprop, ASGD.
+
+Reference semantics: ``python/paddle/optimizer/{adamax,nadam,radam,
+adadelta,rprop,asgd}.py`` (update rules in each class docstring, math
+matching the phi kernels ``phi/kernels/{adamax,nadam,radam,adadelta,
+rprop,asgd}_kernel.h``).  Same style as optimizers.py: module-level
+jitted update bodies so eager steps hit the XLA executable cache.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+@jax.jit
+def _adamax_update(p, g, m, inf, lr, beta1, beta2, epsilon, b1pow):
+    m = beta1 * m + (1 - beta1) * g
+    inf = jnp.maximum(beta2 * inf + epsilon, jnp.abs(g))
+    new_p = p - (lr / (1 - b1pow)) * m / inf
+    return new_p, m, inf
+
+
+class Adamax(Optimizer):
+    """Adam variant on the infinity norm (reference
+    ``python/paddle/optimizer/adamax.py:45``; update rule at :58-64)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, multi_precision, name)
+        self._beta1 = float(beta1)
+        self._beta2 = float(beta2)
+        self._epsilon = float(epsilon)
+
+    def _step_count(self, p):
+        slots = self._accumulators.setdefault(id(p), {})
+        t = slots.get("_t", 0) + 1
+        slots["_t"] = t
+        return t
+
+    def _update_param(self, p, pd, gd, lr, wd):
+        m = self._get_accumulator(p, "moment", dtype=jnp.float32)
+        inf = self._get_accumulator(p, "inf_norm", dtype=jnp.float32)
+        t = self._step_count(p)
+        new_p, m, inf = _adamax_update(
+            pd.astype(jnp.float32), gd.astype(jnp.float32), m, inf, lr,
+            self._beta1, self._beta2, self._epsilon, self._beta1 ** t)
+        self._set_accumulator(p, "moment", m)
+        self._set_accumulator(p, "inf_norm", inf)
+        return new_p.astype(pd.dtype)
+
+
+@jax.jit
+def _nadam_update(p, g, m, v, mu_prod, lr, beta1, beta2, epsilon,
+                  b2pow, mu_t, mu_t1):
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * g * g
+    mu_prod_t = mu_prod * mu_t
+    mu_prod_t1 = mu_prod_t * mu_t1
+    m_hat = mu_t1 * m / (1 - mu_prod_t1) + (1 - mu_t) * g / (1 - mu_prod_t)
+    v_hat = v / (1 - b2pow)
+    new_p = p - lr * m_hat / (jnp.sqrt(v_hat) + epsilon)
+    return new_p, m, v, mu_prod_t
+
+
+class NAdam(Optimizer):
+    """Adam with Nesterov momentum (reference
+    ``python/paddle/optimizer/nadam.py:49``; rule at :60-75 — the
+    mu-product schedule with momentum_decay psi)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, multi_precision, name)
+        self._beta1 = float(beta1)
+        self._beta2 = float(beta2)
+        self._epsilon = float(epsilon)
+        self._psi = float(momentum_decay)
+
+    def _step_count(self, p):
+        slots = self._accumulators.setdefault(id(p), {})
+        t = slots.get("_t", 0) + 1
+        slots["_t"] = t
+        return t
+
+    def _update_param(self, p, pd, gd, lr, wd):
+        m = self._get_accumulator(p, "moment1", dtype=jnp.float32)
+        v = self._get_accumulator(p, "moment2", dtype=jnp.float32)
+        slots = self._accumulators.setdefault(id(p), {})
+        mu_prod = slots.get("_mu_prod", 1.0)
+        t = self._step_count(p)
+        mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = self._beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        new_p, m, v, mu_prod_t = _nadam_update(
+            pd.astype(jnp.float32), gd.astype(jnp.float32), m, v,
+            jnp.float32(mu_prod), lr, self._beta1, self._beta2,
+            self._epsilon, self._beta2 ** t, mu_t, mu_t1)
+        slots["_mu_prod"] = float(mu_prod_t)
+        self._set_accumulator(p, "moment1", m)
+        self._set_accumulator(p, "moment2", v)
+        return new_p.astype(pd.dtype)
+
+
+@jax.jit
+def _radam_update(p, g, m, v, lr, beta1, beta2, epsilon, b1pow, b2pow,
+                  rho_t, rho_inf):
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * g * g
+    m_hat = m / (1 - b1pow)
+    rectified = rho_t > 5.0
+    l_t = jnp.sqrt(1 - b2pow) / (jnp.sqrt(v) + epsilon)
+    r_t = jnp.sqrt((rho_t - 4) * (rho_t - 2) * rho_inf /
+                   ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+    new_p = jnp.where(rectified, p - lr * m_hat * r_t * l_t,
+                      p - lr * m_hat)
+    return new_p, m, v
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (reference ``python/paddle/optimizer/radam.py:49``;
+    rule at :58-76 — variance-rectification term r_t gated on rho_t>5)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, multi_precision, name)
+        self._beta1 = float(beta1)
+        self._beta2 = float(beta2)
+        self._epsilon = float(epsilon)
+
+    def _step_count(self, p):
+        slots = self._accumulators.setdefault(id(p), {})
+        t = slots.get("_t", 0) + 1
+        slots["_t"] = t
+        return t
+
+    def _update_param(self, p, pd, gd, lr, wd):
+        m = self._get_accumulator(p, "moment1", dtype=jnp.float32)
+        v = self._get_accumulator(p, "moment2", dtype=jnp.float32)
+        t = self._step_count(p)
+        rho_inf = 2.0 / (1 - self._beta2) - 1
+        b2pow = self._beta2 ** t
+        rho_t = rho_inf - 2.0 * t * b2pow / (1 - b2pow)
+        new_p, m, v = _radam_update(
+            pd.astype(jnp.float32), gd.astype(jnp.float32), m, v, lr,
+            self._beta1, self._beta2, self._epsilon, self._beta1 ** t,
+            b2pow, jnp.float32(rho_t), jnp.float32(rho_inf))
+        self._set_accumulator(p, "moment1", m)
+        self._set_accumulator(p, "moment2", v)
+        return new_p.astype(pd.dtype)
+
+
+@jax.jit
+def _adadelta_update(p, g, avg_sq_grad, avg_sq_update, lr, rho, epsilon):
+    avg_sq_grad = rho * avg_sq_grad + (1 - rho) * g * g
+    scale = jnp.sqrt((avg_sq_update + epsilon) / (avg_sq_grad + epsilon))
+    delta = -scale * g
+    avg_sq_update = rho * avg_sq_update + (1 - rho) * delta * delta
+    return p + lr * delta, avg_sq_grad, avg_sq_update
+
+
+class Adadelta(Optimizer):
+    """Adadelta (reference ``python/paddle/optimizer/adadelta.py``;
+    rule: E[g^2] / E[dx^2] running averages, scaled delta)."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, multi_precision, name)
+        self._rho = float(rho)
+        self._epsilon = float(epsilon)
+
+    def _update_param(self, p, pd, gd, lr, wd):
+        asg = self._get_accumulator(p, "avg_squared_grad",
+                                    dtype=jnp.float32)
+        asu = self._get_accumulator(p, "avg_squared_update",
+                                    dtype=jnp.float32)
+        new_p, asg, asu = _adadelta_update(
+            pd.astype(jnp.float32), gd.astype(jnp.float32), asg, asu, lr,
+            self._rho, self._epsilon)
+        self._set_accumulator(p, "avg_squared_grad", asg)
+        self._set_accumulator(p, "avg_squared_update", asu)
+        return new_p.astype(pd.dtype)
+
+
+@jax.jit
+def _rprop_update(p, g, prev_g, lrs, eta_neg, eta_pos, lr_min, lr_max):
+    sign = jnp.sign(g * prev_g)
+    lrs = jnp.clip(
+        jnp.where(sign > 0, lrs * eta_pos,
+                  jnp.where(sign < 0, lrs * eta_neg, lrs)),
+        lr_min, lr_max)
+    # on a sign flip the step is skipped and the stored grad zeroed so
+    # the next step takes the "equal" branch
+    g_eff = jnp.where(sign < 0, jnp.zeros_like(g), g)
+    new_p = jnp.where(sign < 0, p, p - jnp.sign(g) * lrs)
+    return new_p, g_eff, lrs
+
+
+class Rprop(Optimizer):
+    """Resilient backprop, full-batch rule (reference
+    ``python/paddle/optimizer/rprop.py``; sign-agreement per-element
+    learning rates in [learning_rate_range], etas multipliers)."""
+
+    def __init__(self, learning_rate=0.001,
+                 learning_rate_range=(1e-5, 50), parameters=None,
+                 etas=(0.5, 1.2), grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lr_min, self._lr_max = map(float, learning_rate_range)
+        self._eta_neg, self._eta_pos = map(float, etas)
+        self._init_lr = float(learning_rate) if isinstance(
+            learning_rate, (int, float)) else 0.001
+
+    def _update_param(self, p, pd, gd, lr, wd):
+        prev = self._get_accumulator(p, "prev_grad", dtype=jnp.float32)
+        slots = self._accumulators.setdefault(id(p), {})
+        if "learning_rates" not in slots:
+            slots["learning_rates"] = jnp.full(
+                pd.shape, self._init_lr, jnp.float32)
+        lrs = slots["learning_rates"]
+        new_p, prev, lrs = _rprop_update(
+            pd.astype(jnp.float32), gd.astype(jnp.float32), prev, lrs,
+            self._eta_neg, self._eta_pos, self._lr_min, self._lr_max)
+        self._set_accumulator(p, "prev_grad", prev)
+        slots["learning_rates"] = lrs
+        return new_p.astype(pd.dtype)
+
+
+@jax.jit
+def _asgd_update(p, g, d, y, lr, n_eff, wd):
+    d = d - y + g
+    new_p = p - lr * (d / n_eff + wd * p)
+    return new_p, d, g
+
+
+class ASGD(Optimizer):
+    """SAG-style averaged stochastic gradient (reference
+    ``python/paddle/optimizer/asgd.py``; rule at :52-60 — running sum d
+    over the last ``batch_num`` per-index gradients y_i)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        if batch_num <= 0:
+            raise ValueError("batch_num must be positive")
+        self._n = int(batch_num)
+        self._wd = float(weight_decay) if isinstance(
+            weight_decay, (int, float)) else (
+                weight_decay.coeff if weight_decay is not None else 0.0)
+
+    def _update_param(self, p, pd, gd, lr, wd):
+        d = self._get_accumulator(p, "d", dtype=jnp.float32)
+        slots = self._accumulators.setdefault(id(p), {})
+        m = slots.get("_m", 0)
+        if "ys" not in slots:
+            slots["ys"] = jnp.zeros((self._n,) + tuple(pd.shape),
+                                    jnp.float32)
+        i = m % self._n
+        y_i = slots["ys"][i]
+        n_eff = min(m + 1, self._n)
+        new_p, d, y_new = _asgd_update(
+            pd.astype(jnp.float32), gd.astype(jnp.float32), d, y_i, lr,
+            jnp.float32(n_eff), jnp.float32(self._wd))
+        slots["ys"] = slots["ys"].at[i].set(y_new)
+        slots["_m"] = m + 1
+        self._set_accumulator(p, "d", d)
+        return new_p.astype(pd.dtype)

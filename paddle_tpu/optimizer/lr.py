@@ -145,6 +145,30 @@ class LinearWarmup(LRScheduler):
             self.lr_after.set_state_dict(inner)
 
 
+class LinearLR(LRScheduler):
+    """Linear interpolation from start_factor*lr to end_factor*lr over
+    total_steps (reference python/paddle/optimizer/lr.py:2348)."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1. / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        if total_steps <= 0:
+            raise ValueError("total_steps must be positive")
+        if not 0 < start_factor <= 1:
+            raise ValueError("start_factor must be in (0, 1]")
+        if not 0 <= end_factor <= 1:
+            raise ValueError("end_factor must be in [0, 1]")
+        self.total_steps = int(total_steps)
+        self.start_factor = float(start_factor)
+        self.end_factor = float(end_factor)
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        frac = min(self.last_epoch, self.total_steps) / self.total_steps
+        factor = self.start_factor + \
+            (self.end_factor - self.start_factor) * frac
+        return self.base_lr * factor
+
+
 class StepDecay(LRScheduler):
     def __init__(self, learning_rate, step_size, gamma=0.1, last_epoch=-1,
                  verbose=False):

@@ -89,11 +89,16 @@ class unique_name:  # noqa: N801 — namespace (reference utils/unique_name.py)
         return _guard()
 
 
-def enable_compile_cache(cache_dir=None, min_compile_secs=5):
+def enable_compile_cache(cache_dir=None, min_compile_secs=0):
     """Turn on jax's persistent XLA compilation cache (repo-local by
     default) — a cold process otherwise pays minutes of compile for the
     large bench/serving programs.  Returns the cache dir in use (None if
-    enabling failed), so callers can report hit/miss growth."""
+    enabling failed), so callers can report hit/miss growth.
+
+    min_compile_secs defaults to 0 because remote-compile backends (the
+    axon TPU tunnel) compile asynchronously: the client-side compile
+    timer reads ~0s, so any positive threshold persists nothing at all
+    and every fresh process recompiles every program."""
     import os
 
     import jax
@@ -107,6 +112,7 @@ def enable_compile_cache(cache_dir=None, min_compile_secs=5):
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           min_compile_secs)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception:
         return None  # an optimization, never a requirement
     return cache_dir

@@ -619,6 +619,11 @@ op("index_add", lambda x, i, v: ops.index_add(x, i, 0, v),
    None, grad_inputs=[0, 2])
 op("index_fill", lambda x, i: ops.index_fill(x, i, 0, 7.0),
    [fa(4, 3), np.array([0, 2], np.int64)], None, grad_inputs=[0])
+op("index_put",
+   lambda x, v, i, j: ops.index_put(x, (i, j), v, accumulate=True),
+   [fa(4, 3), fa(2), np.array([1, 3], np.int64),
+    np.array([0, 2], np.int64)],
+   None, grad_inputs=[0, 1])
 op("tensor_unfold", lambda x: ops.unfold(x, 0, 4, 3), [fa(10)], None)
 op("as_strided", lambda x: ops.as_strided(x, [3, 2], [2, 1], 1),
    [fa(10)], None)

@@ -1,0 +1,146 @@
+"""The declared-__all__ optimizer tail (VERDICT r4 missing #2):
+Adamax, NAdam, RAdam, Adadelta, Rprop, ASGD + lr.LinearLR.
+
+Numerics: torch.optim implements the same published update rules
+(Adamax/NAdam/RAdam/Adadelta/Rprop), so each optimizer is checked
+step-for-step against its torch counterpart on the same grads.
+ASGD's reference rule (python/paddle/optimizer/asgd.py — SAG-style
+running sum over the last batch_num per-slot grads) differs from
+torch's ASGD, so it is checked against a NumPy transcription.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _run_paddle(opt_cls, kwargs, grads, x0):
+    p = paddle.to_tensor(x0.copy())
+    p.stop_gradient = False
+    opt = opt_cls(parameters=[p], **kwargs)
+    for g in grads:
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+    return np.asarray(p.numpy())
+
+
+def _run_torch(opt_cls, kwargs, grads, x0):
+    torch = pytest.importorskip("torch")
+    t = torch.tensor(x0.copy(), requires_grad=True)
+    opt = opt_cls([t], **kwargs)
+    for g in grads:
+        t.grad = torch.tensor(g)
+        opt.step()
+    return t.detach().numpy()
+
+
+RNG = np.random.RandomState(7)
+X0 = RNG.randn(4, 3).astype(np.float32)
+GRADS = [RNG.randn(4, 3).astype(np.float32) for _ in range(6)]
+
+
+def test_adamax_matches_torch():
+    torch = pytest.importorskip("torch")
+    ours = _run_paddle(paddle.optimizer.Adamax,
+                       dict(learning_rate=0.05), GRADS, X0)
+    ref = _run_torch(torch.optim.Adamax, dict(lr=0.05), GRADS, X0)
+    np.testing.assert_allclose(ours, ref, rtol=2e-5, atol=2e-6)
+
+
+def test_nadam_matches_torch():
+    torch = pytest.importorskip("torch")
+    ours = _run_paddle(paddle.optimizer.NAdam,
+                       dict(learning_rate=0.05), GRADS, X0)
+    ref = _run_torch(torch.optim.NAdam, dict(lr=0.05), GRADS, X0)
+    np.testing.assert_allclose(ours, ref, rtol=2e-5, atol=2e-6)
+
+
+def test_radam_matches_torch():
+    torch = pytest.importorskip("torch")
+    # 6 steps keeps rho_t <= 5 (un-rectified branch); run 12 to cross
+    # into the rectified branch as well.
+    grads = GRADS + [RNG.randn(4, 3).astype(np.float32)
+                     for _ in range(6)]
+    ours = _run_paddle(paddle.optimizer.RAdam,
+                       dict(learning_rate=0.05), grads, X0)
+    ref = _run_torch(torch.optim.RAdam, dict(lr=0.05), grads, X0)
+    np.testing.assert_allclose(ours, ref, rtol=2e-5, atol=2e-6)
+
+
+def test_adadelta_matches_torch():
+    torch = pytest.importorskip("torch")
+    ours = _run_paddle(paddle.optimizer.Adadelta,
+                       dict(learning_rate=1.0, rho=0.9), GRADS, X0)
+    ref = _run_torch(torch.optim.Adadelta, dict(lr=1.0, rho=0.9),
+                     GRADS, X0)
+    np.testing.assert_allclose(ours, ref, rtol=2e-5, atol=2e-6)
+
+
+def test_rprop_matches_torch():
+    torch = pytest.importorskip("torch")
+    ours = _run_paddle(
+        paddle.optimizer.Rprop,
+        dict(learning_rate=0.01, learning_rate_range=(1e-6, 50),
+             etas=(0.5, 1.2)), GRADS, X0)
+    ref = _run_torch(
+        torch.optim.Rprop,
+        dict(lr=0.01, step_sizes=(1e-6, 50), etas=(0.5, 1.2)),
+        GRADS, X0)
+    np.testing.assert_allclose(ours, ref, rtol=2e-5, atol=2e-6)
+
+
+def test_asgd_matches_reference_rule():
+    """NumPy transcription of the reference rule
+    (python/paddle/optimizer/asgd.py math block)."""
+    n = 3
+    lr, wd = 0.1, 0.01
+    x = X0.copy().astype(np.float64)
+    d = np.zeros_like(x)
+    ys = np.zeros((n,) + x.shape)
+    for m, g in enumerate(GRADS):
+        i = m % n
+        d = d - ys[i] + g
+        ys[i] = g
+        x = x - lr * (d / min(m + 1, n) + wd * x)
+    ours = _run_paddle(paddle.optimizer.ASGD,
+                       dict(learning_rate=lr, batch_num=n,
+                            weight_decay=wd), GRADS, X0)
+    np.testing.assert_allclose(ours, x, rtol=2e-5, atol=2e-6)
+
+
+def test_linear_lr():
+    sched = paddle.optimizer.lr.LinearLR(
+        learning_rate=0.5, total_steps=4, start_factor=0.25,
+        end_factor=1.0)
+    seen = []
+    for _ in range(6):
+        seen.append(float(sched()))
+        sched.step()
+    np.testing.assert_allclose(
+        seen, [0.125, 0.125 + 0.09375, 0.125 + 2 * 0.09375,
+               0.125 + 3 * 0.09375, 0.5, 0.5], rtol=1e-6)
+
+
+def test_tail_optimizers_train_a_layer():
+    """Each new optimizer actually reduces a quadratic's loss through
+    the autograd tape (integration smoke, all six at once)."""
+    for cls, kw in [
+        (paddle.optimizer.Adamax, {}),
+        (paddle.optimizer.NAdam, {}),
+        (paddle.optimizer.RAdam, {}),
+        (paddle.optimizer.Adadelta, dict(learning_rate=1.0)),
+        (paddle.optimizer.Rprop, {}),
+        (paddle.optimizer.ASGD, dict(batch_num=2)),
+    ]:
+        lin = paddle.nn.Linear(4, 4)
+        opt = cls(parameters=lin.parameters(), **kw)
+        x = paddle.to_tensor(RNG.randn(8, 4).astype(np.float32))
+        first = None
+        for _ in range(8):
+            loss = ((lin(x) - 1.0) ** 2).mean()
+            if first is None:
+                first = float(loss.numpy())
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss.numpy()) < first, cls.__name__
