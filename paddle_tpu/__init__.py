@@ -122,6 +122,31 @@ from ._misc_api import (  # noqa: F401,E402
     is_tensor, rank,
 )
 
+def _bind_tensor_method_table():
+    """Bind the reference's generated Tensor-method table (reference
+    ``python/paddle/tensor/__init__.py`` tensor_method_func) onto Tensor:
+    every table name with a module-level function becomes a method, exactly
+    as the reference monkey-patches its Tensor class."""
+    import sys
+
+    from .core.tensor import Tensor as _T
+    from .core.tensor_method_table import TENSOR_METHOD_FUNC
+
+    mod = sys.modules[__name__]
+    for _name in TENSOR_METHOD_FUNC:
+        if hasattr(_T, _name):
+            continue
+        fn = getattr(mod, _name, None)
+        if fn is None and _name in ("stft", "istft"):
+            from . import signal as _signal
+
+            fn = getattr(_signal, _name, None)
+        if callable(fn):
+            setattr(_T, _name, fn)
+
+
+_bind_tensor_method_table()
+
 __version__ = "0.3.0"
 
 

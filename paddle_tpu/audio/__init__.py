@@ -258,3 +258,8 @@ class features:  # noqa: N801 — namespace (reference audio.features)
     MelSpectrogram = MelSpectrogram
     LogMelSpectrogram = LogMelSpectrogram
     MFCC = MFCC
+
+
+from . import backends  # noqa: E402,F401
+from . import datasets  # noqa: E402,F401
+from .backends import info, load, save  # noqa: E402,F401

@@ -1,0 +1,96 @@
+"""paddle.audio.backends analog — the wave backend.
+
+Reference: ``python/paddle/audio/backends/wave_backend.py`` (info:43,
+load:95, save:174) and ``backends/__init__.py`` (backend selection).  The
+reference's default backend is the stdlib ``wave`` PCM16 codec; optional
+paddleaudio backends are a plugin mechanism.  Here the wave backend is the
+only one (no egress for soundfile wheels) — same default behavior.
+"""
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class AudioInfo:
+    """wave_backend.py:29 — metadata bundle returned by ``info``."""
+
+    def __init__(self, sample_rate, num_frames, num_channels,
+                 bits_per_sample, encoding):
+        self.sample_rate = sample_rate
+        self.num_frames = num_frames
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath):
+    """wave_backend.py:43."""
+    with _wave.open(str(filepath), "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8, "PCM_S")
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """wave_backend.py:95 — PCM16 wav -> (Tensor, sample_rate)."""
+    with _wave.open(str(filepath), "rb") as f:
+        sr = f.getframerate()
+        channels = f.getnchannels()
+        width = f.getsampwidth()
+        if width != 2:
+            raise ValueError(
+                f"wave backend supports 16-bit PCM only, got {width * 8}-bit")
+        f.setpos(int(frame_offset))
+        n = f.getnframes() - int(frame_offset) if num_frames == -1 \
+            else int(num_frames)
+        raw = f.readframes(n)
+    data = np.frombuffer(raw, dtype="<i2").reshape(-1, channels)
+    if normalize:
+        data = (data.astype(np.float32) / 32768.0)
+    if channels_first:
+        data = data.T
+    return Tensor(jnp.asarray(np.ascontiguousarray(data))), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True, encoding=None,
+         bits_per_sample=16):
+    """wave_backend.py:174 — Tensor -> PCM16 wav."""
+    if bits_per_sample not in (None, 16):
+        raise ValueError("wave backend supports 16 bits_per_sample only")
+    arr = np.asarray(src._data if isinstance(src, Tensor) else src)
+    if arr.ndim == 1:
+        arr = arr[None, :] if channels_first else arr[:, None]
+    if channels_first:
+        arr = arr.T  # -> (time, channels)
+    if arr.dtype != np.int16:
+        arr = (np.clip(arr, -1.0, 1.0) * 32767.0).astype(np.int16)
+    with _wave.open(str(filepath), "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(arr).astype("<i2").tobytes())
+
+
+_current_backend = "wave_backend"
+
+
+def list_available_backends():
+    """backends/__init__.py list_available_backends."""
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return _current_backend
+
+
+def set_backend(backend_name):
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"backend {backend_name!r} unavailable; only the wave backend "
+            "ships in the TPU build")

@@ -1,0 +1,122 @@
+"""paddle.audio.datasets analog — ESC50 / TESS.
+
+Reference: ``python/paddle/audio/datasets/esc50.py:26``, ``tess.py:26``,
+``dataset.py`` (AudioClassificationDataset: waveform -> optional feature
+transform -> (feature, label)).  Downloads are gated (zero-egress build):
+point ``data_dir`` at an extracted archive; parsing/feature logic is fully
+functional.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+from .backends import load as _load
+
+
+class AudioClassificationDataset(Dataset):
+    """datasets/dataset.py — (wav file list, labels) + feature transform."""
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **kwargs):
+        self.files = list(files)
+        self.labels = list(labels)
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = kwargs
+
+    def _convert_to_record(self, idx):
+        from ..core.tensor import Tensor
+
+        waveform, sr = _load(self.files[idx])
+        arr = np.asarray(waveform.numpy())
+        if arr.ndim > 1:
+            arr = arr[0]
+        if self.feat_type == "raw":
+            return Tensor(arr), self.labels[idx]
+        from . import features
+
+        feat_cls = {"mfcc": features.MFCC,
+                    "melspectrogram": features.MelSpectrogram,
+                    "spectrogram": features.Spectrogram,
+                    "logmelspectrogram": features.LogMelSpectrogram}.get(
+                        self.feat_type)
+        if feat_cls is None:
+            raise ValueError(f"unknown feat_type {self.feat_type!r}")
+        feat = feat_cls(sr=sr, **self.feat_config)
+        return feat(Tensor(arr[None, :])), self.labels[idx]
+
+    def __getitem__(self, idx):
+        return self._convert_to_record(idx)
+
+    def __len__(self):
+        return len(self.files)
+
+
+def _require_dir(path, what, url):
+    if path is None or not os.path.isdir(path):
+        raise RuntimeError(
+            f"{what}: dataset archive not found at {path!r}. This build has "
+            f"no network egress — download {url} elsewhere, extract it, and "
+            "pass data_dir=<extracted path>.")
+
+
+class ESC50(AudioClassificationDataset):
+    """esc50.py:26 — 2000 5-second environmental recordings, 50 classes,
+    5 official folds (train = all folds but ``split``)."""
+
+    archive = {"url": "https://github.com/karoldvl/ESC-50/archive/master.zip"}
+    n_folds = 5
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 archive=None, data_dir=None, **kwargs):
+        _require_dir(data_dir, "ESC50", self.archive["url"])
+        meta = os.path.join(data_dir, "meta", "esc50.csv")
+        audio_dir = os.path.join(data_dir, "audio")
+        files, labels = [], []
+        with open(meta) as f:
+            header = f.readline().strip().split(",")
+            fold_i = header.index("fold")
+            target_i = header.index("target")
+            for line in f:
+                row = line.strip().split(",")
+                fold = int(row[fold_i])
+                keep = fold != split if mode == "train" else fold == split
+                if keep:
+                    files.append(os.path.join(audio_dir, row[0]))
+                    labels.append(int(row[target_i]))
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)
+
+
+class TESS(AudioClassificationDataset):
+    """tess.py:26 — Toronto emotional speech set: 2800 recordings, 7
+    emotions; random (seeded) n_fold split like the reference."""
+
+    archive = {"url":
+               "https://tspace.library.utoronto.ca/handle/1807/24487"}
+    emotions = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 archive=None, data_dir=None, **kwargs):
+        _require_dir(data_dir, "TESS", self.archive["url"])
+        wavs = []
+        for root, _dirs, names in os.walk(data_dir):
+            wavs.extend(os.path.join(root, n) for n in names
+                        if n.lower().endswith(".wav"))
+        wavs.sort()
+        rng = np.random.RandomState(114514)  # reference's fixed seed
+        fold_of = rng.randint(1, n_folds + 1, len(wavs))
+        files, labels = [], []
+        for path, fold in zip(wavs, fold_of):
+            keep = fold != split if mode == "train" else fold == split
+            if not keep:
+                continue
+            emotion = os.path.basename(path).rsplit(".", 1)[0] \
+                .split("_")[-1].lower()
+            if emotion not in self.emotions:
+                continue
+            files.append(path)
+            labels.append(self.emotions.index(emotion))
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)

@@ -5,7 +5,8 @@ PyLayer (``py_layer.py:280``), saved-tensor hooks.
 """
 from . import engine  # noqa: F401
 from .engine import (  # noqa: F401
-    backward, enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled,
+    backward, enable_grad, grad, is_grad_enabled, no_grad,
+    saved_tensors_hooks, set_grad_enabled,
 )
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401
 from .functional import hessian, jacobian, jvp, vjp  # noqa: F401

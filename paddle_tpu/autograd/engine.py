@@ -73,6 +73,81 @@ class set_grad_enabled(no_grad):
 # Grad graph nodes
 # --------------------------------------------------------------------------
 
+class saved_tensors_hooks:
+    """Pack/unpack hooks over residuals saved for backward (reference
+    ``python/paddle/autograd/saved_tensors_hooks.py:20``): ``pack_hook``
+    runs on every tensor a GradNode saves (offload to host/disk),
+    ``unpack_hook`` reloads it when backward consumes the node.  Ops that
+    fall back to a jax vjp closure keep their residuals inside the closure
+    and are not intercepted (XLA owns that memory)."""
+
+    _active = None  # (pack_hook, unpack_hook) | None
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        self._prev = saved_tensors_hooks._active
+        saved_tensors_hooks._active = (self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        saved_tensors_hooks._active = self._prev
+        return False
+
+
+class _Packed:
+    """Marker holding a pack_hook payload (distinguishes packed array
+    leaves from pass-through non-tensor residuals at unpack time)."""
+
+    __slots__ = ("payload",)
+
+    def __init__(self, payload):
+        self.payload = payload
+
+
+def _pack_saved(saved):
+    """Apply the active pack hook to each array leaf of a residual tree;
+    returns (packed_tree, unpack_hook) or (saved, None) when inactive."""
+    hooks = saved_tensors_hooks._active
+    if hooks is None or saved is None:
+        return saved, None
+    pack, unpack = hooks
+    from ..core.tensor import Tensor
+
+    def _pack_leaf(v):
+        if isinstance(v, jnp.ndarray) or (hasattr(v, "dtype")
+                                          and hasattr(v, "shape")):
+            return _Packed(pack(Tensor(jnp.asarray(v))))
+        return v
+
+    import jax
+
+    packed = jax.tree_util.tree_map(
+        _pack_leaf, saved, is_leaf=lambda x: not isinstance(
+            x, (list, tuple, dict)))
+    return packed, unpack
+
+
+def _unpack_saved(saved, unpack):
+    if unpack is None:
+        return saved
+    from ..core.tensor import Tensor
+
+    def _unpack_leaf(v):
+        if not isinstance(v, _Packed):
+            return v
+        out = unpack(v.payload)
+        return out._data if isinstance(out, Tensor) else jnp.asarray(out)
+
+    import jax
+
+    return jax.tree_util.tree_map(
+        _unpack_leaf, saved,
+        is_leaf=lambda x: not isinstance(x, (list, tuple, dict)))
+
+
 class GradNode:
     """One backward step; created per differentiable forward op call.
 
@@ -82,13 +157,16 @@ class GradNode:
 
     __slots__ = ("op", "saved", "inputs", "attrs", "vjp_fallback",
                  "diff_idx", "out_meta", "n_outs", "name", "released",
-                 "out_hooks")
+                 "out_hooks", "unpack_hook")
 
     def __init__(self, op, saved, inputs, attrs, vjp_fallback=False,
                  diff_idx=None):
         self.released = False
         self.op = op
         self.name = op.name if op is not None else "custom"
+        self.unpack_hook = None
+        if not vjp_fallback:
+            saved, self.unpack_hook = _pack_saved(saved)
         self.saved = saved
         self.inputs = list(inputs)  # Tensor | raw array per forward slot
         self.attrs = attrs
@@ -144,7 +222,8 @@ class GradNode:
             return grads
 
         gout = filled[0] if self.n_outs == 1 else tuple(filled)
-        grads = self.op.jit_bwd(self.saved, gout, **self.attrs)
+        saved = _unpack_saved(self.saved, self.unpack_hook)
+        grads = self.op.jit_bwd(saved, gout, **self.attrs)
         if not isinstance(grads, (tuple, list)):
             grads = (grads,)
         return list(grads) + [None] * (len(self.inputs) - len(grads))

@@ -48,6 +48,45 @@ def is_compiled_with_custom_device(device_type=None):
     return False
 
 
+def get_all_device_type():
+    """reference device/__init__.py get_all_device_type — device kinds the
+    build supports (here: the PJRT platforms jax can see)."""
+    kinds = ["cpu"]
+    try:
+        kinds.append(jax.devices()[0].platform)
+    except Exception:
+        pass
+    return sorted(set(kinds))
+
+
+def get_available_custom_device():
+    """reference get_available_custom_device — custom (plugin) devices;
+    TPU is a first-class backend here, so the custom list is empty."""
+    return []
+
+
+def get_cudnn_version():
+    """reference device/__init__.py:203 — None when not built with CUDA."""
+    return None
+
+
+class IPUPlace:
+    """Signature-parity placeholder (no IPU backend in a TPU build)."""
+
+    def __init__(self):
+        raise RuntimeError("paddle_tpu is not compiled with IPU support")
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def set_stream(stream=None):
+    """reference device/__init__.py set_stream — PJRT owns stream binding;
+    returns the (singleton) current stream for parity."""
+    return current_stream()
+
+
 def is_compiled_with_rocm():
     return False
 

@@ -11,11 +11,15 @@ from .env import (  # noqa: F401
     ParallelEnv, get_rank, get_world_size, init_parallel_env,
     is_initialized,
 )
+from .env import (  # noqa: F401
+    gloo_barrier, gloo_init_parallel_env, gloo_release,
+)
 from .communication import (  # noqa: F401
     Group, P2POp, ReduceOp, all_gather, all_gather_object, all_reduce,
     all_to_all, alltoall, alltoall_single, barrier, batch_isend_irecv,
-    broadcast, destroy_process_group, get_group, irecv, isend, new_group,
-    recv, reduce, reduce_scatter, scatter, send, stream, wait,
+    broadcast, broadcast_object_list, destroy_process_group, gather,
+    get_group, irecv, isend, new_group, recv, reduce, reduce_scatter,
+    scatter, scatter_object_list, send, stream, wait,
 )
 from .auto_parallel import (  # noqa: F401
     DistAttr, Partial, Placement, ProcessMesh, Replicate, Shard,
@@ -34,6 +38,15 @@ from .engine import Engine  # noqa: F401
 from . import utils  # noqa: F401
 from .fleet.sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from .fleet import sharding  # noqa: F401  - paddle.distributed.sharding
+from .api_tail import (  # noqa: F401
+    DistModel, ParallelMode, ReduceType, ShardDataloader, ShardingStage1,
+    ShardingStage2, ShardingStage3, Strategy, shard_dataloader,
+    shard_optimizer, shard_scaler, split, to_static,
+)
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from . import io  # noqa: F401
+from . import launch  # noqa: F401
 
 
 def get_backend():

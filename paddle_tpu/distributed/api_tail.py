@@ -1,0 +1,384 @@
+"""Declared-``__all__`` tail of ``paddle.distributed``.
+
+Reference points:
+- ``python/paddle/distributed/fleet/base/topology.py:37`` (ParallelMode)
+- ``paddle/fluid/pybind/auto_parallel_py.cc:401`` (ReduceType enum)
+- ``python/paddle/distributed/auto_parallel/strategy.py`` (Strategy)
+- ``python/paddle/distributed/auto_parallel/api.py:1154`` (ShardingStage1-3),
+  ``:1393`` (shard_optimizer), ``:1440`` (shard_scaler), ``:2896``
+  (shard_dataloader), ``:1904`` (DistModel), ``:2390`` (to_static)
+- ``python/paddle/distributed/fleet/layers/mpu/mp_ops.py:698`` (split)
+
+TPU-native mapping: every API resolves onto the existing GSPMD substrate —
+``shard_tensor`` placements for accumulator sharding, the mpu layers for
+``split``, and ``Engine``/``CompiledTrainStep`` for ``to_static``.  Nothing
+here launches manual collectives; sharding annotations are the contract and
+XLA inserts the communication.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .auto_parallel import (
+    Partial, ProcessMesh, Replicate, Shard, get_placements, shard_tensor,
+)
+
+
+class ParallelMode:
+    """fleet/base/topology.py:37 — the four hybrid-parallel modes."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class ReduceType:
+    """auto_parallel_py.cc:401 — reduce kind carried by Partial placements."""
+
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class _ConfigBag:
+    """Attribute bag accepting arbitrary config keys (strategy sub-config)."""
+
+    def __init__(self, **defaults):
+        self.__dict__.update(defaults)
+
+    def __setattr__(self, k, v):
+        self.__dict__[k] = v
+
+    def __repr__(self):
+        body = ", ".join(f"{k}={v!r}" for k, v in self.__dict__.items())
+        return f"{type(self).__name__}({body})"
+
+
+class Strategy:
+    """auto_parallel/strategy.py Strategy — config bundle consumed by
+    ``to_static``.  Sub-configs mirror the reference's names; on TPU they
+    translate to CompiledTrainStep knobs (sharding stage -> zero_opt_states,
+    amp -> compute dtype, pipeline/gradient_merge are GSPMD/scan concerns).
+    """
+
+    def __init__(self, config=None):
+        config = config or {}
+
+        def bag(key, **defaults):
+            merged = {**defaults, **config.get(key, {})}
+            return _ConfigBag(**merged)
+
+        self.sharding = bag("sharding", enable=False, stage=1, degree=8)
+        self.amp = bag("amp", enable=False, dtype="float16", level="o1")
+        self.pipeline = bag("pipeline", enable=False, schedule_mode="1F1B",
+                            micro_batch_size=1, accumulate_steps=1)
+        self.fused_passes = bag("fused_passes", enable=False,
+                                fused_passes_list=[])
+        self.gradient_merge = bag("gradient_merge", enable=False, k_steps=1,
+                                  avg=True)
+
+    def __repr__(self):
+        return (f"Strategy(sharding={self.sharding}, amp={self.amp}, "
+                f"pipeline={self.pipeline})")
+
+
+# -- sharding stages (shard_fn for shard_optimizer) --------------------------
+
+def _placement_with_sharding(param, mesh, shard_axis_name="dp"):
+    """Accumulator placements: keep the param's own sharding and
+    additionally shard the first replicated dim over the sharding axis
+    (reference get_placement_with_sharding, auto_parallel/api.py:1108)."""
+    placements = get_placements(param)
+    if placements is None:
+        placements = [Replicate() for _ in mesh.dim_names]
+    placements = list(placements)
+    try:
+        axis = list(mesh.dim_names).index(shard_axis_name)
+    except ValueError:
+        axis = 0
+    if isinstance(placements[axis], Replicate):
+        sharded_dims = {p.dim for p in placements if isinstance(p, Shard)}
+        ndim = len(param.shape)
+        for d in range(ndim):
+            if d not in sharded_dims and param.shape[d] > 1:
+                placements[axis] = Shard(d)
+                break
+    return placements
+
+
+class _ShardingStageBase:
+    def __init__(self, mesh=None, sharding_mesh_dim=None):
+        self._mesh = mesh
+        self._sharding_mesh_dim = sharding_mesh_dim or "dp"
+
+    def _target_mesh(self, param):
+        if self._mesh is not None:
+            return self._mesh
+        from .auto_parallel import get_mesh
+
+        return get_mesh()
+
+
+class ShardingStage1(_ShardingStageBase):
+    """ZeRO-1: shard optimizer accumulators (not params/grads) over the
+    sharding axis (auto_parallel/api.py:1154)."""
+
+    shards_params = False
+
+    def __call__(self, key, param, accumulator):
+        mesh = self._target_mesh(param)
+        if mesh is None:
+            return accumulator
+        if "beta" in key or getattr(accumulator, "ndim", 1) == 0:
+            placements = [Replicate() for _ in mesh.dim_names]
+        else:
+            placements = _placement_with_sharding(
+                param, mesh, self._sharding_mesh_dim)
+        return shard_tensor(accumulator, mesh, placements)
+
+
+class ShardingStage2(ShardingStage1):
+    """ZeRO-2: stage-1 accumulator sharding; gradient sharding is the
+    compiled step's reduce-scatter concern (GSPMD emits it when the
+    accumulator layout demands it), so the shard_fn is identical
+    (auto_parallel/api.py:1214)."""
+
+
+class ShardingStage3(ShardingStage1):
+    """ZeRO-3: additionally shard the parameters themselves
+    (auto_parallel/api.py:1274)."""
+
+    shards_params = True
+
+    def shard_param(self, param):
+        mesh = self._target_mesh(param)
+        if mesh is None:
+            return param
+        placements = _placement_with_sharding(
+            param, mesh, self._sharding_mesh_dim)
+        return shard_tensor(param, mesh, placements)
+
+
+class _ShardOptimizer:
+    """shard_optimizer wrapper (auto_parallel/api.py:1120): delegates to the
+    inner optimizer but reshards every accumulator it creates through
+    shard_fn at creation time."""
+
+    def __init__(self, optimizer, shard_fn=None):
+        if optimizer is None:
+            raise ValueError("optimizer cannot be None")
+        self.__dict__["_inner_opt"] = optimizer
+        self.__dict__["_shard_fn"] = shard_fn
+        self.__dict__["_sharded"] = set()
+        if isinstance(shard_fn, ShardingStage3):
+            for p in optimizer._parameter_list():
+                shard_fn.shard_param(p)
+
+    def _shard_accumulators(self):
+        opt, fn = self._inner_opt, self._shard_fn
+        for p in opt._parameter_list():
+            slots = opt._accumulators.get(id(p), {})
+            for name, val in list(slots.items()):
+                # host-side scalar slots ("_t" step counters, "_mu_prod")
+                # carry no device data — nothing to shard
+                if not hasattr(val, "ndim") or getattr(val, "ndim", 0) == 0:
+                    continue
+                tag = (id(p), name)
+                if tag in self._sharded:
+                    continue
+                self._sharded.add(tag)
+                if fn is not None:
+                    out = fn(name, p, val)
+                    from ..core.tensor import Tensor
+
+                    slots[name] = out._data if isinstance(out, Tensor) \
+                        else out
+
+    def step(self):
+        self._inner_opt.step()
+        self._shard_accumulators()
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner_opt"], item)
+
+    def __setattr__(self, item, value):
+        if item in self.__dict__:
+            self.__dict__[item] = value
+        else:
+            setattr(self.__dict__["_inner_opt"], item, value)
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """auto_parallel/api.py:1393 — distributed view of an optimizer."""
+    return _ShardOptimizer(optimizer, shard_fn)
+
+
+def shard_scaler(scaler):
+    """auto_parallel/api.py:1440.  Our GradScaler's found-inf reduction is
+    computed from the (already global-view) grads, and GSPMD owns the
+    collective, so the distributed view is the scaler itself — tagged so
+    callers can assert it went through the API."""
+    scaler._is_distributed = True
+    return scaler
+
+
+class ShardDataloader:
+    """auto_parallel/api.py:2753 — wraps a DataLoader so every batch comes
+    out sharded over the mesh's data-parallel dim."""
+
+    def __init__(self, dataloader, meshes, input_keys=None, shard_dims=None,
+                 is_dataset_splitted=False):
+        self._loader = dataloader
+        self._meshes = meshes if isinstance(meshes, (list, tuple)) \
+            else [meshes]
+        self._input_keys = input_keys
+        if shard_dims is None:
+            shard_dims = "dp" if "dp" in self._meshes[0].dim_names \
+                else self._meshes[0].dim_names[0]
+        self._shard_dims = shard_dims
+
+    def __len__(self):
+        return len(self._loader)
+
+    def _shard_one(self, value, mesh, shard_dim):
+        from ..core.tensor import Tensor
+
+        if not isinstance(value, (Tensor, jnp.ndarray)) and \
+                not hasattr(value, "shape"):
+            return value
+        placements = [Shard(0) if name == shard_dim else Replicate()
+                      for name in mesh.dim_names]
+        return shard_tensor(value, mesh, placements)
+
+    def __iter__(self):
+        mesh = self._meshes[0]
+        dim = self._shard_dims if isinstance(self._shard_dims, str) \
+            else self._shard_dims[0]
+        for batch in self._loader:
+            if isinstance(batch, dict):
+                yield {k: self._shard_one(v, mesh, dim)
+                       for k, v in batch.items()}
+            elif isinstance(batch, (list, tuple)):
+                yield type(batch)(self._shard_one(v, mesh, dim)
+                                  for v in batch)
+            else:
+                yield self._shard_one(batch, mesh, dim)
+
+
+def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None,
+                     is_dataset_splitted=False):
+    """auto_parallel/api.py:2896."""
+    return ShardDataloader(dataloader, meshes, input_keys, shard_dims,
+                           is_dataset_splitted)
+
+
+# -- to_static / DistModel ---------------------------------------------------
+
+class DistModel:
+    """auto_parallel/api.py:1904 — the static-graph distributed model
+    returned by ``dist.to_static``: call it to run one step in the current
+    mode (train/eval/predict)."""
+
+    def __init__(self, layer, loader, loss=None, optimizer=None,
+                 strategy=None, metrics=None):
+        from .auto_parallel import get_mesh
+        from .engine import Engine
+
+        if isinstance(optimizer, _ShardOptimizer):
+            optimizer = optimizer._inner_opt
+        # ZeRO accumulator sharding defaults on (free at world=1); an
+        # explicit strategy drives it.
+        zero = True
+        compute_dtype = None
+        if strategy is not None:
+            zero = bool(strategy.sharding.enable)
+            if strategy.amp.enable:
+                compute_dtype = jnp.bfloat16 \
+                    if "bfloat16" in str(strategy.amp.dtype) else jnp.float16
+        self._engine = Engine(layer, loss=loss, optimizer=optimizer,
+                              strategy=strategy, mesh=get_mesh(),
+                              compute_dtype=compute_dtype,
+                              zero_opt_states=zero)
+        self._layer = layer
+        self._loader = loader
+        self._mode = "train" if optimizer is not None and loss is not None \
+            else ("eval" if loss is not None else "predict")
+
+    def train(self):
+        if self._engine.optimizer is None or self._engine.loss is None:
+            raise ValueError(
+                "to_static needs loss+optimizer for train mode")
+        self._mode = "train"
+
+    def eval(self):
+        if self._engine.loss is None:
+            raise ValueError("to_static needs a loss for eval mode")
+        self._mode = "eval"
+
+    def predict(self):
+        self._mode = "predict"
+
+    def __call__(self, *args):
+        if self._mode == "train":
+            return self._engine.step(*args)
+        if self._mode == "eval":
+            return self._engine.evaluate_batch(*args)
+        return self._engine.predict_batch(*args)
+
+    def state_dict(self, mode="all"):
+        return self._engine.state_dict()
+
+    def set_state_dict(self, state_dict):
+        return self._engine.set_state_dict(state_dict)
+
+    def dist_main_program(self, mode=None):
+        """The compiled step stands in for the partitioned main program."""
+        return self._engine._step
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              input_spec=None):
+    """auto_parallel/api.py:2390 — dygraph + shard annotations -> DistModel
+    (the compiled sharded program)."""
+    return DistModel(layer, loader, loss=loss, optimizer=optimizer,
+                     strategy=strategy)
+
+
+# -- split (mp op) -----------------------------------------------------------
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """fleet/layers/mpu/mp_ops.py:698 — build-and-apply a megatron-parallel
+    embedding/linear.  TPU-native: constructs the corresponding mpu layer
+    (weight sharded over the 'mp' mesh axis; GSPMD inserts the collectives)
+    and applies it to ``x``.
+    """
+    from .fleet.mpu import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    )
+
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr, name=name)
+        return layer(x)
+    if operation != "linear":
+        raise ValueError(
+            f"paddle.distributed.split supports 'linear' and 'embedding', "
+            f"got {operation!r}")
+    has_bias = bias_attr is not False
+    if axis == 0:
+        layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                  has_bias=has_bias, name=name)
+    elif axis == 1:
+        layer = ColumnParallelLinear(size[0], size[1],
+                                     weight_attr=weight_attr,
+                                     has_bias=has_bias,
+                                     gather_output=gather_out, name=name)
+    else:
+        raise ValueError(f"axis must be 0 (row) or 1 (column), got {axis}")
+    return layer(x)

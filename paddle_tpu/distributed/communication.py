@@ -318,6 +318,75 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     raise RuntimeError("scatter outside SPMD needs a mesh-bound group")
 
 
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather ``tensor`` from every rank into ``gather_list`` on rank
+    ``dst`` (reference communication/gather.py:29); other ranks leave the
+    list empty.  SPMD lowering is an all_gather — XLA dead-code-eliminates
+    the copies unused on non-dst ranks."""
+    group = group or _get_default_group()
+    if gather_list is None:
+        gather_list = []
+    if _in_spmd(group):
+        d = _data(tensor)
+        gathered = jax.lax.all_gather(d, group.axis_name)
+        for i in range(group.nranks):
+            gather_list.append(_wrap_like(tensor, gathered[i]))
+        return gather_list
+    from .env import get_rank
+
+    if group.nranks <= 1:
+        if get_rank() == dst:
+            gather_list.append(tensor)
+        return gather_list
+    gathered = _eager_process_gather(tensor, group, "gather")
+    if get_rank() == dst:
+        for i in range(gathered.shape[0]):
+            gather_list.append(_wrap_like(tensor, jnp.asarray(gathered[i])))
+    return gather_list
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Broadcast picklable objects from rank ``src``, replacing
+    ``object_list`` contents in place on every rank (reference
+    communication/broadcast.py broadcast_object_list)."""
+    group = group or _get_default_group()
+    if jax.process_count() <= 1:
+        return object_list
+    # Ride the object allgather substrate and keep src's payload — one
+    # exchange, same deadlock-safety checks.
+    gathered: list = []
+    all_gather_object(gathered, list(object_list), group=group)
+    object_list[:] = gathered[int(src)]
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Scatter one picklable object per rank from ``src``'s
+    ``in_object_list`` (reference communication/scatter.py
+    scatter_object_list)."""
+    group = group or _get_default_group()
+    from .env import get_rank
+
+    if jax.process_count() <= 1:
+        if in_object_list:
+            if len(in_object_list) < group.nranks:
+                raise ValueError(
+                    f"scatter_object_list needs one object per rank "
+                    f"({group.nranks}), src provided {len(in_object_list)}")
+            out_object_list.append(in_object_list[get_rank()])
+        return out_object_list
+    gathered: list = []
+    all_gather_object(gathered, list(in_object_list or []), group=group)
+    src_list = gathered[int(src)]
+    if len(src_list) < group.nranks:
+        raise ValueError(
+            f"scatter_object_list needs one object per rank "
+            f"({group.nranks}), src provided {len(src_list)}")
+    out_object_list.append(src_list[get_rank()])
+    return out_object_list
+
+
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     group = group or _get_default_group()
     if _in_spmd(group):

@@ -107,3 +107,39 @@ def get_world_size(group=None):
 
 def parallel_device_count():
     return jax.device_count()
+
+
+# -- gloo compat -------------------------------------------------------------
+# Reference: python/paddle/distributed/parallel.py gloo_init_parallel_env
+# (:1210) / gloo_barrier / gloo_release — a CPU-side out-of-band process
+# group.  TPU-native the coordination service fills that role; these keep
+# launch-script compat.
+
+_gloo_ready = False
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU-side rendezvous.  The jax coordination service (already wired by
+    init_parallel_env) is the gloo store; we only record intent."""
+    global _gloo_ready
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+    if server_endpoint and ":" in str(server_endpoint):
+        host, port = str(server_endpoint).rsplit(":", 1)
+        os.environ.setdefault("MASTER_ADDR", host)
+        os.environ.setdefault("MASTER_PORT", port)
+    init_parallel_env()
+    _gloo_ready = True
+
+
+def gloo_barrier():
+    """Host-process barrier over the coordination service."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("paddle_tpu_gloo_barrier")
+
+
+def gloo_release():
+    global _gloo_ready
+    _gloo_ready = False

@@ -1,0 +1,67 @@
+"""paddle.distributed.io analog.
+
+Reference: ``python/paddle/distributed/io.py`` — ``save_persistables``
+(:392), ``load_persistables`` (:132), ``is_persistable`` (:357),
+``load_inference_model_distributed`` (:464).  There these walk a static
+Program's persistable vars through an executor; TPU-native the persistable
+set is a Layer's state_dict and a sharded GDA save (distributed/checkpoint)
+replaces the per-var executor ops.
+"""
+from __future__ import annotations
+
+import os
+
+from .checkpoint import load_state_dict as _ckpt_load
+from .checkpoint import save_state_dict as _ckpt_save
+
+
+def is_persistable(var) -> bool:
+    """io.py:357 — parameters and buffers persist; activations don't.
+    Tensor analog: anything carrying data that belongs to a state_dict."""
+    from ..core.tensor import Tensor
+
+    if isinstance(var, Tensor):
+        return bool(getattr(var, "persistable", True))
+    return hasattr(var, "shape") and hasattr(var, "dtype")
+
+
+def _state_of(main_program):
+    from ..nn.layers import Layer
+
+    if isinstance(main_program, Layer):
+        return main_program.state_dict()
+    if isinstance(main_program, dict):
+        return main_program
+    raise TypeError(
+        "distributed.io expects a Layer or state_dict on TPU (static "
+        f"Programs are a recorded scope decision), got {type(main_program)}")
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """io.py:392 — write every persistable to ``dirname`` (sharded when a
+    mesh is active).  ``executor`` is accepted for signature parity and
+    ignored (PJRT owns execution)."""
+    state = _state_of(main_program if main_program is not None else executor)
+    os.makedirs(dirname, exist_ok=True)
+    _ckpt_save(state, os.path.join(dirname, filename or "persistables"))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """io.py:132 — read persistables saved by ``save_persistables`` back
+    into the Layer/state_dict, resharding to the current mesh."""
+    target = main_program if main_program is not None else executor
+    state = _state_of(target)
+    _ckpt_load(state, os.path.join(dirname, filename or "persistables"))
+    from ..nn.layers import Layer
+
+    if isinstance(target, Layer):
+        target.set_state_dict(state)
+    return state
+
+
+def load_inference_model_distributed(dirname, executor=None):
+    """io.py:464 — load a saved inference bundle; the jit.load program is
+    the distributed-inference analog (StableHLO is placement-agnostic)."""
+    from ..jit import load as jit_load
+
+    return jit_load(dirname)

@@ -1,0 +1,83 @@
+"""paddle.distributed.spawn analog.
+
+Reference: ``python/paddle/distributed/spawn.py:448`` — start ``nprocs``
+worker processes running ``func``, wiring the rendezvous env
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / MASTER_ADDR / MASTER_PORT) into
+each child so ``init_parallel_env`` connects them.
+
+TPU-native: one JAX process drives all local chips, so spawn's unit is the
+*host process* (multi-host data loading, elastic workers, CPU test meshes)
+— not one-process-per-device.  Children rendezvous through
+``jax.distributed`` exactly as ``launch`` workers do.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import os
+import socket
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker(func, rank, nprocs, env, args):
+    os.environ.update(env)
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    func(*args)
+
+
+class MultiprocessContext:
+    """spawn.py:364 — holds the spawned processes; ``join`` reaps them and
+    raises on the first non-zero exit."""
+
+    def __init__(self, processes):
+        self.processes = processes
+
+    def join(self, timeout=None):
+        for p in self.processes:
+            p.join(timeout)
+        failed = [(i, p.exitcode) for i, p in enumerate(self.processes)
+                  if p.exitcode not in (0, None)]
+        if failed:
+            rank, code = failed[0]
+            raise RuntimeError(
+                f"spawned process rank {rank} exited with code {code}")
+        return all(p.exitcode is not None for p in self.processes)
+
+    def pids(self):
+        return [p.pid for p in self.processes]
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Start ``nprocs`` processes running ``func(*args)`` with a distributed
+    rendezvous configured (reference spawn.py:448).  ``options`` honors
+    ``start_method`` ('spawn'|'fork'|'forkserver'), ``ips`` and
+    ``master_port``."""
+    if nprocs == -1:
+        nprocs = int(os.environ.get("PADDLE_NNODES", "1"))
+    start_method = options.get("start_method", "spawn")
+    ctx = multiprocessing.get_context(start_method)
+    master = options.get("ips", "127.0.0.1").split(",")[0]
+    port = int(options.get("master_port", 0)) or _free_port()
+    env = {
+        "MASTER_ADDR": master,
+        "MASTER_PORT": str(port),
+        "PADDLE_NNODES": str(nprocs),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(
+            f"{master}:{port + i}" for i in range(nprocs)),
+    }
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, rank, nprocs, dict(env), tuple(args)),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    context = MultiprocessContext(procs)
+    if join:
+        context.join()
+    return context

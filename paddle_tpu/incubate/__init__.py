@@ -35,7 +35,6 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
     neighbors per frontier node per hop, then compact ids."""
     from ..geometric import reindex_graph, sample_neighbors
 
-    cur = input_nodes
     all_neigh, all_cnt, all_eids = [], [], []
     import numpy as _np
 
@@ -44,8 +43,8 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
     from ..core.tensor import Tensor
 
     frontier = _np.asarray(
-        cur._data if hasattr(cur, "_data") else cur).reshape(-1)
-    seen = list(frontier.tolist())
+        input_nodes._data if hasattr(input_nodes, "_data")
+        else input_nodes).reshape(-1)
     per_hop_src = []
     for size in sample_sizes:
         res = sample_neighbors(row, colptr, Tensor(_jnp.asarray(frontier)),
@@ -58,7 +57,6 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
         all_cnt.append(cnt)
         per_hop_src.append(frontier)
         frontier = _np.unique(_np.asarray(neigh._data))
-        seen.extend(frontier.tolist())
     # flatten hops into one neighbor/count list over the union frontier
     srcs = _np.concatenate([_np.asarray(s) for s in per_hop_src])
     neighs = _np.concatenate([_np.asarray(n._data) for n in all_neigh])

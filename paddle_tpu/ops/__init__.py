@@ -90,6 +90,10 @@ from .tail import (  # noqa: F401
     ormqr, cholesky_inverse, frexp, bitwise_left_shift,
     bitwise_right_shift,
 )
+from .lowrank import (  # noqa: F401
+    create_tensor, fp8_fp8_half_gemm_fused, histogram_bin_edges,
+    matrix_norm, pca_lowrank, svd_lowrank, top_p_sampling, vector_norm,
+)
 
 import builtins as _bi  # noqa: E402
 

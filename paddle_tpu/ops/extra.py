@@ -468,6 +468,10 @@ def _index_put_impl(x, value, *indices, accumulate):
         # is static (an input shape) even though the True count is not.
         mask = idx[0]
         suffix = x.shape[mask.ndim:]
+        if value.ndim > len(suffix) and value.shape[0] == 1:
+            # length-1 leading dim broadcasts over every masked element
+            # (reference semantics), not "first True position only"
+            value = value.reshape(value.shape[1:])
         if value.ndim <= len(suffix):  # scalar-per-masked-element
             vb = jnp.broadcast_to(value, mask.shape + suffix)
             m = mask.reshape(mask.shape + (1,) * len(suffix))

@@ -465,3 +465,19 @@ def multi_dot(tensors, name=None):
 
     datas = [_raw(t) for t in tensors]
     return Tensor(jnp.linalg.multi_dot(datas))
+
+
+# Declared-``__all__`` tail (reference python/paddle/linalg.py): re-exports
+# of ops that live in the shared tail modules plus the lowrank family.
+from .lowrank import (  # noqa: F401,E402
+    fp8_fp8_half_gemm_fused, matrix_norm, pca_lowrank, svd_lowrank,
+    vector_norm,
+)
+from .tail import (  # noqa: F401,E402
+    cholesky_inverse, householder_product, ormqr,
+)
+
+
+def inv(x, name=None):
+    """reference linalg.inv — alias of paddle.inverse."""
+    return inverse(x, name=name)

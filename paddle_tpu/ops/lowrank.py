@@ -1,0 +1,241 @@
+"""Low-rank factorizations + sampling/creation tail.
+
+Reference points: ``python/paddle/sparse/unary.py:1066`` (pca_lowrank)
+/ ``:1186`` (svd_lowrank), ``python/paddle/tensor/search.py:1360``
+(top_p_sampling), ``python/paddle/tensor/creation.py:263`` (create_tensor),
+``python/paddle/tensor/linalg.py:2461`` (histogram_bin_edges), ``:327``
+(fp8_fp8_half_gemm_fused) and the linalg norms
+(``vector_norm``/``matrix_norm``).
+
+TPU-native: the low-rank path is the randomized range-finder (Halko et al.)
+— q tall-skinny matmuls + one small exact SVD, all MXU work with static
+shapes; top-p rides sort/cumsum + Gumbel-categorical so it stays jittable
+inside a decode loop.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# -- randomized low-rank -----------------------------------------------------
+
+def _on_factorization_device(fn, *args):
+    """Run a small QR/SVD.  Eagerly on a TPU backend the tiny [.., q]
+    factorizations go through the CPU backend — they're microseconds of
+    work, and the remote TPU compiler is a known crash on degenerate
+    small-transpose HLO; under tracing (jit) the op stays in-graph."""
+    if any(isinstance(a, jax.core.Tracer) for a in args):
+        return fn(*args)
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        return fn(*args)
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        out = fn(*[jax.device_put(a, cpu) for a in args])
+    return jax.tree_util.tree_map(lambda t: jax.device_put(t, dev), out)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized truncated SVD (sparse/unary.py:1186): subspace iteration
+    on a Gaussian sketch, exact SVD of the small projected matrix."""
+    from .random import default_generator
+
+    a = _raw(x)
+    if M is not None:
+        a = a - _raw(M)
+    m, n = a.shape[-2], a.shape[-1]
+    q = int(min(q, m, n))
+    key = default_generator.next_key()
+    omega = jax.random.normal(key, a.shape[:-2] + (n, q), a.dtype)
+    y = a @ omega                                   # [.., m, q] range sketch
+    # Subspace (power) iteration sharpens the spectrum; QR re-orthogonalizes
+    # to keep the basis numerically independent.  The sketch matmuls stay on
+    # the accelerator (MXU work); only the tiny QR/SVD factorizations are
+    # routed via _on_factorization_device.
+    _qr = lambda t: jnp.linalg.qr(t)  # noqa: E731
+    qb, _ = _on_factorization_device(_qr, y)
+    for _ in range(int(niter)):
+        z = jnp.swapaxes(a, -2, -1) @ qb
+        qz, _ = _on_factorization_device(_qr, z)
+        y = a @ qz
+        qb, _ = _on_factorization_device(_qr, y)
+    b = jnp.swapaxes(qb, -2, -1) @ a                # [.., q, n] small
+    u_b, s, vt = _on_factorization_device(
+        lambda t: jnp.linalg.svd(t, full_matrices=False), b)
+    u = qb @ u_b
+    v = jnp.swapaxes(vt, -2, -1)
+    return Tensor(u), Tensor(s), Tensor(v)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """sparse/unary.py:1066 — PCA via the randomized SVD above."""
+    a = _raw(x)
+    m, n = a.shape[-2], a.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        a = a - jnp.mean(a, axis=-2, keepdims=True)
+    return svd_lowrank(Tensor(a), q=q, niter=niter)
+
+
+# -- top-p sampling ----------------------------------------------------------
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
+                   mode="truncated", return_top=False, name=None):
+    """tensor/search.py:1360 — nucleus sampling.  x [B, V] probabilities,
+    ps [B] per-row top-p.  Keeps the minimal prefix of the descending
+    distribution with mass >= p, renormalizes, samples one id per row.
+    Fully jittable (sort + cumsum + categorical)."""
+    from .random import default_generator
+
+    probs = _raw(x).astype(jnp.float32)
+    p = _raw(ps).astype(jnp.float32).reshape(-1, 1)
+    order = jnp.argsort(-probs, axis=-1)
+    sorted_p = jnp.take_along_axis(probs, order, axis=-1)
+    csum = jnp.cumsum(sorted_p, axis=-1)
+    # Keep every token whose *preceding* mass is < p (so the boundary token
+    # that crosses p stays in the nucleus).
+    keep = (csum - sorted_p) < p
+    if mode == "truncated":
+        kept = jnp.where(keep, sorted_p, 0.0)
+    else:
+        kept = sorted_p
+    if threshold is not None:
+        kept = jnp.where(sorted_p >= _raw(threshold).reshape(-1, 1),
+                         kept, 0.0)
+    # Guard: never zero out an entire row.
+    kept = jnp.where(keep.any(-1, keepdims=True), kept, sorted_p)
+    if seed != -1:
+        key = jax.random.PRNGKey(seed)
+    else:
+        key = default_generator.next_key()
+    pick = jax.random.categorical(
+        key, jnp.log(jnp.maximum(kept, 1e-30)), axis=-1)  # [B] sorted idx
+    ids = jnp.take_along_axis(order, pick[:, None], axis=-1)
+    scores = jnp.take_along_axis(probs, ids, axis=-1).astype(_raw(x).dtype)
+    out = (Tensor(scores), Tensor(ids.astype(jnp.int64)))
+    if return_top and k > 0:
+        topv, topi = jax.lax.top_k(probs, k)
+        return out + (Tensor(topv.astype(_raw(x).dtype)),
+                      Tensor(topi.astype(jnp.int64)))
+    return out
+
+
+# -- creation / histogram tail ----------------------------------------------
+
+def create_tensor(dtype, name=None, persistable=False):
+    """tensor/creation.py:263 — an empty typed holder variable."""
+    from ..core.dtype import convert_dtype
+
+    t = Tensor(jnp.zeros((0,), convert_dtype(dtype)))
+    t.persistable = persistable
+    if name:
+        t.name = name
+    return t
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    """tensor/linalg.py:2461 — the bin edges ``histogram`` would use."""
+    import numpy as np
+
+    arr = np.asarray(_raw(input))
+    lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
+    return Tensor(jnp.asarray(np.histogram_bin_edges(
+        arr, bins=bins, range=(float(lo), float(hi))), jnp.float32))
+
+
+# -- linalg norms ------------------------------------------------------------
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """linalg.vector_norm (tensor/linalg.py) — p-norm treating the selected
+    axes as one flattened vector."""
+    a = _raw(x)
+    if axis is None:
+        a = a.reshape(-1)
+        axis = 0
+    p = float(p)
+    if p == float("inf"):
+        return Tensor(jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim))
+    if p == float("-inf"):
+        return Tensor(jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim))
+    if p == 0:
+        return Tensor(jnp.sum((a != 0).astype(a.dtype), axis=axis,
+                              keepdims=keepdim))
+    return Tensor(jnp.sum(jnp.abs(a) ** p, axis=axis,
+                          keepdims=keepdim) ** (1.0 / p))
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    """linalg.matrix_norm — Frobenius/nuclear/operator norms over the two
+    trailing (or given) axes."""
+    a = _raw(x)
+    axis = tuple(axis)
+    if p in ("fro", "f"):
+        return Tensor(jnp.sqrt(jnp.sum(
+            jnp.abs(a) ** 2, axis=axis, keepdims=keepdim)))
+    if p == "nuc" or p in (2, -2, 2.0, -2.0):
+        a2 = jnp.moveaxis(a, axis, (-2, -1))
+        s = jnp.linalg.svd(a2, compute_uv=False)
+        if p == "nuc":
+            out = jnp.sum(s, axis=-1)
+        elif float(p) > 0:
+            out = jnp.max(s, axis=-1)
+        else:
+            out = jnp.min(s, axis=-1)
+        if keepdim:
+            out = jnp.expand_dims(out, axis)
+        return Tensor(out)
+    row_axis, col_axis = axis
+    if p in (1, -1, 1.0, -1.0):
+        sums = jnp.sum(jnp.abs(a), axis=row_axis, keepdims=True)
+        red = jnp.max if float(p) > 0 else jnp.min
+        out = red(sums, axis=col_axis, keepdims=True)
+    elif p in (float("inf"), float("-inf")):
+        sums = jnp.sum(jnp.abs(a), axis=col_axis, keepdims=True)
+        red = jnp.max if p > 0 else jnp.min
+        out = red(sums, axis=row_axis, keepdims=True)
+    else:
+        raise ValueError(f"unsupported matrix norm order {p!r}")
+    if not keepdim:
+        out = jnp.squeeze(out, axis)
+    return Tensor(out)
+
+
+# -- fp8 gemm ----------------------------------------------------------------
+
+def fp8_fp8_half_gemm_fused(x, y, transpose_x=False, transpose_y=False,
+                            bias=None, scale=1.0, output_dtype="float16",
+                            act="identity", name=None):
+    """tensor/linalg.py:327 — fp8 x fp8 -> half gemm.  TPU-native: XLA
+    lowers float8_e4m3fn dot_general onto the MXU directly; scale/bias/act
+    fuse into the epilogue."""
+    a, b = _raw(x), _raw(y)
+    if a.dtype not in (jnp.float8_e4m3fn, jnp.float8_e5m2):
+        a = a.astype(jnp.float8_e4m3fn)
+    if b.dtype not in (jnp.float8_e4m3fn, jnp.float8_e5m2):
+        b = b.astype(jnp.float8_e4m3fn)
+    if transpose_x:
+        a = jnp.swapaxes(a, -2, -1)
+    if transpose_y:
+        b = jnp.swapaxes(b, -2, -1)
+    out = jax.lax.dot_general(
+        a, b, (((a.ndim - 1,), (b.ndim - 2,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    out = out * scale
+    if bias is not None:
+        out = out + _raw(bias).astype(out.dtype)
+    if act in ("relu",):
+        out = jnp.maximum(out, 0)
+    elif act in ("gelu",):
+        out = jax.nn.gelu(out)
+    elif act != "identity":
+        raise ValueError(f"unsupported act {act!r}")
+    dt = jnp.bfloat16 if "bfloat16" in str(output_dtype) else jnp.float16
+    return Tensor(out.astype(dt))

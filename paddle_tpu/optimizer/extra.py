@@ -36,12 +36,6 @@ class Adamax(Optimizer):
         self._beta2 = float(beta2)
         self._epsilon = float(epsilon)
 
-    def _step_count(self, p):
-        slots = self._accumulators.setdefault(id(p), {})
-        t = slots.get("_t", 0) + 1
-        slots["_t"] = t
-        return t
-
     def _update_param(self, p, pd, gd, lr, wd):
         m = self._get_accumulator(p, "moment", dtype=jnp.float32)
         inf = self._get_accumulator(p, "inf_norm", dtype=jnp.float32)
@@ -64,7 +58,7 @@ def _nadam_update(p, g, m, v, mu_prod, lr, beta1, beta2, epsilon,
     m_hat = mu_t1 * m / (1 - mu_prod_t1) + (1 - mu_t) * g / (1 - mu_prod_t)
     v_hat = v / (1 - b2pow)
     new_p = p - lr * m_hat / (jnp.sqrt(v_hat) + epsilon)
-    return new_p, m, v, mu_prod_t
+    return new_p, m, v
 
 
 class NAdam(Optimizer):
@@ -83,12 +77,6 @@ class NAdam(Optimizer):
         self._epsilon = float(epsilon)
         self._psi = float(momentum_decay)
 
-    def _step_count(self, p):
-        slots = self._accumulators.setdefault(id(p), {})
-        t = slots.get("_t", 0) + 1
-        slots["_t"] = t
-        return t
-
     def _update_param(self, p, pd, gd, lr, wd):
         m = self._get_accumulator(p, "moment1", dtype=jnp.float32)
         v = self._get_accumulator(p, "moment2", dtype=jnp.float32)
@@ -97,11 +85,13 @@ class NAdam(Optimizer):
         t = self._step_count(p)
         mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (t * self._psi))
         mu_t1 = self._beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
-        new_p, m, v, mu_prod_t = _nadam_update(
+        new_p, m, v = _nadam_update(
             pd.astype(jnp.float32), gd.astype(jnp.float32), m, v,
             jnp.float32(mu_prod), lr, self._beta1, self._beta2,
             self._epsilon, self._beta2 ** t, mu_t, mu_t1)
-        slots["_mu_prod"] = float(mu_prod_t)
+        # mu_prod is a pure host-side scalar recurrence — keeping it out of
+        # the jit outputs avoids one device fetch per parameter per step.
+        slots["_mu_prod"] = mu_prod * mu_t
         self._set_accumulator(p, "moment1", m)
         self._set_accumulator(p, "moment2", v)
         return new_p.astype(pd.dtype)
@@ -134,12 +124,6 @@ class RAdam(Optimizer):
         self._beta1 = float(beta1)
         self._beta2 = float(beta2)
         self._epsilon = float(epsilon)
-
-    def _step_count(self, p):
-        slots = self._accumulators.setdefault(id(p), {})
-        t = slots.get("_t", 0) + 1
-        slots["_t"] = t
-        return t
 
     def _update_param(self, p, pd, gd, lr, wd):
         m = self._get_accumulator(p, "moment1", dtype=jnp.float32)

@@ -105,6 +105,13 @@ class Optimizer:
     def _set_accumulator(self, p, name, value):
         self._accumulators.setdefault(id(p), {})[name] = value
 
+    def _step_count(self, p):
+        """Per-parameter step counter (host-side scalar slot)."""
+        slots = self._accumulators.setdefault(id(p), {})
+        t = slots.get("_t", 0) + 1
+        slots["_t"] = t
+        return t
+
     def _master_weight(self, p):
         mw = self._master_weights.get(id(p))
         if mw is None:

@@ -146,12 +146,6 @@ class Adam(Optimizer):
         self._beta2 = beta2
         self._epsilon = epsilon
 
-    def _step_count(self, p):
-        slots = self._accumulators.setdefault(id(p), {})
-        t = slots.get("_t", 0) + 1
-        slots["_t"] = t
-        return t
-
     def _update_param(self, p, pd, gd, lr, wd):
         m = self._get_accumulator(p, "moment1", dtype=jnp.float32)
         v = self._get_accumulator(p, "moment2", dtype=jnp.float32)

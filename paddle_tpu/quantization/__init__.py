@@ -121,6 +121,46 @@ class FakeQuanterWithAbsMaxObserver(_Factory):
                          quant_bits=quant_bits, moving_rate=moving_rate)
 
 
+class BaseQuanter(BaseObserver):
+    """reference base_quanter.py:27 — abstract base for custom quanters:
+    forward/scales/zero_points/quant_axis/bit_length."""
+
+    def zero_points(self):
+        return None
+
+    def quant_axis(self):
+        return None
+
+
+def quanter(class_name):
+    """reference factory.py:78 — decorator declaring a factory class named
+    ``class_name`` for a BaseQuanter subclass, installed into the
+    declaring module's globals (so configs can reference the factory)."""
+    import inspect
+    import sys
+
+    def wrapper(target_class):
+        class _QuanterFactory(_Factory):
+            def __init__(self, *args, **kwargs):
+                self._cls = target_class
+                self._args = args
+                self._kwargs = kwargs
+
+            def _instance(self, layer=None):
+                return self._cls(*self._args, **self._kwargs)
+
+        _QuanterFactory.__name__ = class_name
+        _QuanterFactory.__qualname__ = class_name
+        frame = inspect.stack()[1]
+        mod = inspect.getmodule(frame[0])
+        if mod is not None:
+            setattr(sys.modules[mod.__name__], class_name, _QuanterFactory)
+        setattr(quanters, class_name, _QuanterFactory)
+        return target_class
+
+    return wrapper
+
+
 # namespace parity: paddle.quantization.observers / .quanters
 class observers:  # noqa: N801
     AbsmaxObserver = AbsmaxObserver

@@ -83,25 +83,8 @@ class ViterbiDecoder(Layer):
                               self.include_bos_eos_tag)
 
 
-class _NeedsDownload:
-    def __init__(self, name):
-        self._name = name
-
-    def __call__(self, *a, **k):
-        raise RuntimeError(
-            f"paddle.text.datasets.{self._name} needs a network download "
-            "(reference text/datasets); this environment has no egress — "
-            "load the corpus from local files with paddle.io.Dataset")
-
-
-class datasets:  # noqa: N801
-    Imdb = _NeedsDownload("Imdb")
-    Imikolov = _NeedsDownload("Imikolov")
-    Movielens = _NeedsDownload("Movielens")
-    Conll05st = _NeedsDownload("Conll05st")
-    UCIHousing = _NeedsDownload("UCIHousing")
-    WMT14 = _NeedsDownload("WMT14")
-    WMT16 = _NeedsDownload("WMT16")
-
-
 from .tokenizer import BPETokenizer  # noqa: F401,E402
+from . import datasets  # noqa: F401,E402
+from .datasets import (  # noqa: F401,E402
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)

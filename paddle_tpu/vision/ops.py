@@ -716,8 +716,9 @@ def prior_box(input, image, min_sizes, max_sizes=None,
               min_max_aspect_ratios_order=False, name=None):
     """SSD prior boxes (kernel cpu/prior_box_kernel.cc).  Returns
     (boxes [H,W,num_priors,4], variances same shape)."""
-    fH, fW = _np(input).shape[2:]
-    iH, iW = _np(image).shape[2:]
+    # only the static shapes are needed — no device fetch
+    fH, fW = tuple(input.shape)[2:]
+    iH, iW = tuple(image.shape)[2:]
     min_sizes = [float(m) for m in np.atleast_1d(min_sizes)]
     max_sizes = [] if max_sizes is None else \
         [float(m) for m in np.atleast_1d(max_sizes)]

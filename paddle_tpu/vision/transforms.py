@@ -372,11 +372,15 @@ def adjust_hue(img, hue_factor):
 
 def erase(img, i, j, h, w, v, inplace=False):
     """Erase the [i:i+h, j:j+w] patch with value v (reference
-    functional.erase)."""
+    functional.erase).  v may be a scalar, a per-channel vector, or a full
+    [C, h, w] patch (the RandomErasing 'random' fill)."""
     from ..core.tensor import Tensor
 
     vv = np.asarray(v)
-    if vv.ndim >= 1:
+    patch = None  # full [C, h, w] fill
+    if vv.ndim >= 2:
+        patch = vv.reshape(-1, h, w)
+    elif vv.ndim == 1:
         vv = vv.reshape(-1)  # per-channel vector, any input orientation
     if isinstance(img, Tensor):
         import paddle_tpu as paddle
@@ -384,23 +388,31 @@ def erase(img, i, j, h, w, v, inplace=False):
         a = np.array(img.numpy())
         chw = a.ndim == 3 and _is_chw(a)
         if chw:
-            pv = vv[:, None, None] if vv.ndim else vv
+            pv = patch if patch is not None else (
+                vv[:, None, None] if vv.ndim else vv)
             a[:, i:i + h, j:j + w] = np.broadcast_to(
-                pv.astype(a.dtype), (a.shape[0], h, w))
+                np.asarray(pv).astype(a.dtype), (a.shape[0], h, w))
         else:
+            pv = np.moveaxis(patch, 0, -1) if patch is not None else vv
             a[i:i + h, j:j + w] = np.broadcast_to(
-                vv.astype(a.dtype), a[i:i + h, j:j + w].shape)
+                np.asarray(pv).astype(a.dtype),
+                a[i:i + h, j:j + w].shape)
         out = paddle.to_tensor(a)
         if inplace:
             img.set_value(out)
             return img
         return out
-    a = np.asarray(img) if inplace else np.array(img)
+    a = np.asarray(img)
     hwc, fmt = _hwc(a)
     hwc = hwc.copy()
+    pv = np.moveaxis(patch, 0, -1) if patch is not None else vv
     hwc[i:i + h, j:j + w] = np.broadcast_to(
-        vv.astype(a.dtype), (h, w, hwc.shape[-1]))
-    return _unhwc(hwc, fmt)
+        np.asarray(pv).astype(a.dtype), (h, w, hwc.shape[-1]))
+    out = _unhwc(hwc, fmt)
+    if inplace and isinstance(img, np.ndarray):
+        img[...] = out
+        return img
+    return out
 
 
 def _bilinear_sample(a, sy, sx, fill):
@@ -729,7 +741,10 @@ class RandomErasing(BaseTransform):
                     if self.value != "random":
                         raise ValueError(
                             "value only supports 'random' as a string")
-                    v = np.random.rand()
+                    # reference RandomErasing: per-element normal noise
+                    c = a.shape[-1]
+                    v = np.random.normal(
+                        size=(c, eh, ew)).astype(np.float32)
                 elif np.isscalar(self.value):
                     v = self.value
                 else:
