@@ -51,9 +51,6 @@ class _ConfigBag:
     def __init__(self, **defaults):
         self.__dict__.update(defaults)
 
-    def __setattr__(self, k, v):
-        self.__dict__[k] = v
-
     def __repr__(self):
         body = ", ".join(f"{k}={v!r}" for k, v in self.__dict__.items())
         return f"{type(self).__name__}({body})"
@@ -177,7 +174,12 @@ class _ShardOptimizer:
         self.__dict__["_sharded"] = set()
         if isinstance(shard_fn, ShardingStage3):
             for p in optimizer._parameter_list():
-                shard_fn.shard_param(p)
+                out = shard_fn.shard_param(p)
+                if out is not p:
+                    # adopt the sharded array in place so the layer's own
+                    # reference to this parameter sees the new layout
+                    p._data = out._data
+                    p._dist_attr = getattr(out, "_dist_attr", None)
 
     def _shard_accumulators(self):
         opt, fn = self._inner_opt, self._shard_fn

@@ -84,7 +84,20 @@ class StaticFunction:
         self._remat = remat  # jax.checkpoint the traced body
         self._cache = {}
         self._warned_break = False
+        self._converted = None  # lazily AST-converted body (dy2static)
+        self._n_converted = 0
         functools.update_wrapper(self, function)
+
+    def _traced_fn(self):
+        """The function the whole-graph trace runs: the AST-converted
+        body when the source has tensor-driven control flow (reference
+        program_translator.py:1714 AST path), else the original."""
+        if self._converted is None:
+            from .dy2static import convert_to_static
+
+            self._converted, self._n_converted = convert_to_static(
+                self._fn)
+        return self._converted
 
     def _state_tensors(self):
         if self._layer is None:
@@ -201,7 +214,7 @@ class StaticFunction:
         return jax.tree.unflatten(out_tree, outs)
 
     def _compile(self, args, kwargs, state):
-        fn = self._fn
+        fn = self._traced_fn()
         treedef, _ = _Guard.key(args, kwargs)
         leaves, _ = jax.tree.flatten((args, kwargs),
                                      is_leaf=lambda x: isinstance(x, Tensor))
@@ -238,7 +251,19 @@ class StaticFunction:
     # Reference API parity.
     @property
     def code(self):
-        return "<compiled by paddle_tpu.jit (XLA)>"
+        """The traced source (reference StaticFunction.code returns the
+        dy2static-transformed source)."""
+        import inspect
+
+        fn = self._traced_fn()
+        src = getattr(fn, "__dy2static_source__", None)
+        if src:
+            return src
+        try:
+            return inspect.getsource(
+                fn.__func__ if inspect.ismethod(fn) else fn)
+        except (OSError, TypeError):
+            return "<compiled by paddle_tpu.jit (XLA)>"
 
     def concrete_program_specify_input_spec(self, *a, **k):
         return None

@@ -1,0 +1,418 @@
+"""Automatic control-flow conversion for ``to_static``.
+
+Reference: ``python/paddle/jit/dy2static/program_translator.py:1714``
+(StaticFunction AST path) + ``dy2static/transformers/`` (IfElse / Loop
+transformers) — there, Python source is transpiled so that ``if``/``while``
+over tensors become ``cond``/``while_loop`` layers before tracing.
+
+TPU-native re-design: the same source-to-source transform, but the emitted
+runtime calls (``_dy2st_if`` / ``_dy2st_while``) dispatch *dynamically* —
+a concrete (eager) condition runs plain Python, a traced condition lowers
+onto ``jax.lax.cond`` / ``lax.while_loop`` via ``static.nn``.  One
+transformed body therefore serves both dygraph and the jit trace, which is
+exactly the contract the reference's convert_ifelse/convert_while_loop
+helpers implement (``dy2static/convert_operators.py:40``).
+
+Coverage: ``if``/``elif``/``else`` (including both-branches-return),
+``while``, and ``for _ in range(...)``.  Statements that cannot be lifted
+into functional control flow (``break``/``continue`` under a traced
+condition, one-armed returns) keep Python semantics and surface through
+the existing graph-break fallback — the reference behaves the same way
+through SOT's subgraph fallback.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+
+class _Undef:
+    """UndefinedVar analog (reference dy2static/utils.py UndefinedVar):
+    placeholder for names bound in only one branch; any real use raises."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name="<var>"):
+        self.name = name
+
+    def _die(self, *a, **k):
+        raise UnboundLocalError(
+            f"variable {self.name!r} was only assigned on one branch of a "
+            "converted if/while and is read on a path where it is unbound")
+
+    __bool__ = __call__ = __getitem__ = __add__ = __radd__ = _die
+    __mul__ = __sub__ = __getattr__ = _die
+
+
+def _is_traced(x):
+    import jax
+
+    from ..core.tensor import Tensor
+
+    d = x._data if isinstance(x, Tensor) else x
+    return isinstance(d, jax.core.Tracer)
+
+
+def _to_bool(x):
+    from ..core.tensor import Tensor
+
+    return bool(x._data if isinstance(x, Tensor) else x)
+
+
+def _dy2st_if(cond, true_fn, false_fn, vals):
+    """convert_ifelse analog (convert_operators.py:40): traced condition
+    -> lax.cond through static.nn; concrete -> plain Python."""
+    if _is_traced(cond):
+        from ..static import nn as static_nn
+
+        return static_nn.cond(cond, lambda: true_fn(*vals),
+                              lambda: false_fn(*vals))
+    return true_fn(*vals) if _to_bool(cond) else false_fn(*vals)
+
+
+def _dy2st_while(cond_fn, body_fn, vals):
+    """convert_while_loop analog: a traced condition lowers the whole
+    loop onto lax.while_loop; otherwise plain Python iteration."""
+    vals = tuple(vals)
+    c = cond_fn(*vals)
+    if _is_traced(c) or any(_is_traced(v) for v in vals
+                            if not isinstance(v, _Undef)):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        from ..static import nn as static_nn
+
+        if any(isinstance(v, _Undef) for v in vals):
+            bad = [v.name for v in vals if isinstance(v, _Undef)]
+            raise UnboundLocalError(
+                f"converted while loop carries unbound variables {bad} "
+                "into a traced lowering")
+        # Loop carries must be arrays with stable dtype: promote python
+        # scalars once so `i = 0; while i < n: i += 1` lowers cleanly.
+        carry = [v if isinstance(v, Tensor) else Tensor(jnp.asarray(v))
+                 for v in vals]
+        out = static_nn.while_loop(cond_fn, lambda *vs: tuple(body_fn(*vs)),
+                                   carry)
+        return tuple(out)
+    while _to_bool(c):
+        vals = tuple(body_fn(*vals))
+        c = cond_fn(*vals)
+    return vals
+
+
+class _AssignedNames(ast.NodeVisitor):
+    """Names bound by a statement list (assignment/augassign/for/with
+    targets) — the candidate outputs of a converted branch/loop body."""
+
+    def __init__(self):
+        self.names = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.names.add(node.id)
+
+    def visit_FunctionDef(self, node):
+        self.names.add(node.name)  # don't descend: inner scope
+
+    def visit_AsyncFunctionDef(self, node):
+        self.names.add(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_ClassDef(self, node):
+        self.names.add(node.name)
+
+
+def _assigned(stmts):
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+class _LoadedNames(ast.NodeVisitor):
+    def __init__(self):
+        self.names = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.names.add(node.id)
+
+
+def _loaded(node_or_list):
+    v = _LoadedNames()
+    for n in (node_or_list if isinstance(node_or_list, list)
+              else [node_or_list]):
+        v.visit(n)
+    return v.names
+
+
+def _contains(stmts, *types):
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, types):
+                return True
+    return False
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _tuple_of(names, ctx=None):
+    return ast.Tuple(elts=[_name(n, ctx or ast.Load()) for n in names],
+                     ctx=ctx or ast.Load())
+
+
+def _localfix(names):
+    """`x = locals().get('x', _Undef('x'))` pre-bindings: makes every
+    captured name referenceable whether or not it is bound yet (the
+    reference inserts UndefinedVar assignments the same way)."""
+    out = []
+    for n in sorted(names):
+        call = ast.Call(
+            func=ast.Attribute(
+                value=ast.Call(func=_name("locals"), args=[], keywords=[]),
+                attr="get", ctx=ast.Load()),
+            args=[ast.Constant(n),
+                  ast.Call(func=_name("_dy2st_undef_cls"),
+                           args=[ast.Constant(n)], keywords=[])],
+            keywords=[])
+        out.append(ast.Assign(targets=[_name(n, ast.Store())], value=call))
+    return out
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    """IfElseTransformer + LoopTransformer analog
+    (dy2static/transformers/ifelse_transformer.py, loop_transformer.py)."""
+
+    def __init__(self, local_names=None):
+        self._n = 0
+        self.converted = 0
+        # the function's local-name universe: only these may become branch
+        # parameters (a global like `paddle` or `F` must resolve through
+        # the generated functions' enclosing scope, never be shadowed)
+        self._locals = set(local_names or ())
+
+    def _only_locals(self, names):
+        if not self._locals:
+            return sorted(names)
+        return sorted(set(names) & self._locals)
+
+    def _uid(self, kind):
+        self._n += 1
+        return f"__dy2st_{kind}_{self._n}"
+
+    # -- if/else ------------------------------------------------------------
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        # Unsupported shapes keep Python semantics (graph-break fallback).
+        if _contains([node], ast.Break, ast.Continue, ast.Yield,
+                     ast.YieldFrom):
+            return node
+        body_ret = any(isinstance(s, ast.Return) for s in node.body)
+        else_ret = any(isinstance(s, ast.Return) for s in node.orelse)
+        if body_ret or else_ret:
+            # liftable only when BOTH arms end in a return (then the
+            # whole statement becomes `return _dy2st_if(...)`)
+            if not (node.body and node.orelse
+                    and isinstance(node.body[-1], ast.Return)
+                    and isinstance(node.orelse[-1], ast.Return)
+                    and not _contains(node.body[:-1], ast.Return)
+                    and not _contains(node.orelse[:-1], ast.Return)):
+                return node
+            return self._convert_returning_if(node)
+        return self._convert_assigning_if(node)
+
+    def _branch_fn(self, fname, params, stmts, result_names):
+        ret = ast.Return(value=_tuple_of(result_names))
+        return ast.FunctionDef(
+            name=fname,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=p) for p in params],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=list(stmts) + [ret], decorator_list=[], returns=None)
+
+    def _convert_assigning_if(self, node):
+        out_names = self._only_locals(
+            _assigned(node.body) | _assigned(node.orelse))
+        if not out_names:
+            # side-effect-only branches (e.g. list.append): keep Python
+            return node
+        params = self._only_locals(
+            (_loaded(node.body) | _loaded(node.orelse)) | set(out_names))
+        params = sorted(set(params) | set(out_names))
+        tname, fname = self._uid("true"), self._uid("false")
+        tfn = self._branch_fn(tname, params, node.body, out_names)
+        ffn = self._branch_fn(fname, params, node.orelse or [ast.Pass()],
+                              out_names)
+        call = ast.Call(
+            func=_name("_dy2st_if"),
+            args=[node.test, _name(tname), _name(fname),
+                  _tuple_of(params)],
+            keywords=[])
+        assign = ast.Assign(targets=[_tuple_of(out_names, ast.Store())],
+                            value=call)
+        self.converted += 1
+        return _localfix(params) + [tfn, ffn, assign]
+
+    def _convert_returning_if(self, node):
+        params = self._only_locals(_loaded(node.body) | _loaded(node.orelse)
+                                   | _loaded(node.test))
+        tname, fname = self._uid("true"), self._uid("false")
+
+        def as_fn(fname_, stmts):
+            last = stmts[-1]
+            body = list(stmts[:-1]) + [ast.Return(
+                value=last.value if last.value is not None
+                else ast.Constant(None))]
+            return ast.FunctionDef(
+                name=fname_,
+                args=ast.arguments(
+                    posonlyargs=[], args=[ast.arg(arg=p) for p in params],
+                    kwonlyargs=[], kw_defaults=[], defaults=[]),
+                body=body, decorator_list=[], returns=None)
+
+        tfn = as_fn(tname, node.body)
+        ffn = as_fn(fname, node.orelse)
+        call = ast.Call(
+            func=_name("_dy2st_if"),
+            args=[node.test, _name(tname), _name(fname),
+                  _tuple_of(params)],
+            keywords=[])
+        self.converted += 1
+        return _localfix(params) + [tfn, ffn, ast.Return(value=call)]
+
+    # -- while --------------------------------------------------------------
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _contains([node], ast.Break, ast.Continue,
+                                    ast.Return, ast.Yield, ast.YieldFrom):
+            return node
+        carried = self._only_locals(_assigned(node.body)
+                                    | _loaded(node.test))
+        carried = [n for n in carried if not n.startswith("__dy2st")]
+        if not carried:
+            return node
+        cname, bname = self._uid("cond"), self._uid("body")
+        cond_fn = ast.FunctionDef(
+            name=cname,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=p) for p in carried],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=[ast.Return(value=node.test)], decorator_list=[],
+            returns=None)
+        body_fn = self._branch_fn(bname, carried, node.body, carried)
+        call = ast.Call(
+            func=_name("_dy2st_while"),
+            args=[_name(cname), _name(bname), _tuple_of(carried)],
+            keywords=[])
+        assign = ast.Assign(targets=[_tuple_of(carried, ast.Store())],
+                            value=call)
+        self.converted += 1
+        return _localfix(carried) + [cond_fn, body_fn, assign]
+
+    # -- for over range -----------------------------------------------------
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if node.orelse or not isinstance(node.target, ast.Name):
+            return node
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and 1 <= len(it.args) <= 3
+                and not it.keywords):
+            return node
+        if _contains([node], ast.Break, ast.Continue, ast.Return,
+                     ast.Yield, ast.YieldFrom):
+            return node
+        # for i in range(a[,b[,c]]): body  ->  i = a0; while i < b0: ...
+        i = node.target.id
+        if len(it.args) == 1:
+            start, stop, step = ast.Constant(0), it.args[0], ast.Constant(1)
+        elif len(it.args) == 2:
+            start, stop, step = it.args[0], it.args[1], ast.Constant(1)
+        else:
+            start, stop, step = it.args
+        start_name = self._uid("start")
+        stop_name = self._uid("stop")
+        step_name = self._uid("step")
+        pre = [
+            ast.Assign(targets=[_name(start_name, ast.Store())],
+                       value=start),
+            ast.Assign(targets=[_name(stop_name, ast.Store())], value=stop),
+            ast.Assign(targets=[_name(step_name, ast.Store())], value=step),
+            ast.Assign(targets=[_name(i, ast.Store())],
+                       value=_name(start_name)),
+        ]
+        test = ast.Compare(left=_name(i), ops=[ast.Lt()],
+                           comparators=[_name(stop_name)])
+        body = list(node.body) + [ast.AugAssign(
+            target=_name(i, ast.Store()), op=ast.Add(),
+            value=_name(step_name))]
+        while_node = ast.While(test=test, body=body, orelse=[])
+        out = pre + [while_node]
+        # re-run the while conversion on the rewritten loop
+        converted = self.visit_While(while_node)
+        if isinstance(converted, list):
+            out = pre + converted
+        self.converted += 1
+        return out
+
+
+def convert_to_static(fn):
+    """Transpile ``fn``'s source so tensor-driven if/while/for lower onto
+    lax control flow (reference program_translator.py:1714).  Returns
+    (converted_fn, n_converted); (fn, 0) when nothing needed conversion
+    or the source is unavailable."""
+    try:
+        raw_fn = fn.__func__ if inspect.ismethod(fn) else fn
+        src = textwrap.dedent(inspect.getsource(raw_fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn, 0
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn, 0
+    fdef.decorator_list = []  # the wrapper re-applies semantics
+    # local-name universe: code-object locals + anything assigned in source
+    local_names = set(raw_fn.__code__.co_varnames) \
+        | set(raw_fn.__code__.co_cellvars) | _assigned(fdef.body)
+    local_names |= {a.arg for a in fdef.args.args}
+    tr = ControlFlowTransformer(local_names)
+    tr.visit(tree)
+    if not tr.converted:
+        return fn, 0
+    ast.fix_missing_locations(tree)
+    glb = dict(raw_fn.__globals__)
+    glb["_dy2st_if"] = _dy2st_if
+    glb["_dy2st_while"] = _dy2st_while
+    glb["_dy2st_undef_cls"] = _Undef
+    if raw_fn.__closure__:
+        # re-expose free variables by value (reference's closure capture)
+        for name, cell in zip(raw_fn.__code__.co_freevars,
+                              raw_fn.__closure__):
+            try:
+                glb.setdefault(name, cell.cell_contents)
+            except ValueError:
+                pass
+    try:
+        code = compile(tree, filename=f"<dy2static {raw_fn.__name__}>",
+                       mode="exec")
+        ns = {}
+        exec(code, glb, ns)
+        new_fn = ns[fdef.name]
+    except Exception:
+        return fn, 0
+    new_fn.__defaults__ = raw_fn.__defaults__
+    new_fn.__kwdefaults__ = raw_fn.__kwdefaults__
+    functools.update_wrapper(new_fn, raw_fn)
+    new_fn.__dy2static_source__ = ast.unparse(tree)
+    if inspect.ismethod(fn):
+        new_fn = new_fn.__get__(fn.__self__)
+    return new_fn, tr.converted

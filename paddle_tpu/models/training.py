@@ -352,14 +352,21 @@ class CompiledTrainStep:
         shape-guessed: a batch whose size equals ``k`` must not be
         silently unstacked).  Returns the last step's loss.  Donation
         and mesh out-shardings follow the constructor's contract
-        exactly like ``step``."""
-        from ..core.tensor import Tensor
-        from ..optimizer.lr import LRScheduler
+        exactly like ``step``.
 
-        if isinstance(self.lr, LRScheduler):
-            raise ValueError("multi_step requires a constant lr "
-                             "(schedulers advance per-host-step)")
-        lr_val = float(self.lr)
+        LR schedulers compose: the next ``k`` per-step rates are computed
+        on host (advancing the scheduler exactly as ``step`` would) and
+        threaded into the scanned body as a step-indexed [k] array, so a
+        warmup+decay recipe through ``multi_step`` matches per-step
+        execution bit-for-bit.  Loss-dependent schedulers
+        (ReduceOnPlateau) cannot be precomputed and still raise."""
+        from ..core.tensor import Tensor
+        from ..optimizer.lr import LRScheduler, ReduceOnPlateau
+
+        if isinstance(self.lr, ReduceOnPlateau):
+            raise ValueError(
+                "multi_step cannot precompute a loss-dependent schedule "
+                "(ReduceOnPlateau) — use step()")
         batch = [b._data if isinstance(b, Tensor) else b for b in batch]
         if isinstance(stacked, bool):
             stacked = (stacked,) * len(batch)
@@ -373,6 +380,17 @@ class CompiledTrainStep:
                 raise ValueError(
                     f"stacked batch element must have leading dim "
                     f"{k}, got {getattr(b, 'shape', ())}")
+        # Advance the scheduler only after every argument check passed — a
+        # rejected call must not leave the schedule k steps ahead.
+        if isinstance(self.lr, LRScheduler):
+            lrs = []
+            for _ in range(k):
+                lrs.append(float(self.lr()))
+                self.lr.step()
+            lr_val = jnp.asarray(lrs, jnp.float32)
+        else:
+            # uniform [k] array keeps one compiled program for both cases
+            lr_val = jnp.full((k,), float(self.lr), jnp.float32)
         with jax.enable_x64(False):
             batch = [self._place_batch(b) for b in batch]
             jitted = self._multi.get((k, stacked))
@@ -386,7 +404,7 @@ class CompiledTrainStep:
                             b, i, keepdims=False) if s else b
                             for b, s in zip(batch, stacked)]
                         params, master, m, v, loss = raw(
-                            params, master, m, v, t, lr, *per)
+                            params, master, m, v, t, lr[i], *per)
                         return (params, master, m, v, t + 1), loss
 
                     (params, master, m, v, t), losses = jax.lax.scan(

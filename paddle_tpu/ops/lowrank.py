@@ -112,12 +112,19 @@ def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
                          kept, 0.0)
     # Guard: never zero out an entire row.
     kept = jnp.where(keep.any(-1, keepdims=True), kept, sorted_p)
-    if seed != -1:
-        key = jax.random.PRNGKey(seed)
+    logits = jnp.log(jnp.maximum(kept, 1e-30))
+    if topp_seed is not None:
+        # per-row seeds (the reference's per-query determinism knob)
+        seeds = _raw(topp_seed).astype(jnp.uint32).reshape(-1)
+        keys = jax.vmap(jax.random.PRNGKey)(seeds)
+        pick = jax.vmap(
+            lambda k, lg: jax.random.categorical(k, lg))(keys, logits)
     else:
-        key = default_generator.next_key()
-    pick = jax.random.categorical(
-        key, jnp.log(jnp.maximum(kept, 1e-30)), axis=-1)  # [B] sorted idx
+        if seed != -1:
+            key = jax.random.PRNGKey(seed)
+        else:
+            key = default_generator.next_key()
+        pick = jax.random.categorical(key, logits, axis=-1)  # [B]
     ids = jnp.take_along_axis(order, pick[:, None], axis=-1)
     scores = jnp.take_along_axis(probs, ids, axis=-1).astype(_raw(x).dtype)
     out = (Tensor(scores), Tensor(ids.astype(jnp.int64)))

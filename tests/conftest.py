@@ -56,9 +56,37 @@ def pytest_configure(config):
         "lane via -m 'not slow')")
 
 
+# Fast-lane guardrails (VERDICT r4 weak #5): the op coverage gate (~8s)
+# always runs in the fast lane, plus a rotating ~10% hash-sample of the op
+# rows so a breadth regression surfaces within the 5-minute lane instead of
+# waiting for a slow-lane run.  The sample rotates daily (deterministic
+# within a day for reproducible failures); PT_FAST_SAMPLE_SEED pins it.
+_FAST_ALWAYS = {"test_coverage_complete"}
+
+
+def _fast_sample_seed():
+    import datetime
+
+    seed = os.environ.get("PT_FAST_SAMPLE_SEED")
+    if seed is not None:
+        return int(seed)
+    return datetime.date.today().toordinal()
+
+
+def _sampled(item_name):
+    import zlib
+
+    return (zlib.crc32(item_name.encode()) + _fast_sample_seed()) % 10 == 0
+
+
 def pytest_collection_modifyitems(config, items):
     import pytest as _pytest
 
     for item in items:
-        if item.fspath.basename in _SLOW_FILES:
-            item.add_marker(_pytest.mark.slow)
+        if item.fspath.basename not in _SLOW_FILES:
+            continue
+        if item.fspath.basename == "test_op_suite.py":
+            base = item.name.split("[")[0]
+            if base in _FAST_ALWAYS or _sampled(item.name):
+                continue  # stays in the fast lane
+        item.add_marker(_pytest.mark.slow)

@@ -1,0 +1,62 @@
+"""Model bodies for the dy2static tests — in a real file so
+inspect.getsource works (the AST path transpiles source)."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class BranchLoopNet(nn.Layer):
+    """Plain Python data-dependent branch AND loop in forward — the
+    reference converts these via dy2static AST transpile
+    (program_translator.py:1714)."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(8, 8)
+
+    def forward(self, x, steps):
+        h = self.fc(x)
+        if h.mean() > 0:
+            h = h * 2.0
+        else:
+            h = -h
+        i = 0
+        acc = h.sum()
+        while i < steps:
+            acc = acc + h.mean()
+            i = i + 1
+        return acc
+
+
+class EarlyReturnNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.fc(x)
+        if h.sum() > 0:
+            return h * 3.0
+        else:
+            return h - 1.0
+
+
+class ForRangeNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 4)
+
+    def forward(self, x, n):
+        h = x
+        for _ in range(n):
+            h = self.fc(h)
+        return h.sum()
+
+
+def plain_branch_fn(x):
+    if x.sum() > 0:
+        y = x * 2.0
+    else:
+        y = x / 2.0
+    return y.sum()

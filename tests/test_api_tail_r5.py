@@ -299,6 +299,35 @@ def test_index_put_bool_mask_length1_value_broadcasts():
     assert out.numpy().tolist() == [5.0, 0.0, 5.0, 5.0]
 
 
+def test_multi_step_rejected_call_does_not_advance_scheduler():
+    # review r5: a failed multi_step must leave the LR schedule untouched
+    from paddle_tpu.models.training import CompiledTrainStep
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.optimizer.lr import StepDecay
+
+    sched = StepDecay(0.1, step_size=2)
+    step = CompiledTrainStep(
+        paddle.nn.Linear(4, 2), lr=sched, loss_fn=F.cross_entropy)
+    before = float(sched())
+    rng = np.random.RandomState(0)
+    with pytest.raises(ValueError, match="stacked"):
+        step.multi_step(4, rng.randn(2, 4).astype(np.float32),
+                        rng.randint(0, 2, (2,)).astype(np.int32),
+                        stacked=(True,))
+    assert float(sched()) == before
+
+
+def test_top_p_sampling_topp_seed_per_row_determinism():
+    probs = paddle.to_tensor(np.tile(
+        np.array([[0.25, 0.25, 0.25, 0.25]], np.float32), (3, 1)))
+    ps = paddle.to_tensor(np.ones(3, np.float32))
+    seeds = paddle.to_tensor(np.array([7, 7, 9], np.int64))
+    _, ids1 = paddle.top_p_sampling(probs, ps, topp_seed=seeds)
+    _, ids2 = paddle.top_p_sampling(probs, ps, topp_seed=seeds)
+    assert (ids1.numpy() == ids2.numpy()).all()
+    assert ids1.numpy()[0][0] == ids1.numpy()[1][0]
+
+
 def test_scatter_object_list_rejects_short_src():
     import paddle_tpu.distributed as dist
 

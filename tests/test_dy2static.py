@@ -1,0 +1,155 @@
+"""Automatic control-flow conversion in to_static (VERDICT r4 next #2).
+
+Reference: ``python/paddle/jit/dy2static/program_translator.py:1714`` (AST
+path), ``dy2static/convert_operators.py:40`` (convert_ifelse /
+convert_while_loop).  Done-criterion: a model with a plain Python
+data-dependent branch and loop compiles with ZERO graph breaks and
+matches eager.
+"""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from dy2static_models import (
+    BranchLoopNet, EarlyReturnNet, ForRangeNet, plain_branch_fn,
+)
+from paddle_tpu.jit import _FALLBACK
+
+
+def _no_breaks(sf):
+    assert not any(v is _FALLBACK for v in sf._cache.values()), \
+        "graph break recorded"
+    assert sf._n_converted > 0, "AST conversion did not trigger"
+
+
+def test_branch_and_loop_zero_graph_breaks():
+    net = BranchLoopNet()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 8).astype(np.float32))
+    steps = paddle.to_tensor(np.asarray(5, np.int32))
+    eager = float(net(x, steps).numpy())
+    static = paddle.jit.to_static(BranchLoopNet(), full_graph=True)
+    static.set_state_dict(net.state_dict()) if hasattr(
+        static, "set_state_dict") else None
+    # fresh net shares nothing — rebuild with same weights instead
+    net2 = BranchLoopNet()
+    net2.set_state_dict(net.state_dict())
+    net2 = paddle.jit.to_static(net2, full_graph=True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any graph-break warning fails
+        got = float(np.asarray(net2(x, steps).numpy()))
+    np.testing.assert_allclose(got, eager, rtol=1e-5)
+    _no_breaks(net2.forward)
+
+
+def test_branch_taken_per_input_signature():
+    """The SAME compiled graph must take both branches data-dependently
+    (lax.cond, not baked-in)."""
+    net = BranchLoopNet()
+    snet = BranchLoopNet()
+    snet.set_state_dict(net.state_dict())
+    snet = paddle.jit.to_static(snet, full_graph=True)
+    steps = paddle.to_tensor(np.asarray(3, np.int32))
+    xpos = paddle.to_tensor(np.full((2, 8), 2.0, np.float32))
+    xneg = paddle.to_tensor(np.full((2, 8), -2.0, np.float32))
+    for x in (xpos, xneg):
+        want = float(net(x, steps).numpy())
+        got = float(np.asarray(snet(x, steps).numpy()))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+    # one guard signature -> one cache entry, no fallback
+    assert len(snet.forward._cache) == 1
+    _no_breaks(snet.forward)
+
+
+def test_early_return_both_arms():
+    net = EarlyReturnNet()
+    snet = EarlyReturnNet()
+    snet.set_state_dict(net.state_dict())
+    snet = paddle.jit.to_static(snet, full_graph=True)
+    for fill in (1.0, -1.0):
+        x = paddle.to_tensor(np.full((2, 4), fill, np.float32))
+        want = np.asarray(net(x).numpy())
+        got = np.asarray(snet(x).numpy())
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+    _no_breaks(snet.forward)
+
+
+def test_for_range_over_tensor_bound():
+    net = ForRangeNet()
+    snet = ForRangeNet()
+    snet.set_state_dict(net.state_dict())
+    snet = paddle.jit.to_static(snet, full_graph=True)
+    x = paddle.to_tensor(
+        np.random.RandomState(1).randn(2, 4).astype(np.float32))
+    for n in (1, 3):
+        nt = paddle.to_tensor(np.asarray(n, np.int32))
+        want = float(net(x, nt).numpy())
+        got = float(np.asarray(snet(x, nt).numpy()))
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+    _no_breaks(snet.forward)
+
+
+def test_plain_function_conversion_and_grad():
+    """Converted control flow must stay differentiable through the
+    to_static training path."""
+    sf = paddle.jit.to_static(plain_branch_fn, full_graph=True)
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    x.stop_gradient = False
+    out = sf(x)
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0], rtol=1e-6)
+    x2 = paddle.to_tensor(np.array([-3.0, 1.0], np.float32))
+    x2.stop_gradient = False
+    out2 = sf(x2)
+    out2.backward()
+    np.testing.assert_allclose(x2.grad.numpy(), [0.5, 0.5], rtol=1e-6)
+    _no_breaks(sf)
+
+
+def test_code_property_shows_converted_source():
+    sf = paddle.jit.to_static(plain_branch_fn, full_graph=True)
+    sf(paddle.to_tensor(np.ones(2, np.float32)))
+    assert "_dy2st_if" in sf.code
+
+
+def test_unliftable_code_still_graph_breaks():
+    """break under a traced condition is genuinely unliftable: the AST
+    pass must leave it alone and the existing fallback must serve it."""
+    import dy2static_models as m
+
+    src = '''
+def with_break(x):
+    total = x.sum() * 0
+    i = 0
+    while i < 10:
+        total = total + 1
+        if i > 2:
+            break
+        i = i + 1
+    return total
+'''
+    path = m.__file__.replace("dy2static_models.py", "_dy2st_break_tmp.py")
+    with open(path, "w") as f:
+        f.write(src)
+    try:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("_dy2st_break_tmp",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        sf = paddle.jit.to_static(mod.with_break, full_graph=False)
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = sf(x)
+        assert float(np.asarray(out.numpy() if hasattr(out, "numpy")
+                                else out)) == 4.0
+    finally:
+        import os
+
+        os.remove(path)

@@ -545,3 +545,395 @@ def test_constrain_override_forces_layout():
             y, NamedSharding(mesh, P(None, "y")))
 
     assert _out_spec(f, a) == (None, "y")
+
+
+# -- round-5 expansion: 42 -> 70+ families (VERDICT r4 next #7) --------------
+# attention backward, fused_rope variants, manipulation-op families
+# (squeeze/unsqueeze/stack/tile/expand_as/unbind/flatten/cast/triu),
+# scatter/gather variants, remaining optimizer states, fused-pass analogs.
+
+
+def _spec_of(arr):
+    t = tuple(arr.sharding.spec) + (None,) * (
+        arr.ndim - len(tuple(arr.sharding.spec)))
+    return tuple(x[0] if isinstance(x, tuple) and len(x) == 1 else x
+                 for x in t)
+
+
+def test_sdpa_backward_batch_head_sharded():
+    """flash_attention.cc backward rule: dq/dk/dv inherit q/k/v's
+    [B_x, S, H_y, D] shardings."""
+    mesh = _mesh()
+    q = _sharded(mesh, (4, 16, 8, 8), P("x", None, "y", None))
+    k = _sharded(mesh, (4, 16, 8, 8), P("x", None, "y", None), seed=1)
+    v = _sharded(mesh, (4, 16, 8, 8), P("x", None, "y", None), seed=2)
+
+    def attn_loss(q, k, v):
+        s = jnp.einsum("bshd,bthd->bhst", q, k) / np.sqrt(8)
+        p = jax.nn.softmax(s, -1)
+        return jnp.einsum("bhst,bthd->bshd", p, v).sum()
+
+    dq, dk, dv = jax.jit(jax.grad(attn_loss, argnums=(0, 1, 2)))(q, k, v)
+    for d in (dq, dk, dv):
+        assert _spec_of(d) == ("x", None, "y", None), _spec_of(d)
+
+
+def test_sdpa_backward_seq_sharded_exact():
+    """flash_attention.cc backward with the sequence dim sharded (the
+    context-parallel layout): grads numerically equal the unsharded run."""
+    mesh = _mesh()
+    rng = np.random.RandomState(0)
+    qf = rng.randn(2, 16, 4, 8).astype(np.float32)
+    kf = rng.randn(2, 16, 4, 8).astype(np.float32)
+    vf = rng.randn(2, 16, 4, 8).astype(np.float32)
+
+    def attn_loss(q, k, v):
+        s = jnp.einsum("bshd,bthd->bhst", q, k) / np.sqrt(8)
+        p = jax.nn.softmax(s, -1)
+        return (jnp.einsum("bhst,bthd->bshd", p, v) ** 2).sum()
+
+    want = jax.grad(attn_loss)(jnp.asarray(qf), jnp.asarray(kf),
+                               jnp.asarray(vf))
+    q = jax.device_put(jnp.asarray(qf),
+                       NamedSharding(mesh, P(None, "y", None, None)))
+    k = jax.device_put(jnp.asarray(kf),
+                       NamedSharding(mesh, P(None, "y", None, None)))
+    v = jax.device_put(jnp.asarray(vf),
+                       NamedSharding(mesh, P(None, "y", None, None)))
+    got = jax.jit(jax.grad(attn_loss))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_rope_backward_sharded():
+    """fused_rope.cc backward: rotary grad keeps [B_x, S, H_y, D]."""
+    mesh = _mesh()
+    x = _sharded(mesh, (4, 16, 8, 8), P("x", None, "y", None))
+
+    def rope_loss(x):
+        B, S, H, D = x.shape
+        pos = jnp.arange(S)[:, None]
+        inv = 1.0 / (10000 ** (jnp.arange(D // 2) / (D // 2)))
+        ang = pos * inv[None, :]
+        cos = jnp.cos(ang)[None, :, None, :]
+        sin = jnp.sin(ang)[None, :, None, :]
+        x1, x2 = x[..., ::2], x[..., 1::2]
+        out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+        return (out ** 2).sum()
+
+    g = jax.jit(jax.grad(rope_loss))(x)
+    assert _spec_of(g) == ("x", None, "y", None)
+
+
+def test_fused_rope_partial_rotary_variant():
+    """fused_rope.cc partial-rotary (rotary_dim < head_dim): concat of
+    rotated and pass-through halves keeps the sharding."""
+    mesh = _mesh()
+    x = _sharded(mesh, (4, 16, 8, 16), P("x", None, "y", None))
+
+    def rope_partial(x):
+        rot, rest = x[..., :8], x[..., 8:]
+        S = x.shape[1]
+        pos = jnp.arange(S)[:, None]
+        inv = 1.0 / (10000 ** (jnp.arange(4) / 4.0))
+        ang = pos * inv[None, :]
+        cos = jnp.cos(ang)[None, :, None, :]
+        sin = jnp.sin(ang)[None, :, None, :]
+        x1, x2 = rot[..., ::2], rot[..., 1::2]
+        r = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                      -1).reshape(rot.shape)
+        return jnp.concatenate([r, rest], -1)
+
+    assert _out_spec(rope_partial, x) == ("x", None, "y", None)
+
+
+def test_squeeze_drops_dim_keeps_sharding():
+    """squeeze.cc: removing a size-1 dim preserves the other dims'
+    mapping."""
+    mesh = _mesh()
+    a = _sharded(mesh, (8, 1, 32), P("x", None, "y"))
+    assert _out_spec(lambda t: jnp.squeeze(t, 1), a) == ("x", "y")
+
+
+def test_unsqueeze_inserts_replicated_dim():
+    """unsqueeze.cc: the new dim is replicated, others pass through."""
+    mesh = _mesh()
+    a = _sharded(mesh, (8, 32), P("x", "y"))
+    assert _out_spec(lambda t: jnp.expand_dims(t, 1), a) == \
+        ("x", None, "y")
+
+
+def test_stack_new_axis_replicated():
+    """stack.cc: stacking adds a replicated axis; the inputs' common
+    sharding propagates."""
+    mesh = _mesh()
+    a = _sharded(mesh, (8, 32), P("x", "y"))
+    b = _sharded(mesh, (8, 32), P("x", "y"), seed=1)
+    assert _out_spec(lambda u, v: jnp.stack([u, v], 0), a, b) == \
+        (None, "x", "y")
+
+
+def test_tile_sharded_dim_exact():
+    """tile.cc: tiling a sharded dim — output is numerically exact
+    (compiler reshards as needed)."""
+    mesh = _mesh()
+    full = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    a = jax.device_put(jnp.asarray(full), NamedSharding(mesh, P("x", None)))
+    out = jax.jit(lambda t: jnp.tile(t, (2, 1)))(a)
+    np.testing.assert_allclose(np.asarray(out), np.tile(full, (2, 1)),
+                               rtol=1e-6)
+
+
+def test_expand_as_broadcasts_to_sharded_target():
+    """expand_as.cc: broadcasting [1, n] to a sharded [x, n] target
+    follows the target's row sharding."""
+    mesh = _mesh()
+    a = _sharded(mesh, (1, 32), P(None, "y"))
+
+    def expand(t):
+        return jnp.broadcast_to(t, (8, 32))
+
+    out = jax.jit(expand)(a)
+    assert _spec_of(out)[1] == "y"
+
+
+def test_unbind_rows_keep_trailing_sharding():
+    """unbind.cc: slicing out a row keeps the remaining dims' mapping."""
+    mesh = _mesh()
+    a = _sharded(mesh, (4, 8, 32), P(None, "x", "y"))
+    outs = jax.jit(lambda t: tuple(t[i] for i in range(4)))(a)
+    for o in outs:
+        assert _spec_of(o) == ("x", "y")
+
+
+def test_flatten_merges_keep_outer_shard():
+    """flatten.cc: merging trailing dims keeps the leading shard."""
+    mesh = _mesh()
+    a = _sharded(mesh, (8, 4, 8), P("x", None, None))
+    assert _out_spec(lambda t: t.reshape(8, 32), a)[0] == "x"
+
+
+def test_cast_preserves_sharding():
+    """cast.cc: dtype cast is layout-neutral."""
+    mesh = _mesh()
+    a = _sharded(mesh, (8, 32), P("x", "y"))
+    assert _out_spec(lambda t: t.astype(jnp.bfloat16), a) == ("x", "y")
+
+
+def test_triu_preserves_sharding():
+    """triu.cc: masking is elementwise over the matrix dims."""
+    mesh = _mesh()
+    a = _sharded(mesh, (32, 32), P("x", "y"))
+    assert _out_spec(lambda t: jnp.triu(t), a) == ("x", "y")
+
+
+def test_full_like_inherits_shape_replicated():
+    """full_like.cc: a constant fill of a sharded operand compiles and
+    is exact (layout free to be anything)."""
+    mesh = _mesh()
+    a = _sharded(mesh, (8, 32), P("x", "y"))
+    out = jax.jit(lambda t: jnp.full_like(t, 3.0))(a)
+    assert np.asarray(out).min() == np.asarray(out).max() == 3.0
+
+
+def test_gather_nd_sharded_params_exact():
+    """gather_nd.cc: nd-gather from a sharded table matches unsharded."""
+    mesh = _mesh()
+    rng = np.random.RandomState(0)
+    table = rng.randn(16, 8, 4).astype(np.float32)
+    idx = rng.randint(0, 16, (6, 1)).astype(np.int32)
+    t = jax.device_put(jnp.asarray(table),
+                       NamedSharding(mesh, P("x", None, None)))
+    got = jax.jit(lambda t, i: t[i[:, 0]])(t, jnp.asarray(idx))
+    np.testing.assert_allclose(np.asarray(got), table[idx[:, 0]],
+                               rtol=1e-6)
+
+
+def test_scatter_overwrite_sharded_exact():
+    """scatter.cc (overwrite mode): .at[].set on a row-sharded operand is
+    exact after compiler resharding."""
+    mesh = _mesh()
+    rng = np.random.RandomState(0)
+    base = rng.randn(16, 8).astype(np.float32)
+    upd = rng.randn(4, 8).astype(np.float32)
+    idx = np.array([1, 5, 9, 13], np.int32)
+    b = jax.device_put(jnp.asarray(base), NamedSharding(mesh, P("x", None)))
+    got = jax.jit(lambda b, u, i: b.at[i].set(u))(
+        b, jnp.asarray(upd), jnp.asarray(idx))
+    want = base.copy()
+    want[idx] = upd
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def test_momentum_state_keeps_param_sharding():
+    """optimizer.cc (momentum): velocity inherits parameter sharding."""
+    mesh = _mesh()
+    p = _sharded(mesh, (16, 32), P(None, "y"))
+    g = _sharded(mesh, (16, 32), P(None, "y"), seed=1)
+    m = _sharded(mesh, (16, 32), P(None, "y"), seed=2)
+
+    def momentum(p, g, m):
+        vel = 0.9 * m + g
+        return p - 1e-2 * vel, vel
+
+    p2, vel = jax.jit(momentum)(p, g, m)
+    assert _spec_of(p2)[-1] == "y" and _spec_of(vel)[-1] == "y"
+
+
+def test_adagrad_state_keeps_param_sharding():
+    """optimizer.cc (adagrad): accumulated squared grad inherits the
+    parameter's sharding."""
+    mesh = _mesh()
+    p = _sharded(mesh, (16, 32), P(None, "y"))
+    g = _sharded(mesh, (16, 32), P(None, "y"), seed=1)
+    acc = jax.device_put(jnp.abs(jnp.asarray(
+        np.random.RandomState(2).randn(16, 32), jnp.float32)),
+        NamedSharding(mesh, P(None, "y")))
+
+    def adagrad(p, g, acc):
+        acc2 = acc + g * g
+        return p - 1e-2 * g / (jnp.sqrt(acc2) + 1e-6), acc2
+
+    p2, acc2 = jax.jit(adagrad)(p, g, acc)
+    assert _spec_of(p2)[-1] == "y" and _spec_of(acc2)[-1] == "y"
+
+
+def test_squared_l2_norm_over_sharded_params_exact():
+    """squared_l2_norm.cc: the grad-clip global norm over a sharded tree
+    reduces to one replicated scalar, numerically exact."""
+    mesh = _mesh()
+    rng = np.random.RandomState(0)
+    a_full = rng.randn(16, 32).astype(np.float32)
+    b_full = rng.randn(8, 8).astype(np.float32)
+    a = jax.device_put(jnp.asarray(a_full), NamedSharding(mesh, P("x", "y")))
+    b = jax.device_put(jnp.asarray(b_full), NamedSharding(mesh, P("x", None)))
+    got = float(jax.jit(lambda u, v: (u ** 2).sum() + (v ** 2).sum())(a, b))
+    want = (a_full ** 2).sum() + (b_full ** 2).sum()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_scale_preserves_sharding():
+    """scale.cc: affine scalar transform is layout-neutral."""
+    mesh = _mesh()
+    a = _sharded(mesh, (8, 32), P("x", "y"))
+    assert _out_spec(lambda t: 2.5 * t + 1.0, a) == ("x", "y")
+
+
+def test_pow_preserves_sharding():
+    """pow.cc."""
+    mesh = _mesh()
+    a = _sharded(mesh, (8, 32), P("x", "y"))
+    assert _out_spec(lambda t: t ** 3, a) == ("x", "y")
+
+
+def test_add_n_aligns_multi_inputs():
+    """add_n.cc: n-ary sum aligns all inputs to one mapping."""
+    mesh = _mesh()
+    a = _sharded(mesh, (8, 32), P("x", "y"))
+    b = _sharded(mesh, (8, 32), P("x", "y"), seed=1)
+    c = _sharded(mesh, (8, 32), P(None, None), seed=2)
+    assert _out_spec(lambda u, v, w: u + v + w, a, b, c) == ("x", "y")
+
+
+def test_swiglu_mp_sharded():
+    """swiglu.cc: gate*up with the hidden dim mp-sharded stays sharded
+    (the llama MLP fused-op layout)."""
+    mesh = _mesh()
+    gate = _sharded(mesh, (8, 64), P("x", "y"))
+    up = _sharded(mesh, (8, 64), P("x", "y"), seed=1)
+
+    def swiglu(g, u):
+        return jax.nn.silu(g) * u
+
+    assert _out_spec(swiglu, gate, up) == ("x", "y")
+
+
+def test_fused_linear_param_grad_add_partial_to_replicated():
+    """fused_linear_param_grad_add.cc: dW = x^T dy with the batch dim
+    dp-sharded — the contraction produces a Partial that the compiler
+    all-reduces; numerically exact."""
+    mesh = _mesh()
+    rng = np.random.RandomState(0)
+    x_full = rng.randn(16, 8).astype(np.float32)
+    dy_full = rng.randn(16, 4).astype(np.float32)
+    wgrad_full = rng.randn(8, 4).astype(np.float32)
+    x = jax.device_put(jnp.asarray(x_full), NamedSharding(mesh, P("x", None)))
+    dy = jax.device_put(jnp.asarray(dy_full),
+                        NamedSharding(mesh, P("x", None)))
+    wg = jax.device_put(jnp.asarray(wgrad_full),
+                        NamedSharding(mesh, P(None, None)))
+    got = jax.jit(lambda x, dy, wg: wg + x.T @ dy)(x, dy, wg)
+    np.testing.assert_allclose(np.asarray(got),
+                               wgrad_full + x_full.T @ dy_full,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_amp_check_finite_over_sharded_grads():
+    """amp_ops.cc (check_finite_and_unscale): isfinite-all over sharded
+    grads reduces to a replicated scalar; exact."""
+    mesh = _mesh()
+    g1 = _sharded(mesh, (16, 32), P("x", "y"))
+    g2 = jax.device_put(
+        jnp.asarray(np.array([[np.inf, 1.0]], np.float32)),
+        NamedSharding(mesh, P(None, None)))
+
+    def finite(a, b):
+        return jnp.isfinite(a).all() & jnp.isfinite(b).all()
+
+    assert not bool(jax.jit(finite)(g1, g2))
+
+
+def test_numel_replicated_scalar():
+    """numel.cc: size of a sharded tensor is a replicated scalar."""
+    mesh = _mesh()
+    a = _sharded(mesh, (8, 32), P("x", "y"))
+    assert int(jax.jit(lambda t: jnp.size(t))(a)) == 256
+
+
+def test_split_along_sharded_axis_exact():
+    """split.cc: splitting THE sharded axis — compiler reshards; each
+    piece numerically exact."""
+    mesh = _mesh()
+    full = np.random.RandomState(0).randn(16, 8).astype(np.float32)
+    a = jax.device_put(jnp.asarray(full), NamedSharding(mesh, P("x", None)))
+    o1, o2 = jax.jit(lambda t: jnp.split(t, 2, 0))(a)
+    np.testing.assert_allclose(np.asarray(o1), full[:8], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(o2), full[8:], rtol=1e-6)
+
+
+def test_default_data_parallel_batch_propagates():
+    """default_data_parallel.cc: an unannotated elementwise chain after a
+    dp-sharded input keeps the batch mapping end-to-end."""
+    mesh = _mesh()
+    a = _sharded(mesh, (8, 32), P("x", None))
+
+    def chain(t):
+        t = jax.nn.relu(t)
+        t = t * 2.0 + 1.0
+        return jnp.tanh(t)
+
+    assert _out_spec(chain, a) == ("x", None)
+
+
+def test_slice_on_sharded_dim_exact():
+    """slice.cc: a strided slice along the sharded dim reshards and
+    matches the unsharded result."""
+    mesh = _mesh()
+    full = np.random.RandomState(0).randn(16, 8).astype(np.float32)
+    a = jax.device_put(jnp.asarray(full), NamedSharding(mesh, P("x", None)))
+    got = jax.jit(lambda t: t[2:14:3])(a)
+    np.testing.assert_allclose(np.asarray(got), full[2:14:3], rtol=1e-6)
+
+
+def test_stack_backward_unstacks_sharding():
+    """stack.cc backward: grads of stacked inputs recover the input
+    mapping."""
+    mesh = _mesh()
+    a = _sharded(mesh, (8, 32), P("x", "y"))
+    b = _sharded(mesh, (8, 32), P("x", "y"), seed=1)
+
+    def loss(u, v):
+        return (jnp.stack([u, v], 0) ** 2).sum()
+
+    da, db = jax.jit(jax.grad(loss, argnums=(0, 1)))(a, b)
+    assert _spec_of(da) == ("x", "y") and _spec_of(db) == ("x", "y")
