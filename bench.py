@@ -93,6 +93,12 @@ def main():
         blocks = (tuple(int(x) for x in blocks.split(","))
                   if blocks else None)
         policy = os.environ.get("PT_BENCH_REMAT", "full")
+        # fused Pallas rms_norm: ~3-4% step-time win at this shape
+        # (PERF.md r5); PT_BENCH_FUSED_RMS=0 reverts to the stock op
+        if os.environ.get("PT_BENCH_FUSED_RMS", "1") == "1":
+            import paddle_tpu
+
+            paddle_tpu.set_flags({"FLAGS_use_fused_rms_norm": True})
         cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
                           intermediate_size=5504, num_hidden_layers=10,
                           num_attention_heads=16, num_key_value_heads=16,

@@ -20,7 +20,8 @@ WHITE_LIST = {
 BLACK_LIST = {
     "exp", "log", "log2", "log10", "log1p", "logsumexp",
     "softmax_with_cross_entropy", "cross_entropy", "reduce_mean",
-    "reduce_sum", "layer_norm", "rms_norm", "group_norm", "batch_norm_stats",
+    "reduce_sum", "layer_norm", "rms_norm", "fused_rms_norm", "group_norm",
+    "batch_norm_stats",
     "batch_norm_infer", "softmax", "log_softmax", "erf", "erfinv",
     "reciprocal", "rsqrt", "pow", "elementwise_pow", "cumsum", "cumprod",
 }

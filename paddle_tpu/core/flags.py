@@ -91,3 +91,6 @@ define_flag("FLAGS_allocator_strategy", "auto_growth",
 define_flag("FLAGS_embedding_deterministic", 0,
             "Deterministic embedding grad accumulation")
 define_flag("FLAGS_cudnn_deterministic", False, "API parity; no-op on TPU")
+define_flag("FLAGS_use_fused_rms_norm", False,
+            "Route nn.functional.rms_norm through the fused Pallas kernel "
+            "(ops/pallas_kernels/rms_norm.py) instead of the stock jnp op")

@@ -89,7 +89,8 @@ class Config:
         self._optim_cache_dir = str(path)
 
     def use_gpu(self):
-        return getattr(self, "_device", (None,))[0] == "accel"
+        d = getattr(self, "_device", None)
+        return bool(d) and d[0] == "accel"
 
     def gpu_device_id(self):
         d = getattr(self, "_device", None)

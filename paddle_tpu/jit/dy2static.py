@@ -85,10 +85,31 @@ def _dy2st_while(cond_fn, body_fn, vals):
         from ..static import nn as static_nn
 
         if any(isinstance(v, _Undef) for v in vals):
-            bad = [v.name for v in vals if isinstance(v, _Undef)]
-            raise UnboundLocalError(
-                f"converted while loop carries unbound variables {bad} "
-                "into a traced lowering")
+            # Vars first bound INSIDE the body (e.g. the for-loop target):
+            # probe the body's output avals to materialize a typed initial
+            # carry (the reference fills UndefinedVar slots the same way).
+            import jax as _jax
+
+            def _unwrap(v):
+                return v._data if isinstance(v, Tensor) else v
+
+            probe = [jnp.zeros((), jnp.int32) if isinstance(v, _Undef)
+                     else _unwrap(v) for v in vals]
+            try:
+                avals = _jax.eval_shape(
+                    lambda *vs: tuple(_unwrap(o) for o in
+                                      body_fn(*[Tensor(jnp.asarray(x))
+                                                for x in vs])), *probe)
+            except Exception as e:
+                bad = [v.name for v in vals if isinstance(v, _Undef)]
+                raise UnboundLocalError(
+                    f"converted while loop carries unbound variables "
+                    f"{bad} into a traced lowering and the body reads "
+                    "them before assigning") from e
+            vals = tuple(
+                Tensor(jnp.zeros(a.shape, a.dtype))
+                if isinstance(v, _Undef) else v
+                for v, a in zip(vals, avals))
         # Loop carries must be arrays with stable dtype: promote python
         # scalars once so `i = 0; while i < n: i += 1` lowers cleanly.
         carry = [v if isinstance(v, Tensor) else Tensor(jnp.asarray(v))
@@ -294,9 +315,12 @@ class ControlFlowTransformer(ast.NodeTransformer):
         if node.orelse or _contains([node], ast.Break, ast.Continue,
                                     ast.Return, ast.Yield, ast.YieldFrom):
             return node
-        carried = self._only_locals(_assigned(node.body)
-                                    | _loaded(node.test))
-        carried = [n for n in carried if not n.startswith("__dy2st")]
+        assigned_in_body = _assigned(node.body)
+        carried = self._only_locals(assigned_in_body | _loaded(node.test))
+        # generated loaded-only temps (range stop/step) stay closed-over;
+        # a generated counter IS loop state and must be carried
+        carried = [n for n in carried
+                   if not n.startswith("__dy2st") or n in assigned_in_body]
         if not carried:
             return node
         cname, bname = self._uid("cond"), self._uid("body")
@@ -331,7 +355,12 @@ class ControlFlowTransformer(ast.NodeTransformer):
         if _contains([node], ast.Break, ast.Continue, ast.Return,
                      ast.Yield, ast.YieldFrom):
             return node
-        # for i in range(a[,b[,c]]): body  ->  i = a0; while i < b0: ...
+        # for i in range(a[,b[,c]]): body  ->  hidden counter k:
+        #   k = a0
+        #   while (b0 - k) * c0 > 0:   # sign-correct for any step
+        #       i = k; body; k += c0
+        # i is assigned INSIDE the body so its post-loop value matches
+        # Python's for semantics (last iterated value, not one past).
         i = node.target.id
         if len(it.args) == 1:
             start, stop, step = ast.Constant(0), it.args[0], ast.Constant(1)
@@ -339,24 +368,30 @@ class ControlFlowTransformer(ast.NodeTransformer):
             start, stop, step = it.args[0], it.args[1], ast.Constant(1)
         else:
             start, stop, step = it.args
-        start_name = self._uid("start")
         stop_name = self._uid("stop")
         step_name = self._uid("step")
+        k = self._uid("iter")
         pre = [
-            ast.Assign(targets=[_name(start_name, ast.Store())],
-                       value=start),
             ast.Assign(targets=[_name(stop_name, ast.Store())], value=stop),
             ast.Assign(targets=[_name(step_name, ast.Store())], value=step),
-            ast.Assign(targets=[_name(i, ast.Store())],
-                       value=_name(start_name)),
+            ast.Assign(targets=[_name(k, ast.Store())], value=start),
         ]
-        test = ast.Compare(left=_name(i), ops=[ast.Lt()],
-                           comparators=[_name(stop_name)])
-        body = list(node.body) + [ast.AugAssign(
-            target=_name(i, ast.Store()), op=ast.Add(),
-            value=_name(step_name))]
+        test = ast.Compare(
+            left=ast.BinOp(
+                left=ast.BinOp(left=_name(stop_name), op=ast.Sub(),
+                               right=_name(k)),
+                op=ast.Mult(), right=_name(step_name)),
+            ops=[ast.Gt()], comparators=[ast.Constant(0)])
+        body = ([ast.Assign(targets=[_name(i, ast.Store())],
+                            value=_name(k))]
+                + list(node.body)
+                + [ast.AugAssign(target=_name(k, ast.Store()),
+                                 op=ast.Add(), value=_name(step_name))])
         while_node = ast.While(test=test, body=body, orelse=[])
         out = pre + [while_node]
+        # the generated counter is loop state: admit it to the local
+        # universe so the while conversion carries it
+        self._locals.add(k)
         # re-run the while conversion on the rewritten loop
         converted = self.visit_While(while_node)
         if isinstance(converted, list):

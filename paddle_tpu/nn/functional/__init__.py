@@ -166,6 +166,12 @@ def _norm_affine_pair(weight, bias):
 
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     if weight is not None:
+        from ...core.flags import flag
+
+        if flag("FLAGS_use_fused_rms_norm"):
+            from ...ops.pallas_kernels.rms_norm import handle
+
+            return handle()(x, weight, epsilon=float(epsilon))
         return registry.apply(nn_ops.rms_norm_op, x, weight,
                               epsilon=float(epsilon))
     return registry.apply(nn_ops.rms_norm_op, x, epsilon=float(epsilon))

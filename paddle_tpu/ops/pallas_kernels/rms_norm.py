@@ -1,0 +1,183 @@
+"""Fused RMSNorm Pallas TPU kernel (self-authored).
+
+Reference analog: ``paddle/phi/kernels/fusion/gpu/rms_norm_kernel.cu``
+(fused residual+rmsnorm family) — the role, not the design.  TPU design:
+one program per row-block with the whole hidden dim resident in VMEM, so
+the normalization is a single HBM round-trip (read x, write out + rstd)
+instead of XLA's usual two-pass reduce + scale.  The backward reuses the
+saved rstd (no re-reduction for the mean-square) and computes dx in one
+fused pass; dw is a plain jnp contraction over the saved tensors (MXU
+work XLA already fuses optimally).
+
+    fwd:  rstd = rsqrt(mean(x^2) + eps);  out = x * rstd * w
+    bwd:  dxhat = dy * w;  xhat = x * rstd
+          dx = rstd * (dxhat - xhat * mean(dxhat * xhat, -1))
+          dw = sum_rows(dy * xhat)
+
+Registered through the public custom-op API (utils/cpp_extension.py
+``register_custom_op``) with this VJP and an SPMD rule (batch dims
+propagate, hidden dim must be replicated), gated into
+``nn.functional.rms_norm`` by ``FLAGS_use_fused_rms_norm``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_BLOCK_ROWS = 256
+
+
+def _fwd_kernel(x_ref, w_ref, o_ref, rstd_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)          # [rows, 1]
+    o_ref[...] = (x * rstd * w_ref[...].astype(jnp.float32)).astype(
+        o_ref.dtype)
+    rstd_ref[...] = rstd.astype(jnp.float32)
+
+
+def _bwd_kernel(x_ref, w_ref, rstd_ref, dy_ref, dx_ref):
+    x = x_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    rstd = rstd_ref[...]                     # [rows, 1]
+    dxhat = dy * w
+    xhat = x * rstd
+    m = jnp.mean(dxhat * xhat, axis=-1, keepdims=True)
+    dx_ref[...] = (rstd * (dxhat - xhat * m)).astype(dx_ref.dtype)
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _pad_rows(n):
+    return -n % _BLOCK_ROWS
+
+
+@functools.partial(jax.jit, static_argnames=("eps",))
+def _fused_fwd_2d(x2, w, eps):
+    n, h = x2.shape
+    pad = _pad_rows(n)
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    rows = x2.shape[0]
+    grid = (rows // _BLOCK_ROWS,)
+    # Mosaic rejects i64 grid/index constants from global x64 mode.
+    with jax.enable_x64(False):
+        out, rstd = pl.pallas_call(
+            functools.partial(_fwd_kernel, eps=eps),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+                pl.BlockSpec((1, h), lambda i: (0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+                pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((rows, h), x2.dtype),
+                jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(x2, w.reshape(1, h))
+    return out[:n], rstd[:n, 0]
+
+
+@jax.jit
+def _fused_bwd_2d(x2, w, rstd, dy2):
+    n, h = x2.shape
+    pad = _pad_rows(n)
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+        dy2 = jnp.pad(dy2, ((0, pad), (0, 0)))
+        rstd = jnp.pad(rstd, (0, pad), constant_values=1.0)
+    rows = x2.shape[0]
+    grid = (rows // _BLOCK_ROWS,)
+    with jax.enable_x64(False):
+        dx = pl.pallas_call(
+            _bwd_kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+                pl.BlockSpec((1, h), lambda i: (0, 0)),
+                pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
+                pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows, h), x2.dtype),
+            interpret=_interpret(),
+        )(x2, w.reshape(1, h), rstd.reshape(-1, 1), dy2)
+    return dx[:n]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _fused(x, w, epsilon):
+    h = x.shape[-1]
+    out, _rstd = _fused_fwd_2d(x.reshape(-1, h), w, float(epsilon))
+    return out.reshape(x.shape)
+
+
+def _fused_f(x, w, epsilon):
+    h = x.shape[-1]
+    out, rstd = _fused_fwd_2d(x.reshape(-1, h), w, float(epsilon))
+    return out.reshape(x.shape), (x, w, rstd)
+
+
+def _fused_b(epsilon, saved, dy):
+    x, w, rstd = saved
+    h = x.shape[-1]
+    x2 = x.reshape(-1, h)
+    dy2 = dy.reshape(-1, h)
+    dx = _fused_bwd_2d(x2, w, rstd, dy2).reshape(x.shape)
+    xhat = x2.astype(jnp.float32) * rstd[:, None]
+    dw = jnp.sum(dy2.astype(jnp.float32) * xhat, axis=0).astype(w.dtype)
+    return dx, dw
+
+
+_fused.defvjp(_fused_f, _fused_b)
+
+
+def fused_rms_norm_fn(x, w, *, epsilon=1e-6):
+    """Forward over jnp arrays (custom-op ``fn``) — differentiable under
+    pure jax AD (custom_vjp) so the compiled train step's value_and_grad
+    and remat both route through the hand-written backward."""
+    return _fused(x, w, float(epsilon))
+
+
+def fused_rms_norm_fwd(x, w, *, epsilon=1e-6):
+    """custom-op ``fwd`` (eager tape): returns (out, saved)."""
+    return _fused_f(x, w, float(epsilon))
+
+
+def fused_rms_norm_vjp(saved, dy, *, epsilon=1e-6):
+    """custom-op ``vjp`` (eager tape): (dx, dw)."""
+    return _fused_b(float(epsilon), saved, dy)
+
+
+def fused_rms_norm_spmd_rule(mesh, x_spec, w_spec):
+    """SPMD rule: every batch dim of x propagates; the hidden (last) dim
+    must be replicated (one row's full reduction lives in one kernel
+    program); the weight is replicated."""
+    spec = tuple(x_spec)[:-1] + (None,)
+    return spec
+
+
+_HANDLE = None
+
+
+def handle():
+    """The registered custom-op handle (lazy: registration is global)."""
+    global _HANDLE
+    if _HANDLE is None:
+        from ...utils.cpp_extension import register_custom_op
+
+        _HANDLE = register_custom_op(
+            "fused_rms_norm", fused_rms_norm_fn, vjp=fused_rms_norm_vjp,
+            fwd=fused_rms_norm_fwd, static_argnames=("epsilon",),
+            spmd_rule=fused_rms_norm_spmd_rule)
+    return _HANDLE

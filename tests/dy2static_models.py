@@ -60,3 +60,19 @@ def plain_branch_fn(x):
     else:
         y = x / 2.0
     return y.sum()
+
+
+def reversed_range_fn(n):
+    s = 0
+    last = -1
+    for i in range(n, 0, -1):
+        s = s + i
+        last = i
+    return s, i, last
+
+
+def loop_var_post_value(x):
+    s = x * 0
+    for i in range(3):
+        s = s + x
+    return s, i

@@ -153,3 +153,60 @@ def with_break(x):
         import os
 
         os.remove(path)
+
+
+def test_for_range_negative_step_and_loop_var_semantics():
+    """review r5: reversed ranges must iterate, and the loop variable's
+    post-loop value must match Python's (last iterated, not one past)."""
+    from dy2static_models import loop_var_post_value, reversed_range_fn
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    g, n = convert_to_static(reversed_range_fn)
+    assert n > 0
+    assert g(3) == reversed_range_fn(3) == (6, 1, 1)
+
+    g2, n2 = convert_to_static(loop_var_post_value)
+    assert n2 > 0
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    s_ref, i_ref = loop_var_post_value(x)
+    s_got, i_got = g2(x)
+    assert int(np.asarray(i_got)) == i_ref == 2
+    np.testing.assert_allclose(np.asarray(s_got.numpy()
+                                          if hasattr(s_got, "numpy")
+                                          else s_got), s_ref.numpy())
+
+    # traced: loop bound is a tensor, step negative, body-defined target
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+
+    def pure(nd):
+        out = g(Tensor(nd))
+        return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+
+    got = [int(np.asarray(r)) for r in jax.jit(pure)(jnp.asarray(3))]
+    assert got == [6, 1, 1]
+
+
+def test_fused_rms_norm_amp_dtype_parity():
+    """review r5: the fused kernel must obey the same AMP black-list
+    promotion as the stock op."""
+    import paddle_tpu.nn.functional as F
+
+    with paddle.amp.auto_cast(level="O1"):
+        x = paddle.to_tensor(np.ones((2, 128), np.float32))
+        w = paddle.to_tensor(np.ones(128, np.float32))
+        stock = F.rms_norm(x, w)
+        paddle.set_flags({"FLAGS_use_fused_rms_norm": True})
+        try:
+            fused = F.rms_norm(x, w)
+        finally:
+            paddle.set_flags({"FLAGS_use_fused_rms_norm": False})
+    assert str(stock.dtype) == str(fused.dtype)
+
+
+def test_inference_config_use_gpu_fresh():
+    from paddle_tpu.inference import Config
+
+    assert Config().use_gpu() is False
