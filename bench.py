@@ -396,8 +396,34 @@ def _bench_resnet(jax):
     mfu = imgs_s * 3 * 4.1e9 / _peak_flops_per_chip()
     print(f"resnet50: step {dt * 1e3:.1f} ms, {imgs_s:.0f} imgs/s, "
           f"~MFU {mfu:.3f}", file=sys.stderr)
-    return {"value": round(imgs_s, 1), "unit": "imgs/s/chip",
-            "batch": batch, "mfu_est": round(mfu, 4)}
+    out = {"value": round(imgs_s, 1), "unit": "imgs/s/chip",
+           "batch": batch, "mfu_est": round(mfu, 4)}
+    # Roofline attribution (VERDICT r4 #4): XLA's own cost analysis of
+    # the compiled step — bytes accessed per step vs HBM peak names the
+    # limiting resource in the artifact itself.
+    try:
+        lowered = step._step.lower(
+            step.params, step._master, step._m, step._v,
+            jnp.asarray(1.0, jnp.float32), 0.1, imgs,
+            jnp.asarray(labels))
+        ca = lowered.compile().cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        bytes_step = float(ca.get("bytes accessed", 0.0))
+        hbm_peak = 819e9  # v5e
+        out["roofline"] = {
+            "xla_bytes_accessed_gb": round(bytes_step / 1e9, 2),
+            "achieved_hbm_gb_s": round(bytes_step / dt / 1e9, 1),
+            "hbm_peak_gb_s": hbm_peak / 1e9,
+            "hbm_utilization": round(bytes_step / dt / hbm_peak, 3),
+        }
+        print(f"resnet50 roofline: {bytes_step / 1e9:.1f} GB/step, "
+              f"{bytes_step / dt / 1e9:.0f} GB/s achieved "
+              f"({bytes_step / dt / hbm_peak:.0%} of HBM peak)",
+              file=sys.stderr)
+    except Exception as e:
+        out["roofline"] = {"error": str(e)[:120]}
+    return out
 
 
 
@@ -538,29 +564,32 @@ def _bench_serving(jax):
     print("serving: prefill + compiling decode...", file=sys.stderr)
     for _ in range(max_seqs):
         eng.add_request(rng.randint(0, cfg.vocab_size, (128,)))
-    eng.step()  # compile the decode program
-    # engine.step() ends in a host transfer of the sampled tokens, so
-    # wall time is honest; difference two loop lengths to cancel the
-    # per-step fetch.
-    k = 16
+    # decode_n keeps the greedy feedback on device: one dispatch per k
+    # tokens (serving.py _decode_n_fwd) — the measured quantity is the
+    # decode THROUGHPUT, not the tunnel's per-dispatch latency.
+    k = 32
+    eng.decode_n(k)  # compile + settle
+    # decode_n ends in a host transfer of all k tokens, so each call's
+    # wall time is honest serving cost (dispatch + decode + fetch);
+    # average over several calls.
+    calls = 4
     t0 = time.perf_counter()
-    for _ in range(k):
-        eng.step()
-    t_k = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    for _ in range(3 * k):
-        eng.step()
-    t_3k = time.perf_counter() - t0
-    dt = max(t_3k - t_k, 1e-9) / (2 * k)
-    reason = _implausible(dt)
+    for _ in range(calls):
+        eng.decode_n(k)
+    wall = time.perf_counter() - t0
+    # plausibility at DISPATCH granularity (the 1 ms floor is calibrated
+    # for wall-clock dispatches, not derived per-token quantities)
+    reason = _implausible(wall / calls)
     if reason is not None:
         raise RuntimeError(f"implausible measurement: {reason}")
+    dt = wall / (calls * k)  # per token-step, fetch amortized k ways
     tok_s = max_seqs / dt
-    print(f"serving: decode step {dt * 1e3:.2f} ms, {tok_s:.0f} tok/s "
-          f"(batch {max_seqs})", file=sys.stderr)
+    print(f"serving: decode {dt * 1e3:.2f} ms/token-step, {tok_s:.0f} "
+          f"tok/s (batch {max_seqs}, {k}-token dispatches)",
+          file=sys.stderr)
     return {"value": round(tok_s, 1), "unit": "decode_tokens/s/chip",
             "batch": max_seqs, "prompt": 128, "page_size": 16,
-            "model_params": n_params}
+            "dispatch_tokens": k, "model_params": n_params}
 
 
 def _bench_large(jax):

@@ -113,17 +113,58 @@ class AutoTuner:
         head = c.micro_batch * self.seq_len * self.vocab * 2 / c.mp
         return weights + opt + act + head
 
+    # hardware constants for the physical cost model (v5e-class chip;
+    # override per target).  peak_flops: bf16 MXU peak per chip; ici_bw:
+    # per-link ICI bandwidth the collectives ride.
+    peak_flops: float = 394e12
+    ici_bw: float = 4.5e10
+    global_batch: int = 8
+
     def estimate_cost(self, c: TunerConfig):
-        """Relative step-time estimate (lower = better): compute spread
-        over the mesh + mp collective tax + pp bubble + small-batch
-        inefficiency."""
-        compute = 1.0 / self.world_size
-        mp_tax = 0.07 * math.log2(c.mp) if c.mp > 1 else 0.0
-        num_micro = max(1, 8 // c.micro_batch)
+        """Per-step time estimate in seconds (reference cost_model.py
+        role, TPU roofline form): MXU compute time + mp activation
+        allreduces + dp/sharding gradient sync over ICI, all divided by
+        pipeline utilization.  Relative ranking is what matters — the
+        constants place collectives and bubbles on a common axis."""
+        # model FLOPs: 6*params per token (fwd+bwd) + attention term
+        flops_tok = 6.0 * self.model_params \
+            + 12.0 * self.layers * self.hidden * self.seq_len
+        tokens_step = self.global_batch * self.seq_len
+        compute = flops_tok * tokens_step / self.world_size \
+            / self.peak_flops
+        # full remat (the bench recipe) recomputes the forward: ~1/3 more
+        compute *= 4.0 / 3.0
+        # mp: 4 activation allreduces per layer (attn out, mlp out,
+        # fwd+bwd), ring cost 2(mp-1)/mp of the bytes, bf16 activations;
+        # ~60% sits on the critical path (XLA overlaps the rest into the
+        # adjacent matmuls)
+        mp_comm = 0.0
+        if c.mp > 1:
+            act_bytes = (tokens_step / max(1, c.dp * c.sharding)
+                         * self.hidden * 2)
+            # each pipeline rank allreduces only its layers/pp layers
+            # (stages run concurrently; the bubble term covers the rest)
+            mp_comm = 0.6 * (4 * (self.layers / c.pp) * act_bytes
+                             * 2 * (c.mp - 1) / c.mp) / self.ici_bw
+        # dp/sharding: one grad reduce-scatter+allgather of this shard's
+        # params per step; largely overlapped with the backward (charge
+        # the ~30% exposed tail)
+        sync = 0.0
+        ways = c.dp * c.sharding
+        if ways > 1:
+            grad_bytes = 2.0 * self.model_params / (c.mp * c.pp)
+            sync = 0.3 * grad_bytes * 2 * (ways - 1) / ways / self.ici_bw
+        # pp: 1F1B bubble (pp-1)/(m+pp-1) with m micro-batches per rank
+        num_micro = max(1, self.global_batch
+                        // max(1, c.dp * c.sharding) // c.micro_batch)
         bubble = (c.pp - 1) / (num_micro + c.pp - 1) if c.pp > 1 else 0.0
-        small_batch = 0.05 / c.micro_batch
-        return compute * (1 + mp_tax + small_batch) / (1 - bubble) \
-            if bubble < 1 else float("inf")
+        if bubble >= 1:
+            return float("inf")
+        # tiny per-chip matmuls lose MXU efficiency: mild penalty when
+        # the local micro-batch rows fall under the 8x128 tile grain
+        local_rows = c.micro_batch * self.seq_len
+        grain = 1.0 + max(0.0, 0.1 * (512 / max(local_rows, 1) - 1))
+        return (compute * grain + mp_comm + sync) / (1 - bubble)
 
     # -- trial loop (reference tuner.py) -----------------------------------
     def tune(self, trial_fn=None, max_trials=8):

@@ -42,12 +42,25 @@ class PagedLlamaEngine:
         self.config = cfg
         state = {k: v._data for k, v in model.state_dict().items()}
         self.layers = _stack_layer_params(state, cfg.num_hidden_layers)
-        self.embed = jnp.asarray(state["llama.embed_tokens.weight"])
-        self.norm_w = jnp.asarray(state["llama.norm.weight"])
-        self.head_w = (self.embed.T if cfg.tie_word_embeddings
-                       else jnp.asarray(state["lm_head.weight"]))
+        embed = jnp.asarray(state["llama.embed_tokens.weight"])
         cos, sin = _rope_tables(cfg)
-        self.cos, self.sin = jnp.asarray(cos), jnp.asarray(sin)
+        # non-layer weights travel as jit ARGUMENTS: closed-over arrays
+        # are baked into the HLO as literals, and multi-MB constants
+        # (embed/head at vocab 32k) choke the remote AOT compiler — the
+        # r5 root cause of the serving prefill "hang"
+        # tied embeddings: alias the SAME buffer and transpose in-graph
+        # (embed.T here would materialize a duplicate vocab x hidden
+        # array in HBM); _head() applies the orientation.
+        self._tied = bool(cfg.tie_word_embeddings)
+        self.tops = {
+            "embed": embed,
+            "norm_w": jnp.asarray(state["llama.norm.weight"]),
+            "head_w": (embed if self._tied
+                       else jnp.asarray(state["lm_head.weight"])),
+            "cos": jnp.asarray(cos),
+            "sin": jnp.asarray(sin),
+        }
+
         pages_per_seq = -(-max_len // page_size)
         self.cache = PagedKVCache(
             n_layers=cfg.num_hidden_layers,
@@ -59,11 +72,15 @@ class PagedLlamaEngine:
         # donate the pools: step() immediately replaces them with the
         # outputs, so XLA updates in place instead of copying GBs of KV
         self._jit_decode = jax.jit(self._decode_fwd,
-                                   donate_argnums=(3, 4))
+                                   donate_argnums=(4, 5))
+
+    def _head(self, x, tops):
+        w = tops["head_w"]
+        return x @ (w.T if self._tied else w)
 
     # -- pure forwards --------------------------------------------------
 
-    def _prefill_fwd(self, layers, ids):
+    def _prefill_fwd(self, layers, tops, ids):
         """[1, S] prompt -> (last-token logits [V], k [L,KV,S,D],
         v [L,KV,S,D]) — plain causal attention, KV returned for the
         page writer."""
@@ -71,7 +88,7 @@ class PagedLlamaEngine:
         nh, nkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
                       cfg.head_dim)
         B, S = ids.shape
-        x = self.embed[ids]
+        x = tops["embed"][ids]
         pos = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
         scale = 1.0 / np.sqrt(d)
 
@@ -81,21 +98,26 @@ class PagedLlamaEngine:
             q = (h @ lp["self_attn.q_proj.weight"]).reshape(B, S, nh, d)
             k = (h @ lp["self_attn.k_proj.weight"]).reshape(B, S, nkv, d)
             v = (h @ lp["self_attn.v_proj.weight"]).reshape(B, S, nkv, d)
-            q, k = _rope_plain(q, k, self.cos, self.sin,
+            q, k = _rope_plain(q, k, tops["cos"], tops["sin"],
                                position_ids=pos)
             g = nh // nkv
-            qt = jnp.swapaxes(q, 1, 2).reshape(B, nkv, g, S, d)
-            kt = jnp.swapaxes(k, 1, 2)
+            qt = jnp.swapaxes(q, 1, 2)              # [B, nh, S, d]
+            kt = jnp.swapaxes(k, 1, 2)              # [B, nkv, S, d]
             vt = jnp.swapaxes(v, 1, 2)
-            logits = jnp.einsum("bngqd,bnkd->bngqk", qt, kt) * scale
+            if g > 1:                               # GQA: expand KV heads
+                kt = jnp.repeat(kt, g, axis=1)
+                vt = jnp.repeat(vt, g, axis=1)
+            # standard 4-D attention: the 5-D grouped einsum + rank-5
+            # masked-broadcast variant compiled pathologically slowly on
+            # the TPU AOT path (95s+ for 2 layers; minutes at vocab 32k)
+            logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
             causal = jnp.tril(jnp.ones((S, S), bool))
-            logits = jnp.where(causal[None, None, None], logits,
+            logits = jnp.where(causal[None, None], logits,
                                jnp.finfo(logits.dtype).min)
             p = jax.nn.softmax(logits.astype(jnp.float32), -1) \
                 .astype(x.dtype)
-            o = jnp.einsum("bngqk,bnkd->bngqd", p, vt)
-            o = jnp.swapaxes(o.reshape(B, nh, S, d), 1, 2) \
-                .reshape(B, S, nh * d)
+            o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+            o = jnp.swapaxes(o, 1, 2).reshape(B, S, nh * d)
             x = x + o @ lp["self_attn.o_proj.weight"]
             h2 = _rms_norm_plain(x, lp["post_attention_layernorm.weight"],
                                  epsilon=cfg.rms_norm_eps)
@@ -104,11 +126,11 @@ class PagedLlamaEngine:
             x = x + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
             return x, (jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2))
 
-        x, (ks, vs) = jax.lax.scan(block, x, self.layers)
-        x = _rms_norm_plain(x, self.norm_w, epsilon=cfg.rms_norm_eps)
-        return (x[:, -1] @ self.head_w)[0], ks[:, 0], vs[:, 0]
+        x, (ks, vs) = jax.lax.scan(block, x, layers)
+        x = _rms_norm_plain(x, tops["norm_w"], epsilon=cfg.rms_norm_eps)
+        return self._head(x[:, -1], tops)[0], ks[:, 0], vs[:, 0]
 
-    def _decode_fwd(self, layers, ids, positions, k_pages, v_pages,
+    def _decode_fwd(self, layers, tops, ids, positions, k_pages, v_pages,
                     lengths, page_tables):
         """One token per active sequence: ids [B], positions [B] (the
         token's position).  Each layer writes the new token's KV into
@@ -120,7 +142,7 @@ class PagedLlamaEngine:
                       cfg.head_dim)
         ps = self.cache.page_size
         B = ids.shape[0]
-        x = self.embed[ids][:, None]              # [B, 1, h]
+        x = tops["embed"][ids][:, None]           # [B, 1, h]
         pos = positions[:, None]
         pids = page_tables[jnp.arange(B), positions // ps]  # [B]
         offs = positions % ps
@@ -132,7 +154,7 @@ class PagedLlamaEngine:
             q = (h @ lp["self_attn.q_proj.weight"]).reshape(B, 1, nh, d)
             k = (h @ lp["self_attn.k_proj.weight"]).reshape(B, 1, nkv, d)
             v = (h @ lp["self_attn.v_proj.weight"]).reshape(B, 1, nkv, d)
-            q, k = _rope_plain(q, k, self.cos, self.sin,
+            q, k = _rope_plain(q, k, tops["cos"], tops["sin"],
                                position_ids=pos)
             kh = jnp.swapaxes(k, 1, 2)[:, :, 0]   # [B, nkv, d]
             vh = jnp.swapaxes(v, 1, 2)[:, :, 0]
@@ -153,9 +175,30 @@ class PagedLlamaEngine:
             return x, (kp, vp)
 
         x, (kps, vps) = jax.lax.scan(
-            block, x, (self.layers, k_pages, v_pages))
-        x = _rms_norm_plain(x, self.norm_w, epsilon=cfg.rms_norm_eps)
-        return (x[:, 0] @ self.head_w), kps, vps
+            block, x, (layers, k_pages, v_pages))
+        x = _rms_norm_plain(x, tops["norm_w"], epsilon=cfg.rms_norm_eps)
+        return self._head(x[:, 0], tops), kps, vps
+
+    def _decode_n_fwd(self, layers, tops, ids, positions, k_pages,
+                      v_pages, lengths, page_tables, n):
+        """``n`` greedy steps in ONE dispatched program: the argmax
+        feedback stays on device (greedy needs no host), so the
+        per-token tunnel/dispatch cost is amortized n ways — the decode
+        analog of CompiledTrainStep.multi_step."""
+
+        def body(carry, _):
+            ids, positions, kp, vp, lengths = carry
+            logits, kp, vp = self._decode_fwd(
+                layers, tops, ids, positions, kp, vp, lengths,
+                page_tables)
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            return (nxt, positions + 1, kp, vp, lengths + 1), nxt
+
+        carry, toks = jax.lax.scan(
+            body, (ids, positions, k_pages, v_pages, lengths), None,
+            length=n)
+        _ids, _pos, kp, vp, _len = carry
+        return toks, kp, vp
 
     # -- control plane --------------------------------------------------
 
@@ -164,7 +207,7 @@ class PagedLlamaEngine:
         sid = self.cache.allocate()
         try:
             ids = jnp.asarray(np.asarray(prompt_ids)[None], jnp.int32)
-            logits, k, v = self._jit_prefill(self.layers, ids)
+            logits, k, v = self._jit_prefill(self.layers, self.tops, ids)
             self.cache.prefill(sid, k, v)
         except BaseException:
             self.cache.free(sid)  # don't strand the slot on failure
@@ -191,7 +234,7 @@ class PagedLlamaEngine:
         tables = jnp.asarray(np.maximum(self.cache.page_table[seqs], 0))
         lengths = jnp.asarray(self.cache.lengths[seqs])
         logits, kps, vps = self._jit_decode(
-            self.layers, ids, positions, self.cache.k_pages,
+            self.layers, self.tops, ids, positions, self.cache.k_pages,
             self.cache.v_pages, lengths, tables)
         self.cache.k_pages = kps
         self.cache.v_pages = vps
@@ -204,4 +247,37 @@ class PagedLlamaEngine:
             tok = int(toks[i])
             self._last_token[s] = tok
             out[s] = tok
+        return out
+
+    def decode_n(self, n):
+        """``n`` greedy tokens per active sequence in one dispatch.
+        Returns {sid: [tok_1..tok_n]}.  Pages for all n tokens are
+        reserved up front (batch-atomic), so the in-graph page writes
+        can never overflow a sequence's table."""
+        seqs = sorted(self._last_token)
+        if not seqs:
+            return {}
+        self.cache.reserve(seqs, extra_tokens=n)
+        ids = jnp.asarray([self._last_token[s] for s in seqs], jnp.int32)
+        positions = jnp.asarray([int(self.cache.lengths[s])
+                                 for s in seqs], jnp.int32)
+        tables = jnp.asarray(np.maximum(self.cache.page_table[seqs], 0))
+        lengths = jnp.asarray(self.cache.lengths[seqs])
+        jitted = getattr(self, "_jit_decode_n", None)
+        if jitted is None:
+            jitted = jax.jit(self._decode_n_fwd,
+                             static_argnames=("n",),
+                             donate_argnums=(4, 5))
+            self._jit_decode_n = jitted
+        toks, kps, vps = jitted(self.layers, self.tops, ids, positions,
+                                self.cache.k_pages, self.cache.v_pages,
+                                lengths, tables, n=int(n))
+        self.cache.k_pages = kps
+        self.cache.v_pages = vps
+        toks = np.asarray(toks)                     # [n, B]
+        out = {}
+        for i, s in enumerate(seqs):
+            self.cache.lengths[s] += n
+            self._last_token[s] = int(toks[-1, i])
+            out[s] = toks[:, i].tolist()
         return out

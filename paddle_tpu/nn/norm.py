@@ -61,8 +61,8 @@ class _BatchNormBase(Layer):
         self._num_features = num_features
         self._momentum = momentum
         self._epsilon = epsilon
-        self._data_format = "NCHW" if data_format in ("NCHW", "NCL", "NC") \
-            else "NHWC"
+        self._data_format = "NCHW" \
+            if data_format in ("NCHW", "NCL", "NC", "NCDHW") else "NHWC"
         self._use_global_stats = use_global_stats
         self.weight = self.create_parameter(
             shape=[num_features], attr=weight_attr,

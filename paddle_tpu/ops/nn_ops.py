@@ -312,10 +312,11 @@ batch_norm_stats_op = register_op(
 
 
 def _bn_axes_shape(ndim, data_format):
-    if data_format == "NCHW" and ndim == 4:
-        return (0, 2, 3), (1, -1, 1, 1)
     if ndim == 2:
         return (0,), (1, -1)
+    if data_format in ("NCHW", "NCL", "NCDHW"):  # channel-first, any rank
+        return (0,) + tuple(range(2, ndim)), \
+            (1, -1) + (1,) * (ndim - 2)
     return tuple(range(ndim - 1)), (1,) * (ndim - 1) + (-1,)
 
 

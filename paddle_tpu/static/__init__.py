@@ -35,3 +35,15 @@ load_inference_model = _subsumed("load_inference_model",
                                  "paddle_tpu.jit.load")
 
 from . import nn  # noqa: E402,F401  (compiled control flow, r4)
+from .compat import (  # noqa: E402,F401
+    BuildStrategy, CompiledProgram, ExponentialMovingAverage,
+    IpuCompiledProgram, IpuStrategy, Print, Variable, WeightNormParamAttr,
+    accuracy, append_backward, auc, cpu_places, create_global_var,
+    create_parameter, ctr_metric_bundle, cuda_places,
+    default_main_program, default_startup_program,
+    deserialize_persistables, deserialize_program, device_guard,
+    global_scope, gradients, ipu_shard_guard, load, load_from_file,
+    load_program_state, name_scope, normalize_program, py_func, save,
+    save_to_file, scope_guard, serialize_persistables, serialize_program,
+    set_ipu_shard, set_program_state, xpu_places,
+)

@@ -1,0 +1,408 @@
+"""paddle.static eager-compatible surface.
+
+Reference: ``python/paddle/static/__init__.py`` — most of these APIs also
+work in the reference's dynamic mode, so they get real eager
+implementations here: Variable IS the Tensor, the "program" is the traced
+jit artifact, save/load move state dicts, gradients rides the autograd
+engine.  Program-proto serialization (serialize_program/
+deserialize_program) maps to the StableHLO payloads jit.save writes.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+Variable = Tensor  # static Variable == eager Tensor on this runtime
+
+
+class _ProgramShim:
+    """default_main_program/default_startup_program handle: in an eager
+    runtime the 'program' is the process's parameter universe; this shim
+    carries the bits tooling touches (random_seed, state capture)."""
+
+    def __init__(self, kind):
+        self._kind = kind
+        self.random_seed = 0
+
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        return []
+
+    def state_dict(self, *a, **k):
+        return {}
+
+    def __repr__(self):
+        return f"<{self._kind} program (eager runtime)>"
+
+
+_MAIN = _ProgramShim("main")
+_STARTUP = _ProgramShim("startup")
+
+
+def default_main_program():
+    return _MAIN
+
+
+def default_startup_program():
+    return _STARTUP
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """static.create_parameter — a trainable Tensor."""
+    from ..nn.initializer import Constant, XavierUniform
+    from ..nn.layers import Layer
+
+    holder = Layer()
+    init = default_initializer or (Constant(0.0) if is_bias
+                                   else XavierUniform())
+    p = holder.create_parameter(shape=list(shape), attr=attr,
+                                dtype=dtype, is_bias=is_bias,
+                                default_initializer=init)
+    if name:
+        p.name = name
+    return p
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import jax.numpy as jnp
+
+    from ..core.dtype import convert_dtype
+
+    t = Tensor(jnp.full(tuple(shape), value, convert_dtype(dtype)))
+    t.persistable = persistable
+    if name:
+        t.name = name
+    return t
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """static.gradients — d(targets)/d(inputs) via the autograd engine."""
+    from ..autograd import grad as _grad
+
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    outs = _grad(targets, inputs, grad_outputs=target_gradients,
+                 allow_unused=True, retain_graph=True)
+    return list(outs)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """static.append_backward — eager analog: run backward, return
+    (param, grad) pairs."""
+    loss.backward(retain_graph=True)
+    params = parameter_list or []
+    return [(p, p.grad) for p in params if getattr(p, "grad", None)
+            is not None]
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """static.accuracy — top-k accuracy over logits."""
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """static.auc — returns (auc_value, batch_auc, state) like the
+    reference's triple; state is opaque here."""
+    from ..metric import Auc
+
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(np.asarray(input.numpy() if hasattr(input, "numpy")
+                        else input),
+             np.asarray(label.numpy() if hasattr(label, "numpy")
+                        else label))
+    import jax.numpy as jnp
+
+    v = Tensor(jnp.asarray(m.accumulate(), jnp.float32))
+    return v, v, None
+
+
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+
+    import jax
+
+    n = device_count or max(1, len(jax.devices("cpu")))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (TPU chips stand in for CUDA devices)."""
+    from ..core.place import CUDAPlace
+
+    import jax
+
+    ids = device_ids if device_ids is not None \
+        else range(len(jax.devices()))
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """static.device_guard — pin ops to a device within the block."""
+    import jax
+
+    if device is None or str(device).startswith(("gpu", "tpu", "xpu")):
+        dev = jax.devices()[0]
+    else:
+        dev = jax.devices("cpu")[0]
+    with jax.default_device(dev):
+        yield
+
+
+class _Scope:
+    def find_var(self, name):
+        return None
+
+    def var(self, name):
+        return None
+
+
+_GLOBAL_SCOPE = _Scope()
+
+
+def global_scope():
+    return _GLOBAL_SCOPE
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    yield
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """static.nn Print op — eager: print and pass through (under jit it
+    uses debug callback)."""
+    import jax
+
+    def _cb(x):
+        head = f"{message or ''} " if message else ""
+        print(f"{head}shape={list(np.shape(x))} "
+              f"values={np.ravel(x)[:summarize]}")
+
+    d = input._data if isinstance(input, Tensor) else input
+    jax.debug.callback(_cb, d)
+    return input
+
+
+class WeightNormParamAttr:
+    """static.WeightNormParamAttr — carried to weight_norm wrapping."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+
+
+class BuildStrategy:
+    """Config bag (XLA owns the actual pass pipeline)."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.build_cuda_graph = False
+
+
+class CompiledProgram:
+    """static.CompiledProgram(program) — the jit-compiled callable is the
+    compiled program; accepts a Layer or a StaticFunction."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __call__(self, *args, **kwargs):
+        from ..jit import to_static
+        from ..nn.layers import Layer
+
+        p = self._program
+        if isinstance(p, Layer) and not hasattr(p.forward, "_cache"):
+            p = to_static(p)
+            self._program = p
+        return p(*args, **kwargs)
+
+
+class ExponentialMovingAverage:
+    """static.ExponentialMovingAverage — EMA shadow of every trainable
+    parameter; apply()/restore() swap the shadow in and out."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._shadow = {}
+        self._backup = {}
+        self._params = []
+
+    def _tracked(self):
+        if self._params:
+            return self._params
+        raise RuntimeError(
+            "EMA has no parameters: call ema.register(layer) (eager "
+            "analog of building the EMA ops into the program)")
+
+    def register(self, layer):
+        self._params = [p for _n, p in layer.named_parameters()
+                        if p.trainable]
+        for p in self._params:
+            self._shadow[id(p)] = np.asarray(p.numpy())
+        return self
+
+    def update(self):
+        d = self._decay
+        for p in self._tracked():
+            prev = self._shadow[id(p)]
+            self._shadow[id(p)] = d * prev + (1 - d) * np.asarray(
+                p.numpy())
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        import jax.numpy as jnp
+
+        for p in self._tracked():
+            self._backup[id(p)] = p._data
+            p._data = jnp.asarray(self._shadow[id(p)], p._data.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._tracked():
+            if id(p) in self._backup:
+                p._data = self._backup.pop(id(p))
+
+
+# -- program/state serialization ---------------------------------------------
+
+def save(program, model_prefix, protocol=4, **configs):
+    """static.save — parameters + optimizer state of the tracked Layer
+    (the eager 'program')."""
+    from ..framework_io import save as _save
+    from ..nn.layers import Layer
+
+    state = program.state_dict() if isinstance(program, (Layer,)) \
+        else dict(program if isinstance(program, dict) else {})
+    _save(state, model_prefix + ".pdparams")
+
+
+def load(program, model_prefix, executor=None, var_list=None):
+    from ..framework_io import load as _load
+    from ..nn.layers import Layer
+
+    state = _load(model_prefix + ".pdparams")
+    if isinstance(program, Layer):
+        program.set_state_dict(state)
+    return state
+
+
+def load_program_state(model_prefix, var_list=None):
+    from ..framework_io import load as _load
+
+    return _load(model_prefix + ".pdparams")
+
+
+def set_program_state(program, state_dict):
+    from ..nn.layers import Layer
+
+    if isinstance(program, Layer):
+        program.set_state_dict(state_dict)
+    return program
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """static.normalize_program — prune to the feed->fetch closure; XLA's
+    DCE does this during jit, so the program passes through."""
+    return program
+
+
+from .nn_layers import py_func  # noqa: E402,F401
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    raise NotImplementedError(
+        "program protos are subsumed by StableHLO artifacts — "
+        "paddle_tpu.jit.save writes the program (SURVEY §7 addendum)")
+
+
+def deserialize_program(data):
+    raise NotImplementedError(
+        "program protos are subsumed by StableHLO artifacts — "
+        "paddle_tpu.jit.load reads the program (SURVEY §7 addendum)")
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None):
+    raise NotImplementedError(
+        "persistables ride state_dict files here — use static.save / "
+        "paddle.save (returning an empty payload would silently lose "
+        "every weight)")
+
+
+def deserialize_persistables(program, data, executor=None):
+    raise NotImplementedError(
+        "persistables ride state_dict files here — use static.load")
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content if isinstance(content, bytes)
+                else bytes(content))
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    raise NotImplementedError(
+        "ctr_metric_bundle belongs to the parameter-server stack "
+        "(recorded scope decision; SURVEY §7 addendum)")
+
+
+# -- IPU (no backend in a TPU build: signature-parity raising stubs) ---------
+
+class IpuStrategy:
+    def __init__(self):
+        raise RuntimeError("paddle_tpu is not compiled with IPU support")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise RuntimeError("paddle_tpu is not compiled with IPU support")
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    raise RuntimeError("paddle_tpu is not compiled with IPU support")
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise RuntimeError("paddle_tpu is not compiled with IPU support")

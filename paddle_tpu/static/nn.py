@@ -147,4 +147,18 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
     return _wrap_like(raw, loop_vars)
 
 
-__all__ = ["cond", "case", "switch_case", "while_loop"]
+from .nn_layers import (  # noqa: E402,F401
+    batch_norm, bilinear_tensor_product, conv2d, conv2d_transpose, conv3d,
+    conv3d_transpose, data_norm, deform_conv2d, embedding, fc, group_norm,
+    instance_norm, layer_norm, prelu, py_func, row_conv, spectral_norm,
+    static_pylayer,
+)
+
+__all__ = [
+    "cond", "case", "switch_case", "while_loop",
+    "fc", "embedding", "conv2d", "conv2d_transpose", "conv3d",
+    "conv3d_transpose", "batch_norm", "layer_norm", "group_norm",
+    "instance_norm", "prelu", "spectral_norm", "deform_conv2d",
+    "bilinear_tensor_product", "row_conv", "data_norm", "py_func",
+    "static_pylayer",
+]

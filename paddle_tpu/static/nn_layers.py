@@ -1,0 +1,294 @@
+"""paddle.static.nn functional layer builders.
+
+Reference: ``python/paddle/static/nn/common.py`` — ``fc`` (:28),
+``conv2d``/``conv3d`` (+transpose), ``batch_norm``, ``layer_norm``,
+``group_norm``, ``instance_norm``, ``embedding``, ``prelu``,
+``spectral_norm``, ``deformable_conv``, ``bilinear_tensor_product``,
+``row_conv``, ``data_norm``, ``py_func``, ``static_pylayer``.
+
+TPU-native: each builder constructs the corresponding ``nn`` Layer once
+per call site and applies it — under ``to_static`` the whole thing traces
+into ONE XLA program, which is exactly what the reference's
+append-op-to-Program achieves.  Parameters are fresh per call (the 1.x
+static API's parameter reuse rode global unique_name scopes; re-use here
+is the Layer object, the dygraph-consistent design).
+
+LoD ``sequence_*`` ops and the PS-backed ``sparse_embedding``/``nce``
+remain recorded scope decisions (SURVEY §7).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _act(out, act):
+    if not act:
+        return out
+    fn = getattr(nn.functional, act, None)
+    if fn is None:
+        raise ValueError(f"unknown activation {act!r}")
+    return fn(out)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """static/nn/common.py:28 — flatten trailing dims, linear, act."""
+    if isinstance(x, (list, tuple)):
+        outs = [fc(xi, size, num_flatten_dims, weight_attr, bias_attr,
+                   None, name) for xi in x]
+        total = outs[0]
+        for o in outs[1:]:
+            total = total + o
+        return _act(total, activation)
+    shape = tuple(x.shape)
+    if num_flatten_dims < 0:
+        num_flatten_dims = len(shape) + num_flatten_dims
+    lead = shape[:num_flatten_dims]
+    in_features = int(np.prod(shape[num_flatten_dims:]))
+    flat = x.reshape((int(np.prod(lead)), in_features))
+    layer = nn.Linear(in_features, size, weight_attr=weight_attr,
+                      bias_attr=bias_attr)
+    out = layer(flat).reshape(tuple(lead) + (size,))
+    return _act(out, activation)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, weight_attr=None,
+              dtype="float32", name=None):
+    """static/nn/common.py embedding."""
+    layer = nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                         weight_attr=weight_attr or param_attr)
+    return layer(input)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None,
+           data_format="NCHW"):
+    in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    layer = nn.Conv2D(in_ch, num_filters, filter_size, stride=stride,
+                      padding=padding, dilation=dilation, groups=groups,
+                      weight_attr=param_attr, bias_attr=bias_attr,
+                      data_format=data_format)
+    return _act(layer(input), act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    if filter_size is None:
+        raise ValueError("filter_size is required on TPU (static output "
+                         "shapes); pass filter_size, optionally "
+                         "output_size")
+    layer = nn.Conv2DTranspose(in_ch, num_filters, filter_size,
+                               stride=stride, padding=padding,
+                               dilation=dilation, groups=groups,
+                               weight_attr=param_attr, bias_attr=bias_attr,
+                               data_format=data_format)
+    out = layer(input, output_size=output_size) \
+        if output_size is not None else layer(input)
+    return _act(out, act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    in_ch = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    layer = nn.Conv3D(in_ch, num_filters, filter_size, stride=stride,
+                      padding=padding, dilation=dilation, groups=groups,
+                      weight_attr=param_attr, bias_attr=bias_attr,
+                      data_format=data_format)
+    return _act(layer(input), act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    in_ch = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    if filter_size is None:
+        raise ValueError("filter_size is required on TPU")
+    layer = nn.Conv3DTranspose(in_ch, num_filters, filter_size,
+                               stride=stride, padding=padding,
+                               dilation=dilation, groups=groups,
+                               weight_attr=param_attr, bias_attr=bias_attr,
+                               data_format=data_format)
+    return _act(layer(input), act)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var
+               =True, use_global_stats=False):
+    ch = input.shape[1] if data_layout.startswith("NC") \
+        else input.shape[-1]
+    cls = {5: nn.BatchNorm3D, 4: nn.BatchNorm2D}.get(
+        len(input.shape), nn.BatchNorm1D)
+    kwargs = dict(momentum=momentum, epsilon=epsilon,
+                  weight_attr=param_attr, bias_attr=bias_attr)
+    if cls is not nn.BatchNorm1D:
+        kwargs["data_format"] = data_layout
+    layer = cls(ch, **kwargs)
+    if is_test or use_global_stats:
+        layer.eval()
+    return _act(layer(input), act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = tuple(int(s) for s in input.shape[begin_norm_axis:])
+    layer = nn.LayerNorm(list(shape), epsilon=epsilon,
+                         weight_attr=param_attr if scale else False,
+                         bias_attr=bias_attr if shift else False)
+    return _act(layer(input), act)
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    layer = nn.GroupNorm(groups, ch, epsilon=epsilon,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+    return _act(layer(input), act)
+
+
+def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
+                  name=None):
+    ch = input.shape[1]
+    cls = {4: nn.InstanceNorm2D, 3: nn.InstanceNorm1D,
+           5: nn.InstanceNorm3D}[len(input.shape)]
+    layer = cls(ch, epsilon=epsilon, weight_attr=param_attr,
+                bias_attr=bias_attr)
+    return layer(input)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    if mode == "all":
+        num = 1
+    elif mode == "channel":
+        num = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    else:  # element
+        num = int(np.prod(x.shape[1:]))
+    layer = nn.PReLU(num_parameters=num, weight_attr=param_attr,
+                     data_format=data_format)
+    return layer(x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ..nn.utils import spectral_norm as sn_fn
+
+    class _Holder(nn.Layer):
+        def __init__(self, w):
+            super().__init__()
+            self.weight = self.create_parameter(shape=list(w.shape))
+            self.weight.set_value(w)
+
+        def forward(self):
+            return self.weight
+
+    holder = sn_fn(_Holder(weight), name="weight", n_power_iterations=
+                   power_iters, eps=eps, dim=dim)
+    return holder()
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..vision.ops import DeformConv2D
+
+    layer = DeformConv2D(x.shape[1], num_filters, filter_size,
+                         stride=stride, padding=padding, dilation=dilation,
+                         groups=groups,
+                         deformable_groups=deformable_groups,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+    return layer(x, offset, mask)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    layer = nn.Bilinear(x.shape[-1], y.shape[-1], size,
+                        weight_attr=param_attr, bias_attr=bias_attr)
+    return _act(layer(x, y), act)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """common.py row_conv — lookahead convolution over [B, T, D]:
+    out[t] = sum_{i=0..k} w[i] * in[t+i] (zero-padded future)."""
+    import jax.numpy as jnp
+
+    k = int(future_context_size)
+    d = int(input.shape[-1])
+
+    class _RowConv(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter(shape=[k + 1, d])
+
+        def forward(self, x):
+            xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+            w = self.weight._data
+            pad = jnp.pad(xd, ((0, 0), (0, k), (0, 0)))
+            out = jnp.zeros_like(xd)
+            for i in range(k + 1):
+                out = out + pad[:, i:i + xd.shape[1], :] * w[i]
+            return Tensor(out)
+
+    return _act(_RowConv()(input), act)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              enable_scale_and_shift=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              summary_decay_rate=0.9999999, sync_stats=False):
+    """common.py data_norm — normalization by accumulated batch summary
+    (no gamma/beta unless enabled); eager analog: standardize by the
+    batch statistics."""
+    import jax.numpy as jnp
+
+    xd = input._data if isinstance(input, Tensor) else jnp.asarray(input)
+    mean = jnp.mean(xd, axis=0, keepdims=True)
+    var = jnp.var(xd, axis=0, keepdims=True)
+    out = (xd - mean) / jnp.sqrt(var + epsilon)
+    return _act(Tensor(out), act)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=
+            None):
+    """common.py py_func — run arbitrary Python in the graph.  Eagerly
+    this is a plain call; under trace it runs via pure_callback (no
+    gradient unless backward_func is provided through PyLayer)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    if backward_func is None:
+        res = func(*xs)
+        return res
+    from ..autograd import PyLayer
+
+    class _Fn(PyLayer):
+        @staticmethod
+        def forward(ctx, *args):
+            ctx.save_for_backward(*args)
+            return func(*args)
+
+        @staticmethod
+        def backward(ctx, *grads):
+            saved = ctx.saved_tensor
+            return backward_func(*saved, *grads)
+
+    return _Fn.apply(*xs)
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """control_flow.py static_pylayer — PyLayer in static graphs."""
+    return py_func(forward_fn, inputs, None, backward_func=backward_fn)

@@ -80,3 +80,30 @@ def test_paged_engine_slot_reuse(model):
     s2 = eng.add_request(p)              # slot comes back
     assert s2 == s
     assert eng.step()[s2] is not None
+
+
+def test_decode_n_matches_per_step(model):
+    """r5: n greedy tokens in one dispatch == n sequential step()s
+    (the device-resident feedback loop must be bit-identical)."""
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, 256, (5,)).astype(np.int32),
+               rng.randint(0, 256, (9,)).astype(np.int32)]
+    n = 6
+
+    a = PagedLlamaEngine(model, max_seqs=2, page_size=4, max_len=64)
+    sids_a = [a.add_request(p) for p in prompts]
+    per_step = {s: [] for s in sids_a}
+    for _ in range(n):
+        out = a.step()
+        for s, t in out.items():
+            per_step[s].append(t)
+
+    b = PagedLlamaEngine(model, max_seqs=2, page_size=4, max_len=64)
+    sids_b = [b.add_request(p) for p in prompts]
+    fused = b.decode_n(n)
+    for sa, sb in zip(sids_a, sids_b):
+        assert fused[sb] == per_step[sa], (fused[sb], per_step[sa])
+    # engine state advanced consistently: another plain step agrees
+    nxt_a, nxt_b = a.step(), b.step()
+    for sa, sb in zip(sids_a, sids_b):
+        assert nxt_a[sa] == nxt_b[sb]

@@ -1,0 +1,181 @@
+"""paddle.static compat surface + static.nn layer builders (r5).
+
+Reference: ``python/paddle/static/__init__.py``, ``static/nn/common.py``
+— these APIs also run in the reference's dynamic mode, so they get real
+eager implementations here.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+def test_fc_flattens_and_activates():
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 3, 5).astype(np.float32))
+    out = static.nn.fc(x, 7, num_flatten_dims=2)
+    assert tuple(out.shape) == (4, 3, 7)
+    out2 = static.nn.fc(x, 7, num_flatten_dims=1, activation="relu")
+    assert tuple(out2.shape) == (4, 7)
+    assert float(out2.numpy().min()) >= 0.0
+
+
+def test_conv_and_norm_builders():
+    rng = np.random.RandomState(1)
+    img = paddle.to_tensor(rng.randn(2, 3, 16, 16).astype(np.float32))
+    c = static.nn.conv2d(img, 8, 3, padding=1, act="relu")
+    assert tuple(c.shape) == (2, 8, 16, 16)
+    b = static.nn.batch_norm(c)
+    assert tuple(b.shape) == (2, 8, 16, 16)
+    g = static.nn.group_norm(c, groups=4)
+    assert tuple(g.shape) == (2, 8, 16, 16)
+    i = static.nn.instance_norm(c)
+    assert tuple(i.shape) == (2, 8, 16, 16)
+    ln = static.nn.layer_norm(
+        paddle.to_tensor(rng.randn(4, 8).astype(np.float32)))
+    assert tuple(ln.shape) == (4, 8)
+    ct = static.nn.conv2d_transpose(img, 6, filter_size=2, stride=2)
+    assert tuple(ct.shape) == (2, 6, 32, 32)
+
+
+def test_embedding_prelu_bilinear_rowconv():
+    rng = np.random.RandomState(2)
+    ids = paddle.to_tensor(np.array([[0, 2], [5, 1]], np.int64))
+    emb = static.nn.embedding(ids, (16, 4))
+    assert tuple(emb.shape) == (2, 2, 4)
+    x = paddle.to_tensor(rng.randn(2, 3, 4, 4).astype(np.float32))
+    p = static.nn.prelu(x, mode="channel")
+    assert tuple(p.shape) == (2, 3, 4, 4)
+    a = paddle.to_tensor(rng.randn(3, 5).astype(np.float32))
+    b = paddle.to_tensor(rng.randn(3, 4).astype(np.float32))
+    bt = static.nn.bilinear_tensor_product(a, b, 6)
+    assert tuple(bt.shape) == (3, 6)
+    seqs = paddle.to_tensor(rng.randn(2, 6, 4).astype(np.float32))
+    rc = static.nn.row_conv(seqs, 2)
+    assert tuple(rc.shape) == (2, 6, 4)
+
+
+def test_create_parameter_and_gradients():
+    p = static.create_parameter([3, 3], "float32")
+    assert p.trainable and tuple(p.shape) == (3, 3)
+    g = static.create_global_var([2], 1.5, "float32", persistable=True)
+    assert np.allclose(g.numpy(), [1.5, 1.5])
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+    x.stop_gradient = False
+    y = (x * x).sum()
+    (dx,) = static.gradients(y, x)
+    np.testing.assert_allclose(dx.numpy(), [4.0, 6.0])
+
+
+def test_append_backward_and_accuracy():
+    import paddle_tpu.nn as nn
+
+    layer = nn.Linear(4, 2)
+    x = paddle.to_tensor(
+        np.random.RandomState(3).randn(4, 4).astype(np.float32))
+    loss = layer(x).sum()
+    pairs = static.append_backward(loss,
+                                   parameter_list=list(
+                                       layer.parameters()))
+    assert pairs and all(g is not None for _p, g in pairs)
+    logits = paddle.to_tensor(np.array([[0.9, 0.1], [0.2, 0.8]],
+                                       np.float32))
+    labels = paddle.to_tensor(np.array([[0], [1]], np.int64))
+    acc = static.accuracy(logits, labels)
+    assert float(np.asarray(acc.numpy() if hasattr(acc, "numpy")
+                            else acc)) == 1.0
+
+
+def test_program_handles_and_places():
+    assert "main" in repr(static.default_main_program())
+    assert static.default_startup_program() is not None
+    assert len(static.cpu_places(2)) == 2
+    assert static.cuda_places()
+    with static.device_guard("cpu"):
+        t = paddle.to_tensor(np.ones(2, np.float32))
+        assert t is not None
+    with static.scope_guard(static.global_scope()):
+        pass
+    with static.name_scope("blk"):
+        pass
+
+
+def test_ema_apply_restore():
+    import paddle_tpu.nn as nn
+
+    layer = nn.Linear(2, 2)
+    ema = static.ExponentialMovingAverage(0.5).register(layer)
+    w0 = layer.weight.numpy().copy()
+    layer.weight.set_value(paddle.to_tensor(w0 + 1.0))
+    ema.update()
+    with ema.apply():
+        applied = layer.weight.numpy().copy()
+    restored = layer.weight.numpy()
+    # shadow = 0.5*w0 + 0.5*(w0+1) = w0 + 0.5
+    np.testing.assert_allclose(applied, w0 + 0.5, rtol=1e-5)
+    np.testing.assert_allclose(restored, w0 + 1.0, rtol=1e-5)
+
+
+def test_static_save_load_roundtrip(tmp_path):
+    import paddle_tpu.nn as nn
+
+    layer = nn.Linear(3, 3)
+    prefix = str(tmp_path / "m")
+    static.save(layer, prefix)
+    w = layer.weight.numpy().copy()
+    layer.weight.set_value(paddle.to_tensor(np.zeros_like(w)))
+    static.load(layer, prefix)
+    np.testing.assert_allclose(layer.weight.numpy(), w)
+    state = static.load_program_state(prefix)
+    assert state
+
+
+def test_compiled_program_and_print():
+    import paddle_tpu.nn as nn
+
+    layer = nn.Linear(4, 2)
+    cp = static.CompiledProgram(layer, static.BuildStrategy())
+    x = paddle.to_tensor(
+        np.random.RandomState(4).randn(2, 4).astype(np.float32))
+    out = cp(x)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               layer(x).numpy(), rtol=1e-5)
+    static.Print(x, message="test")  # must not raise
+
+
+def test_py_func_with_backward():
+    def fwd(a):
+        return a * a
+
+    def bwd(a, dy):
+        return 2.0 * a * dy
+
+    x = paddle.to_tensor(np.array([3.0], np.float32))
+    x.stop_gradient = False
+    out = static.nn.py_func(fwd, x, None, backward_func=bwd)
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_batch_norm_5d_ncdhw():
+    rng = np.random.RandomState(5)
+    vol = paddle.to_tensor(rng.randn(2, 3, 4, 5, 6).astype(np.float32))
+    out = static.nn.batch_norm(vol)
+    assert tuple(out.shape) == (2, 3, 4, 5, 6)
+
+
+def test_serialize_persistables_raises_not_silent():
+    with pytest.raises(NotImplementedError, match="state_dict"):
+        static.serialize_persistables([], [])
+
+
+def test_recorded_decisions_raise_with_guidance():
+    with pytest.raises(NotImplementedError, match="StableHLO"):
+        static.serialize_program([], [])
+    with pytest.raises(RuntimeError, match="IPU"):
+        static.IpuStrategy()
+    with pytest.raises(NotImplementedError, match="parameter-server"):
+        static.ctr_metric_bundle(None, None)
