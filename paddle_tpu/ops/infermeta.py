@@ -1,0 +1,233 @@
+"""Eager argument validation — the InferMeta layer.
+
+Reference: ``paddle/phi/infermeta/`` (binary.cc MatmulInferMeta,
+multiary.cc ConcatInferMeta, unary.cc ReshapeInferMeta, ...) — there,
+every op validates shapes/dtypes BEFORE the kernel runs and raises
+``InvalidArgument`` with an actionable message.  Without this layer a bad
+call surfaces as a jnp broadcasting error deep inside dispatch.
+
+TPU-native: validators run on the *metadata only* (shapes/dtypes — no
+device work, no tracing interaction) for the high-traffic ops where
+jnp's own message is worst.  Registered per op name; ``registry.apply``
+consults the table when eager (tracers skip: XLA's shape checks own the
+traced path, and validators must never force a concrete value).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.enforce import InvalidArgumentError
+
+_VALIDATORS: dict = {}
+
+
+def register_validator(name):
+    def deco(fn):
+        _VALIDATORS[name] = fn
+        return fn
+
+    return deco
+
+
+def validate(op_name, datas, attrs):
+    """Called from registry.apply (eager only).  ``datas`` are raw
+    arrays/scalars — validators read only .shape/.dtype/.ndim."""
+    fn = _VALIDATORS.get(op_name)
+    if fn is not None:
+        fn(datas, attrs)
+
+
+def _shape(x):
+    return tuple(getattr(x, "shape", ()))
+
+
+def _ndim(x):
+    return len(_shape(x))
+
+
+def _fail(op, msg):
+    raise InvalidArgumentError(
+        f"(InvalidArgument) {msg} [operator < {op} > error]")
+
+
+@register_validator("matmul")
+def _matmul(datas, attrs):
+    x, y = datas[0], datas[1]
+    xs, ys = _shape(x), _shape(y)
+    if not xs or not ys:
+        _fail("matmul", f"matmul inputs must have rank >= 1, got "
+                        f"x{list(xs)} @ y{list(ys)}")
+    tx = bool(attrs.get("transpose_x", False))
+    ty = bool(attrs.get("transpose_y", False))
+    kx = xs[-2] if (tx and len(xs) > 1) else xs[-1]
+    ky = (ys[-1] if ty else ys[-2]) if len(ys) > 1 else ys[0]
+    if kx != ky:
+        _fail("matmul",
+              f"Input X's width should be equal to Y's height, but "
+              f"received X'shape: {list(xs)}, Y'shape: {list(ys)} "
+              f"(contracted dims {kx} vs {ky}, transpose_x={tx}, "
+              f"transpose_y={ty})")
+
+
+@register_validator("concat")
+def _concat(datas, attrs):
+    axis = int(attrs.get("axis", 0))
+    shapes = [_shape(d) for d in datas]
+    if not shapes:
+        _fail("concat", "concat expects at least one input")
+    base = shapes[0]
+    nd = len(base)
+    ax = axis + nd if axis < 0 else axis
+    if not 0 <= ax < nd:
+        _fail("concat", f"axis {axis} out of range for rank {nd}")
+    for i, s in enumerate(shapes[1:], 1):
+        if len(s) != nd:
+            _fail("concat",
+                  f"all inputs must share rank; input 0 has rank {nd}, "
+                  f"input {i} has rank {len(s)}")
+        for d in range(nd):
+            if d != ax and s[d] != base[d]:
+                _fail("concat",
+                      f"The shape of input[0] and input[{i}] is "
+                      f"expected to be equal except on axis {ax}, but "
+                      f"received input[0]: {list(base)} vs input[{i}]: "
+                      f"{list(s)}")
+
+
+@register_validator("reshape")
+def _reshape(datas, attrs):
+    x = datas[0]
+    shape = attrs.get("shape")
+    if shape is None:
+        return
+    n = int(np.prod(_shape(x))) if _shape(x) else 1
+    known = 1
+    minus1 = 0
+    for s in shape:
+        if s == -1:
+            minus1 += 1
+        elif s == 0:
+            continue  # reference: 0 copies the input dim
+        else:
+            known *= int(s)
+    if minus1 > 1:
+        _fail("reshape", f"only one dim may be -1, got shape {shape}")
+    if minus1 == 0 and known != n and 0 not in shape:
+        _fail("reshape",
+              f"the number of elements ({n}) is not equal to the "
+              f"target shape {list(shape)} ({known} elements)")
+    if minus1 == 1 and known and n % known != 0:
+        _fail("reshape",
+              f"cannot infer -1: {n} elements not divisible by "
+              f"{known} (target shape {list(shape)})")
+
+
+@register_validator("conv2d")
+def _conv2d(datas, attrs):
+    x, w = datas[0], datas[1]
+    xs, ws = _shape(x), _shape(w)
+    if len(xs) != 4 or len(ws) != 4:
+        _fail("conv2d",
+              f"conv2d expects 4-D input and filter, got input "
+              f"{list(xs)}, filter {list(ws)}")
+    groups = int(attrs.get("groups", 1))
+    fmt = attrs.get("data_format", "NCHW")
+    in_ch = xs[1] if fmt == "NCHW" else xs[-1]
+    if in_ch != ws[1] * groups:
+        _fail("conv2d",
+              f"The number of input's channels should be equal to "
+              f"filter's channels * groups, but received input "
+              f"channels {in_ch}, filter shape {list(ws)}, groups "
+              f"{groups}")
+    if ws[0] % groups != 0:
+        _fail("conv2d",
+              f"output channels {ws[0]} must be divisible by groups "
+              f"{groups}")
+
+
+@register_validator("embedding")
+def _embedding(datas, attrs):
+    ids, table = datas[0], datas[1]
+    if _ndim(table) != 2:
+        _fail("embedding",
+              f"the weight must be 2-D [vocab, dim], got "
+              f"{list(_shape(table))}")
+    dt = getattr(ids, "dtype", None)
+    if dt is not None and not np.issubdtype(np.dtype(str(dt)),
+                                            np.integer):
+        _fail("embedding",
+              f"the input ids must be an integer dtype, got {dt}")
+
+
+def _linear(datas, attrs):  # F.linear rides matmul; kept for custom use
+    x, w = datas[0], datas[1]
+    xs, ws = _shape(x), _shape(w)
+    if len(ws) != 2:
+        _fail("linear", f"weight must be 2-D [in, out], got {list(ws)}")
+    if xs and xs[-1] != ws[0]:
+        _fail("linear",
+              f"Input's last dim ({xs[-1]}) should equal weight's "
+              f"first dim ({ws[0]}); input {list(xs)}, weight "
+              f"{list(ws)}")
+
+
+@register_validator("where")
+def _where(datas, attrs):
+    if len(datas) < 3:
+        return
+    c, x, y = datas[0], datas[1], datas[2]
+    try:
+        np.broadcast_shapes(_shape(c), _shape(x), _shape(y))
+    except ValueError:
+        _fail("where",
+              f"condition/x/y are not broadcast-compatible: "
+              f"{list(_shape(c))}, {list(_shape(x))}, "
+              f"{list(_shape(y))}")
+
+
+@register_validator("softmax_with_cross_entropy")
+def _cross_entropy(datas, attrs):
+    logits, label = datas[0], datas[1]
+    ls, ys = _shape(logits), _shape(label)
+    if not ls:
+        _fail("softmax_with_cross_entropy",
+              "logits must be at least 1-D")
+    if attrs.get("soft_label"):
+        if ls != ys:
+            _fail("cross_entropy",
+                  f"soft labels must match logits shape {list(ls)}, "
+                  f"got {list(ys)}")
+        return
+    if len(ys) == len(ls) and ys[-1] not in (1, ls[-1]):
+        _fail("cross_entropy",
+              f"hard label's last dim must be 1, got label "
+              f"{list(ys)} for logits {list(ls)}")
+
+
+@register_validator("split")
+def _split(datas, attrs):
+    x = datas[0]
+    num = attrs.get("num_or_sections")
+    axis = int(attrs.get("axis", 0))
+    xs = _shape(x)
+    ax = axis + len(xs) if axis < 0 else axis
+    if not 0 <= ax < len(xs):
+        _fail("split", f"axis {axis} out of range for rank {len(xs)}")
+    if isinstance(num, int):
+        if num <= 0 or xs[ax] % num != 0:
+            _fail("split",
+                  f"The input's size along the split dimension must be "
+                  f"evenly divisible by num ({num}), but received "
+                  f"dim {ax} = {xs[ax]}")
+    elif isinstance(num, (list, tuple)):
+        fixed = sum(s for s in num if s != -1)
+        n_infer = sum(1 for s in num if s == -1)
+        if n_infer > 1:
+            _fail("split", f"only one section may be -1, got {num}")
+        if n_infer == 0 and fixed != xs[ax]:
+            _fail("split",
+                  f"sections {list(num)} must sum to dim {ax} = "
+                  f"{xs[ax]}")
+        if n_infer == 1 and fixed > xs[ax]:
+            _fail("split",
+                  f"sections {list(num)} exceed dim {ax} = {xs[ax]}")

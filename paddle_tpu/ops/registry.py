@@ -208,6 +208,13 @@ def apply(op: OpDef, *tensor_args, attrs=None, **kw_attrs):
         else:
             datas.append(t)
 
+    # InferMeta-style eager validation (ops/infermeta.py): metadata-only
+    # checks with reference-style InvalidArgument messages.  Traced
+    # values go through unchanged — XLA's shape system owns that path.
+    if op.name in _infermeta._VALIDATORS and not any(
+            isinstance(d, jax.core.Tracer) for d in datas):
+        _infermeta.validate(op.name, datas, attrs)
+
     if need_grad and op.jit_fwd is not None:
         out_data, saved = op.jit_fwd(*datas, **attrs)
         node = _engine.GradNode(op, saved, tensor_args, attrs)
