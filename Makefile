@@ -1,0 +1,40 @@
+# Developer entry points.  All targets run on CPU (no TPU needed);
+# JAX_PLATFORMS=cpu keeps jax from probing for accelerators.
+
+PY ?= python
+
+.PHONY: smoke test test-fast bench
+
+# <3 min sanity gate: import + one eager op, one jitted llama forward
+# step (the driver's entry()), and a 2-virtual-device multichip train
+# step with numeric parity asserted.  Run this before ANY snapshot
+# commit; it catches the classic "HEAD doesn't even import" breakage
+# (round 5 shipped one) in seconds.
+smoke:
+	JAX_PLATFORMS=cpu $(PY) -c "\
+	import numpy as np; \
+	import paddle_tpu as paddle; \
+	x = paddle.to_tensor(np.ones((2, 3), np.float32)); \
+	y = paddle.to_tensor(np.ones((3, 4), np.float32)); \
+	assert list(paddle.matmul(x, y).shape) == [2, 4]; \
+	print('smoke: eager op OK'); \
+	import __graft_entry__ as ge; \
+	fn, args = ge.entry(); \
+	import jax; \
+	loss = float(jax.jit(fn)(*args)); \
+	assert loss == loss, 'NaN loss'; \
+	print(f'smoke: jitted llama step OK (loss {loss:.3f})'); \
+	ge.dryrun_multichip(2); \
+	print('smoke: multichip(2) OK')"
+
+# Fast lane — must be green before any snapshot commit (see README).
+test-fast:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m "not slow" \
+		--continue-on-collection-errors -p no:cacheprovider
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
+		--continue-on-collection-errors -p no:cacheprovider
+
+bench:
+	$(PY) bench.py

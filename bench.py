@@ -39,6 +39,20 @@ def _enable_compile_cache():
 _CACHE_DIR = _enable_compile_cache()
 
 
+def _cache_entries():
+    try:
+        return len(os.listdir(_CACHE_DIR)) if _CACHE_DIR else 0
+    except OSError:
+        return 0
+
+
+# Warm start: when the persistent compile cache already has entries
+# (any earlier bench run on this machine), compiles are cache hits and
+# the cold-compile cost estimates below would over-skip — use the warm
+# estimates instead.
+_CACHE_WARM = _cache_entries() > 0
+
+
 def _cache_report(tag):
     """Log cache growth so BENCH artifacts show whether compiles hit the
     persistent cache (VERDICT r3 weak #1)."""
@@ -170,23 +184,45 @@ def main():
     # record WHY in the artifact.
     budget_s = float(os.environ.get("PT_BENCH_BUDGET_S", "1500"))
 
-    def _extend(key, skip_env, fn, est_cold_s):
+    def _extend(key, skip_env, fn, est_cold_s, est_warm_s=None):
+        import signal
+
         if on_cpu or os.environ.get(skip_env) == "1":
             return
+        est = (est_warm_s if (_CACHE_WARM and est_warm_s is not None)
+               else est_cold_s)
         elapsed = time.perf_counter() - _T0
-        if elapsed + est_cold_s > budget_s:
+        if elapsed + est > budget_s:
             print(f"{key}: SKIPPED (elapsed {elapsed:.0f}s + est "
-                  f"{est_cold_s}s > budget {budget_s:.0f}s)",
+                  f"{est}s > budget {budget_s:.0f}s)",
                   file=sys.stderr)
             result[key] = {"skipped": "budget",
                            "elapsed_s": round(elapsed, 1)}
             print(json.dumps(result), flush=True)
             return
+        # Hard per-config wall cap: the pre-skip only guards the
+        # ESTIMATE — a config whose compile blows past it must not eat
+        # the remaining configs' budget.  SIGALRM fires when control
+        # next returns to Python, which over the async tunnel is after
+        # each dispatch/fetch call — enough to bound the damage.
+        cap = max(int(budget_s - elapsed), 1)
+
+        def _on_alarm(signum, frame):
+            raise TimeoutError(f"{key} hit per-config cap {cap}s")
+
+        old = signal.signal(signal.SIGALRM, _on_alarm)
+        signal.alarm(cap)
         try:
             result[key] = fn(jax)
+        except TimeoutError as e:
+            print(f"{key}: TIMED OUT: {e}", file=sys.stderr)
+            result[key] = {"skipped": "budget", "hard_cap_s": cap}
         except Exception as e:  # never lose earlier measurements
             print(f"{key}: FAILED: {e}", file=sys.stderr)
             result[key] = {"error": str(e)[:200]}
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
         _cache_report(key)
         print(f"elapsed after {key}: "
               f"{time.perf_counter() - _T0:.0f}s", file=sys.stderr)
@@ -202,15 +238,18 @@ def main():
         del model
         gc.collect()
 
-    # Cheapest-compile-first; the ~1.6B config (longest compile) goes last
-    # so a budget skip can only ever cost the tail configs.  Cold-cost
-    # estimates from the r4 run (first-step + multi-step compiles).
-    _extend("resnet50", "PT_BENCH_SKIP_RESNET", _bench_resnet, 150)
-    _extend("bert_base_squad", "PT_BENCH_SKIP_BERT", _bench_bert, 200)
-    _extend("detection_amp_o2", "PT_BENCH_SKIP_DET", _bench_detection, 150)
-    _extend("serving", "PT_BENCH_SKIP_SERVING", _bench_serving, 120)
-    _extend("sd_unet", "PT_BENCH_SKIP_UNET", _bench_unet, 250)
-    _extend("large", "PT_BENCH_SKIP_LARGE", _bench_large, 500)
+    # Cheapest-compile-first, with the two never-yet-recorded configs
+    # (serving, large) BEFORE the UNet: its compile is the longest and
+    # least predictable, so it must only ever cost itself.  Cold-cost
+    # estimates from the r4/r5 runs; warm estimates assume the
+    # persistent compile cache holds the programs.
+    _extend("resnet50", "PT_BENCH_SKIP_RESNET", _bench_resnet, 150, 40)
+    _extend("bert_base_squad", "PT_BENCH_SKIP_BERT", _bench_bert, 200, 50)
+    _extend("detection_amp_o2", "PT_BENCH_SKIP_DET", _bench_detection,
+            150, 40)
+    _extend("serving", "PT_BENCH_SKIP_SERVING", _bench_serving, 180, 60)
+    _extend("large", "PT_BENCH_SKIP_LARGE", _bench_large, 500, 120)
+    _extend("sd_unet", "PT_BENCH_SKIP_UNET", _bench_unet, 250, 60)
 
 
 def _bench_detection(jax):
@@ -538,16 +577,19 @@ def _bench_serving(jax):
     """Serving throughput (VERDICT r4 next-8): continuous-batching
     greedy decode over the paged-KV engine — the Predictor/serving
     stack's hot path (reference block_multi_head_attention loop).
-    Reports decode tokens/s at full batch occupancy."""
+    Reports decode tokens/s at full batch occupancy, measured A/B:
+    the self-authored fused paged-decode kernel vs the dense jnp
+    gather path (PT_PAGED_IMPL routing in inference/paged.py)."""
     import gc
 
     import jax.numpy as jnp
 
     from paddle_tpu.inference.serving import PagedLlamaEngine
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.ops import autotune
 
     gc.collect()
-    # head_dim must be 128: the paged-attention Pallas kernel requires
+    # head_dim must be 128: the paged-attention Pallas kernels require
     # last-dim 128 blocks, and over the async tunnel a Mosaic lowering
     # error surfaces as a HANG (compile never completes), not a raise.
     cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
@@ -558,38 +600,74 @@ def _bench_serving(jax):
     model.eval()
     n_params = model.num_params()
     max_seqs = int(os.environ.get("PT_BENCH_SERVE_SEQS", "8"))
-    eng = PagedLlamaEngine(model, max_seqs=max_seqs, page_size=16,
-                           max_len=512, dtype=jnp.bfloat16)
     rng = np.random.RandomState(0)
-    print("serving: prefill + compiling decode...", file=sys.stderr)
-    for _ in range(max_seqs):
-        eng.add_request(rng.randint(0, cfg.vocab_size, (128,)))
-    # decode_n keeps the greedy feedback on device: one dispatch per k
-    # tokens (serving.py _decode_n_fwd) — the measured quantity is the
-    # decode THROUGHPUT, not the tunnel's per-dispatch latency.
-    k = 32
-    eng.decode_n(k)  # compile + settle
-    # decode_n ends in a host transfer of all k tokens, so each call's
-    # wall time is honest serving cost (dispatch + decode + fetch);
-    # average over several calls.
-    calls = 4
-    t0 = time.perf_counter()
-    for _ in range(calls):
-        eng.decode_n(k)
-    wall = time.perf_counter() - t0
-    # plausibility at DISPATCH granularity (the 1 ms floor is calibrated
-    # for wall-clock dispatches, not derived per-token quantities)
-    reason = _implausible(wall / calls)
-    if reason is not None:
-        raise RuntimeError(f"implausible measurement: {reason}")
-    dt = wall / (calls * k)  # per token-step, fetch amortized k ways
-    tok_s = max_seqs / dt
-    print(f"serving: decode {dt * 1e3:.2f} ms/token-step, {tok_s:.0f} "
-          f"tok/s (batch {max_seqs}, {k}-token dispatches)",
-          file=sys.stderr)
-    return {"value": round(tok_s, 1), "unit": "decode_tokens/s/chip",
-            "batch": max_seqs, "prompt": 128, "page_size": 16,
-            "dispatch_tokens": k, "model_params": n_params}
+
+    def _measure(impl):
+        """Decode tokens/s with the given attention impl.  A fresh
+        engine per impl: the routing is read at trace time, and each
+        engine holds its own decode executable."""
+        old = os.environ.get("PT_PAGED_IMPL")
+        os.environ["PT_PAGED_IMPL"] = impl
+        try:
+            eng = PagedLlamaEngine(model, max_seqs=max_seqs,
+                                   page_size=16, max_len=512,
+                                   dtype=jnp.bfloat16)
+            print(f"serving[{impl}]: prefill + compiling decode...",
+                  file=sys.stderr)
+            for _ in range(max_seqs):
+                eng.add_request(
+                    rng.randint(0, cfg.vocab_size, (128,)))
+            # decode_n keeps the greedy feedback on device: one
+            # dispatch per k tokens (serving.py _decode_n_fwd) — the
+            # measured quantity is decode THROUGHPUT, not the tunnel's
+            # per-dispatch latency.
+            k = 32
+            eng.decode_n(k)  # compile + settle
+            # decode_n ends in a host transfer of all k tokens, so each
+            # call's wall time is honest serving cost (dispatch +
+            # decode + fetch); average over several calls.
+            calls = 4
+            t0 = time.perf_counter()
+            for _ in range(calls):
+                eng.decode_n(k)
+            wall = time.perf_counter() - t0
+            # plausibility at DISPATCH granularity (the 1 ms floor is
+            # calibrated for wall-clock dispatches, not derived
+            # per-token quantities)
+            reason = _implausible(wall / calls)
+            if reason is not None:
+                raise RuntimeError(
+                    f"implausible measurement: {reason}")
+            dt = wall / (calls * k)  # per token-step, fetch amortized
+            tok_s = max_seqs / dt
+            print(f"serving[{impl}]: decode {dt * 1e3:.2f} "
+                  f"ms/token-step, {tok_s:.0f} tok/s (batch "
+                  f"{max_seqs}, {k}-token dispatches)", file=sys.stderr)
+            del eng
+            gc.collect()
+            return tok_s, dt, k
+        finally:
+            if old is None:
+                os.environ.pop("PT_PAGED_IMPL", None)
+            else:
+                os.environ["PT_PAGED_IMPL"] = old
+
+    tok_s, dt, k = _measure("pallas")
+    out = {"value": round(tok_s, 1), "unit": "decode_tokens/s/chip",
+           "batch": max_seqs, "prompt": 128, "page_size": 16,
+           "dispatch_tokens": k, "model_params": n_params,
+           "impl": "pallas (fused paged_decode)"}
+    if os.environ.get("PT_BENCH_SERVE_AB", "1") == "1":
+        try:
+            dense_tok_s, dense_dt, _ = _measure("dense")
+            out["ab_dense_tokens_s"] = round(dense_tok_s, 1)
+            out["ab_speedup_vs_dense"] = round(dt and dense_dt / dt, 2)
+            # persist the measured winner so auto routing replays it
+            autotune.record("paged_decode_impl", (128, 16),
+                            "pallas" if dt <= dense_dt else "dense")
+        except Exception as e:  # A/B leg must never cost the headline
+            out["ab_dense_tokens_s"] = {"error": str(e)[:120]}
+    return out
 
 
 def _bench_large(jax):

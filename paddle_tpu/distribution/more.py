@@ -264,9 +264,14 @@ class Binomial(Distribution):
 
     def sample(self, shape=()):
         s = _shape(shape) + self.batch_shape
-        n = _raw(self.total_count)
-        p = _raw(self.probs)
-        out = jax.random.binomial(default_generator.next_key(), n, p, s)
+        # jax's binomial sampler clamps with bare float literals, which
+        # lower as f64 under global x64 and trip lax.clamp's strict dtype
+        # check against its f32 intermediates.  Trace it with x64 off
+        # (the sample is returned as f32 regardless).
+        n = _raw(self.total_count).astype(jnp.float32)
+        p = _raw(self.probs).astype(jnp.float32)
+        with jax.enable_x64(False):
+            out = jax.random.binomial(default_generator.next_key(), n, p, s)
         return Tensor(out.astype(jnp.float32))
 
     def log_prob(self, value):

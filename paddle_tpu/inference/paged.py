@@ -95,21 +95,63 @@ def _dense_paged_attention(q, k_pages, v_pages, lengths, page_indices):
     return out.reshape(B, H, D).astype(q.dtype)
 
 
+def _select_impl(head_dim, page_size):
+    """Resolve the decode-attention implementation.
+
+    ``PT_PAGED_IMPL`` ∈ {auto, pallas, stock, dense} forces a path
+    (the A/B lever bench.py uses); ``auto`` prefers the self-authored
+    fused kernel when its shape gate passes, then the stock flash-style
+    kernel, then the dense jnp gather.  The gate is load-bearing: over
+    the async tunnel a Mosaic lowering error surfaces as a compile
+    HANG, not a raise, so an incompatible shape must never reach a
+    compiled kernel."""
+    import os
+
+    from ..ops.pallas_kernels import paged_decode as _fused
+
+    impl = os.environ.get("PT_PAGED_IMPL", "auto").lower()
+    if impl not in ("auto", "pallas", "stock", "dense"):
+        raise ValueError(
+            f"PT_PAGED_IMPL={impl!r}: expected auto|pallas|stock|dense")
+    if impl != "auto":
+        return impl
+    from ..ops import autotune as _autotune
+
+    if _fused.supported(head_dim, page_size, _on_tpu()):
+        # measured choice between the two compiled kernels, cached per
+        # (device, shape); defaults to the fused kernel untuned
+        return _autotune.lookup(
+            "paged_decode_impl", (head_dim, page_size),
+            default="pallas")
+    if _on_tpu() and head_dim % 128 == 0:
+        return "stock"
+    return "dense"
+
+
 def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
                            pages_per_compute_block=4):
-    """Decode attention over the page pool.  On TPU this is the Pallas
-    ``paged_attention`` kernel (flash-style, page-gathering in VMEM);
-    elsewhere the dense-gather fallback jit-cached through the op
-    registry.  Returns a Tensor iff ``q`` is a Tensor."""
+    """Decode attention over the page pool.  On TPU this is the
+    self-authored fused kernel (``ops/pallas_kernels/paged_decode.py``:
+    per-sequence DMA page gather + whole decode attention in VMEM) or
+    the stock flash-style ``paged_attention`` kernel; elsewhere the
+    dense-gather fallback jit-cached through the op registry.  Routing
+    is overridable via ``PT_PAGED_IMPL`` (see ``_select_impl``).
+    Returns a Tensor iff ``q`` is a Tensor."""
     wrap = isinstance(q, Tensor)
     q = q._data if wrap else jnp.asarray(q)
     lengths = jnp.asarray(lengths, jnp.int32)
     page_indices = jnp.asarray(page_indices, jnp.int32)
-    # head_dim must tile to 128 lanes for the stock Pallas kernel; an
-    # incompatible shape must take the dense path — over the async
-    # tunnel a Mosaic lowering error surfaces as a compile HANG, not a
-    # raise, so guarding here is load-bearing.
-    if not _on_tpu() or q.shape[-1] % 128 != 0:
+    impl = _select_impl(q.shape[-1], k_pages.shape[2])
+
+    if impl == "pallas":
+        from ..ops.pallas_kernels import paged_decode as _fused
+
+        out = _fused.handle()(
+            Tensor(q), Tensor(jnp.asarray(k_pages)),
+            Tensor(jnp.asarray(v_pages)), Tensor(lengths),
+            Tensor(page_indices))
+        return out if wrap else out._data
+    if impl == "dense":
         out = _op("paged_decode_attention", _dense_paged_attention,
                   Tensor(q), Tensor(jnp.asarray(k_pages)),
                   Tensor(jnp.asarray(v_pages)), Tensor(lengths),

@@ -147,7 +147,9 @@ def _conv2d(datas, attrs):
 
 @register_validator("embedding")
 def _embedding(datas, attrs):
-    ids, table = datas[0], datas[1]
+    # arg order matches the embedding op's signature — the call site
+    # (nn/functional/__init__.py embedding) passes (weight, ids)
+    table, ids = datas[0], datas[1]
     if _ndim(table) != 2:
         _fail("embedding",
               f"the weight must be 2-D [vocab, dim], got "

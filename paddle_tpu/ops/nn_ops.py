@@ -664,12 +664,17 @@ def _fit_block(block, n, floor=128):
 
 def _fa_block_sizes(q_seq_len, kv_seq_len, blocks=None):
     """Pallas flash-attention tile sizes.  ``blocks`` is a (block_q,
-    block_k) pair; defaults tuned on v5e at S=2048 (bigger q tiles than
-    the library's 128 default keep the MXU busier per grid step).  Tiles
+    block_k) pair; the default comes from the autotune cache
+    (ops/autotune.py) — seeded with the v5e-measured 512/1024 (bigger q
+    tiles than the library's 128 default keep the MXU busier per grid
+    step), overridden by any per-shape measurement on record.  Tiles
     are clamped to divisors of the sequence lengths — pallas'
     _verify_block rejects non-dividing tiles (e.g. S=1536 with bk=1024)."""
     m = _fa_mod()
-    bq, bk = blocks if blocks is not None else (512, 1024)
+    from . import autotune as _autotune
+
+    bq, bk = blocks if blocks is not None else _autotune.lookup(
+        "fa_blocks", (q_seq_len, kv_seq_len), default=(512, 1024))
     bq = _fit_block(bq, q_seq_len)
     bk = _fit_block(bk, kv_seq_len)
     return m.BlockSizes(
@@ -782,9 +787,12 @@ def _sdpa_plain(q, k, v, mask=None, key=None, dropout=0.0, causal=False,
                and D % 128 == 0 and Sq % 256 == 0 and Sq <= 2048
                and Hkv == H and on_tpu)
     if impl == "auto" and long_ok and causal and Sq >= 1024:
+        from . import autotune as _autotune
         from .pallas_kernels.long_attention import long_attention
 
-        out = long_attention(qt, kt, vt, float(scale), 256,
+        block_q = int(_autotune.lookup("long_attention_block_q",
+                                       (Sq, D), default=256))
+        out = long_attention(qt, kt, vt, float(scale), block_q,
                              bool(causal), None)
         return jnp.swapaxes(out, 1, 2)
     # Self-authored short-sequence kernel (pallas_kernels/short_attention):

@@ -1,0 +1,207 @@
+"""Fused paged-decode attention kernel (self-authored, #4).
+
+Reference analog: ``paddle/phi/kernels/fusion/gpu/
+block_multi_head_attention_kernel.cu`` — single-token decode attention
+against a block-table (paged) KV cache, the kernel behind the
+reference's continuous-batching serving path.  The role, not the
+design.
+
+TPU design: one program per (sequence, kv-head).  The program DMAs the
+sequence's block-table window — ``pages_per_seq`` pages of
+``[page_size, head_dim]`` K and V — from the HBM page pool into VMEM
+scratch (all copies started before any is waited on, so the gather is
+one pipelined burst), then computes the whole decode attention for that
+head group in VMEM:
+
+    scores = q_group @ K_window^T * scale      [group, S_window]
+    p      = softmax(scores  masked to length)
+    out    = p @ V_window                      [group, head_dim]
+
+No online-softmax machinery: a decode window is S_window = pages_per_seq
+* page_size tokens, and one head's K+V window at S=1024, D=128 bf16 is
+512 KB — it fits VMEM outright (same VMEM-residency argument as
+``long_attention``).  GQA rides free: the q rows of one program are the
+``H // KV`` query heads sharing that KV head.
+
+What this fuses (vs ``inference/paged._dense_paged_attention``): the
+jnp path materializes the gathered dense cache [B, KV, T, D] (x2) in
+HBM, then runs einsum -> mask -> softmax -> einsum as separate XLA
+fusions over HBM round-trips.  Here the page gather lands directly in
+VMEM and every intermediate (scores, probs) lives and dies there; HBM
+traffic is the theoretical floor (read each page once, write [B, H, D]
+once).
+
+Layout contract (matches PagedKVCache):
+  q            [B, KV, G, D]   (G = H // KV query heads per KV head)
+  k/v_pages    [KV, P, ps, D]  (the pool; P = total pages)
+  lengths      [B]   int32     valid tokens per sequence
+  page_indices [B, pps] int32  each sequence's block-table window
+returns        [B, KV, G, D]
+
+TPU constraints (callers gate, inference/paged.py): D % 128 == 0 (lane
+tiling), page_size % 8 == 0 (f32 sublane tiling of the DMA'd page).
+Off-TPU the kernel runs in interpreter mode (tests); serving uses the
+dense jnp path there.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(len_ref, tbl_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf,
+            sem, *, page_size, pages_per_seq, scale):
+    b = pl.program_id(0)
+    kv = pl.program_id(1)
+    # Keep every scalar explicitly i32: the repo's global x64 mode turns
+    # weak Python-int constants into i64 at lowering, and a mixed
+    # i32/i64 divide fails StableHLO verification (interpret mode) and
+    # Mosaic (compiled).
+    length = len_ref[b]
+    npages = pl.cdiv(length, jnp.int32(page_size))
+
+    def page_dma(i, pool, buf):
+        """HBM pool page -> VMEM window row block, one async copy."""
+        return pltpu.make_async_copy(
+            pool.at[kv, tbl_ref[b, i]],
+            buf.at[pl.ds(i * page_size, page_size)],
+            sem)
+
+    # Start EVERY needed page copy before waiting on any (the DMA engine
+    # pipelines them); zero the window tail instead — VMEM scratch holds
+    # garbage from the previous program, and a NaN bit pattern in V
+    # would poison p @ V even at p == 0.
+    for i in range(pages_per_seq):
+        @pl.when(i < npages)
+        def _start():
+            page_dma(i, k_hbm, k_buf).start()
+            page_dma(i, v_hbm, v_buf).start()
+
+        @pl.when(i >= npages)
+        def _zero():
+            k_buf[pl.ds(i * page_size, page_size)] = jnp.zeros(
+                (page_size, k_buf.shape[-1]), k_buf.dtype)
+            v_buf[pl.ds(i * page_size, page_size)] = jnp.zeros(
+                (page_size, v_buf.shape[-1]), v_buf.dtype)
+
+    for i in range(pages_per_seq):
+        @pl.when(i < npages)
+        def _wait():
+            page_dma(i, k_hbm, k_buf).wait()
+            page_dma(i, v_hbm, v_buf).wait()
+
+    q = q_ref[0, 0].astype(jnp.float32) * jnp.float32(scale)  # [G, D]
+    k = k_buf[...].astype(jnp.float32)               # [S_window, D]
+    v = v_buf[...].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    S = k.shape[0]
+    col = jax.lax.broadcasted_iota(jnp.int32, (q.shape[0], S), 1)
+    s = jnp.where(col < length, s, jnp.float32(-1e30))
+    m = jnp.max(s, axis=1, keepdims=True)
+    e = jnp.exp(s - m)
+    p = e / jnp.sum(e, axis=1, keepdims=True)
+    o = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    o_ref[0, 0] = o.astype(o_ref.dtype)
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.jit, static_argnames=("scale",))
+def _call(q, k_pages, v_pages, lengths, page_indices, scale):
+    B, KV, G, D = q.shape
+    ps = k_pages.shape[2]
+    pps = page_indices.shape[1]
+    kernel = functools.partial(_kernel, page_size=ps, pages_per_seq=pps,
+                               scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,          # lengths + page table
+        grid=(B, KV),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, D),
+                         lambda b, kv, lens, tbl: (b, kv, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # K pool stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),   # V pool stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, D),
+                               lambda b, kv, lens, tbl: (b, kv, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((pps * ps, D), k_pages.dtype),
+            pltpu.VMEM((pps * ps, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    # Mosaic rejects i64 grid/index constants from the repo's global
+    # x64 mode — trace x64-off like every other kernel in this package.
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((B, KV, G, D), q.dtype),
+            interpret=_interpret(),
+        )(jnp.asarray(lengths, jnp.int32),
+          jnp.asarray(page_indices, jnp.int32), q, k_pages, v_pages)
+
+
+def paged_decode(q, k_pages, v_pages, lengths, page_indices, scale=None):
+    """Fused paged-decode attention over the page pool.
+
+    q [B, H, D] (H % KV == 0); k/v_pages [KV, P, ps, D]; lengths [B];
+    page_indices [B, pps].  Returns [B, H, D].  Pure function of its
+    arguments (no custom VJP: decode is inference-only).
+    """
+    B, H, D = q.shape
+    KV = k_pages.shape[0]
+    if H % KV:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {KV}")
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    qg = q.reshape(B, KV, H // KV, D)
+    out = _call(qg, k_pages, v_pages, lengths, page_indices,
+                float(scale))
+    return out.reshape(B, H, D)
+
+
+def supported(head_dim, page_size, on_tpu):
+    """Shape gate for the compiled (non-interpret) kernel: D must tile
+    to 128 lanes and a page must tile to 8 f32 sublanes.  Off-TPU the
+    interpreter imposes no tiling, but serving takes the dense path
+    there (kernel-in-interpreter is test machinery, not a fast path)."""
+    if not on_tpu:
+        return False
+    return head_dim % 128 == 0 and page_size % 8 == 0
+
+
+def paged_decode_spmd_rule(mesh, q_spec, k_spec, v_spec, len_spec,
+                           tbl_spec):
+    """SPMD rule: shard the batch dim (grid axis 0 — programs are
+    independent per sequence) and/or the head dim (grid axis 1 — the
+    pools' KV axis must carry the same sharding); D and the page axes
+    are kernel-internal and must be replicated.  Output follows q."""
+    return tuple(q_spec)[:2] + (None,)
+
+
+_HANDLE = None
+
+
+def handle():
+    """Custom-op handle (lazy — registration is global).  Registered as
+    ``fused_paged_decode``: the dense fallback already owns the dynamic
+    op name ``paged_decode_attention`` via ``cached_apply``, and custom
+    ops must not shadow an existing name."""
+    global _HANDLE
+    if _HANDLE is None:
+        from ...utils.cpp_extension import register_custom_op
+
+        _HANDLE = register_custom_op(
+            "fused_paged_decode", paged_decode,
+            static_argnames=("scale",),
+            spmd_rule=paged_decode_spmd_rule)
+    return _HANDLE

@@ -54,30 +54,41 @@ def _interpret():
     return jax.default_backend() != "tpu"
 
 
-def _pad_rows(n):
-    return -n % _BLOCK_ROWS
+def _block_rows(h):
+    """Row-block size for hidden width ``h`` — the autotune cache's
+    winner when one is on record (ops/autotune.py), else the measured
+    256 default."""
+    from .. import autotune as _autotune
+
+    return int(_autotune.lookup("rms_norm_block_rows", (h,),
+                                default=_BLOCK_ROWS))
+
+
+def _pad_rows(n, br=_BLOCK_ROWS):
+    return -n % br
 
 
 @functools.partial(jax.jit, static_argnames=("eps",))
 def _fused_fwd_2d(x2, w, eps):
     n, h = x2.shape
-    pad = _pad_rows(n)
+    br = _block_rows(h)
+    pad = _pad_rows(n, br)
     if pad:
         x2 = jnp.pad(x2, ((0, pad), (0, 0)))
     rows = x2.shape[0]
-    grid = (rows // _BLOCK_ROWS,)
+    grid = (rows // br,)
     # Mosaic rejects i64 grid/index constants from global x64 mode.
     with jax.enable_x64(False):
         out, rstd = pl.pallas_call(
             functools.partial(_fwd_kernel, eps=eps),
             grid=grid,
             in_specs=[
-                pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+                pl.BlockSpec((br, h), lambda i: (i, 0)),
                 pl.BlockSpec((1, h), lambda i: (0, 0)),
             ],
             out_specs=[
-                pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
-                pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
+                pl.BlockSpec((br, h), lambda i: (i, 0)),
+                pl.BlockSpec((br, 1), lambda i: (i, 0)),
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((rows, h), x2.dtype),
@@ -91,24 +102,25 @@ def _fused_fwd_2d(x2, w, eps):
 @jax.jit
 def _fused_bwd_2d(x2, w, rstd, dy2):
     n, h = x2.shape
-    pad = _pad_rows(n)
+    br = _block_rows(h)
+    pad = _pad_rows(n, br)
     if pad:
         x2 = jnp.pad(x2, ((0, pad), (0, 0)))
         dy2 = jnp.pad(dy2, ((0, pad), (0, 0)))
         rstd = jnp.pad(rstd, (0, pad), constant_values=1.0)
     rows = x2.shape[0]
-    grid = (rows // _BLOCK_ROWS,)
+    grid = (rows // br,)
     with jax.enable_x64(False):
         dx = pl.pallas_call(
             _bwd_kernel,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+                pl.BlockSpec((br, h), lambda i: (i, 0)),
                 pl.BlockSpec((1, h), lambda i: (0, 0)),
-                pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
-                pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+                pl.BlockSpec((br, 1), lambda i: (i, 0)),
+                pl.BlockSpec((br, h), lambda i: (i, 0)),
             ],
-            out_specs=pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0)),
+            out_specs=pl.BlockSpec((br, h), lambda i: (i, 0)),
             out_shape=jax.ShapeDtypeStruct((rows, h), x2.dtype),
             interpret=_interpret(),
         )(x2, w.reshape(1, h), rstd.reshape(-1, 1), dy2)

@@ -32,6 +32,7 @@ from typing import Callable, Sequence
 import jax
 
 from ..core import flags
+from . import infermeta as _infermeta
 
 _OPS: dict[str, "OpDef"] = {}
 

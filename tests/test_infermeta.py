@@ -1,0 +1,76 @@
+"""InferMeta eager validation through the PUBLIC API.
+
+The round-5 snapshot shipped an infermeta layer that (a) was never
+imported (every eager op died with NameError at registry.py:214) and
+(b) read the embedding validator's operands swapped — bugs that survive
+precisely when nothing exercises the validators through the real call
+path.  These tests call ``paddle.*`` / ``paddle.nn.functional.*``, not
+the validator functions directly.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.enforce import InvalidArgumentError
+
+
+def test_eager_dispatch_alive():
+    """Regression for the r5 NameError: a bare eager op must run (the
+    validator table import is part of the dispatch path)."""
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    y = paddle.to_tensor(np.ones((3, 4), np.float32))
+    assert list(paddle.matmul(x, y).shape) == [2, 4]
+
+
+def test_embedding_accepts_valid_call():
+    """Accept path: (ids, weight) through the public functional API —
+    the call site passes (weight, ids) to the op, and the validator
+    must read them in that order."""
+    w = paddle.to_tensor(np.random.randn(10, 4).astype(np.float32))
+    ids = paddle.to_tensor(np.array([1, 2, 3], np.int64))
+    out = F.embedding(ids, w)
+    assert list(out.shape) == [3, 4]
+    np.testing.assert_allclose(out.numpy(), w.numpy()[[1, 2, 3]])
+
+
+def test_embedding_accepts_2d_ids():
+    w = paddle.to_tensor(np.random.randn(7, 5).astype(np.float32))
+    ids = paddle.to_tensor(np.zeros((2, 3), np.int32))
+    assert list(F.embedding(ids, w).shape) == [2, 3, 5]
+
+
+def test_embedding_rejects_float_ids():
+    w = paddle.to_tensor(np.random.randn(10, 4).astype(np.float32))
+    bad = paddle.to_tensor(np.ones((3,), np.float32))
+    with pytest.raises(InvalidArgumentError, match="integer dtype"):
+        F.embedding(bad, w)
+
+
+def test_embedding_rejects_non_2d_weight():
+    """The r5 swap made THIS case pass and valid calls fail: a 2-D ids
+    batch looked like a 2-D table once the operands were crossed."""
+    w3 = paddle.to_tensor(np.ones((2, 3, 4), np.float32))
+    ids = paddle.to_tensor(np.array([0, 1], np.int64))
+    with pytest.raises(InvalidArgumentError, match="2-D"):
+        F.embedding(ids, w3)
+
+
+def test_embedding_grad_flows():
+    """The swapped validator rejected every valid eager embedding call,
+    so the grad tests were red — keep one here next to the validator."""
+    w = paddle.to_tensor(np.random.randn(6, 4).astype(np.float32),
+                         stop_gradient=False)
+    ids = paddle.to_tensor(np.array([1, 1, 5], np.int64))
+    out = F.embedding(ids, w)
+    out.sum().backward()
+    g = w.grad.numpy()
+    assert g[1].sum() == pytest.approx(8.0)   # two hits x 4 dims
+    assert g[0].sum() == pytest.approx(0.0)
+
+
+def test_matmul_rejects_mismatched_inner_dims():
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    y = paddle.to_tensor(np.ones((4, 5), np.float32))
+    with pytest.raises(InvalidArgumentError, match="width"):
+        paddle.matmul(x, y)
