@@ -26,6 +26,11 @@ smoke:
 	print(f'smoke: jitted llama step OK (loss {loss:.3f})'); \
 	ge.dryrun_multichip(2); \
 	print('smoke: multichip(2) OK')"
+	JAX_PLATFORMS=cpu $(PY) -m pytest -q -p no:cacheprovider \
+		tests/test_checkpoint_faults.py \
+		tests/test_checkpoint_shardwise.py \
+		tests/test_watchdog.py \
+		tests/test_dataloader_hardening.py
 
 # Fast lane — must be green before any snapshot commit (see README).
 test-fast:

@@ -44,6 +44,8 @@ from .api_tail import (  # noqa: F401
     shard_optimizer, shard_scaler, split, to_static,
 )
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from . import ckpt_commit  # noqa: F401
+from .ckpt_commit import CheckpointManager  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from . import io  # noqa: F401
 from . import launch  # noqa: F401

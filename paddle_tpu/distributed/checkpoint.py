@@ -8,10 +8,18 @@ global metadata index of ``LocalTensorMetadata`` (offsets per dist tensor);
 
 TPU-native: tensors are jax arrays that may carry a NamedSharding.  Each
 process writes its addressable shards as ``.npy`` with global offsets in
-``metadata.json``; load reads whatever shards exist, reassembles the
-requested region and ``device_put``s onto the target sharding — so a
+``metadata.json``; load is *shard-wise* — for every addressable shard of
+the target sharding only the intersecting ``.npy`` regions are read
+(memory-mapped, so peak host allocation ≈ shard bytes, never
+``global_shape`` bytes), then ``device_put`` onto the target — so a
 checkpoint written on one mesh loads onto any other (the reference's
 converter/dist_saver behavior).
+
+Crash safety is layered on top by ``ckpt_commit.CheckpointManager``
+(step-N.tmp → rank done markers → rename → COMMIT sentinel); this module
+provides the mechanics: fault-point-instrumented writes and an async
+save handle that *re-raises* worker failures instead of swallowing them
+in a daemon thread.
 """
 from __future__ import annotations
 
@@ -24,15 +32,67 @@ import numpy as np
 import jax
 
 from ..core.tensor import Tensor
+from ..testing import faults
 
 
 def _arr(v):
     return v._data if isinstance(v, Tensor) else v
 
 
+# -- async save handle -------------------------------------------------------
+
+class AsyncSaveHandle:
+    """Handle for a background save.
+
+    The worker runs on a NON-daemon thread (interpreter exit waits for
+    the write to finish instead of tearing the file mid-``np.save``) and
+    any exception is captured and re-raised from :meth:`result` — a
+    failing shard write surfaces in the caller, it does not vanish with
+    the thread.
+    """
+
+    def __init__(self, target, args=()):
+        self._exc = None
+
+        def _run():
+            try:
+                target(*args)
+            except BaseException as e:  # re-raised in result()
+                self._exc = e
+
+        self._thread = threading.Thread(target=_run, daemon=False,
+                                        name="paddle-tpu-ckpt-save")
+        self._thread.start()
+
+    def done(self):
+        return not self._thread.is_alive()
+
+    def result(self, timeout=None):
+        """Wait for the save; re-raise the worker's exception if any."""
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError(
+                f"checkpoint save still running after {timeout}s")
+        if self._exc is not None:
+            raise self._exc
+
+    # Thread-like aliases: pre-handle callers did `save_state_dict(...,
+    # async_save=True).join()` on the returned Thread; keep that working
+    # (now with error propagation).
+    def join(self, timeout=None):
+        self.result(timeout)
+
+    def is_alive(self):
+        return self._thread.is_alive()
+
+
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, async_save=False):
-    """Write {name: Tensor/array} as sharded files + metadata.json."""
+    """Write {name: Tensor/array} as sharded files + metadata.json.
+
+    With ``async_save=True`` returns an :class:`AsyncSaveHandle`; call
+    ``.result()`` to surface any write failure.
+    """
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
     meta = {"format": "paddle_tpu.dist_ckpt.v1", "tensors": {}}
@@ -63,25 +123,66 @@ def save_state_dict(state_dict, path, process_group=None,
                          np.asarray(shard.data)))
         meta["tensors"][name] = entry
 
+    meta_path = os.path.join(path, f"{rank}.metadata.json")
+
     def _write():
         for fpath, data in work:
-            np.save(fpath, data)
+            faults.fire("ckpt.shard_write", "before", path=fpath)
+            with open(fpath, "wb") as f:
+                np.save(f, data)
+                f.flush()
+                os.fsync(f.fileno())
+            faults.fire("ckpt.shard_write", "after", path=fpath)
         # EVERY rank writes its own metadata (it indexes only this rank's
         # addressable shards); load merges all *.metadata.json files.
-        with open(os.path.join(path, f"{rank}.metadata.json"), "w") as f:
+        faults.fire("ckpt.metadata", "before", path=meta_path)
+        with open(meta_path, "w") as f:
             json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        faults.fire("ckpt.metadata", "after", path=meta_path)
 
     if async_save:
-        t = threading.Thread(target=_write, daemon=True)
-        t.start()
-        return t
+        return AsyncSaveHandle(_write)
     _write()
 
 
-def load_state_dict(state_dict, path, process_group=None,
-                    coordinator_rank=0, offload=False):
-    """Fill ``state_dict``'s tensors in place from a checkpoint dir,
-    resharding to each tensor's current sharding."""
+# -- load --------------------------------------------------------------------
+
+class LoadStats:
+    """Host-allocation accounting for one ``load_state_dict`` call.
+
+    ``peak_buffer_bytes`` is the largest single assembly buffer
+    materialized — the shard-wise-load done bar asserts it stays ≈ shard
+    bytes on sharded targets, not ``global_shape`` bytes.
+    """
+
+    def __init__(self):
+        self.peak_buffer_bytes = 0
+        self.total_read_bytes = 0
+        self.regions = 0
+
+    def record(self, nbytes):
+        self.regions += 1
+        self.total_read_bytes += nbytes
+        self.peak_buffer_bytes = max(self.peak_buffer_bytes, nbytes)
+
+
+_last_load_stats = None
+
+
+def last_load_stats():
+    """Stats of the most recent ``load_state_dict`` (None before any)."""
+    return _last_load_stats
+
+
+def _np_dtype(name):
+    if name == "bfloat16":
+        return np.dtype(jax.numpy.bfloat16)
+    return np.dtype(name)
+
+
+def _merge_metadata(path):
     metas = [f for f in os.listdir(path) if f.endswith("metadata.json")]
     if not metas:
         raise FileNotFoundError(f"no metadata.json under {path}")
@@ -96,37 +197,179 @@ def load_state_dict(state_dict, path, process_group=None,
                     for s in entry["shards"]:
                         if tuple(s["offsets"]) not in seen:
                             merged[name]["shards"].append(s)
+                            seen.add(tuple(s["offsets"]))
                 else:
                     merged[name] = entry
+    return merged
 
-    missing = []
-    for name, target in state_dict.items():
-        if name not in merged:
-            missing.append(name)
+
+def _check_coverage(name, entry):
+    """Verify the union of saved shard boxes covers the full global
+    extent — BEFORE any target tensor is touched, so a checkpoint with a
+    hole (e.g. a rank's shards lost) fails cleanly instead of filling
+    part of the state with zeros."""
+    gshape = entry["global_shape"]
+    shards = entry["shards"]
+    if not shards:
+        raise ValueError(f"checkpoint entry '{name}' has no shards")
+    if not gshape:
+        return  # scalar: any shard is full coverage
+    boxes = [tuple((o, o + l) for o, l in zip(s["offsets"], s["lengths"]))
+             for s in shards]
+    # Coordinate compression: candidate cells are the grid of all shard
+    # start/stop coords; every cell midpoint must land in some box.
+    coords = []
+    ncells = 1
+    for d, g in enumerate(gshape):
+        cs = {0, g}
+        for b in boxes:
+            cs.add(max(0, min(b[d][0], g)))
+            cs.add(max(0, min(b[d][1], g)))
+        cs = sorted(cs)
+        coords.append(cs)
+        ncells *= max(1, len(cs) - 1)
+    if ncells > 65536:
+        # Degenerate many-shard case: fall back to a volume lower bound
+        # (exact per-cell check would be quadratic-ish).
+        vol = sum(int(np.prod([b[d][1] - b[d][0]
+                               for d in range(len(gshape))]))
+                  for b in boxes)
+        if vol < int(np.prod(gshape)):
+            raise ValueError(
+                f"checkpoint entry '{name}' does not cover its global "
+                f"shape {gshape} (shard volume {vol})")
+        return
+    import itertools
+
+    for cell in itertools.product(*[range(len(c) - 1) for c in coords]):
+        mid = [coords[d][i] for d, i in enumerate(cell)]
+        hi = [coords[d][i + 1] for d, i in enumerate(cell)]
+        if any(m >= h for m, h in zip(mid, hi)):
             continue
+        if not any(all(b[d][0] <= mid[d] and hi[d] <= b[d][1]
+                       for d in range(len(gshape))) for b in boxes):
+            raise ValueError(
+                f"checkpoint entry '{name}' does not cover region "
+                f"{[(m, h) for m, h in zip(mid, hi)]} of global shape "
+                f"{gshape} — torn or partial checkpoint?")
+
+
+def _read_region(path, entry, region, stats):
+    """Assemble one rectangular region of a tensor from the shard files
+    that intersect it.  Files are memory-mapped; only the intersection
+    bytes are copied, so peak host allocation ≈ region bytes."""
+    dtype = _np_dtype(entry["dtype"])
+    shape = tuple(r.stop - r.start for r in region)
+    buf = np.zeros(shape, dtype)
+    stats.record(buf.nbytes if buf.nbytes else dtype.itemsize)
+    for shard in entry["shards"]:
+        offs, lens = shard["offsets"], shard["lengths"]
+        inter = []
+        empty = False
+        for r, o, l in zip(region, offs, lens):
+            lo, hi = max(r.start, o), min(r.stop, o + l)
+            if lo >= hi:
+                empty = True
+                break
+            inter.append((lo, hi))
+        if empty and region:
+            continue
+        fpath = os.path.join(path, shard["file"])
+        try:
+            mm = np.load(fpath, mmap_mode="r", allow_pickle=False)
+        except (ValueError, OSError):
+            # Some dtypes (or exotic filesystems) refuse to mmap; fall
+            # back to a full read of this one shard file.
+            mm = np.load(fpath, allow_pickle=False)
+        if not region:  # scalar
+            buf[()] = np.asarray(mm).view(dtype).reshape(())
+            del mm
+            break
+        src = tuple(slice(lo - o, hi - o)
+                    for (lo, hi), o in zip(inter, offs))
+        dst = tuple(slice(lo - r.start, hi - r.start)
+                    for (lo, hi), r in zip(inter, region))
+        piece = np.asarray(mm[src])
+        if piece.dtype != dtype:
+            # bf16 round-trips through .npy as raw void bytes ('|V2');
+            # reinterpret instead of casting.
+            if piece.dtype.itemsize == dtype.itemsize:
+                piece = piece.view(dtype)
+            else:
+                piece = piece.astype(dtype)
+        buf[dst] = piece
+        del mm
+    return buf
+
+
+def _validate(state_dict, merged):
+    """Every requested name must exist, match shape, and be fully
+    covered by shards — checked before ANY tensor is mutated, so a
+    failed load leaves ``state_dict`` untouched."""
+    missing = [name for name in state_dict if name not in merged]
+    if missing:
+        raise KeyError(f"checkpoint missing tensors: {missing[:5]}"
+                       f"{'...' if len(missing) > 5 else ''}")
+    for name, target in state_dict.items():
         entry = merged[name]
-        full = np.zeros(entry["global_shape"],
-                        np.dtype(entry["dtype"])
-                        if entry["dtype"] != "bfloat16"
-                        else jax.numpy.bfloat16)
-        for shard in entry["shards"]:
-            data = np.load(os.path.join(path, shard["file"]),
-                           allow_pickle=False)
-            idx = tuple(slice(o, o + l) for o, l in
-                        zip(shard["offsets"], shard["lengths"]))
-            full[idx] = data
         arr = _arr(target)
-        if isinstance(arr, jax.Array) and hasattr(arr, "sharding") \
-                and arr.sharding is not None:
-            new = jax.device_put(jax.numpy.asarray(full, arr.dtype),
-                                 arr.sharding)
+        tshape = tuple(getattr(arr, "shape", ()) or ())
+        gshape = tuple(entry["global_shape"])
+        if hasattr(arr, "shape") and tshape != gshape:
+            raise ValueError(
+                f"shape mismatch for '{name}': checkpoint has "
+                f"{list(gshape)}, target has {list(tshape)}")
+        _check_coverage(name, entry)
+
+
+def load_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, offload=False):
+    """Fill ``state_dict``'s tensors in place from a checkpoint dir,
+    resharding to each tensor's current sharding.
+
+    Shard-wise: for a target carrying a NamedSharding, each addressable
+    shard region is assembled independently from the intersecting saved
+    shard files (memory-mapped reads), so peak host allocation stays
+    ≈ shard bytes.  All names/shapes/coverage are validated *before*
+    anything is written — a failing load never half-applies.
+    """
+    global _last_load_stats
+    merged = _merge_metadata(path)
+    _validate(state_dict, merged)
+
+    stats = LoadStats()
+    for name, target in state_dict.items():
+        entry = merged[name]
+        gshape = tuple(entry["global_shape"])
+        arr = _arr(target)
+        sharding = getattr(arr, "sharding", None) \
+            if isinstance(arr, jax.Array) else None
+        if sharding is not None and gshape:
+            tdtype = arr.dtype
+
+            def _cb(index, entry=entry, gshape=gshape, tdtype=tdtype):
+                region = tuple(
+                    slice(s.start or 0,
+                          s.stop if s.stop is not None else g)
+                    for s, g in zip(index, gshape))
+                piece = _read_region(path, entry, region, stats)
+                if piece.dtype != np.dtype(tdtype):
+                    piece = piece.astype(tdtype)
+                return piece
+
+            new = jax.make_array_from_callback(gshape, sharding, _cb)
         else:
-            new = jax.numpy.asarray(full)
+            region = tuple(slice(0, g) for g in gshape)
+            full = _read_region(path, entry, region, stats)
+            if isinstance(arr, jax.Array):
+                new = jax.device_put(
+                    jax.numpy.asarray(full, arr.dtype),
+                    sharding if sharding is not None else None)
+            else:
+                new = jax.numpy.asarray(full)
         if isinstance(target, Tensor):
             target._data = new
         else:
             state_dict[name] = new
-    if missing:
-        raise KeyError(f"checkpoint missing tensors: {missing[:5]}"
-                       f"{'...' if len(missing) > 5 else ''}")
+    _last_load_stats = stats
     return state_dict

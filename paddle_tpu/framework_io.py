@@ -33,8 +33,24 @@ def save(obj, path, protocol=4, **configs):
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+    # Atomic: pickle into a temp file IN the target dir (same
+    # filesystem, so the rename is atomic), fsync, then os.replace — a
+    # crash at any instant leaves either the old file or the new one,
+    # never a torn .pdparams (the per-rank elastic-restart checkpoints
+    # ride on this).
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def load(path, **configs):

@@ -112,14 +112,20 @@ class ModelCheckpoint(Callback):
         self.save_freq = save_freq
         self.save_dir = save_dir
 
+    def _save(self, path):
+        from ..testing import faults
+
+        faults.fire("hapi.save", "before", path=path)
+        self.model.save(path)
+        faults.fire("hapi.save", "after", path=path)
+
     def on_epoch_end(self, epoch, logs=None):
         if self.save_dir and epoch % self.save_freq == 0:
-            path = os.path.join(self.save_dir, str(epoch))
-            self.model.save(path)
+            self._save(os.path.join(self.save_dir, str(epoch)))
 
     def on_train_end(self, logs=None):
         if self.save_dir:
-            self.model.save(os.path.join(self.save_dir, "final"))
+            self._save(os.path.join(self.save_dir, "final"))
 
 
 class EarlyStopping(Callback):

@@ -329,21 +329,52 @@ def default_collate_fn(batch):
     return batch
 
 
+class DataLoaderWorkerError(RuntimeError):
+    """A pool worker failed (or timed out) while producing one batch;
+    the message names the batch indices so the bad sample is findable
+    (reference worker.py wraps worker exceptions the same way)."""
+
+    def __init__(self, indices, cause, timed_out=False):
+        self.indices = list(indices)
+        self.timed_out = timed_out
+        what = ("timed out" if timed_out
+                else f"raised {type(cause).__name__}: {cause}")
+        super().__init__(
+            f"DataLoader worker {what} while fetching batch indices "
+            f"{self.indices}")
+        self.__cause__ = cause
+
+
 def _worker_fn(dataset, indices, collate_fn):
+    from ..testing import faults
+
+    faults.fire("io.worker", "before")
     batch = [dataset[i] for i in indices]
-    return collate_fn(batch)
+    out = collate_fn(batch)
+    faults.fire("io.worker", "after")
+    return out
 
 
 class _MPWorkerIter:
     """Multiprocess prefetch iterator (reference: _DataLoaderIterMultiProcess
-    dataloader_iter.py:370 — index queue -> worker pool -> ordered results)."""
+    dataloader_iter.py:370 — index queue -> worker pool -> ordered results).
+
+    Hardened: result waits honor the loader's ``timeout`` (a worker
+    killed mid-batch turns into a ``DataLoaderWorkerError`` naming the
+    batch indices instead of an eternal hang — a hard-killed pool
+    worker's task never completes); worker exceptions are wrapped the
+    same way; and with ``persistent_workers=True`` the pool is owned by
+    the DataLoader and reused across epochs."""
 
     def __init__(self, loader):
         self.loader = loader
-        self.pool = mp.get_context("fork").Pool(loader.num_workers)
+        self.persistent = loader.persistent_workers
+        self.pool = loader._acquire_pool()
+        self.timeout = loader.timeout if loader.timeout else None
         self.batches = iter(loader.batch_sampler)
-        self.pending = []
+        self.pending = []  # (AsyncResult, indices)
         self.prefetch = max(2 * loader.num_workers, 2)
+        self._finished = False
         self._prime()
 
     def _prime(self):
@@ -357,21 +388,52 @@ class _MPWorkerIter:
             return
         ds = self.loader.dataset
         cf = self.loader.collate_fn or default_collate_fn
-        self.pending.append(self.pool.apply_async(_worker_fn,
-                                                  (ds, indices, cf)))
+        self.pending.append(
+            (self.pool.apply_async(_worker_fn, (ds, indices, cf)),
+             list(indices)))
 
     def __next__(self):
         if not self.pending:
-            self.pool.close()
+            self._finish()
             raise StopIteration
-        result = self.pending.pop(0).get()
+        result, indices = self.pending.pop(0)
+        try:
+            batch = result.get(self.timeout)
+        except mp.TimeoutError as e:
+            self._abort()
+            raise DataLoaderWorkerError(indices, e, timed_out=True) \
+                from e
+        except Exception as e:
+            self._abort()
+            raise DataLoaderWorkerError(indices, e) from e
         self._submit()
-        return result
+        return batch
+
+    def _finish(self):
+        """Normal exhaustion: release (persistent) or retire the pool."""
+        if self._finished:
+            return
+        self._finished = True
+        if not self.persistent:
+            self.pool.close()
+
+    def _abort(self):
+        """A worker died or hung: the pool state is suspect, tear it
+        down (a persistent loader re-forks a fresh pool next epoch)."""
+        self._finished = True
+        try:
+            self.pool.terminate()
+        except Exception:
+            pass
+        if self.persistent:
+            self.loader._release_pool(self.pool)
 
     def __iter__(self):
         return self
 
     def __del__(self):
+        if self._finished or self.persistent:
+            return
         try:
             self.pool.terminate()
         except Exception:
@@ -392,6 +454,9 @@ class DataLoader:
         self.collate_fn = collate_fn
         self.num_workers = num_workers
         self.return_list = return_list
+        self.timeout = timeout
+        self.persistent_workers = persistent_workers
+        self._pool = None
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
         elif isinstance(dataset, IterableDataset):
@@ -402,6 +467,26 @@ class DataLoader:
             self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
                                               batch_size=batch_size,
                                               drop_last=drop_last)
+
+    def _acquire_pool(self):
+        if not self.persistent_workers:
+            return mp.get_context("fork").Pool(self.num_workers)
+        if self._pool is None:
+            self._pool = mp.get_context("fork").Pool(self.num_workers)
+        return self._pool
+
+    def _release_pool(self, pool):
+        """Drop a broken persistent pool so the next epoch re-forks."""
+        if self._pool is pool:
+            self._pool = None
+
+    def __del__(self):
+        pool, self._pool = getattr(self, "_pool", None), None
+        if pool is not None:
+            try:
+                pool.terminate()
+            except Exception:
+                pass
 
     def __iter__(self):
         if self.batch_sampler is None:

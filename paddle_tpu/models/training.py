@@ -426,7 +426,11 @@ class CompiledTrainStep:
     def step(self, *batch):
         from ..core.tensor import Tensor
         from ..optimizer.lr import LRScheduler
+        from ..testing import faults
 
+        # Host-boundary fault point: kill-and-resume tests arm this to
+        # preempt the train loop between (not inside) XLA dispatches.
+        faults.fire("train.step", "before")
         self._t += 1
         if isinstance(self.lr, LRScheduler):
             lr_val = float(self.lr())
@@ -443,6 +447,7 @@ class CompiledTrainStep:
             (self.params, self._master, self._m, self._v, loss) = \
                 self._step(self.params, self._master, self._m, self._v,
                            jnp.asarray(self._t, jnp.float32), lr_val, *batch)
+        faults.fire("train.step", "after")
         return loss
 
     def sync_to_model(self):

@@ -1,0 +1,8 @@
+"""paddle_tpu.testing — deterministic test harnesses.
+
+``faults`` is the fault-injection harness threaded through the
+checkpoint/commit path, the DataLoader worker loop and the train step
+(see ``faults.py`` for the ``PT_FAULTS`` grammar).
+"""
+from . import faults  # noqa: F401
+from .faults import InjectedFault  # noqa: F401

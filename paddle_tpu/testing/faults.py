@@ -1,0 +1,174 @@
+"""Deterministic fault injection for crash-safety tests.
+
+Production code is threaded with *named fault points* —
+``faults.fire("ckpt.shard_write", "after", path=...)`` — that are inert
+unless armed.  Arming is either declarative via the ``PT_FAULTS``
+environment variable (survives fork/exec into launch trainers and
+DataLoader pool workers) or programmatic via :func:`arm` (in-process
+tests).
+
+Grammar (comma-separated specs)::
+
+    PT_FAULTS="point:phase:nth=action[:arg][,point:phase:nth=action...]"
+
+    point   registered dotted name (see REGISTERED)
+    phase   before | after              (site-relative)
+    nth     1-based hit count at which the fault fires, or * (every hit)
+    action  crash          os._exit(EXIT_CODE) — a hard kill, exactly
+                           what a preemption looks like to the survivors
+            raise          raise InjectedFault (exercises error
+                           propagation, e.g. async-save handles)
+            truncate       truncate the file at the site's ``path`` to
+                           half its bytes, then os._exit — a torn write
+            delay:SECS     sleep SECS (default 0.05) and continue
+
+Example: ``PT_FAULTS="ckpt.shard_write:after:2=crash"`` kills the
+process right after the second shard file hits disk — mid-save, before
+metadata or commit.  Counters are per-process and per-spec, so a forked
+DataLoader worker counts its own hits (deterministic per worker).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+#: exit status used by ``crash``/``truncate`` so tests can tell an
+#: injected kill from an organic failure.
+EXIT_CODE = 53
+
+#: every fault point threaded through the codebase; firing or arming an
+#: unknown name is an error (typos must not silently never fire).
+REGISTERED = {
+    "ckpt.shard_write": "each sharded .npy write in save_state_dict "
+                        "(before=pre-write, after=file on disk)",
+    "ckpt.metadata": "the per-rank metadata.json write",
+    "ckpt.commit": "CheckpointManager commit (before=pre-rename, "
+                   "after=renamed but COMMIT sentinel not yet written)",
+    "io.worker": "DataLoader pool worker around one batch fetch",
+    "train.step": "CompiledTrainStep.step host boundary",
+    "hapi.save": "hapi ModelCheckpoint save",
+}
+
+_PHASES = ("before", "after")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by the ``raise`` action."""
+
+
+class _Spec:
+    __slots__ = ("point", "phase", "nth", "action", "arg", "hits")
+
+    def __init__(self, point, phase, nth, action, arg=None):
+        if point not in REGISTERED:
+            raise ValueError(
+                f"unknown fault point {point!r}; registered: "
+                f"{sorted(REGISTERED)}")
+        if phase not in _PHASES:
+            raise ValueError(f"fault phase must be one of {_PHASES}, "
+                             f"got {phase!r}")
+        if action not in ("crash", "raise", "truncate", "delay"):
+            raise ValueError(f"unknown fault action {action!r}")
+        self.point = point
+        self.phase = phase
+        self.nth = nth  # int (1-based) or "*"
+        self.action = action
+        self.arg = arg
+        self.hits = 0
+
+
+_lock = threading.Lock()
+_specs = None  # lazily parsed; None = not yet read from env
+
+
+def _parse(text):
+    specs = []
+    for part in filter(None, (p.strip() for p in text.split(","))):
+        try:
+            site, action = part.split("=", 1)
+            point, phase, nth = site.split(":")
+        except ValueError:
+            raise ValueError(
+                f"bad PT_FAULTS spec {part!r}; expected "
+                "'point:phase:nth=action[:arg]'") from None
+        arg = None
+        if ":" in action:
+            action, arg = action.split(":", 1)
+        specs.append(_Spec(point, phase,
+                           "*" if nth == "*" else int(nth), action, arg))
+    return specs
+
+
+def _ensure_loaded():
+    global _specs
+    if _specs is None:
+        _specs = _parse(os.environ.get("PT_FAULTS", ""))
+    return _specs
+
+
+def reset(spec_text=None):
+    """Re-arm from ``spec_text`` (or the current ``PT_FAULTS`` env when
+    None), zeroing all hit counters.  Tests call this between cases."""
+    global _specs
+    with _lock:
+        if spec_text is None:
+            spec_text = os.environ.get("PT_FAULTS", "")
+        _specs = _parse(spec_text)
+    return _specs
+
+
+def arm(point, phase="before", nth=1, action="raise", arg=None):
+    """Programmatically add one armed spec (in-process tests)."""
+    with _lock:
+        _ensure_loaded()
+        spec = _Spec(point, phase, nth, action, arg)
+        _specs.append(spec)
+    return spec
+
+
+def disarm_all():
+    global _specs
+    with _lock:
+        _specs = []
+
+
+def _trip(spec, path):
+    if spec.action == "delay":
+        time.sleep(float(spec.arg) if spec.arg is not None else 0.05)
+        return
+    if spec.action == "raise":
+        raise InjectedFault(
+            f"injected fault at {spec.point}:{spec.phase} "
+            f"(hit {spec.hits})")
+    if spec.action == "truncate" and path and os.path.exists(path):
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size // 2)
+    # crash / truncate: hard kill, no atexit, no flush — the point is
+    # that survivors must cope with exactly this.
+    os._exit(EXIT_CODE)
+
+
+def fire(point, phase, path=None):
+    """Hit the fault point; no-op unless an armed spec matches."""
+    specs = _specs if _specs is not None else _ensure_loaded()
+    if not specs:
+        return
+    assert point in REGISTERED, f"unregistered fault point {point!r}"
+    tripped = None
+    with _lock:
+        for spec in specs:
+            if spec.point != point or spec.phase != phase:
+                continue
+            spec.hits += 1
+            if spec.nth == "*" or spec.hits == spec.nth:
+                tripped = spec
+                break
+    if tripped is not None:
+        _trip(tripped, path)
+
+
+def registered_points():
+    """Names usable in specs — the property test iterates these."""
+    return sorted(REGISTERED)

@@ -1,0 +1,317 @@
+"""Crash-safe checkpoint property tests.
+
+For EVERY registered checkpoint fault point, a subprocess saves step 2
+with a ``crash`` fault armed (a real ``os._exit`` mid-save) and the
+parent then proves the commit protocol's invariant: the last COMMITTED
+step (saved before the crash) reloads bit-exactly — parameters and
+optimizer state — and no ``step-N/`` directory without the COMMIT
+sentinel is ever selected.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle  # noqa: F401  (conftest sets the 8-dev mesh)
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed.ckpt_commit import (
+    CheckpointManager, committed_steps, latest_step)
+from paddle_tpu.testing import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm_all()
+    yield
+    faults.disarm_all()
+
+
+def _state(step):
+    rng = np.random.RandomState(step)
+    return {
+        "w": rng.randn(4, 6).astype(np.float32),
+        "opt_m": rng.randn(4, 6).astype(np.float32),
+        "opt_v": rng.randn(4, 6).astype(np.float32),
+    }
+
+
+def _assert_state_equal(loaded, step):
+    want = _state(step)
+    for k, v in want.items():
+        np.testing.assert_array_equal(np.asarray(loaded[k]), v)
+
+
+_CRASH_CHILD = r"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, sys.argv[1])
+import numpy as np
+from paddle_tpu.distributed.ckpt_commit import CheckpointManager
+from paddle_tpu.testing import faults
+
+root, spec = sys.argv[2], sys.argv[3]
+rng = np.random.RandomState(2)
+state = {"w": rng.randn(4, 6).astype(np.float32),
+         "opt_m": rng.randn(4, 6).astype(np.float32),
+         "opt_v": rng.randn(4, 6).astype(np.float32)}
+faults.reset(spec)
+mgr = CheckpointManager(root, keep_last_k=None, world_size=1, rank=0)
+mgr.save(state, 2)
+print("SURVIVED")  # fault never fired -> parent fails the test
+"""
+
+_CKPT_FAULT_SPECS = [
+    "ckpt.shard_write:before:1=crash",
+    "ckpt.shard_write:after:2=crash",
+    "ckpt.shard_write:after:1=truncate",
+    "ckpt.metadata:before:1=crash",
+    "ckpt.metadata:after:1=crash",
+    "ckpt.commit:before:1=crash",
+    "ckpt.commit:after:1=crash",  # renamed but COMMIT never written
+]
+
+
+def test_every_ckpt_fault_point_is_covered():
+    """The spec list above must exercise every registered ckpt.* point
+    (the acceptance bar), so adding a fault point forces a new case."""
+    pts = {s.split(":")[0] for s in _CKPT_FAULT_SPECS}
+    assert pts == {p for p in faults.registered_points()
+                   if p.startswith("ckpt.")}
+
+
+@pytest.mark.parametrize("spec", _CKPT_FAULT_SPECS)
+def test_crash_mid_save_recovers_last_committed_step(tmp_path, spec):
+    root = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(root, keep_last_k=None, world_size=1, rank=0)
+    mgr.save(_state(1), 1)
+    assert mgr.committed_steps() == [1]
+
+    env = dict(os.environ)
+    env.pop("PT_FAULTS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, "-c", _CRASH_CHILD, REPO, root, spec],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert res.returncode == faults.EXIT_CODE, (
+        f"fault {spec} did not kill the child "
+        f"(rc={res.returncode}):\n{res.stdout}\n{res.stderr}")
+    assert "SURVIVED" not in res.stdout
+
+    # Invariant: only step 1 is committed and it reloads bit-exactly.
+    assert latest_step(root) == 1
+    assert committed_steps(root) == [1]
+    loaded = {k: np.zeros_like(v) for k, v in _state(1).items()}
+    got = CheckpointManager(root, world_size=1, rank=0).load(loaded)
+    assert got == 1
+    _assert_state_equal(loaded, 1)
+    # A step-2 dir may exist (kill after rename) but must be sentinel-
+    # less and therefore never selectable.
+    step2 = os.path.join(root, "step-2")
+    if os.path.isdir(step2):
+        assert not os.path.exists(os.path.join(step2, "COMMIT"))
+
+
+def test_uncommitted_dir_is_never_selected(tmp_path):
+    root = str(tmp_path / "ckpt")
+    os.makedirs(os.path.join(root, "step-7"))
+    with open(os.path.join(root, "step-7", "0.metadata.json"), "w") as f:
+        json.dump({"tensors": {}}, f)
+    assert latest_step(root) is None
+    mgr = CheckpointManager(root, world_size=1, rank=0)
+    with pytest.raises(FileNotFoundError):
+        mgr.load({"w": np.zeros((2, 2), np.float32)})
+
+
+def test_async_save_surfaces_worker_error(tmp_path):
+    faults.arm("ckpt.shard_write", phase="before", nth=1, action="raise")
+    h = ckpt.save_state_dict({"w": np.ones((3, 3), np.float32)},
+                             str(tmp_path / "d"), async_save=True)
+    with pytest.raises(faults.InjectedFault):
+        h.result()
+    assert h.done()
+
+
+def test_async_save_handle_is_nondaemon_and_joinable(tmp_path):
+    faults.arm("ckpt.metadata", phase="before", nth=1, action="delay",
+               arg="0.2")
+    h = ckpt.save_state_dict({"w": np.ones((3, 3), np.float32)},
+                             str(tmp_path / "d"), async_save=True)
+    assert not h._thread.daemon
+    h.result(timeout=10)
+    assert h.done()
+
+
+def test_manager_async_save_and_overlap_guard(tmp_path):
+    root = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(root, world_size=1, rank=0)
+    faults.arm("ckpt.metadata", phase="before", nth=1, action="delay",
+               arg="0.3")
+    h1 = mgr.save(_state(1), 1, async_save=True)
+    # The overlap guard joins (and error-checks) the in-flight save
+    # before starting the next one.
+    mgr.save(_state(2), 2)
+    assert h1.done()
+    assert mgr.committed_steps() == [1, 2]
+    loaded = {k: np.zeros_like(v) for k, v in _state(2).items()}
+    mgr.load(loaded, step=2)
+    _assert_state_equal(loaded, 2)
+
+
+def test_manager_async_error_surfaces_on_next_save(tmp_path):
+    root = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(root, world_size=1, rank=0)
+    faults.arm("ckpt.shard_write", phase="before", nth=1, action="raise")
+    mgr.save(_state(1), 1, async_save=True)
+    with pytest.raises(faults.InjectedFault):
+        mgr.save(_state(2), 2)  # overlap guard re-raises worker failure
+    assert mgr.committed_steps() == []
+
+
+def test_keep_last_k_retention(tmp_path):
+    root = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(root, keep_last_k=2, world_size=1, rank=0)
+    for step in range(1, 6):
+        mgr.save(_state(step), step)
+    assert mgr.committed_steps() == [4, 5]
+    # pruning never removes the newest committed step
+    loaded = {k: np.zeros_like(v) for k, v in _state(5).items()}
+    assert mgr.load(loaded) == 5
+    _assert_state_equal(loaded, 5)
+
+
+def test_save_committed_step_is_noop(tmp_path):
+    root = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(root, world_size=1, rank=0)
+    mgr.save(_state(1), 1)
+    h = mgr.save(_state(2), 1)  # step 1 already committed
+    h.result()
+    loaded = {k: np.zeros_like(v) for k, v in _state(1).items()}
+    mgr.load(loaded, step=1)
+    _assert_state_equal(loaded, 1)  # original content kept
+
+
+def test_load_missing_name_leaves_state_untouched(tmp_path):
+    path = str(tmp_path / "d")
+    ckpt.save_state_dict({"present": np.ones((2, 2), np.float32)}, path)
+    target = {"present": np.zeros((2, 2), np.float32),
+              "absent": np.zeros((3,), np.float32)}
+    with pytest.raises(KeyError, match="absent"):
+        ckpt.load_state_dict(target, path)
+    # validation failed BEFORE any fill: 'present' was not mutated
+    np.testing.assert_array_equal(target["present"],
+                                  np.zeros((2, 2), np.float32))
+
+
+def test_load_shape_mismatch_leaves_state_untouched(tmp_path):
+    path = str(tmp_path / "d")
+    ckpt.save_state_dict({"w": np.ones((2, 2), np.float32)}, path)
+    target = {"w": np.zeros((4, 4), np.float32)}
+    with pytest.raises(ValueError, match="shape mismatch"):
+        ckpt.load_state_dict(target, path)
+    np.testing.assert_array_equal(target["w"],
+                                  np.zeros((4, 4), np.float32))
+
+
+def test_load_coverage_hole_detected_before_fill(tmp_path):
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.auto_parallel import ProcessMesh, Shard
+
+    mesh = ProcessMesh(shape=[8], dim_names=["mp"])
+    x = paddle.to_tensor(np.arange(64, dtype=np.float32).reshape(8, 8))
+    sharded = dist.shard_tensor(x, mesh, [Shard(0)])
+    path = str(tmp_path / "d")
+    ckpt.save_state_dict({"w": sharded}, path)
+    # Tear a hole: drop one shard from the metadata index.
+    meta_path = os.path.join(path, "0.metadata.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    assert len(meta["tensors"]["w"]["shards"]) == 8
+    del meta["tensors"]["w"]["shards"][3]
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+
+    target = {"w": np.full((8, 8), 7.0, np.float32)}
+    with pytest.raises(ValueError, match="does not cover"):
+        ckpt.load_state_dict(target, path)
+    np.testing.assert_array_equal(target["w"],
+                                  np.full((8, 8), 7.0, np.float32))
+
+
+_SIGTERM_CHILD = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, sys.argv[1])
+import numpy as np
+from paddle_tpu.distributed.ckpt_commit import CheckpointManager
+from paddle_tpu.testing import faults
+
+root = sys.argv[2]
+
+def state(step):
+    rng = np.random.RandomState(step)
+    return {"w": rng.randn(4, 6).astype(np.float32),
+            "opt_m": rng.randn(4, 6).astype(np.float32),
+            "opt_v": rng.randn(4, 6).astype(np.float32)}
+
+mgr = CheckpointManager(root, keep_last_k=None, world_size=1, rank=0)
+mgr.save(state(1), 1)
+# slow async save of step 2 so SIGTERM lands while it is in flight
+faults.reset("ckpt.metadata:before:1=delay:0.8")
+mgr.save(state(2), 2, async_save=True)
+mgr.install_preemption_hook(lambda: state(3), lambda: 3)
+print("READY", flush=True)
+while True:
+    time.sleep(0.05)
+"""
+
+
+def test_sigterm_preemption_commits_final_checkpoint(tmp_path):
+    root = str(tmp_path / "ckpt")
+    env = dict(os.environ)
+    env.pop("PT_FAULTS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SIGTERM_CHILD, REPO, root],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        line = proc.stdout.readline()
+        assert "READY" in line, line
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == 0, proc.stderr.read()
+    # the in-flight step-2 save finished AND the final step-3 committed
+    assert committed_steps(root) == [1, 2, 3]
+    loaded = {k: np.zeros_like(v) for k, v in _state(3).items()}
+    CheckpointManager(root, world_size=1, rank=0).load(loaded)
+    _assert_state_equal(loaded, 3)
+
+
+def test_commit_barrier_times_out_naming_missing_ranks(tmp_path):
+    from paddle_tpu.distributed.watchdog import CommWatchdog
+
+    root = str(tmp_path / "ckpt")
+    wd = CommWatchdog(timeout=0.15, abort=False, world_size=2, rank=0)
+    mgr = CheckpointManager(root, world_size=2, rank=0,
+                            barrier_timeout=0.5, watchdog=wd)
+    with pytest.raises(RuntimeError,
+                       match=r"missing done markers: \[1\]"):
+        mgr.save({"w": np.ones((2, 2), np.float32)}, 1)
+    # the barrier wait ran under CommWatchdog.task and it fired
+    deadline = time.time() + 2.0
+    while not wd.fired and time.time() < deadline:
+        time.sleep(0.01)
+    assert wd.fired and "ckpt commit barrier step-1" in wd.fired[0][0]
+    assert mgr.committed_steps() == []

@@ -1,0 +1,118 @@
+"""DataLoader worker hardening (ROADMAP item / ISSUE 2 satellite):
+timeouts honored, worker failures wrapped in an error NAMING the batch
+indices (no eternal hang when a worker is hard-killed mid-epoch), and
+pool reuse across epochs with ``persistent_workers=True``.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import DataLoader, DataLoaderWorkerError, Dataset
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("PT_FAULTS", raising=False)
+    faults.disarm_all()
+    yield
+    faults.disarm_all()
+
+
+class _ArrDataset(Dataset):
+    def __init__(self, n=24):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, idx):
+        return np.full((3,), idx, np.float32)
+
+
+class _BadIndexDataset(_ArrDataset):
+    def __getitem__(self, idx):
+        if idx == 7:
+            raise ValueError("sample 7 is corrupt")
+        return super().__getitem__(idx)
+
+
+class _SlowIndexDataset(_ArrDataset):
+    def __getitem__(self, idx):
+        if idx == 5:
+            time.sleep(2.0)
+        return super().__getitem__(idx)
+
+
+class _PidDataset(_ArrDataset):
+    def __getitem__(self, idx):
+        return np.asarray([os.getpid()], np.int64)
+
+
+def test_worker_exception_names_failing_batch_indices():
+    loader = DataLoader(_BadIndexDataset(), batch_size=4, num_workers=2)
+    with pytest.raises(DataLoaderWorkerError) as ei:
+        list(loader)
+    assert 7 in ei.value.indices
+    assert "7" in str(ei.value) and "ValueError" in str(ei.value)
+    assert isinstance(ei.value.__cause__, ValueError)
+
+
+def test_timeout_honored_instead_of_hang():
+    loader = DataLoader(_SlowIndexDataset(), batch_size=4,
+                        num_workers=2, timeout=0.3)
+    t0 = time.time()
+    with pytest.raises(DataLoaderWorkerError) as ei:
+        list(loader)
+    assert time.time() - t0 < 5.0
+    assert ei.value.timed_out
+    assert 5 in ei.value.indices
+
+
+def test_worker_killed_mid_epoch_raises_named_error_not_hang():
+    # Arm a real kill (os._exit) on each worker's SECOND batch; the
+    # lost tasks must surface as a named-index error via the timeout,
+    # not an eternal .get().
+    faults.reset("io.worker:before:2=crash")
+    loader = DataLoader(_ArrDataset(n=32), batch_size=2,
+                        num_workers=2, timeout=1.5)
+    t0 = time.time()
+    with pytest.raises(DataLoaderWorkerError) as ei:
+        list(loader)
+    assert time.time() - t0 < 20.0
+    assert ei.value.indices  # the failing batch is named
+    assert "batch indices" in str(ei.value)
+
+
+def test_persistent_workers_reuse_pool_across_epochs():
+    loader = DataLoader(_PidDataset(n=8), batch_size=2, num_workers=2,
+                        persistent_workers=True)
+    epoch1 = {int(b.numpy().ravel()[0]) for b in loader}
+    pool1 = loader._pool
+    assert pool1 is not None
+    epoch2 = {int(b.numpy().ravel()[0]) for b in loader}
+    assert loader._pool is pool1  # same pool object
+    assert epoch1 == epoch2  # literally the same worker processes
+    del loader
+
+
+def test_nonpersistent_loader_forks_fresh_pool_each_epoch():
+    loader = DataLoader(_PidDataset(n=8), batch_size=2, num_workers=2)
+    epoch1 = {int(b.numpy().ravel()[0]) for b in loader}
+    epoch2 = {int(b.numpy().ravel()[0]) for b in loader}
+    assert loader._pool is None
+    assert epoch1.isdisjoint(epoch2)
+
+
+def test_persistent_pool_replaced_after_worker_failure():
+    loader = DataLoader(_BadIndexDataset(), batch_size=4,
+                        num_workers=2, persistent_workers=True)
+    with pytest.raises(DataLoaderWorkerError):
+        list(loader)
+    assert loader._pool is None  # broken pool dropped
+    # next epoch re-forks and works on a clean dataset path
+    loader.dataset = _ArrDataset()
+    out = list(loader)
+    assert len(out) == 6

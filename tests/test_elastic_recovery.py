@@ -95,6 +95,96 @@ def test_killed_worker_restarts_from_checkpoint():
             assert os.path.exists(os.path.join(work, f"done_rank{r}"))
 
 
+FAULT_TRAIN_SCRIPT = r"""
+import os, sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.ckpt_commit import CheckpointManager
+from paddle_tpu.testing import faults
+
+rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+work = sys.argv[1]
+total_steps = int(sys.argv[2])
+
+root = os.path.join(work, f"ckpt_rank{rank}")
+mgr = CheckpointManager(root, keep_last_k=2, world_size=1, rank=0)
+
+start = mgr.latest_step() or 0
+state = {"w": np.zeros((4, 4), np.float32)}
+if start:
+    mgr.load(state)
+    assert float(np.asarray(state["w"])[0, 0]) == float(start), \
+        "resumed state does not match committed step"
+    with open(os.path.join(work, f"resumed_rank{rank}"), "w") as f:
+        f.write(str(start))
+
+life = os.path.join(work, f"life_rank{rank}")
+first_life = not os.path.exists(life)
+open(life, "w").write("x")
+if rank == 1 and first_life:
+    # crash mid-save via the fault harness (after a shard file hits
+    # disk, before metadata/commit) instead of a lucky sleep
+    faults.reset(os.environ.get("PT_FAULTS_RANK1", ""))
+
+for step in range(start, total_steps):
+    val = np.full((4, 4), float(step + 1), np.float32)
+    handle = mgr.save({"w": val}, step + 1, async_save=True)
+    handle.result()
+
+with open(os.path.join(work, f"done_rank{rank}"), "w") as f:
+    f.write(str(mgr.latest_step()))
+"""
+
+
+def test_fault_injected_crash_resumes_from_committed_step():
+    """Kill-and-resume proven at a *named fault point*: rank 1 dies via
+    PT_FAULTS mid-save of step 2 (shard written, nothing committed);
+    the launch watcher restarts it and it must resume from step 1 — the
+    last COMMITTED checkpoint — then run to completion."""
+    with tempfile.TemporaryDirectory() as work:
+        script = os.path.join(work, "train.py")
+        with open(script, "w") as f:
+            f.write(FAULT_TRAIN_SCRIPT)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env.pop("PT_FAULTS", None)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        # save of step 1 = shard-write hit 1; save of step 2 = hit 2
+        env["PT_FAULTS_RANK1"] = "ckpt.shard_write:after:2=crash"
+        total = 4
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--nproc_per_node", "2", "--max_restart", "2",
+               "--log_dir", os.path.join(work, "logs"),
+               script, work, str(total)]
+        res = subprocess.run(cmd, env=env, capture_output=True,
+                             text=True, timeout=180)
+        assert res.returncode == 0, res.stderr[-2000:]
+        assert "restart 1/2" in res.stderr
+        resumed = os.path.join(work, "resumed_rank1")
+        assert os.path.exists(resumed), "restart did not resume"
+        # resumed from the last COMMITTED step (1), not the torn step 2
+        assert int(open(resumed).read()) == 1
+        for r in (0, 1):
+            done = os.path.join(work, f"done_rank{r}")
+            assert os.path.exists(done)
+            assert int(open(done).read()) == total
+        # the final state reloads bit-exactly in this process
+        from paddle_tpu.distributed.ckpt_commit import CheckpointManager
+
+        import numpy as np
+
+        mgr = CheckpointManager(os.path.join(work, "ckpt_rank1"),
+                                world_size=1, rank=0)
+        state = {"w": np.zeros((4, 4), np.float32)}
+        assert mgr.load(state) == total
+        np.testing.assert_array_equal(
+            np.asarray(state["w"]),
+            np.full((4, 4), float(total), np.float32))
+
+
 def test_max_restart_exhaustion_fails_job():
     with tempfile.TemporaryDirectory() as work:
         # crash_at == every life: marker per incarnation prevents that,

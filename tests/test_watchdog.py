@@ -1,0 +1,76 @@
+"""CommWatchdog coverage (ISSUE 2 satellite): abort=False firing
+records a diagnosis; the KV-store roll call names the missing node
+rank; the checkpoint commit barrier runs under ``CommWatchdog.task``.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.watchdog import CommWatchdog
+
+
+def _wait_fired(wd, deadline=3.0):
+    t0 = time.time()
+    while not wd.fired and time.time() - t0 < deadline:
+        time.sleep(0.01)
+    return wd.fired
+
+
+def test_abort_false_records_diagnosis_instead_of_killing():
+    wd = CommWatchdog(timeout=0.15, abort=False, world_size=2, rank=0)
+    with wd.task("unit-test blocking wait"):
+        time.sleep(0.4)
+    fired = _wait_fired(wd)
+    assert len(fired) == 1
+    desc, diag = fired[0]
+    assert desc == "unit-test blocking wait"
+    assert "exceeded" in diag and "rank 0" in diag
+    # no KV store reachable -> the diagnosis says so instead of
+    # inventing an empty roll call
+    assert "expected world size 2" in diag
+
+
+def test_fast_operation_does_not_fire():
+    wd = CommWatchdog(timeout=0.5, abort=False)
+    with wd.task("quick op"):
+        pass
+    time.sleep(0.1)
+    assert wd.fired == []
+
+
+def test_kv_roll_call_names_missing_node_rank(monkeypatch):
+    from paddle_tpu.distributed.launch.master import HTTPMaster, KVClient
+
+    master = HTTPMaster("127.0.0.1:0").start()
+    try:
+        host, port = master.endpoint.split(":")
+        monkeypatch.setenv("MASTER_ADDR", host)
+        monkeypatch.setenv("PADDLE_RDZV_PORT", port)
+        monkeypatch.setenv("PADDLE_JOB_ID", "wdjob")
+        monkeypatch.setenv("PADDLE_NNODES", "3")
+        kv = KVClient(master.endpoint)
+        # nodes 0 and 2 registered; node 1 never arrived
+        kv.put("/rendezvous/wdjob/0", "h0:8000")
+        kv.put("/rendezvous/wdjob/2", "h2:8000")
+
+        wd = CommWatchdog(timeout=0.1, abort=False, world_size=3,
+                          rank=0)
+        diag = wd.diagnose("barrier over kv", waited=1.0)
+        assert "registered node ranks: [0, 2]" in diag
+        assert "MISSING: [1]" in diag
+        assert "worker logs" in diag
+    finally:
+        master.stop()
+
+
+def test_ckpt_commit_barrier_routed_through_watchdog(tmp_path):
+    from paddle_tpu.distributed.ckpt_commit import CheckpointManager
+
+    wd = CommWatchdog(timeout=0.1, abort=False, world_size=2, rank=0)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), world_size=2,
+                            rank=0, barrier_timeout=0.4, watchdog=wd)
+    with pytest.raises(RuntimeError, match="missing done markers"):
+        mgr.save({"w": np.ones((2, 2), np.float32)}, 1)
+    fired = _wait_fired(wd)
+    assert fired and fired[0][0] == "ckpt commit barrier step-1"
