@@ -23,6 +23,7 @@ in a daemon thread.
 """
 from __future__ import annotations
 
+import bisect
 import json
 import os
 import threading
@@ -86,15 +87,16 @@ class AsyncSaveHandle:
         return self._thread.is_alive()
 
 
-def save_state_dict(state_dict, path, process_group=None,
-                    coordinator_rank=0, async_save=False):
-    """Write {name: Tensor/array} as sharded files + metadata.json.
+def _prepare_save(state_dict, path, rank=None):
+    """Build one rank's write closure for ``state_dict`` -> ``path``.
 
-    With ``async_save=True`` returns an :class:`AsyncSaveHandle`; call
-    ``.result()`` to surface any write failure.
+    Runs EAGERLY: every shard is materialized on host here, so the
+    closure holds a snapshot of the state at call time — handing it to a
+    background thread cannot mix in values from later training steps.
     """
     os.makedirs(path, exist_ok=True)
-    rank = jax.process_index()
+    if rank is None:
+        rank = jax.process_index()
     meta = {"format": "paddle_tpu.dist_ckpt.v1", "tensors": {}}
     work = []
     for name, value in state_dict.items():
@@ -142,6 +144,19 @@ def save_state_dict(state_dict, path, process_group=None,
             os.fsync(f.fileno())
         faults.fire("ckpt.metadata", "after", path=meta_path)
 
+    return _write
+
+
+def save_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, async_save=False):
+    """Write {name: Tensor/array} as sharded files + metadata.json.
+
+    With ``async_save=True`` returns an :class:`AsyncSaveHandle`; call
+    ``.result()`` to surface any write failure.  The shard data is
+    snapshotted synchronously either way — only the file writes run on
+    the background thread.
+    """
+    _write = _prepare_save(state_dict, path)
     if async_save:
         return AsyncSaveHandle(_write)
     _write()
@@ -212,46 +227,65 @@ def _check_coverage(name, entry):
     shards = entry["shards"]
     if not shards:
         raise ValueError(f"checkpoint entry '{name}' has no shards")
-    if not gshape:
-        return  # scalar: any shard is full coverage
-    boxes = [tuple((o, o + l) for o, l in zip(s["offsets"], s["lengths"]))
-             for s in shards]
-    # Coordinate compression: candidate cells are the grid of all shard
-    # start/stop coords; every cell midpoint must land in some box.
+    if not gshape or int(np.prod(gshape)) == 0:
+        return  # scalar / empty extent: any shard is full coverage
+    ndim = len(gshape)
+    # Clip to the global extent and dedupe replicas, so neither overlap
+    # nor out-of-range extents can ever inflate apparent coverage.
+    boxes = sorted({
+        box for box in (
+            tuple((max(0, min(o, g)), max(0, min(o + l, g)))
+                  for o, l, g in zip(s["offsets"], s["lengths"], gshape))
+            for s in shards)
+        if all(lo < hi for lo, hi in box)})
+    # Coordinate compression: cells are the grid of all box edges; a
+    # cell is covered iff a single box contains it wholly.
     coords = []
     ncells = 1
     for d, g in enumerate(gshape):
-        cs = {0, g}
-        for b in boxes:
-            cs.add(max(0, min(b[d][0], g)))
-            cs.add(max(0, min(b[d][1], g)))
-        cs = sorted(cs)
+        cs = sorted({0, g} | {b[d][0] for b in boxes}
+                    | {b[d][1] for b in boxes})
         coords.append(cs)
-        ncells *= max(1, len(cs) - 1)
-    if ncells > 65536:
-        # Degenerate many-shard case: fall back to a volume lower bound
-        # (exact per-cell check would be quadratic-ish).
-        vol = sum(int(np.prod([b[d][1] - b[d][0]
-                               for d in range(len(gshape))]))
-                  for b in boxes)
-        if vol < int(np.prod(gshape)):
-            raise ValueError(
-                f"checkpoint entry '{name}' does not cover its global "
-                f"shape {gshape} (shard volume {vol})")
-        return
-    import itertools
+        ncells *= len(cs) - 1
+    dims = [len(c) - 1 for c in coords]
 
-    for cell in itertools.product(*[range(len(c) - 1) for c in coords]):
-        mid = [coords[d][i] for d, i in enumerate(cell)]
-        hi = [coords[d][i + 1] for d, i in enumerate(cell)]
-        if any(m >= h for m, h in zip(mid, hi)):
-            continue
-        if not any(all(b[d][0] <= mid[d] and hi[d] <= b[d][1]
-                       for d in range(len(gshape))) for b in boxes):
-            raise ValueError(
-                f"checkpoint entry '{name}' does not cover region "
-                f"{[(m, h) for m, h in zip(mid, hi)]} of global shape "
-                f"{gshape} — torn or partial checkpoint?")
+    def _uncovered(lo, hi):
+        raise ValueError(
+            f"checkpoint entry '{name}' does not cover region "
+            f"{list(zip(lo, hi))} of global shape {gshape} — torn or "
+            f"partial checkpoint?")
+
+    if ncells <= (1 << 24):
+        # Exact: mark every cell each box covers; overlapping boxes just
+        # mark twice, they can never mask a hole.  ≤ 16 MiB of bools.
+        grid = np.zeros(dims, dtype=bool)
+        for b in boxes:
+            grid[tuple(slice(bisect.bisect_left(coords[d], b[d][0]),
+                             bisect.bisect_left(coords[d], b[d][1]))
+                       for d in range(ndim))] = True
+        if not grid.all():
+            cell = np.unravel_index(int(np.argmin(grid)), grid.shape)
+            _uncovered([coords[d][i] for d, i in enumerate(cell)],
+                       [coords[d][i + 1] for d, i in enumerate(cell)])
+        return
+    # Astronomically many cells: deterministically sample cell midpoints
+    # (evenly strided over the compressed grid) and test containment
+    # directly.  May miss a hole, but — unlike a raw shard-volume sum —
+    # overlapping boxes can never make a torn checkpoint pass.
+    lows = np.array([[b[d][0] for d in range(ndim)] for b in boxes])
+    highs = np.array([[b[d][1] for d in range(ndim)] for b in boxes])
+    nsamples = max(1024, (1 << 26) // max(1, len(boxes)))
+    stride = max(1, ncells // nsamples)
+    for lin in range(0, ncells, stride):
+        rem, cell = lin, []
+        for n in reversed(dims):
+            cell.append(rem % n)
+            rem //= n
+        cell.reverse()
+        lo = np.array([coords[d][i] for d, i in enumerate(cell)])
+        hi = np.array([coords[d][i + 1] for d, i in enumerate(cell)])
+        if not np.any(np.all((lows <= lo) & (hi <= highs), axis=1)):
+            _uncovered(lo.tolist(), hi.tolist())
 
 
 def _read_region(path, entry, region, stats):

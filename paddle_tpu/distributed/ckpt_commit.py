@@ -45,7 +45,7 @@ import time
 import jax
 
 from ..testing import faults
-from .checkpoint import AsyncSaveHandle, load_state_dict, save_state_dict
+from .checkpoint import AsyncSaveHandle, _prepare_save, load_state_dict
 from .watchdog import CommWatchdog
 
 COMMIT_FILE = "COMMIT"
@@ -172,12 +172,19 @@ class CheckpointManager:
         self.wait()  # overlap guard: join (and surface) the in-flight save
         if is_committed(self.step_dir(step)):
             return _DoneHandle()
+        tmp = self._tmp_dir(step)
+        # Leftovers from a torn prior attempt: remove only THIS rank's
+        # files.  A blanket rmtree would race a multi-rank save — a
+        # late-arriving rank would delete shard files and done markers
+        # faster ranks already wrote into the shared tmp, and the commit
+        # could then reference deleted shards.
+        self._clear_rank_files(tmp)
+        # The state is snapshotted here, synchronously — an async save
+        # cannot mix in parameter values from later training steps.
+        write = _prepare_save(state_dict, tmp, rank=self.rank)
 
         def _job():
-            tmp = self._tmp_dir(step)
-            # A leftover torn attempt at this same step is dead weight.
-            shutil.rmtree(tmp, ignore_errors=True)
-            save_state_dict(state_dict, tmp)
+            write()
             done = os.path.join(tmp, f"rank-{self.rank}.done")
             _write_file_atomic(done, "1")
             if self.rank == self.coordinator_rank:
@@ -189,6 +196,24 @@ class CheckpointManager:
             return handle
         _job()
         return _DoneHandle()
+
+    def _clear_rank_files(self, tmp):
+        """Delete this rank's files under a leftover ``tmp`` — done
+        marker first, so the coordinator can never count a stale marker
+        while the shard files behind it are being replaced."""
+        if not os.path.isdir(tmp):
+            return
+        done = f"rank-{self.rank}.done"
+        names = os.listdir(tmp)
+        mine = [n for n in names if n.startswith(done)]
+        mine += [n for n in names
+                 if n == f"{self.rank}.metadata.json"
+                 or n.endswith(f".r{self.rank}.npy")]
+        for name in mine:
+            try:
+                os.remove(os.path.join(tmp, name))
+            except OSError:
+                pass
 
     def wait(self):
         """Join the in-flight async save, re-raising its error."""

@@ -432,7 +432,10 @@ class _MPWorkerIter:
         return self
 
     def __del__(self):
-        if self._finished or self.persistent:
+        # getattr defaults: __init__ may have raised before these were
+        # set (pool fork / batch_sampler failure) — stay silent then.
+        if getattr(self, "_finished", True) \
+                or getattr(self, "persistent", True):
             return
         try:
             self.pool.terminate()

@@ -141,7 +141,9 @@ def _trip(spec, path):
         raise InjectedFault(
             f"injected fault at {spec.point}:{spec.phase} "
             f"(hit {spec.hits})")
-    if spec.action == "truncate" and path and os.path.exists(path):
+    # isfile guard: some sites (e.g. ckpt.commit) fire with a directory
+    # path — skip straight to the hard kill rather than die on open().
+    if spec.action == "truncate" and path and os.path.isfile(path):
         size = os.path.getsize(path)
         with open(path, "r+b") as f:
             f.truncate(size // 2)

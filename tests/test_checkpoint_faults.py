@@ -75,6 +75,9 @@ _CKPT_FAULT_SPECS = [
     "ckpt.metadata:after:1=crash",
     "ckpt.commit:before:1=crash",
     "ckpt.commit:after:1=crash",  # renamed but COMMIT never written
+    # commit fires with a DIRECTORY path — truncate must skip to the
+    # hard kill, not die on open(IsADirectoryError)
+    "ckpt.commit:before:1=truncate",
 ]
 
 
@@ -173,6 +176,66 @@ def test_manager_async_error_surfaces_on_next_save(tmp_path):
     with pytest.raises(faults.InjectedFault):
         mgr.save(_state(2), 2)  # overlap guard re-raises worker failure
     assert mgr.committed_steps() == []
+
+
+def test_multirank_save_preserves_other_ranks_files(tmp_path):
+    """A late-arriving rank clearing leftovers from the shared tmp must
+    not delete shard files or done markers a faster rank already wrote
+    for this step (a blanket rmtree did exactly that, so a commit could
+    reference deleted shards)."""
+    root = str(tmp_path / "ckpt")
+    r1 = CheckpointManager(root, world_size=2, rank=1,
+                           coordinator_rank=0, barrier_timeout=10.0)
+    r0 = CheckpointManager(root, world_size=2, rank=0,
+                           coordinator_rank=0, barrier_timeout=10.0)
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    b = np.arange(6, dtype=np.float32).reshape(2, 3) + 100.0
+    # rank 1 finishes its part of step 1 first (no commit: not coord)...
+    r1.save({"b": b}, 1)
+    # ...then rank 0 arrives, writes its part, and commits.
+    r0.save({"a": a}, 1)
+    assert committed_steps(root) == [1]
+    loaded = {"a": np.zeros_like(a), "b": np.zeros_like(b)}
+    CheckpointManager(root, world_size=2, rank=0).load(loaded, step=1)
+    np.testing.assert_array_equal(np.asarray(loaded["a"]), a)
+    np.testing.assert_array_equal(np.asarray(loaded["b"]), b)
+
+
+def test_clear_rank_files_touches_only_own_rank(tmp_path):
+    root = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(root, world_size=2, rank=0)
+    tmp = mgr._tmp_dir(3)
+    os.makedirs(tmp)
+    mine = ["rank-0.done", "0.metadata.json", "w.0-2.r0.npy"]
+    theirs = ["rank-1.done", "1.metadata.json", "w.2-4.r1.npy",
+              "w.0-2.r10.npy"]  # r10 must not match rank 0's patterns
+    for n in mine + theirs:
+        with open(os.path.join(tmp, n), "w") as f:
+            f.write("x")
+    mgr._clear_rank_files(tmp)
+    assert sorted(os.listdir(tmp)) == sorted(theirs)
+
+
+def test_async_save_snapshots_state_at_call_time(tmp_path):
+    """Mutating the state after save() returns must not leak into the
+    checkpoint: shard data is captured synchronously; only the file
+    writes run on the background thread."""
+    root = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(root, world_size=1, rank=0)
+    faults.arm("ckpt.shard_write", phase="before", nth=1,
+               action="delay", arg="0.2")
+    w = np.arange(16, dtype=np.float32).reshape(4, 4)
+    state = {"w": w}
+    h = mgr.save(state, 1, async_save=True)
+    # training moves on while the write is still in flight
+    w[:] = -1.0
+    state["w"] = np.zeros((4, 4), np.float32)
+    h.result()
+    loaded = {"w": np.zeros((4, 4), np.float32)}
+    mgr.load(loaded, step=1)
+    np.testing.assert_array_equal(
+        np.asarray(loaded["w"]),
+        np.arange(16, dtype=np.float32).reshape(4, 4))
 
 
 def test_keep_last_k_retention(tmp_path):

@@ -113,6 +113,46 @@ def test_optimizer_state_roundtrip_across_mesh(tmp_path):
     assert ckpt.last_load_stats().peak_buffer_bytes == (16 // 4) * 8 * 4
 
 
+def test_coverage_overlap_cannot_mask_hole_beyond_grid_threshold():
+    """>65536 compressed cells used to fall back to a raw shard-volume
+    sum, which overlapping shards could inflate past the global volume
+    — letting a torn checkpoint pass validation and load its hole as
+    zeros.  Overlap must never mask a missing region."""
+    n = 70000
+    entry = {
+        "global_shape": [n + 2], "dtype": "float32",
+        # unit-strided boxes of length 2: heavy overlap, union covers
+        # only [0, n) — volume sum ≈ 2n easily exceeds n + 2
+        "shards": [{"offsets": [i], "lengths": [2]}
+                   for i in range(n - 1)],
+    }
+    with pytest.raises(ValueError, match="does not cover"):
+        ckpt._check_coverage("w", entry)
+
+
+def test_coverage_overlap_full_cover_passes_beyond_grid_threshold():
+    n = 70000
+    entry = {
+        "global_shape": [n + 1], "dtype": "float32",
+        "shards": [{"offsets": [i], "lengths": [2]}
+                   for i in range(n)],
+    }
+    ckpt._check_coverage("w", entry)  # overlapping but complete: OK
+
+
+def test_coverage_sampled_path_detects_hole():
+    """Past the exact-bitmap budget (>2^24 cells) coverage is checked by
+    deterministically sampled cells — a gross hole must still raise."""
+    n = 4200  # 4200^2 cells > 2^24
+    entry = {
+        "global_shape": [n, n], "dtype": "float32",
+        "shards": [{"offsets": [i, i], "lengths": [1, 1]}
+                   for i in range(n)],  # diagonal only
+    }
+    with pytest.raises(ValueError, match="does not cover"):
+        ckpt._check_coverage("w", entry)
+
+
 def test_validation_runs_before_any_mutation_on_sharded_targets(
         tmp_path):
     mesh = ProcessMesh(shape=[8], dim_names=["mp"])

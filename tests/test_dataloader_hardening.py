@@ -116,3 +116,13 @@ def test_persistent_pool_replaced_after_worker_failure():
     loader.dataset = _ArrDataset()
     out = list(loader)
     assert len(out) == 6
+
+
+def test_mp_iter_del_after_failed_init_is_silent():
+    """__del__ on a partially-constructed iterator (``__init__`` raised
+    before its attributes were set) must not spray AttributeError noise
+    during GC."""
+    from paddle_tpu.io import _MPWorkerIter
+
+    it = _MPWorkerIter.__new__(_MPWorkerIter)
+    it.__del__()  # no attributes set at all: must be a no-op
