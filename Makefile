@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: smoke test test-fast bench
+.PHONY: smoke test test-fast verify-fast bench
 
 # <3 min sanity gate: import + one eager op, one jitted llama forward
 # step (the driver's entry()), and a 2-virtual-device multichip train
@@ -29,6 +29,8 @@ smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest -q -p no:cacheprovider \
 		tests/test_checkpoint_faults.py \
 		tests/test_checkpoint_shardwise.py \
+		tests/test_ckpt_checksum.py \
+		tests/test_guardian.py \
 		tests/test_watchdog.py \
 		tests/test_dataloader_hardening.py
 
@@ -40,6 +42,12 @@ test-fast:
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 		--continue-on-collection-errors -p no:cacheprovider
+
+# Fast lane + regression gate: fails ONLY on failures not recorded in
+# tools/fastlane_baseline.txt, so a dirty-but-known lane never blocks
+# unrelated work while any NEW breakage does.
+verify-fast:
+	$(PY) tools/check_fastlane.py
 
 bench:
 	$(PY) bench.py

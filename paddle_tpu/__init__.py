@@ -73,6 +73,7 @@ from . import signal  # noqa: F401
 from . import static  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import utils  # noqa: F401
+from . import training  # noqa: F401
 from .hapi import callbacks  # noqa: F401
 from .hapi.summary import summary  # noqa: F401
 from . import geometric  # noqa: F401

@@ -53,6 +53,17 @@ class GradScaler:
         self._found_inf = found
         self._unscaled = True
 
+    def mark_found_inf(self):
+        """Force found_inf for the current step (training-guardian
+        skip-step): the next ``step`` skips the optimizer update and
+        ``update`` moves the scale schedule exactly as if ``unscale_``
+        had seen a non-finite gradient.  Grads are discarded either
+        way, so the pending unscale is marked done."""
+        if not self._enable:
+            return
+        self._found_inf = True
+        self._unscaled = True
+
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()

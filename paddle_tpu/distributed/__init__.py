@@ -43,7 +43,9 @@ from .api_tail import (  # noqa: F401
     ShardingStage2, ShardingStage3, Strategy, shard_dataloader,
     shard_optimizer, shard_scaler, split, to_static,
 )
-from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    ChecksumError, load_state_dict, save_state_dict,
+)
 from . import ckpt_commit  # noqa: F401
 from .ckpt_commit import CheckpointManager  # noqa: F401
 from .spawn import spawn  # noqa: F401

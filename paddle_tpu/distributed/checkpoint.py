@@ -27,6 +27,7 @@ import bisect
 import json
 import os
 import threading
+import zlib
 
 import numpy as np
 
@@ -38,6 +39,28 @@ from ..testing import faults
 
 def _arr(v):
     return v._data if isinstance(v, Tensor) else v
+
+
+class ChecksumError(ValueError):
+    """A shard file's bytes no longer match the crc32 recorded in the
+    checkpoint metadata at save time — silent bit rot (or tampering).
+    Raised BEFORE any target tensor is mutated, naming shard + file."""
+
+
+_CRC_CHUNK = 1 << 20
+
+
+def _crc32_file(path):
+    """Streaming crc32 of the whole file (header included) — constant
+    ~1 MiB host allocation regardless of shard size."""
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(_CRC_CHUNK)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
 
 
 # -- async save handle -------------------------------------------------------
@@ -115,25 +138,33 @@ def _prepare_save(state_dict, path, rank=None):
             fname = (f"{name.replace('/', '_')}."
                      f"{'_'.join(f'{a}-{b}' for a, b in key) or 'full'}"
                      f".r{rank}.npy")
-            entry["shards"].append({
+            shard_meta = {
                 "file": fname,
                 "offsets": [a for a, _ in key],
                 "lengths": [(b if b is not None else g) - a
                             for (a, b), g in zip(key, arr.shape)],
-            })
+            }
+            entry["shards"].append(shard_meta)
+            # shard_meta travels with the write job: the crc32 of the
+            # on-disk bytes is stamped into it after the file lands,
+            # before the (later) metadata write indexes it.
             work.append((os.path.join(path, fname),
-                         np.asarray(shard.data)))
+                         np.asarray(shard.data), shard_meta))
         meta["tensors"][name] = entry
 
     meta_path = os.path.join(path, f"{rank}.metadata.json")
 
     def _write():
-        for fpath, data in work:
+        for fpath, data, shard_meta in work:
             faults.fire("ckpt.shard_write", "before", path=fpath)
             with open(fpath, "wb") as f:
                 np.save(f, data)
                 f.flush()
                 os.fsync(f.fileno())
+            # Checksum the bytes as written, BEFORE the after-phase
+            # fault point: a 'corrupt' fault there flips a bit the crc
+            # does not cover — exactly the bit-rot load must catch.
+            shard_meta["crc32"] = _crc32_file(fpath)
             faults.fire("ckpt.shard_write", "after", path=fpath)
         # EVERY rank writes its own metadata (it indexes only this rank's
         # addressable shards); load merges all *.metadata.json files.
@@ -356,8 +387,33 @@ def _validate(state_dict, merged):
         _check_coverage(name, entry)
 
 
+def _verify_checksums(state_dict, merged, path):
+    """Compare each referenced shard file's crc32 against the value
+    recorded at save time.  Runs before ANY tensor is mutated, so a
+    corrupt shard fails the load with the target state untouched.
+    Shards without a recorded crc32 (pre-checksum checkpoints) are
+    skipped.  Each file is read once (streaming, ~1 MiB buffer)."""
+    seen = {}
+    for name in state_dict:
+        for shard in merged[name]["shards"]:
+            want = shard.get("crc32")
+            if want is None:
+                continue
+            fname = shard["file"]
+            got = seen.get(fname)
+            if got is None:
+                got = seen[fname] = _crc32_file(
+                    os.path.join(path, fname))
+            if got != int(want):
+                raise ChecksumError(
+                    f"checkpoint shard file '{fname}' (tensor '{name}') "
+                    f"is corrupt: metadata crc32 {int(want):#010x} != "
+                    f"on-disk {got:#010x} — silent bit rot; no target "
+                    f"state was modified")
+
+
 def load_state_dict(state_dict, path, process_group=None,
-                    coordinator_rank=0, offload=False):
+                    coordinator_rank=0, offload=False, verify=True):
     """Fill ``state_dict``'s tensors in place from a checkpoint dir,
     resharding to each tensor's current sharding.
 
@@ -365,11 +421,15 @@ def load_state_dict(state_dict, path, process_group=None,
     shard region is assembled independently from the intersecting saved
     shard files (memory-mapped reads), so peak host allocation stays
     ≈ shard bytes.  All names/shapes/coverage are validated *before*
-    anything is written — a failing load never half-applies.
+    anything is written — a failing load never half-applies.  With
+    ``verify`` (default) every referenced shard file's crc32 is checked
+    against the save-time metadata first (:class:`ChecksumError`).
     """
     global _last_load_stats
     merged = _merge_metadata(path)
     _validate(state_dict, merged)
+    if verify:
+        _verify_checksums(state_dict, merged, path)
 
     stats = LoadStats()
     for name, target in state_dict.items():

@@ -118,15 +118,63 @@ class Model:
         scaled = self._scaler.scale(loss) if self._scaler else loss
         scaled.backward()
         if update:
-            if self._scaler:
-                self._scaler.step(self._optimizer)
-                self._scaler.update()
-            else:
-                self._optimizer.step()
-            self._optimizer.clear_grad()
+            self._apply_update()
 
         metrics = self._update_metrics(outputs, labels)
         return (float(np.asarray(loss.numpy())), metrics)
+
+    def _apply_update(self, found_inf=False):
+        """Apply (or, with ``found_inf``, skip with GradScaler found_inf
+        semantics) the pending optimizer update and clear grads."""
+        if self._scaler:
+            if found_inf:
+                self._scaler.mark_found_inf()
+            self._scaler.step(self._optimizer)
+            self._scaler.update()
+        elif not found_inf:
+            self._optimizer.step()
+        self._optimizer.clear_grad()
+
+    def _global_grad_norm(self):
+        """Global L2 norm over all parameter grads (guardian monitor;
+        eager path — the loop is host-synchronous anyway)."""
+        tot = 0.0
+        for p in self._optimizer._parameter_list():
+            if p.grad is not None:
+                g = np.asarray(p.grad._data, np.float64)
+                tot += float((g * g).sum())
+        return float(np.sqrt(tot))
+
+    def _guarded_train_batch(self, guardian, inputs, labels):
+        """One fit-loop step under the training guardian: forward +
+        backward, poll the guard.* value-fault points, classify, then
+        apply / skip (found_inf semantics) / roll back per the
+        escalation policy."""
+        from ..testing import faults
+        from ..training.guardian import Decision
+
+        loss, metrics = self.train_batch(inputs, labels, update=False)
+        if faults.poll("guard.nan_loss") is not None:
+            loss = float("nan")
+        else:
+            spike = faults.poll("guard.loss_spike")
+            if spike is not None:
+                loss = loss + (1e6 if spike is True else float(spike))
+        gnorm = None
+        if guardian.policy.check_grad_norm:
+            gnorm = self._global_grad_norm()
+            if faults.poll("guard.nan_grad") is not None:
+                gnorm = float("nan")
+        decision = guardian.observe(loss, gnorm)
+        if decision is Decision.OK:
+            self._apply_update()
+            guardian.maybe_commit(guardian.steps_seen)
+        elif decision is Decision.SKIP:
+            self._apply_update(found_inf=True)
+        else:  # ROLLBACK — restore last committed state, drop grads
+            guardian.rollback()
+            self._optimizer.clear_grad()
+        return loss, metrics
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
@@ -184,7 +232,13 @@ class Model:
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
-            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            guardian=None):
+        """``guardian``: a ``paddle.training.TrainingGuardian`` (e.g.
+        from ``training.guardian.guardian_for_model``) — each train
+        step is then monitored (NaN/Inf loss, grad norm, loss spike)
+        and anomalies escalate skip -> rollback-to-last-committed ->
+        ``GuardianAbort`` per its policy."""
         loader = self._make_loader(train_data, batch_size, shuffle,
                                    num_workers, drop_last)
         cbks = _to_list(callbacks)
@@ -205,6 +259,10 @@ class Model:
                                                for m in self._metrics]})
         self.stop_training = False
         cbk.on_train_begin()
+        if guardian is not None and guardian.manager is not None \
+                and guardian.manager.latest_step() is None:
+            # Rollback must always have a committed source.
+            guardian.commit(0)
         logs = {}
         for epoch in range(epochs):
             if self.stop_training:
@@ -215,7 +273,11 @@ class Model:
             for step, batch in enumerate(loader):
                 cbk.on_train_batch_begin(step)
                 ins, labs = self._split_batch(batch)
-                loss, metrics = self.train_batch(ins, labs)
+                if guardian is not None:
+                    loss, metrics = self._guarded_train_batch(
+                        guardian, ins, labs)
+                else:
+                    loss, metrics = self.train_batch(ins, labs)
                 logs = {"loss": loss, **metrics}
                 cbk.on_train_batch_end(step, logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:

@@ -261,8 +261,9 @@ class CompiledTrainStep:
 
         single_copy = self._single_copy
 
-        def step(params, master, m, v, t, lr_val, *batch):
-            loss, grads = jax.value_and_grad(loss_of)(params, *batch)
+        def apply_update(params, master, m, v, t, lr_val, grads):
+            """Shared optimizer body: grads -> new state trees.  Used by
+            the plain step and the guarded (anomaly-gated) step."""
             if single_copy:
                 # Single-copy bf16 training: fp32 math in-step, write
                 # back with stochastic rounding (unbiased), no fp32
@@ -291,10 +292,45 @@ class CompiledTrainStep:
                             p32, jax.random.fold_in(key, i))
                     else:
                         cast_back[k] = p32.astype(params[k].dtype)
-                return cast_back, {}, new_m, new_v, loss
+                return cast_back, {}, new_m, new_v
             cast_back = {k: newp[k].astype(params[k].dtype)
                          for k in params}
-            return cast_back, newp, new_m, new_v, loss
+            return cast_back, newp, new_m, new_v
+
+        def step(params, master, m, v, t, lr_val, *batch):
+            loss, grads = jax.value_and_grad(loss_of)(params, *batch)
+            newp, newmaster, new_m, new_v = apply_update(
+                params, master, m, v, t, lr_val, grads)
+            return newp, newmaster, new_m, new_v, loss
+
+        def guarded(params, master, m, v, t, lr_val, gate, *batch):
+            """Anomaly-gated step (training guardian).  ``gate`` is a
+            [3] f32 vector: [loss ceiling, loss inject, grad inject]
+            (injects are 0.0 when inert — the guard.* fault points).
+            The update is applied only where the loss and the global
+            grad norm are finite AND the loss stays under the ceiling;
+            otherwise every state tree keeps its input value — the
+            skip-step is part of the same XLA program, no extra host
+            sync."""
+            loss, grads = jax.value_and_grad(loss_of)(params, *batch)
+            loss = loss + gate[1].astype(loss.dtype)
+            grads = {k: g + gate[2].astype(g.dtype)
+                     for k, g in grads.items()}
+            gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                      for g in grads.values())
+            gnorm = jnp.sqrt(gsq)
+            ok = (jnp.isfinite(loss) & jnp.isfinite(gnorm)
+                  & (loss.astype(jnp.float32) <= gate[0]))
+            newp, newmaster, new_m, new_v = apply_update(
+                params, master, m, v, t, lr_val, grads)
+
+            def sel(new, old):
+                # jnp.where never propagates the discarded branch's
+                # NaNs, so a poisoned update can't leak through a skip.
+                return {k: jnp.where(ok, new[k], old[k]) for k in old}
+
+            return (sel(newp, params), sel(newmaster, master),
+                    sel(new_m, m), sel(new_v, v), loss, gnorm, ok)
 
         self._step_fn = step  # raw body, reused by multi_step
         self._multi = {}
@@ -313,6 +349,13 @@ class CompiledTrainStep:
         # multi_step reuses the same donation/out-sharding contract
         self._step_jit_kwargs = dict(jit_kwargs)
         self._step = jax.jit(step, **jit_kwargs)
+        guarded_kwargs = dict(jit_kwargs)
+        if "out_shardings" in guarded_kwargs:
+            # gated state keeps the declared layout; loss/gnorm/ok are
+            # replicated scalars
+            guarded_kwargs["out_shardings"] = \
+                guarded_kwargs["out_shardings"][:-1] + (None, None, None)
+        self._guarded = jax.jit(guarded, **guarded_kwargs)
 
     def _zero_sharding(self, name, value, rules, dp_axis):
         """Opt-state sharding: param's TP sharding + dp over the first
@@ -449,6 +492,61 @@ class CompiledTrainStep:
                            jnp.asarray(self._t, jnp.float32), lr_val, *batch)
         faults.fire("train.step", "after")
         return loss
+
+    def guarded_step(self, threshold, *batch):
+        """One train step through the in-graph anomaly gate: the update
+        is APPLIED only where the loss and the global grad norm are
+        finite and the loss does not exceed ``threshold`` (the
+        guardian's rolling median+MAD ceiling, ``inf`` to disable);
+        otherwise every state tree keeps its previous value — GradScaler
+        found_inf semantics: a skipped step leaves params, moments, AND
+        the Adam step counter untouched.
+
+        Returns ``(loss, grad_norm, ok)`` as host float/float/bool.
+        Fetching them is the one host sync the training loop already
+        pays for the loss; the skip decision itself runs inside the
+        same XLA program.
+
+        The ``guard.nan_loss`` / ``guard.nan_grad`` / ``guard.loss_spike``
+        fault points are polled here (``inject`` action): when armed they
+        poison the loss/grads INSIDE the gated program, so harness tests
+        exercise the exact production skip path.
+        """
+        from ..core.tensor import Tensor
+        from ..optimizer.lr import LRScheduler
+        from ..testing import faults
+
+        faults.fire("train.step", "before")
+        l_inj = 0.0
+        if faults.poll("guard.nan_loss") is not None:
+            l_inj = float("nan")
+        else:
+            spike = faults.poll("guard.loss_spike")
+            if spike is not None:
+                l_inj = 1e6 if spike is True else float(spike)
+        g_inj = float("nan") \
+            if faults.poll("guard.nan_grad") is not None else 0.0
+        self._t += 1
+        if isinstance(self.lr, LRScheduler):
+            lr_val = float(self.lr())
+            self.lr.step()
+        else:
+            lr_val = float(self.lr)
+        batch = [b._data if isinstance(b, Tensor) else b for b in batch]
+        with jax.enable_x64(False):
+            batch = [self._place_batch(b) for b in batch]
+            gate = jnp.asarray([threshold, l_inj, g_inj], jnp.float32)
+            (self.params, self._master, self._m, self._v, loss, gnorm,
+             ok) = self._guarded(
+                self.params, self._master, self._m, self._v,
+                jnp.asarray(self._t, jnp.float32), lr_val, gate, *batch)
+        faults.fire("train.step", "after")
+        loss_f, gnorm_f, ok_b = float(loss), float(gnorm), bool(ok)
+        if not ok_b:
+            # The gate kept the old state; the Adam step counter must
+            # not advance either (found_inf semantics).
+            self._t -= 1
+        return loss_f, gnorm_f, ok_b
 
     def sync_to_model(self):
         """Write current (possibly sharded) params back into the Layer."""

@@ -96,6 +96,22 @@ def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations=1,
     u = u / (jnp.linalg.norm(u) + eps)
     vv = jnp.asarray(rng.randn(wd), jnp.float32)
     vv = vv / (jnp.linalg.norm(vv) + eps)
+    # Burn in the power iteration at wrap time: from a random u/v one
+    # step badly underestimates sigma (the normalized weight's top
+    # singular value can land well above 1).  Iterate to convergence
+    # here so the very first forward already divides by an accurate
+    # sigma; the per-forward n_power_iterations then only track weight
+    # updates.
+    sigma_prev = 0.0
+    for _ in range(64):
+        vv = mat.T @ u
+        vv = vv / (jnp.linalg.norm(vv) + eps)
+        u = mat @ vv
+        u = u / (jnp.linalg.norm(u) + eps)
+        sigma_now = float(u @ mat @ vv)
+        if abs(sigma_now - sigma_prev) <= 1e-6 * max(abs(sigma_now), 1.0):
+            break
+        sigma_prev = sigma_now
     del layer._parameters[name]
     orig = layer.create_parameter(list(w.shape))
     orig.set_value(w)

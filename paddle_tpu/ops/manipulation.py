@@ -588,13 +588,20 @@ topk_op = register_op(
 
 
 def _topk(x, k, axis, largest):
-    if not largest:
-        vals, idx = jax.lax.top_k(jnp.moveaxis(-x, axis, -1), k)
-        vals = -vals
-    else:
-        vals, idx = jax.lax.top_k(jnp.moveaxis(x, axis, -1), k)
+    # SPMD rule (reference top_k spmd rule: batch dims pass through):
+    # ``jax.lax.top_k`` replicates its output under GSPMD, silently
+    # all-gathering a batch-sharded operand.  A variadic ``lax.sort``
+    # propagates the batch sharding, so topk is routed through one
+    # stable key sort carrying the index payload; negating the key for
+    # ``largest`` keeps top_k's lowest-index-first tie order.
+    xm = jnp.moveaxis(x, axis, -1)
+    iota = jax.lax.broadcasted_iota(jnp.int32, xm.shape, xm.ndim - 1)
+    keys = -xm if largest else xm
+    sk, si = jax.lax.sort((keys, iota), dimension=-1, num_keys=1,
+                          is_stable=True)
+    vals = -sk[..., :k] if largest else sk[..., :k]
     return (jnp.moveaxis(vals, -1, axis),
-            jnp.moveaxis(idx, -1, axis).astype(jnp.int64))
+            jnp.moveaxis(si[..., :k], -1, axis).astype(jnp.int64))
 
 
 def topk(x, k, axis=-1, largest=True, sorted=True, name=None):

@@ -21,6 +21,14 @@ Grammar (comma-separated specs)::
             truncate       truncate the file at the site's ``path`` to
                            half its bytes, then os._exit — a torn write
             delay:SECS     sleep SECS (default 0.05) and continue
+            corrupt        flip one bit in the middle of the file at the
+                           site's ``path`` and CONTINUE — silent bit rot
+                           (checksum verification must catch it at load)
+            inject[:ARG]   value injection: the site polls the harness
+                           via :func:`poll` and poisons its own value
+                           (NaN loss, spiked loss, NaN grads) when armed.
+                           ``fire`` never trips these — only value sites
+                           consume them.
 
 Example: ``PT_FAULTS="ckpt.shard_write:after:2=crash"`` kills the
 process right after the second shard file hits disk — mid-save, before
@@ -48,6 +56,12 @@ REGISTERED = {
     "io.worker": "DataLoader pool worker around one batch fetch",
     "train.step": "CompiledTrainStep.step host boundary",
     "hapi.save": "hapi ModelCheckpoint save",
+    "guard.nan_loss": "guardian monitor: poison the step loss to NaN "
+                      "(value site — arm with the 'inject' action)",
+    "guard.nan_grad": "guardian monitor: poison the gradients to NaN "
+                      "while the loss stays finite (value site)",
+    "guard.loss_spike": "guardian monitor: add a large finite spike to "
+                        "the step loss (value site; arg = magnitude)",
 }
 
 _PHASES = ("before", "after")
@@ -68,7 +82,8 @@ class _Spec:
         if phase not in _PHASES:
             raise ValueError(f"fault phase must be one of {_PHASES}, "
                              f"got {phase!r}")
-        if action not in ("crash", "raise", "truncate", "delay"):
+        if action not in ("crash", "raise", "truncate", "delay",
+                          "corrupt", "inject"):
             raise ValueError(f"unknown fault action {action!r}")
         self.point = point
         self.phase = phase
@@ -133,9 +148,30 @@ def disarm_all():
         _specs = []
 
 
+def _flip_bit(path):
+    """Flip one bit in the middle of the file — the on-disk signature of
+    silent bit rot.  The process continues; nothing crashes here — the
+    corruption must be CAUGHT later (checksum verification at load)."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    pos = size // 2
+    with open(path, "r+b") as f:
+        f.seek(pos)
+        byte = f.read(1)
+        f.seek(pos)
+        f.write(bytes([byte[0] ^ 0x10]))
+        f.flush()
+        os.fsync(f.fileno())
+
+
 def _trip(spec, path):
     if spec.action == "delay":
         time.sleep(float(spec.arg) if spec.arg is not None else 0.05)
+        return
+    if spec.action == "corrupt":
+        if path and os.path.isfile(path):
+            _flip_bit(path)
         return
     if spec.action == "raise":
         raise InjectedFault(
@@ -153,7 +189,12 @@ def _trip(spec, path):
 
 
 def fire(point, phase, path=None):
-    """Hit the fault point; no-op unless an armed spec matches."""
+    """Hit the fault point; no-op unless an armed spec matches.
+
+    ``inject`` specs are NEVER tripped here — they are value faults a
+    site consumes via :func:`poll`; counting their hits at a ``fire``
+    site would silently shift which call the injection lands on.
+    """
     specs = _specs if _specs is not None else _ensure_loaded()
     if not specs:
         return
@@ -161,7 +202,8 @@ def fire(point, phase, path=None):
     tripped = None
     with _lock:
         for spec in specs:
-            if spec.point != point or spec.phase != phase:
+            if spec.point != point or spec.phase != phase \
+                    or spec.action == "inject":
                 continue
             spec.hits += 1
             if spec.nth == "*" or spec.hits == spec.nth:
@@ -169,6 +211,27 @@ def fire(point, phase, path=None):
                 break
     if tripped is not None:
         _trip(tripped, path)
+
+
+def poll(point, phase="before"):
+    """Value-injection probe: returns the matching armed ``inject``
+    spec's arg (or ``True`` when the spec has no arg) when the fault
+    fires at this hit, else ``None``.  The call site poisons its own
+    value — e.g. the guardian's train-step wrapper turns the loss NaN —
+    so the injected anomaly flows through the REAL monitoring path."""
+    specs = _specs if _specs is not None else _ensure_loaded()
+    if not specs:
+        return None
+    assert point in REGISTERED, f"unregistered fault point {point!r}"
+    with _lock:
+        for spec in specs:
+            if spec.point != point or spec.phase != phase \
+                    or spec.action != "inject":
+                continue
+            spec.hits += 1
+            if spec.nth == "*" or spec.hits == spec.nth:
+                return spec.arg if spec.arg is not None else True
+    return None
 
 
 def registered_points():

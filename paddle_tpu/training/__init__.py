@@ -1,0 +1,13 @@
+"""Training-loop robustness subsystem (guardian).
+
+``TrainingGuardian`` watches per-step training health (NaN/Inf loss,
+NaN/Inf global grad norm, loss spikes against a rolling median+MAD
+window) and enforces an escalation policy: skip-step, then automatic
+rollback to the last committed checkpoint, then abort with a
+diagnostic bundle.  ``GuardedTrainStep`` is the drop-in driver for
+``models.training.CompiledTrainStep``.
+"""
+from .guardian import (  # noqa: F401
+    Decision, GuardedTrainStep, GuardianAbort, GuardianPolicy,
+    TrainingGuardian,
+)

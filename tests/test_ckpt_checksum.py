@@ -1,0 +1,148 @@
+"""Per-shard crc32 checksums: silent bit rot must be CAUGHT at load.
+
+The writer stamps a streaming crc32 of every shard file into the
+save-time metadata *before* the ``ckpt.shard_write:after`` fault point,
+so a ``corrupt`` fault there (one flipped bit mid-file, process
+continues — the on-disk signature of bit rot) is exactly what the
+shard-wise loader's verification must detect: ``ChecksumError`` naming
+the shard file and tensor, raised BEFORE any target state is filled.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle  # noqa: F401  (conftest sets the 8-dev mesh)
+from paddle_tpu.distributed import ChecksumError
+from paddle_tpu.distributed.checkpoint import (
+    load_state_dict, save_state_dict, _crc32_file)
+from paddle_tpu.distributed.ckpt_commit import CheckpointManager
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm_all()
+    yield
+    faults.disarm_all()
+
+
+def _state(seed=0):
+    # big enough that the corrupt fault's mid-file bit flip lands in
+    # the npy payload, not the header
+    rng = np.random.RandomState(seed)
+    return {"w": rng.randn(64, 64).astype(np.float32),
+            "b": rng.randn(256).astype(np.float32)}
+
+
+def _zeros_like(state):
+    return {k: np.zeros_like(v) for k, v in state.items()}
+
+
+def test_crc32_stamped_in_metadata_and_clean_roundtrip(tmp_path):
+    import json
+
+    path = str(tmp_path)
+    state = _state()
+    save_state_dict(state, path)
+    metas = [f for f in os.listdir(path) if f.endswith("metadata.json")]
+    assert metas
+    shards = []
+    for m in metas:
+        with open(os.path.join(path, m)) as f:
+            meta = json.load(f)
+        for entry in meta["tensors"].values():
+            shards += entry["shards"]
+    assert shards
+    for shard in shards:
+        # every shard carries its file's actual crc32
+        assert shard["crc32"] == _crc32_file(
+            os.path.join(path, shard["file"]))
+    target = _zeros_like(state)
+    load_state_dict(target, path)
+    for k, v in state.items():
+        np.testing.assert_array_equal(np.asarray(target[k]), v)
+
+
+def test_corrupt_shard_caught_and_target_untouched(tmp_path):
+    """PT_FAULTS-driven acceptance: a bit flipped in a shard file right
+    after it hit disk must surface as ChecksumError at load — naming
+    the shard file and tensor — with the load target left untouched."""
+    path = str(tmp_path)
+    state = _state()
+    old = os.environ.get("PT_FAULTS")
+    os.environ["PT_FAULTS"] = "ckpt.shard_write:after:1=corrupt"
+    try:
+        faults.reset()  # arm from the env, as a launcher would
+        save_state_dict(state, path)
+    finally:
+        if old is None:
+            os.environ.pop("PT_FAULTS", None)
+        else:
+            os.environ["PT_FAULTS"] = old
+        faults.disarm_all()
+
+    target = _zeros_like(state)
+    with pytest.raises(ChecksumError) as ei:
+        load_state_dict(target, path)
+    msg = str(ei.value)
+    assert ".npy" in msg  # names the shard file
+    assert "crc32" in msg and "corrupt" in msg
+    # validate-before-fill: nothing was written into the target
+    for v in target.values():
+        np.testing.assert_array_equal(np.asarray(v), 0)
+
+
+def test_corrupt_shard_verify_opt_out(tmp_path):
+    """verify=False skips the checksum pass (escape hatch for callers
+    that want mmap-speed loads of trusted files) — the flipped bit then
+    flows straight into the loaded values."""
+    path = str(tmp_path)
+    state = _state()
+    faults.reset("ckpt.shard_write:after:1=corrupt")
+    save_state_dict(state, path)
+    faults.disarm_all()
+    target = _zeros_like(state)
+    load_state_dict(target, path, verify=False)  # no raise
+    changed = any(
+        not np.array_equal(np.asarray(target[k]), state[k])
+        for k in state)
+    assert changed  # the corruption really was there
+
+
+def test_manager_load_verifies_checksums(tmp_path):
+    """The commit-protocol manager (the guardian's rollback source)
+    goes through the same verified loader."""
+    mgr = CheckpointManager(str(tmp_path), world_size=1, rank=0)
+    faults.reset("ckpt.shard_write:after:1=corrupt")
+    mgr.save(_state(), 1)
+    faults.disarm_all()
+    target = _zeros_like(_state())
+    with pytest.raises(ChecksumError):
+        mgr.load(target)
+    for v in target.values():
+        np.testing.assert_array_equal(np.asarray(v), 0)
+
+
+def test_pre_checksum_checkpoints_still_load(tmp_path):
+    """Backward compatibility: metadata written before checksums (no
+    crc32 keys) must load without complaint."""
+    import json
+
+    path = str(tmp_path)
+    state = _state()
+    save_state_dict(state, path)
+    for m in (f for f in os.listdir(path)
+              if f.endswith("metadata.json")):
+        mp = os.path.join(path, m)
+        with open(mp) as f:
+            meta = json.load(f)
+        for entry in meta["tensors"].values():
+            for shard in entry["shards"]:
+                shard.pop("crc32", None)
+        with open(mp, "w") as f:
+            json.dump(meta, f)
+    target = _zeros_like(state)
+    load_state_dict(target, path)
+    for k, v in state.items():
+        np.testing.assert_array_equal(np.asarray(target[k]), v)

@@ -56,6 +56,44 @@ def test_compiled_step_single_device(tiny_cfg):
     step.sync_to_model()
 
 
+def test_guarded_llama_step_recovers_from_injected_nan(tiny_cfg, tmp_path):
+    """The training guardian on the real llama path: an injected NaN
+    burst mid-run skips, then rolls back to the last committed
+    checkpoint, and the run finishes identical to an uninjected one
+    (batches replayed by global_step)."""
+    from paddle_tpu.distributed.ckpt_commit import CheckpointManager
+    from paddle_tpu.testing import faults
+    from paddle_tpu.training import GuardedTrainStep, GuardianPolicy
+
+    def run(manager=None, n=8):
+        paddle.seed(0)
+        g = GuardedTrainStep(
+            CompiledTrainStep(LlamaForCausalLM(tiny_cfg), lr=1e-3),
+            manager=manager,
+            policy=GuardianPolicy(window=8, min_history=4,
+                                  skip_budget=1, rollback_budget=1,
+                                  checkpoint_every=3))
+        while g.global_step < n:
+            g.step(*_batch(tiny_cfg, bs=4, seq=16,
+                           seed=g.global_step + 1))
+        return g
+
+    clean = run()
+    # two consecutive NaN losses at step 4: skip (budget 1), rollback
+    faults.reset(",".join(["guard.nan_loss:before:4=inject"] * 2))
+    try:
+        mgr = CheckpointManager(str(tmp_path), world_size=1, rank=0)
+        injected = run(manager=mgr)
+    finally:
+        faults.disarm_all()
+    assert injected.guardian.skips == 1
+    assert injected.guardian.rollbacks == 1
+    for k in clean.inner.params:
+        np.testing.assert_array_equal(
+            np.asarray(clean.inner.params[k]),
+            np.asarray(injected.inner.params[k]))
+
+
 def test_compiled_step_matches_eager_adamw(tiny_cfg):
     """Compiled path and eager AdamW must implement the same math."""
     paddle.seed(3)

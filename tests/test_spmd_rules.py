@@ -477,10 +477,20 @@ def test_scatter_add_keeps_operand_sharding():
 
 
 def test_topk_keeps_batch_sharding():
+    """topk.cc rule: batch dims pass through.  Raw ``jax.lax.top_k``
+    replicates under GSPMD, so the framework op routes through a
+    variadic sort (ops/manipulation.py _topk) — assert the rule holds
+    on the op the framework actually uses, values included."""
+    from paddle_tpu.ops.manipulation import _topk
+
     mesh = _mesh()
     a = _sharded(mesh, (8, 64), P("x", None))
-    out = jax.jit(lambda x: jax.lax.top_k(x, 4)[0])(a)
-    assert tuple(out.sharding.spec)[:1] == ("x",)
+    vals, idx = jax.jit(lambda x: _topk(x, 4, -1, True))(a)
+    assert tuple(vals.sharding.spec)[:1] == ("x",)
+    assert tuple(idx.sharding.spec)[:1] == ("x",)
+    np.testing.assert_allclose(
+        np.asarray(vals), -np.sort(-np.asarray(a), axis=-1)[:, :4],
+        rtol=1e-6)
 
 
 def test_conv2d_batch_sharded():

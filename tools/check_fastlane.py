@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Fast-lane regression gate: fail on any NEW test failure.
+
+Runs the tier-1 fast lane (``pytest -m "not slow"``) and diffs the
+failing test ids against ``tools/fastlane_baseline.txt`` — the list of
+failures known and accepted at the last baseline refresh.  The gate:
+
+* exits non-zero when a test fails that is NOT in the baseline (a
+  regression someone just introduced), listing exactly which;
+* stays green when only baselined failures (or none) occur, and
+  reports baselined entries that now pass so the baseline can be
+  trimmed.
+
+Refresh the baseline by running with ``--update`` after consciously
+accepting the current failure set.
+"""
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "tools", "fastlane_baseline.txt")
+
+FASTLANE = [sys.executable, "-m", "pytest", "tests/", "-q", "-m",
+            "not slow", "--continue-on-collection-errors",
+            "-p", "no:cacheprovider"]
+
+# pytest -q summary lines: "FAILED tests/x.py::test_y - AssertionError"
+_FAIL_RE = re.compile(r"^(?:FAILED|ERROR)\s+(\S+)")
+
+
+def read_baseline():
+    known = set()
+    if os.path.exists(BASELINE):
+        with open(BASELINE) as f:
+            for line in f:
+                line = line.strip()
+                if line and not line.startswith("#"):
+                    known.add(line)
+    return known
+
+
+def run_fastlane():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(FASTLANE, cwd=REPO, env=env,
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True)
+    failures = set()
+    for line in proc.stdout.splitlines():
+        m = _FAIL_RE.match(line.strip())
+        if m:
+            failures.add(m.group(1))
+    tail = "\n".join(proc.stdout.splitlines()[-15:])
+    return proc.returncode, failures, tail
+
+
+def main(argv):
+    update = "--update" in argv
+    rc, failures, tail = run_fastlane()
+    known = read_baseline()
+    new = sorted(failures - known)
+    fixed = sorted(known - failures)
+
+    if update:
+        with open(BASELINE, "w") as f:
+            f.write("# Known fast-lane failures (one pytest node id per"
+                    " line).\n# verify-fast fails only on failures NOT"
+                    " listed here.\n")
+            for nid in sorted(failures):
+                f.write(nid + "\n")
+        print(f"[fastlane] baseline refreshed: {len(failures)} known "
+              f"failure(s) recorded")
+        return 0
+
+    print(tail)
+    print(f"[fastlane] {len(failures)} failure(s); baseline carries "
+          f"{len(known)}")
+    if fixed:
+        print("[fastlane] baselined entries now PASSING (trim the "
+              "baseline):")
+        for nid in fixed:
+            print(f"  - {nid}")
+    if new:
+        print("[fastlane] NEW failures (not in baseline) — this is a "
+              "regression:")
+        for nid in new:
+            print(f"  + {nid}")
+        return 1
+    if rc != 0 and not failures:
+        # pytest died without reporting test failures (collection crash,
+        # signal) — never mask that.
+        print(f"[fastlane] pytest exited {rc} without parseable "
+              "failures; failing the gate")
+        return rc
+    print("[fastlane] OK: no new failures")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
