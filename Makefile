@@ -32,7 +32,10 @@ smoke:
 		tests/test_ckpt_checksum.py \
 		tests/test_guardian.py \
 		tests/test_watchdog.py \
-		tests/test_dataloader_hardening.py
+		tests/test_dataloader_hardening.py \
+		tests/test_grouped_gemm.py \
+		tests/test_infermeta.py \
+		tests/test_moe_ep.py
 
 # Fast lane — must be green before any snapshot commit (see README).
 test-fast:

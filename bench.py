@@ -106,6 +106,9 @@ def main():
         blocks = os.environ.get("PT_BENCH_FLASH_BLOCKS")
         blocks = (tuple(int(x) for x in blocks.split(","))
                   if blocks else None)
+        # full | dots | save_attn | save_mlp (save the two MLP dot
+        # outputs; refwd skips the layer's two big H×I GEMMs — the
+        # candidate 0.60-MFU setting, HBM math in PERF.md round-7)
         policy = os.environ.get("PT_BENCH_REMAT", "full")
         # fused Pallas rms_norm: ~3-4% step-time win at this shape
         # (PERF.md r5); PT_BENCH_FUSED_RMS=0 reverts to the stock op
@@ -248,6 +251,7 @@ def main():
     _extend("detection_amp_o2", "PT_BENCH_SKIP_DET", _bench_detection,
             150, 40)
     _extend("serving", "PT_BENCH_SKIP_SERVING", _bench_serving, 180, 60)
+    _extend("moe", "PT_BENCH_SKIP_MOE", _bench_moe, 150, 40)
     _extend("large", "PT_BENCH_SKIP_LARGE", _bench_large, 500, 120)
     _extend("sd_unet", "PT_BENCH_SKIP_UNET", _bench_unet, 250, 60)
 
@@ -667,6 +671,116 @@ def _bench_serving(jax):
                             "pallas" if dt <= dense_dt else "dense")
         except Exception as e:  # A/B leg must never cost the headline
             out["ab_dense_tokens_s"] = {"error": str(e)[:120]}
+    return out
+
+
+def _bench_moe(jax):
+    """Fused-MoE step A/B (ROADMAP: >=1.5x vs the jnp path at d_model
+    2048 / 8 experts / top-2 on-chip).  One train-step body of the MoE
+    block — gate, dispatch, both expert GEMMs, combine, fwd+bwd — run
+    twice through PT_MOE_IMPL routing: 'fused' (sort dispatch +
+    grouped-GEMM Pallas kernel) vs 'einsum' (GShard mask-matmul).
+    Both legs share the single-device ep_moe_local body bench'd
+    directly at the jax level (no mesh — the all-to-alls are identical
+    between impls, so the A/B isolates dispatch + GEMM).  The grouped
+    GEMM tile is tuned first and the winning impl is persisted so auto
+    routing replays it (PERF.md round-7 methodology)."""
+    import gc
+    import math
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.utils import moe_utils
+    from paddle_tpu.ops import autotune
+    from paddle_tpu.ops.pallas_kernels import grouped_gemm
+
+    gc.collect()
+    H, E, k = 2048, 8, 2
+    F = int(os.environ.get("PT_BENCH_MOE_FFN", "5504"))
+    T = int(os.environ.get("PT_BENCH_MOE_TOKENS", "8192"))
+    C = max(1, int(math.ceil(T * 1.25 * k / E)))
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randn(T, H), jnp.bfloat16)
+    wg = jnp.asarray(rng.randn(H, E) * 0.02, jnp.float32)
+    w1 = jnp.asarray(rng.randn(E, H, F) * 0.02, jnp.bfloat16)
+    b1 = jnp.zeros([E, 1, F], jnp.bfloat16)
+    w2 = jnp.asarray(rng.randn(E, F, H) * 0.02, jnp.bfloat16)
+    b2 = jnp.zeros([E, 1, H], jnp.bfloat16)
+    args = (tokens, wg, w1, b1, w2, b2)
+
+    # Tile-tune the grouped GEMM at this shape before the A/B so the
+    # fused leg runs its best configuration (same contract as
+    # fa_blocks/paged_decode: winner cached per device+shape).
+    x_bkt = jnp.asarray(rng.randn(E, C, H), jnp.bfloat16)
+
+    def _measure_tile(cand):
+        autotune.record("grouped_gemm_blocks", (H, F), cand)
+
+        def thunk():
+            return grouped_gemm.grouped_ffn(x_bkt, w1, b1, w2, b2,
+                                            activation="gelu",
+                                            impl="pallas")
+        return autotune.measure_thunk(thunk, iters=4)
+
+    prior = autotune.lookup("grouped_gemm_blocks", (H, F), None)
+    if prior is None:
+        cands = [(128, 256), (256, 256), (128, 512), (512, 256)]
+        best = None
+        best_t = float("inf")
+        for cand in cands:
+            try:
+                t = _measure_tile(cand)
+            except Exception as e:
+                print(f"moe: tile {cand} failed: {e}", file=sys.stderr)
+                continue
+            print(f"moe: tile {cand}: {t * 1e3:.2f} ms", file=sys.stderr)
+            if t < best_t:
+                best, best_t = cand, t
+        if best is not None:
+            autotune.record("grouped_gemm_blocks", (H, F), best)
+            prior = best
+
+    def _step(impl):
+        def loss_fn(tokens, wg, w1, b1, w2, b2):
+            out, aux = moe_utils.ep_moe_local(
+                tokens, wg, w1, b1, w2, b2, axis_name=None, n=1,
+                num_experts=E, top_k=k, capacity=C, activation="gelu",
+                gate_kind="gshard", impl=impl)
+            return jnp.sum(out.astype(jnp.float32) ** 2) / T + aux
+        g = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 2, 3, 4, 5)))
+
+        def thunk():
+            return g(*args)
+        return thunk
+
+    print("moe[fused]: compiling...", file=sys.stderr)
+    fused_dt = autotune.measure_thunk(_step("fused"), iters=4)
+    reason = _implausible(fused_dt)
+    if reason is not None:
+        raise RuntimeError(f"implausible measurement: {reason}")
+    tok_s = T / fused_dt
+    print(f"moe[fused]: step {fused_dt * 1e3:.2f} ms, "
+          f"{tok_s:.0f} tok/s", file=sys.stderr)
+    out = {"value": round(tok_s, 1), "unit": "moe_tokens/s/chip",
+           "metric": "moe_block_fwdbwd_tokens_per_sec",
+           "d_model": H, "experts": E, "top_k": k, "ffn": F,
+           "tokens": T, "capacity": C, "dtype": "bfloat16",
+           "gemm_blocks": list(prior) if prior else None,
+           "impl": "fused (sort dispatch + grouped GEMM)"}
+    if os.environ.get("PT_BENCH_MOE_AB", "1") == "1":
+        try:
+            einsum_dt = autotune.measure_thunk(_step("einsum"), iters=4)
+            out["ab_einsum_tokens_s"] = round(T / einsum_dt, 1)
+            out["ab_speedup_vs_einsum"] = round(einsum_dt / fused_dt, 2)
+            print(f"moe[einsum]: step {einsum_dt * 1e3:.2f} ms "
+                  f"(fused speedup {einsum_dt / fused_dt:.2f}x)",
+                  file=sys.stderr)
+            # persist the measured winner so auto routing replays it
+            autotune.record("moe_impl", (H, E, k),
+                            "fused" if fused_dt <= einsum_dt
+                            else "einsum")
+        except Exception as e:  # A/B leg must never cost the headline
+            out["ab_einsum_tokens_s"] = {"error": str(e)[:120]}
     return out
 
 

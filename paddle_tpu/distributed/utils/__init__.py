@@ -5,6 +5,10 @@ Reference: ``python/paddle/distributed/utils/moe_utils.py``.
 from .moe_utils import (  # noqa: F401
     dispatch_masks,
     ep_moe_local,
+    fused_combine,
+    fused_dispatch,
     global_gather,
     global_scatter,
+    resolve_moe_impl,
+    sort_dispatch,
 )

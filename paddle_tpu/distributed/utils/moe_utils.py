@@ -16,8 +16,31 @@ tokens are dropped exactly as the reference's capacity-clipped gates do.
 
 These helpers are jax-level and must run inside a ``shard_map`` region whose
 mesh binds ``axis_name`` (see ``MoELayer(dispatch_mode='alltoall')``).
+
+Two dispatch implementations coexist (``PT_MOE_IMPL`` ∈ {auto, fused,
+einsum}):
+
+* ``einsum`` — the GShard mask-matmul formulation (Lepikhin et al.,
+  2020): one-hot einsums over dense ``dispatch [T, E, C]`` and
+  ``slot_mask [T, k, E, C]`` masks.  Simple, but the masks round-trip
+  HBM and their contractions are almost entirely multiply-by-zero.
+* ``fused`` — MegaBlocks-style (Gale et al., 2022) sort-based dispatch:
+  stable-sort token slots by expert id (the same variadic ``lax.sort``
+  trick topk uses for SPMD-friendliness), within-expert positions from
+  the sorted offsets, capacity clip, and a direct ``take`` of tokens
+  into ``[E, C, H]`` buckets — no ``[T, E, C]``-sized intermediate
+  exists anywhere in the program.  The expert FFN then runs through the
+  grouped GEMM kernel (``ops/pallas_kernels/grouped_gemm.py``) and the
+  combine is a gather back to token order weighted by gate probs.
+
+``auto`` takes the fused path on TPU when the hidden dim tiles to 128
+lanes, einsum otherwise.  Both paths drop the same overflow tokens: the
+stable sort preserves the flat ``(t, k)`` order within an expert, which
+is exactly the order the einsum path's running cumsum counts.
 """
 from __future__ import annotations
+
+import os
 
 import jax
 import jax.numpy as jnp
@@ -81,6 +104,93 @@ def dispatch_masks(probs, idx, num_experts, capacity):
     return dispatch, slot_mask, jax.lax.stop_gradient(keep)
 
 
+def resolve_moe_impl(hidden, impl=None):
+    """'fused' or 'einsum' for this hidden width.  ``impl`` (or
+    ``PT_MOE_IMPL``) ∈ {auto, fused, einsum}; auto = fused on TPU when
+    the hidden dim tiles to 128 lanes (the grouped-GEMM/VMEM layout
+    gate), einsum otherwise — CPU always resolves to einsum under auto
+    so the measured-good default never changes off-TPU."""
+    impl = (impl or os.environ.get("PT_MOE_IMPL", "auto")).lower()
+    if impl not in ("auto", "fused", "einsum"):
+        raise ValueError(
+            f"PT_MOE_IMPL={impl!r}: expected auto|fused|einsum")
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        return "fused" if (on_tpu and hidden % 128 == 0) else "einsum"
+    return impl
+
+
+def sort_dispatch(idx, num_experts, capacity):
+    """Sort-based routing plan from top-k expert ids (no dense masks).
+
+    idx: [T, k] top-k expert ids.  Returns a dict of stop-gradient
+    index/mask arrays:
+
+      src_tok [E*C] int32  token id filling each expert slot (0 if empty)
+      filled  [E*C] bool   slot actually holds a token
+      slot    [T, k] int32 expert slot of each (token, choice) (0 if
+                           dropped — always mask with ``keep``)
+      keep    [T, k] bool  choice survived the capacity clip
+
+    Construction: flatten to ``[T*k]`` expert ids, stable variadic
+    ``lax.sort`` carrying the flat position payload, within-expert
+    position = sorted rank − first-occurrence offset (one
+    ``searchsorted`` over the sorted ids — O(E log Tk), no [T*k, E]
+    one-hot), capacity clip, then two O(T*k) scatters build the
+    slot→token and (t, k)→slot maps.  Drop order matches
+    :func:`dispatch_masks` exactly: the stable sort preserves flat
+    (t, k) order within an expert — the order the einsum path's
+    cumsum counts.
+    """
+    T, k = idx.shape
+    E, C = num_experts, capacity
+    tk = T * k
+    e_flat = idx.reshape(tk).astype(jnp.int32)
+    flat_pos = jnp.arange(tk, dtype=jnp.int32)
+    se, sflat = jax.lax.sort((e_flat, flat_pos), dimension=0, num_keys=1,
+                             is_stable=True)
+    starts = jnp.searchsorted(se, jnp.arange(E, dtype=jnp.int32),
+                              side="left").astype(jnp.int32)
+    pos = flat_pos - starts[se]
+    keep_s = pos < C
+    slot_s = se * C + jnp.minimum(pos, C - 1)
+    # Overflow entries scatter to index E*C, which mode='drop' discards.
+    slot_write = jnp.where(keep_s, slot_s, E * C)
+    src_tok = jnp.zeros([E * C], jnp.int32).at[slot_write].set(
+        sflat // k, mode="drop")
+    filled = jnp.zeros([E * C], jnp.bool_).at[slot_write].set(
+        True, mode="drop")
+    # Unsort: slot/keep in flat (t, k) order.
+    slot_f = jnp.zeros([tk], jnp.int32).at[sflat].set(
+        jnp.where(keep_s, slot_s, 0))
+    keep_f = jnp.zeros([tk], jnp.bool_).at[sflat].set(keep_s)
+    sg = jax.lax.stop_gradient
+    return {"src_tok": sg(src_tok), "filled": sg(filled),
+            "slot": sg(slot_f.reshape(T, k)),
+            "keep": sg(keep_f.reshape(T, k))}
+
+
+def fused_dispatch(tokens, plan, capacity):
+    """Take tokens directly into [E, C, H] expert buckets (empty slots
+    zeroed).  Differentiable w.r.t. tokens (gather; its transpose is
+    the scatter-add the einsum path's mask contraction computes)."""
+    H = tokens.shape[-1]
+    picked = jnp.take(tokens, plan["src_tok"], axis=0)   # [E*C, H]
+    picked = picked * plan["filled"][:, None].astype(tokens.dtype)
+    return picked.reshape(-1, capacity, H)
+
+
+def fused_combine(y, plan, gate_w):
+    """Scatter-combine expert outputs back to token order, weighted by
+    gate probs.  y: [E, C, H]; gate_w: [T, k] (already keep-masked, so
+    a dropped choice contributes exactly 0 and routes no gradient)."""
+    T, k = plan["slot"].shape
+    y_flat = y.reshape(-1, y.shape[-1])                  # [E*C, H]
+    picked = jnp.take(y_flat, plan["slot"].reshape(T * k),
+                      axis=0).reshape(T, k, -1)          # [T, k, H]
+    return jnp.einsum("tkh,tk->th", picked, gate_w.astype(y.dtype))
+
+
 def _aux_loss(probs, idx, num_experts, kind, axis_name=None):
     """GShard/Switch load-balance loss: E * sum_e(me * ce)."""
     if kind == "naive":
@@ -97,12 +207,14 @@ def _aux_loss(probs, idx, num_experts, kind, axis_name=None):
 
 
 def ep_moe_local(tokens, wg, w1, b1, w2, b2, *, axis_name, n, num_experts,
-                 top_k, capacity, activation, gate_kind):
-    """Per-device EP MoE body (runs inside shard_map over ``axis_name``).
+                 top_k, capacity, activation, gate_kind, impl=None):
+    """Per-device EP MoE body (runs inside shard_map over ``axis_name``;
+    ``axis_name=None`` runs the same body single-device — the dense
+    MoELayer path and the bench harness use it that way).
 
     tokens: [T_local, H]; wg: [H, E] replicated gate; w1/b1/w2/b2: this
     device's expert slice ([E_local, H, F] etc).  Returns (out [T_local, H],
-    aux_loss scalar).
+    aux_loss scalar).  ``impl`` overrides PT_MOE_IMPL for this call.
     """
     E = num_experts
     logits = tokens.astype(jnp.float32) @ wg.astype(jnp.float32)
@@ -110,7 +222,15 @@ def ep_moe_local(tokens, wg, w1, b1, w2, b2, *, axis_name, n, num_experts,
     _, idx = jax.lax.top_k(probs, top_k)
     aux = _aux_loss(probs, idx, E, gate_kind, axis_name)
 
-    dispatch, slot_mask, keep = dispatch_masks(probs, idx, E, capacity)
+    impl = resolve_moe_impl(tokens.shape[-1], impl)
+    cdt = tokens.dtype
+    if impl == "fused":
+        plan = sort_dispatch(idx, E, capacity)
+        keep = plan["keep"]
+        expert_in = fused_dispatch(tokens, plan, capacity)  # [E, C, H]
+    else:
+        dispatch, slot_mask, keep = dispatch_masks(probs, idx, E, capacity)
+        expert_in = jnp.einsum("tec,th->ech", dispatch.astype(cdt), tokens)
 
     gate_w = jnp.take_along_axis(probs, idx, axis=-1)  # [T, k]
     if top_k > 1:
@@ -118,18 +238,31 @@ def ep_moe_local(tokens, wg, w1, b1, w2, b2, *, axis_name, n, num_experts,
         gate_w = gate_w / denom
     gate_w = gate_w * keep.astype(gate_w.dtype)
 
-    cdt = tokens.dtype
-    expert_in = jnp.einsum("tec,th->ech", dispatch.astype(cdt), tokens)
-    xin = global_scatter(expert_in, axis_name, n)  # [E_local, n*C, H]
-    if activation == "gelu":
-        # Match ops.gelu (exact erf form), not jax.nn.gelu's tanh default.
-        def act(v):
-            return jax.nn.gelu(v, approximate=False)
+    if axis_name is not None:
+        xin = global_scatter(expert_in, axis_name, n)  # [E_local, n*C, H]
     else:
-        act = getattr(jax.nn, activation)
-    h = act(jnp.einsum("ech,ehf->ecf", xin, w1) + b1)
-    y_local = jnp.einsum("ecf,efh->ech", h, w2) + b2
-    y = global_gather(y_local, axis_name, n)  # [E, C, H]
-    slot_out = jnp.einsum("ech,tkec->tkh", y, slot_mask.astype(cdt))
-    out = jnp.einsum("tkh,tk->th", slot_out, gate_w.astype(cdt))
+        xin = expert_in
+    if impl == "fused":
+        from ...ops.pallas_kernels.grouped_gemm import grouped_ffn
+
+        y_local = grouped_ffn(xin, w1, b1, w2, b2, activation)
+    else:
+        if activation == "gelu":
+            # Match ops.gelu (exact erf form), not jax.nn.gelu's tanh
+            # default.
+            def act(v):
+                return jax.nn.gelu(v, approximate=False)
+        else:
+            act = getattr(jax.nn, activation)
+        h = act(jnp.einsum("ech,ehf->ecf", xin, w1) + b1)
+        y_local = jnp.einsum("ecf,efh->ech", h, w2) + b2
+    if axis_name is not None:
+        y = global_gather(y_local, axis_name, n)  # [E, C, H]
+    else:
+        y = y_local
+    if impl == "fused":
+        out = fused_combine(y, plan, gate_w)
+    else:
+        slot_out = jnp.einsum("ech,tkec->tkh", y, slot_mask.astype(cdt))
+        out = jnp.einsum("tkh,tk->th", slot_out, gate_w.astype(cdt))
     return out, aux

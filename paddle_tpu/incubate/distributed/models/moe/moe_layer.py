@@ -60,11 +60,16 @@ class MoELayer(Layer):
                  gate=None, top_k=2, capacity_factor=1.25,
                  moe_group=None, mp_group=None, activation="gelu",
                  recompute_interval=0, mesh=None, ep_axis="ep",
-                 dispatch_mode="gspmd"):
+                 dispatch_mode="gspmd", moe_impl=None):
         """dispatch_mode: 'gspmd' routes via sharded einsums (GSPMD inserts
         the collectives); 'alltoall' runs the explicit expert-parallel
         exchange (global_scatter/global_gather all-to-alls under shard_map,
-        matching the reference's moe_utils.py:20,153 semantics)."""
+        matching the reference's moe_utils.py:20,153 semantics).
+
+        moe_impl: dispatch/FFN implementation — None defers to
+        ``PT_MOE_IMPL`` (auto = fused on TPU when H%128==0); 'fused'
+        forces sort-based dispatch + grouped GEMM; 'einsum' forces the
+        mask-matmul formulation.  Resolved at first trace."""
         super().__init__()
         self.d_model = d_model
         self.num_experts = num_experts
@@ -73,6 +78,7 @@ class MoELayer(Layer):
         self.mesh = mesh
         self.ep_axis = ep_axis
         self.dispatch_mode = dispatch_mode
+        self.moe_impl = moe_impl
         self._ep_op = None
         if dispatch_mode == "alltoall":
             if mesh is None or ep_axis not in mesh.dim_names:
@@ -156,7 +162,7 @@ class MoELayer(Layer):
             body = functools.partial(
                 moe_utils.ep_moe_local, axis_name=ep, n=n, num_experts=E,
                 top_k=k, capacity=C, activation=activation,
-                gate_kind=gate_kind)
+                gate_kind=gate_kind, impl=self.moe_impl)
             mapped = jax.shard_map(
                 body, mesh=mesh.jax_mesh,
                 in_specs=(tok_spec, P(), espec, espec, espec, espec),
@@ -202,9 +208,18 @@ class MoELayer(Layer):
         p = probs._data
         idx = topk_idx._data  # [T, k]
         k = idx.shape[-1]
-        dispatch_d, slot_mask_d, keep = _mu.dispatch_masks(p, idx, E, C)
-        dispatch = Tensor(dispatch_d.astype(p.dtype))
-        slot_mask = Tensor(slot_mask_d.astype(p.dtype))
+        # Per-expert layer lists can't feed the grouped GEMM (it wants
+        # stacked [E, ...] weights) — they stay on the einsum path.
+        impl = _mu.resolve_moe_impl(H, self.moe_impl)
+        fused = impl == "fused" and not isinstance(self.experts,
+                                                   (list, tuple))
+        if fused:
+            plan = _mu.sort_dispatch(idx, E, C)
+            keep = plan["keep"]
+        else:
+            dispatch_d, slot_mask_d, keep = _mu.dispatch_masks(p, idx, E, C)
+            dispatch = Tensor(dispatch_d.astype(p.dtype))
+            slot_mask = Tensor(slot_mask_d.astype(p.dtype))
 
         # Differentiable path: gate weights from probs, expert FFN, combine.
         gate_w = ops.take_along_axis(probs, topk_idx, axis=-1)  # [T, k]
@@ -215,6 +230,9 @@ class MoELayer(Layer):
         gate_w = ops.multiply(gate_w,
                               Tensor(keep.astype(p.dtype)))
 
+        if fused:
+            return self._forward_fused_dense(tokens, gate_w, plan,
+                                             B, S, H, C)
         expert_in = ops.einsum("tec,th->ech", dispatch, tokens)  # [E,C,H]
         if isinstance(self.experts, (list, tuple)):
             outs = [self.experts[e](expert_in[e]) for e in range(E)]
@@ -226,4 +244,30 @@ class MoELayer(Layer):
                               ops.cast(slot_mask, str(expert_out.dtype)))
         out = ops.einsum("tkh,tk->th", slot_out,
                          ops.cast(gate_w, str(expert_out.dtype)))
+        return ops.reshape(out, [B, S, H])
+
+    def _forward_fused_dense(self, tokens, gate_w, plan, B, S, H, C):
+        """Sort-dispatched dense forward: gather tokens into [E, C, H]
+        buckets, grouped expert GEMM (custom op ``grouped_expert_gemm``),
+        gather-combine back to token order.  No [T, E, C]-sized mask is
+        ever built; gradients flow through the gathers and the GEMM's
+        custom VJP exactly like the einsum path's mask contractions."""
+        from .....ops.pallas_kernels import grouped_gemm as _gg
+
+        E = self.num_experts
+        T, k = plan["slot"].shape
+        e = self.experts
+        cdt = str(tokens.dtype)
+        src_tok = Tensor(plan["src_tok"])
+        filled = Tensor(plan["filled"][:, None].astype(tokens._data.dtype))
+        expert_in = ops.reshape(
+            ops.multiply(ops.gather(tokens, src_tok, axis=0), filled),
+            [E, C, H])
+        expert_out = _gg.handle()(expert_in, e.w1, e.b1, e.w2, e.b2,
+                                  activation=e.activation)
+        y_flat = ops.reshape(expert_out, [E * C, H])
+        picked = ops.reshape(
+            ops.gather(y_flat, Tensor(plan["slot"].reshape(T * k)), axis=0),
+            [T, k, H])
+        out = ops.einsum("tkh,tk->th", picked, ops.cast(gate_w, cdt))
         return ops.reshape(out, [B, S, H])

@@ -43,11 +43,14 @@ class LlamaConfig:
     dtype: str = "float32"
     recompute: bool = False  # remat decoder layers in compiled steps
     # (the reference's fleet recompute, fleet/recompute/recompute.py:109)
-    recompute_policy: str = "full"  # "full" | "dots" | "save_attn"
-    # "full" = rematerialize everything in
+    recompute_policy: str = "full"  # "full" | "dots" | "save_attn" |
+    # "save_mlp".  "full" = rematerialize everything in
     # backward; "dots" = save matmul outputs, recompute elementwise only
     # (jax.checkpoint_policies.checkpoint_dots) — the reference's selective
-    # recompute (fleet recompute_hybrid granularity) done as an XLA policy
+    # recompute (fleet recompute_hybrid granularity) done as an XLA policy;
+    # "save_attn" saves the attention output (refwd skips qkv + attention);
+    # "save_mlp" saves the two MLP dot outputs (refwd skips the two big
+    # H×I GEMMs — the r6 MFU lever)
     scan_layers: bool = False  # lax.scan over decoder layers under jit:
     # one compiled layer body instead of L inlined copies (compile time
     # O(1) in depth; the XLA-native analog of the reference's static
@@ -102,11 +105,30 @@ def _remat_policy(name):
     if name == "save_attn":
         return _jax.checkpoint_policies.save_only_these_names(
             "attn_out")
+    if name == "save_mlp":
+        # Save only the two MLP dot outputs (gate_proj/up_proj, the
+        # [B, S, I] intermediates): the remat re-forward then skips the
+        # layer's two largest matmuls (2·B·S·H·I MACs each) at a cost of
+        # 2·B·S·I extra residual bytes per layer — the ROADMAP r6
+        # "selective remat MFU" lever (HBM math in PERF.md round-7).
+        return _jax.checkpoint_policies.save_only_these_names(
+            "mlp_gate", "mlp_up")
     if name not in (None, "full"):
         raise ValueError(
             f"unknown recompute_policy {name!r}; expected 'full', "
-            f"'dots' or 'save_attn'")
+            f"'dots', 'save_attn' or 'save_mlp'")
     return None
+
+
+def _ckpt_site(t, name):
+    """Tag a Tensor as a named checkpoint site (no-op outside a trace)."""
+    import jax as _jax
+    from jax.ad_checkpoint import checkpoint_name as _ckpt_name
+
+    if isinstance(t._data, _jax.core.Tracer):
+        return Tensor(_ckpt_name(t._data, name),
+                      stop_gradient=t.stop_gradient)
+    return t
 
 
 class LlamaAttention(nn.Layer):
@@ -144,12 +166,7 @@ class LlamaAttention(nn.Layer):
         # this value so the remat refwd skips qkv projections + the
         # attention kernel entirely (~670MB at the bench config; the
         # r3 "cut the remat extra forward" lever, PERF.md).
-        import jax as _jax
-        from jax.ad_checkpoint import checkpoint_name as _ckpt_name
-
-        out = Tensor(_ckpt_name(out._data, "attn_out"),
-                     stop_gradient=out.stop_gradient) \
-            if isinstance(out._data, _jax.core.Tracer) else out
+        out = _ckpt_site(out, "attn_out")
         return self.o_proj(out)
 
     def _context_parallel_attention(self, q, k, v, attn_mask=None):
@@ -203,7 +220,11 @@ class LlamaMLP(nn.Layer):
         self.down_proj = nn.Linear(i, h, bias_attr=False)
 
     def forward(self, x):
-        return self.down_proj(ops.swiglu(self.gate_proj(x), self.up_proj(x)))
+        # named sites: recompute_policy="save_mlp" saves these two dot
+        # outputs so the remat refwd skips the layer's two big H×I GEMMs.
+        g = _ckpt_site(self.gate_proj(x), "mlp_gate")
+        u = _ckpt_site(self.up_proj(x), "mlp_up")
+        return self.down_proj(ops.swiglu(g, u))
 
 
 class LlamaDecoderLayer(nn.Layer):

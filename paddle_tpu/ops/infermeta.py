@@ -206,6 +206,163 @@ def _cross_entropy(datas, attrs):
               f"{list(ys)} for logits {list(ls)}")
 
 
+def _int_dtype(x):
+    dt = getattr(x, "dtype", None)
+    return dt is None or np.issubdtype(np.dtype(str(dt)), np.integer)
+
+
+def _axis_in(op, axis, nd, extra=0):
+    """Normalize ``axis`` against rank ``nd`` (+``extra`` for ops that
+    insert dims); fail with the reference-style message if out of range."""
+    lo, hi = -(nd + extra), nd + extra
+    if not lo <= axis < hi:
+        _fail(op,
+              f"The axis is expected to be in range of [{lo}, {hi}), "
+              f"but got {axis}")
+    return axis % hi if axis < 0 else axis
+
+
+@register_validator("stack")
+def _stack(datas, attrs):
+    shapes = [_shape(d) for d in datas]
+    if not shapes:
+        _fail("stack", "stack expects at least one input")
+    base = shapes[0]
+    for i, s in enumerate(shapes[1:], 1):
+        if s != base:
+            _fail("stack",
+                  f"inputs to stack must all have the same shape; "
+                  f"input[0]: {list(base)} vs input[{i}]: {list(s)}")
+    _axis_in("stack", int(attrs.get("axis", 0)), len(base), extra=1)
+
+
+@register_validator("gather")
+def _gather(datas, attrs):
+    x, index = datas[0], datas[1]
+    if not _int_dtype(index):
+        _fail("gather",
+              f"the index must be an integer dtype, got "
+              f"{getattr(index, 'dtype', None)}")
+    if _ndim(index) > 1:
+        _fail("gather",
+              f"the index should be a 0-D or 1-D tensor, got rank "
+              f"{_ndim(index)}")
+    _axis_in("gather", int(attrs.get("axis", 0)), max(_ndim(x), 1))
+
+
+@register_validator("scatter")
+def _scatter(datas, attrs):
+    x, index, updates = datas[0], datas[1], datas[2]
+    if not _int_dtype(index):
+        _fail("scatter",
+              f"the index must be an integer dtype, got "
+              f"{getattr(index, 'dtype', None)}")
+    xs, us = _shape(x), _shape(updates)
+    if _ndim(index) == 1 and len(us) == len(xs) and len(xs) >= 1:
+        if us[0] != _shape(index)[0]:
+            _fail("scatter",
+                  f"updates' first dim should equal index length "
+                  f"({_shape(index)[0]}), but received updates "
+                  f"{list(us)}")
+        if us[1:] != xs[1:]:
+            _fail("scatter",
+                  f"updates' trailing dims should match input's "
+                  f"({list(xs[1:])}), but received updates {list(us)}")
+
+
+@register_validator("take_along_axis")
+def _take_along_axis(datas, attrs):
+    x, index = datas[0], datas[1]
+    if not _int_dtype(index):
+        _fail("take_along_axis",
+              f"the indices must be an integer dtype, got "
+              f"{getattr(index, 'dtype', None)}")
+    if _ndim(index) != _ndim(x):
+        _fail("take_along_axis",
+              f"indices rank ({_ndim(index)}) must equal input rank "
+              f"({_ndim(x)}); input {list(_shape(x))}, indices "
+              f"{list(_shape(index))}")
+    _axis_in("take_along_axis", int(attrs.get("axis", 0)),
+             max(_ndim(x), 1))
+
+
+@register_validator("squeeze")
+def _squeeze(datas, attrs):
+    x = datas[0]
+    axis = attrs.get("axis")
+    if axis is None:
+        return
+    nd = _ndim(x)
+    for a in (axis if isinstance(axis, (list, tuple)) else (axis,)):
+        _axis_in("squeeze", int(a), nd)
+
+
+@register_validator("unsqueeze")
+def _unsqueeze(datas, attrs):
+    x = datas[0]
+    axis = attrs.get("axis")
+    axes = axis if isinstance(axis, (list, tuple)) else (axis,)
+    # rank grows by one per inserted dim; each axis addresses the
+    # already-expanded rank (jnp.expand_dims semantics).
+    nd = _ndim(x) + len(axes) - 1
+    for a in axes:
+        _axis_in("unsqueeze", int(a), nd, extra=1)
+
+
+@register_validator("tile")
+def _tile(datas, attrs):
+    rt = attrs.get("repeat_times", ())
+    for r in rt:
+        if int(r) <= 0:
+            _fail("tile",
+                  f"every element of repeat_times must be a positive "
+                  f"integer, got {list(rt)}")
+
+
+@register_validator("pad")
+def _pad(datas, attrs):
+    pw = attrs.get("pad_width", ())
+    for pair in pw:
+        lo, hi = pair
+        if int(lo) < 0 or int(hi) < 0:
+            _fail("pad",
+                  f"paddings must be non-negative, got "
+                  f"{[list(p) for p in pw]}")
+
+
+@register_validator("expand")
+def _expand(datas, attrs):
+    x = datas[0]
+    shape = attrs.get("shape", ())
+    xs = _shape(x)
+    if len(shape) < len(xs):
+        _fail("expand",
+              f"the target shape's rank ({len(shape)}) must be >= the "
+              f"input's rank ({len(xs)}); input {list(xs)}, target "
+              f"{list(shape)}")
+    for xd, td in zip(xs[::-1], tuple(shape)[::-1]):
+        if xd != 1 and xd != td:
+            _fail("expand",
+                  f"input shape {list(xs)} cannot expand to "
+                  f"{list(shape)}: dim {xd} is neither 1 nor {td}")
+
+
+@register_validator("transpose")
+def _transpose(datas, attrs):
+    x = datas[0]
+    perm = attrs.get("perm", ())
+    nd = _ndim(x)
+    if len(perm) != nd:
+        _fail("transpose",
+              f"perm's length ({len(perm)}) must equal input rank "
+              f"({nd}); perm {list(perm)}")
+    norm = [int(p) + nd if int(p) < 0 else int(p) for p in perm]
+    if sorted(norm) != list(range(nd)):
+        _fail("transpose",
+              f"perm {list(perm)} is not a permutation of "
+              f"[0, {nd})")
+
+
 @register_validator("split")
 def _split(datas, attrs):
     x = datas[0]

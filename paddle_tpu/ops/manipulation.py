@@ -85,15 +85,23 @@ squeeze_op = register_op(
 
 
 def squeeze(x, axis=None, name=None):
+    # Out-of-range axes pass through raw so the squeeze InferMeta
+    # validator rejects them (silently wrapping with % would accept
+    # axis=5 on a 2-D input).
     if isinstance(axis, (list, tuple)):
-        axis = tuple(a % x.ndim for a in axis)
-        axis = tuple(a for a in axis if x.shape[a] == 1)
-        if not axis:
-            return assign(x)
+        if all(-x.ndim <= int(a) < x.ndim for a in axis):
+            axis = tuple(int(a) % x.ndim for a in axis)
+            axis = tuple(a for a in axis if x.shape[a] == 1)
+            if not axis:
+                return assign(x)
+        else:
+            axis = tuple(int(a) for a in axis)
     elif axis is not None:
-        axis = int(axis) % x.ndim
-        if x.shape[axis] != 1:
-            return assign(x)
+        axis = int(axis)
+        if -x.ndim <= axis < x.ndim:
+            axis %= x.ndim
+            if x.shape[axis] != 1:
+                return assign(x)
     return apply(squeeze_op, x, axis=axis)
 
 
@@ -228,7 +236,16 @@ def split(x, num_or_sections, axis=0, name=None):
 
     if isinstance(axis, Tensor):
         axis = int(axis.item())
-    axis = int(axis) % x.ndim
+    axis = int(axis)
+    if not -x.ndim <= axis < x.ndim:
+        # Out-of-range axis goes through raw so the split InferMeta
+        # validator rejects it with the reference-style message.
+        return list(apply(split_op, x,
+                          indices=(num_or_sections
+                                   if isinstance(num_or_sections, int)
+                                   else tuple(num_or_sections)),
+                          axis=axis))
+    axis %= x.ndim
     dim = x.shape[axis]
     if isinstance(num_or_sections, int):
         indices = int(num_or_sections)

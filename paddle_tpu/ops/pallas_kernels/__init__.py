@@ -8,5 +8,10 @@ designed for this framework's hot paths and profiles:
   sequences (BERT-class S<=1024), where materializing [B,H,S,S] probs
   and their dropout masks in HBM dominated the step (r4 profile:
   ~60 ms of a 180 ms BERT step).
+- ``grouped_gemm``: both expert matmuls of a sort-dispatched MoE step
+  for all experts in one kernel (MegaBlocks-style), the [E, C, F]
+  hidden activation VMEM-resident per tile instead of an HBM
+  round-trip.
 """
+from .grouped_gemm import grouped_ffn  # noqa: F401
 from .short_attention import short_attention  # noqa: F401

@@ -74,3 +74,166 @@ def test_matmul_rejects_mismatched_inner_dims():
     y = paddle.to_tensor(np.ones((4, 5), np.float32))
     with pytest.raises(InvalidArgumentError, match="width"):
         paddle.matmul(x, y)
+
+
+# -- batch 2 (round 9): manipulation / indexing ops -------------------------
+#
+# One accept + one reject case per op, all through the public API.
+
+def _f32(*shape):
+    return paddle.to_tensor(np.random.randn(*shape).astype(np.float32))
+
+
+def test_concat_accepts_matching_ranks():
+    out = paddle.concat([_f32(2, 3), _f32(4, 3)], axis=0)
+    assert list(out.shape) == [6, 3]
+
+
+def test_concat_rejects_mismatched_off_axis_dims():
+    with pytest.raises(InvalidArgumentError, match="expected to be equal"):
+        paddle.concat([_f32(2, 3), _f32(2, 4)], axis=0)
+
+
+def test_split_accepts_even_sections():
+    parts = paddle.split(_f32(6, 2), 3, axis=0)
+    assert [list(p.shape) for p in parts] == [[2, 2]] * 3
+
+
+def test_split_rejects_bad_axis():
+    with pytest.raises(InvalidArgumentError, match="axis"):
+        paddle.split(_f32(6, 2), 3, axis=5)
+
+
+def test_where_accepts_broadcast():
+    c = paddle.to_tensor(np.array([True, False]))
+    out = paddle.where(c, _f32(3, 2), _f32(3, 2))
+    assert list(out.shape) == [3, 2]
+
+
+def test_where_rejects_incompatible():
+    c = paddle.to_tensor(np.array([True, False, True]))
+    with pytest.raises(InvalidArgumentError, match="broadcast"):
+        paddle.where(c, _f32(3, 2), _f32(3, 2))
+
+
+def test_matmul_accepts_transpose_y():
+    out = paddle.matmul(_f32(2, 3), _f32(5, 3), transpose_y=True)
+    assert list(out.shape) == [2, 5]
+
+
+def test_stack_accepts_same_shapes():
+    out = paddle.stack([_f32(2, 3), _f32(2, 3)], axis=1)
+    assert list(out.shape) == [2, 2, 3]
+
+
+def test_stack_rejects_mismatched_shapes():
+    with pytest.raises(InvalidArgumentError, match="same shape"):
+        paddle.stack([_f32(2, 3), _f32(3, 2)])
+
+
+def test_gather_accepts_1d_index():
+    idx = paddle.to_tensor(np.array([2, 0], np.int64))
+    out = paddle.gather(_f32(4, 3), idx, axis=0)
+    assert list(out.shape) == [2, 3]
+
+
+def test_gather_rejects_float_index():
+    idx = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    with pytest.raises(InvalidArgumentError, match="integer dtype"):
+        paddle.gather(_f32(4, 3), idx, axis=0)
+
+
+def test_scatter_accepts_row_updates():
+    x = _f32(4, 3)
+    idx = paddle.to_tensor(np.array([1, 3], np.int64))
+    out = paddle.scatter(x, idx, _f32(2, 3))
+    assert list(out.shape) == [4, 3]
+
+
+def test_scatter_rejects_mismatched_updates():
+    idx = paddle.to_tensor(np.array([1, 3], np.int64))
+    with pytest.raises(InvalidArgumentError, match="first dim"):
+        paddle.scatter(_f32(4, 3), idx, _f32(3, 3))
+
+
+def test_take_along_axis_accepts_matching_rank():
+    idx = paddle.to_tensor(np.zeros((4, 1), np.int64))
+    out = paddle.take_along_axis(_f32(4, 3), idx, axis=1)
+    assert list(out.shape) == [4, 1]
+
+
+def test_take_along_axis_rejects_rank_mismatch():
+    idx = paddle.to_tensor(np.zeros((4,), np.int64))
+    with pytest.raises(InvalidArgumentError, match="rank"):
+        paddle.take_along_axis(_f32(4, 3), idx, axis=1)
+
+
+def test_squeeze_accepts_unit_axis():
+    assert list(paddle.squeeze(_f32(2, 1, 3), axis=1).shape) == [2, 3]
+
+
+def test_squeeze_rejects_out_of_range_axis():
+    with pytest.raises(InvalidArgumentError, match="range"):
+        paddle.squeeze(_f32(2, 1, 3), axis=5)
+
+
+def test_unsqueeze_accepts_new_trailing_axis():
+    assert list(paddle.unsqueeze(_f32(2, 3), axis=-1).shape) == [2, 3, 1]
+
+
+def test_unsqueeze_rejects_out_of_range_axis():
+    with pytest.raises(InvalidArgumentError, match="range"):
+        paddle.unsqueeze(_f32(2, 3), axis=4)
+
+
+def test_tile_accepts_positive_repeats():
+    assert list(paddle.tile(_f32(2, 3), [2, 1]).shape) == [4, 3]
+
+
+def test_tile_rejects_nonpositive_repeats():
+    with pytest.raises(InvalidArgumentError, match="positive"):
+        paddle.tile(_f32(2, 3), [2, 0])
+
+
+def test_pad_accepts_nonnegative_paddings():
+    out = F.pad(_f32(2, 3), [1, 2])
+    assert list(out.shape) == [2, 6]
+
+
+def test_pad_rejects_negative_paddings():
+    with pytest.raises(InvalidArgumentError, match="non-negative"):
+        F.pad(_f32(2, 3), [1, -2])
+
+
+def test_expand_accepts_broadcastable_target():
+    assert list(paddle.expand(_f32(1, 3), [4, 3]).shape) == [4, 3]
+
+
+def test_expand_rejects_incompatible_dim():
+    with pytest.raises(InvalidArgumentError, match="expand"):
+        paddle.expand(_f32(2, 3), [4, 3])
+
+
+def test_transpose_accepts_permutation():
+    assert list(paddle.transpose(_f32(2, 3, 4), [2, 0, 1]).shape) \
+        == [4, 2, 3]
+
+
+def test_transpose_rejects_non_permutation():
+    with pytest.raises(InvalidArgumentError, match="permutation"):
+        paddle.transpose(_f32(2, 3, 4), [0, 0, 2])
+
+
+def test_validators_skip_traced_values():
+    """Validators are eager-only: a traced call with shapes the eager
+    checker would reject at the metadata level must defer to XLA (here
+    the shapes are valid, so the jit path simply runs)."""
+    import paddle_tpu.jit as jit
+
+    @jit.to_static
+    def f(x, idx):
+        return paddle.gather(x, idx, axis=0)
+
+    x = _f32(4, 3)
+    idx = paddle.to_tensor(np.array([1, 2], np.int64))
+    assert list(f(x, idx).shape) == [2, 3]

@@ -258,3 +258,30 @@ def test_stochastic_round_unbiased():
     # unbiased: mean of rounded values ~ the fp32 value, far tighter than
     # the 1/256 bf16 ulp that deterministic rounding would miss by
     np.testing.assert_allclose(mean, 1.0 + 1e-3, atol=2e-4)
+
+
+def test_llama_save_mlp_policy_matches_full():
+    """recompute_policy='save_mlp' (save the two MLP dot outputs; the
+    remat refwd skips the two big H x I GEMMs) computes the same loss
+    as full remat, with and without scan_layers."""
+    losses = {}
+    for policy, scan in (("full", True), ("save_mlp", True),
+                         ("save_mlp", False)):
+        cfg = LlamaConfig.tiny(recompute=True, recompute_policy=policy,
+                               scan_layers=scan)
+        paddle.seed(3)
+        model = LlamaForCausalLM(cfg)
+        step = CompiledTrainStep(model, lr=1e-3, donate=False)
+        ids = np.random.RandomState(0).randint(
+            0, 256, (2, 64)).astype(np.int32)
+        losses[(policy, scan)] = float(step.step(ids, ids))
+    ref = losses[("full", True)]
+    for key, val in losses.items():
+        np.testing.assert_allclose(val, ref, rtol=1e-5, err_msg=str(key))
+
+
+def test_llama_unknown_remat_policy_rejected():
+    from paddle_tpu.models.llama import _remat_policy
+
+    with pytest.raises(ValueError, match="recompute_policy"):
+        _remat_policy("save_everything")
