@@ -35,7 +35,9 @@ smoke:
 		tests/test_dataloader_hardening.py \
 		tests/test_grouped_gemm.py \
 		tests/test_infermeta.py \
-		tests/test_moe_ep.py
+		tests/test_moe_ep.py \
+		tests/test_serving_scheduler.py \
+		tests/test_load_harness.py
 
 # Fast lane — must be green before any snapshot commit (see README).
 test-fast:

@@ -671,7 +671,54 @@ def _bench_serving(jax):
                             "pallas" if dt <= dense_dt else "dense")
         except Exception as e:  # A/B leg must never cost the headline
             out["ab_dense_tokens_s"] = {"error": str(e)[:120]}
+    if os.environ.get("PT_BENCH_SERVE_SCHED", "1") == "1":
+        try:
+            out["scheduler"] = _measure_scheduler(model, cfg, max_seqs)
+        except Exception as e:  # same guard as the A/B leg
+            out["scheduler"] = {"error": str(e)[:120]}
     return out
+
+
+def _measure_scheduler(model, cfg, max_seqs):
+    """Continuous-batching scheduler under seeded load (r10): the
+    ServingEngine admits/preempts/streams a generate_load workload and
+    the SLO metrics come straight out of engine.stats() — serving
+    tok/s, TTFT/TPOT percentiles, batch occupancy."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.inference.server import ServingEngine
+    from paddle_tpu.testing.load import LoadSpec, generate_load, run_load
+
+    n_req = int(os.environ.get("PT_BENCH_SERVE_REQS", "16"))
+    eng = ServingEngine(model, max_seqs=max_seqs, page_size=16,
+                        max_len=512, dtype=jnp.bfloat16,
+                        prefill_chunk=128)
+    work = generate_load(LoadSpec(
+        n_requests=n_req, mean_interarrival=1.0, prompt_len=(64, 128),
+        max_new=(16, 32), vocab=cfg.vocab_size, seed=0))
+    print(f"serving[scheduler]: {n_req} seeded requests, batch "
+          f"{max_seqs}...", file=sys.stderr)
+    res = run_load(eng, work)
+    st = res["stats"]
+    done = st["requests"]["finished"] + st["requests"]["truncated"]
+    if done != n_req:
+        raise RuntimeError(f"load did not finish cleanly: "
+                           f"{st['requests']}")
+    print(f"serving[scheduler]: {st['throughput_tok_s']:.0f} tok/s, "
+          f"ttft p50 {st['ttft_ms_p50']} ms, occupancy "
+          f"{st['batch_occupancy']}", file=sys.stderr)
+    return {
+        "serving_tok_s": st["throughput_tok_s"],
+        "ttft_ms_p50": st["ttft_ms_p50"],
+        "ttft_ms_p99": st["ttft_ms_p99"],
+        "tpot_ms_p50": st["tpot_ms_p50"],
+        "tpot_ms_p99": st["tpot_ms_p99"],
+        "batch_occupancy": st["batch_occupancy"],
+        "page_utilization": st["page_utilization"],
+        "preemptions": st["preemptions"],
+        "requests": n_req,
+        "steps": st["steps"],
+    }
 
 
 def _bench_moe(jax):

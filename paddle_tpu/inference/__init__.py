@@ -494,3 +494,6 @@ from .paged import (  # noqa: F401,E402
     PagedKVCache, masked_multihead_attention, paged_decode_attention,
 )
 from .serving import PagedLlamaEngine  # noqa: F401,E402
+from .server import (  # noqa: F401,E402
+    PagedExecutor, RequestHandle, RequestState, ServingEngine,
+)

@@ -192,11 +192,17 @@ class PagedKVCache:
     """
 
     def __init__(self, n_layers, n_kv_heads, head_dim, num_pages,
-                 page_size=16, max_seqs=8, dtype=jnp.bfloat16):
+                 page_size=16, max_seqs=8, dtype=jnp.bfloat16,
+                 max_pages_per_seq=None):
         self.n_layers = n_layers
         self.page_size = page_size
         self.num_pages = num_pages
-        self.max_pages_per_seq = num_pages // max_seqs
+        # Per-seq budget decoupled from the pool size: a serving pool is
+        # deliberately OVERSUBSCRIBED (num_pages < max_seqs * budget) so
+        # admission pressure is real and preemption has something to do.
+        self.max_pages_per_seq = (num_pages // max_seqs
+                                  if max_pages_per_seq is None
+                                  else int(max_pages_per_seq))
         self.max_seqs = max_seqs
         shape = (n_layers, n_kv_heads, num_pages, page_size, head_dim)
         self.k_pages = jnp.zeros(shape, dtype)
@@ -268,26 +274,51 @@ class PagedKVCache:
 
     def prefill(self, seq: int, k, v) -> None:
         """Write a prompt's KV: k/v [L, KV, T, D]."""
+        self.write_at(seq, k, v, 0)
+
+    def write_at(self, seq: int, k, v, start: int) -> None:
+        """Write a token span's KV at position ``start`` (chunked
+        prefill): k/v [L, KV, T, D] covering positions
+        ``start..start+T-1``.  Pages are allocated as needed; the
+        sequence length becomes ``start + T``."""
         k = jnp.asarray(k, self.k_pages.dtype)
         v = jnp.asarray(v, self.v_pages.dtype)
         T = k.shape[2]
-        self._ensure_capacity(seq, T)
+        self._ensure_capacity(seq, start + T)
         ps = self.page_size
-        n_full = T // ps
-        for i in range(n_full):  # whole-page scatters
-            pid = int(self.page_table[seq, i])
-            self.k_pages = self.k_pages.at[:, :, pid].set(
-                k[:, :, i * ps:(i + 1) * ps])
-            self.v_pages = self.v_pages.at[:, :, pid].set(
-                v[:, :, i * ps:(i + 1) * ps])
-        rem = T - n_full * ps
-        if rem:
-            pid = int(self.page_table[seq, n_full])
-            self.k_pages = self.k_pages.at[:, :, pid, :rem].set(
-                k[:, :, n_full * ps:])
-            self.v_pages = self.v_pages.at[:, :, pid, :rem].set(
-                v[:, :, n_full * ps:])
-        self.lengths[seq] = T
+        t = 0
+        while t < T:
+            pos = start + t
+            page, off = pos // ps, pos % ps
+            n = min(ps - off, T - t)  # span within this page
+            pid = int(self.page_table[seq, page])
+            self.k_pages = self.k_pages.at[:, :, pid, off:off + n].set(
+                k[:, :, t:t + n])
+            self.v_pages = self.v_pages.at[:, :, pid, off:off + n].set(
+                v[:, :, t:t + n])
+            t += n
+        self.lengths[seq] = start + T
+
+    def gather_dense(self, seq: int, length=None):
+        """Gather a sequence's pages into dense [L, KV, P, D] arrays
+        (P = page-multiple cover of ``length``) — the past-KV operand of
+        the chunked-prefill forward.  Positions >= length are garbage
+        and must be masked by the consumer."""
+        L = int(self.lengths[seq]) if length is None else int(length)
+        n = -(-L // self.page_size)
+        pids = jnp.asarray(np.maximum(self.page_table[seq, :n], 0))
+        k = self.k_pages[:, :, pids]          # [L, KV, n, ps, D]
+        v = self.v_pages[:, :, pids]
+        sh = (k.shape[0], k.shape[1], n * self.page_size, k.shape[4])
+        return k.reshape(sh), v.reshape(sh)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def free_slots(self) -> int:
+        return self._active.count(False)
 
     def append(self, seqs, k, v) -> None:
         """Decode-step write: one new token per listed sequence.

@@ -1,0 +1,21 @@
+"""Continuous-batching serving subsystem over the paged KV cache.
+
+Layering (host control plane / device data plane):
+
+  ServingEngine (engine.py)  user API: submit / cancel / step / stats
+    Scheduler   (scheduler.py) iteration-level admission, chunked
+                               prefill, preemption-with-recompute
+    EngineMetrics (metrics.py) TTFT/TPOT/queue-wait/occupancy SLOs
+    PagedExecutor (executor.py) jit'd prefill/chunk/decode forwards
+                                over paged.PagedKVCache slots
+"""
+from .engine import ServingEngine
+from .executor import PagedExecutor
+from .metrics import EngineMetrics
+from .request import Request, RequestHandle, RequestState, TERMINAL
+from .scheduler import Scheduler
+
+__all__ = [
+    "ServingEngine", "PagedExecutor", "EngineMetrics", "Request",
+    "RequestHandle", "RequestState", "TERMINAL", "Scheduler",
+]

@@ -1,0 +1,102 @@
+"""User-facing continuous-batching serving engine.
+
+Single-threaded by design: ``submit()`` enqueues, ``step()`` runs one
+scheduler iteration, and handles pull results by driving ``step()``
+themselves.  This keeps every test deterministic (the logical clock IS
+the iteration count) while the control flow matches what a threaded
+front-end would do per tick.
+
+    engine = ServingEngine(model, max_seqs=4, page_size=16)
+    h = engine.submit(prompt_ids, max_new_tokens=32)
+    for tok in h.stream():   # drives engine.step() under the hood
+        ...
+    engine.stats()           # SLO metrics dict
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .executor import PagedExecutor
+from .metrics import EngineMetrics
+from .request import Request, RequestHandle, RequestState
+from .scheduler import Scheduler
+
+
+class ServingEngine:
+    def __init__(self, model, max_seqs=4, page_size=16, max_len=256,
+                 dtype=jnp.float32, num_pages=None, policy="fifo",
+                 prefill_chunk=None, eos_token_id=None,
+                 max_preemptions=4):
+        self.executor = PagedExecutor(
+            model, max_seqs=max_seqs, page_size=page_size,
+            max_len=max_len, dtype=dtype, num_pages=num_pages)
+        self.metrics = EngineMetrics(
+            max_seqs=max_seqs, num_pages=self.executor.cache.num_pages)
+        self.scheduler = Scheduler(
+            self.executor, self.metrics, policy=policy,
+            prefill_chunk=prefill_chunk, eos_token_id=eos_token_id,
+            max_preemptions=max_preemptions)
+        self._next_rid = 0
+
+    # -- submission ------------------------------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens=16, priority=0,
+               deadline=None, on_token=None, rid=None) -> RequestHandle:
+        """Enqueue a request; admission happens at the next step().
+
+        ``deadline`` is in scheduler iterations (logical steps) from
+        submission; ``on_token(rid, tok)`` streams tokens as they land.
+        """
+        if rid is None:
+            rid = f"req-{self._next_rid}"
+        if rid in self.scheduler.requests:
+            raise ValueError(f"duplicate request id {rid!r}")
+        req = Request(rid, prompt_ids, max_new_tokens=max_new_tokens,
+                      priority=priority, deadline=deadline,
+                      on_token=on_token, arrival_seq=self._next_rid)
+        self._next_rid += 1
+        if len(req.prompt_ids) == 0:
+            raise ValueError("prompt_ids must be non-empty")
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.scheduler.add(req)
+        return RequestHandle(self, req)
+
+    def cancel(self, rid) -> None:
+        """Flag a request for cancellation; it turns CANCELLED at the
+        start of the next step() (pages freed there, not here)."""
+        req = self.scheduler.requests.get(rid)
+        if req is not None and not req.terminal:
+            req.cancel_flag = True
+
+    # -- driving ---------------------------------------------------------
+
+    def step(self) -> dict:
+        """One scheduler iteration; returns {rid: [new tokens]}."""
+        return self.scheduler.step()
+
+    def run(self, max_steps=100000) -> dict:
+        """Step until no request is in flight; returns stats()."""
+        while self.scheduler.has_work():
+            if self.scheduler.tick >= max_steps:
+                raise RuntimeError(
+                    f"serving engine did not drain in {max_steps} steps")
+            self.step()
+        return self.stats()
+
+    # -- introspection ---------------------------------------------------
+
+    @property
+    def tick(self) -> int:
+        return self.scheduler.tick
+
+    @property
+    def in_flight(self) -> int:
+        s = self.scheduler
+        return len(s.queue) + len(s.prefilling) + len(s.running)
+
+    def request(self, rid):
+        return self.scheduler.requests.get(rid)
+
+    def stats(self) -> dict:
+        return self.metrics.stats()

@@ -1,0 +1,384 @@
+"""PagedExecutor — the model-execution backend of the serving stack.
+
+This is the data plane the continuous-batching scheduler drives: it owns
+the stacked model parameters, the jitted prefill/decode programs and the
+:class:`~paddle_tpu.inference.paged.PagedKVCache` page pool.  It knows
+NOTHING about queues, priorities or deadlines — those live in
+``scheduler.py`` — it only exposes slot-granular operations:
+
+  * ``prefill(sid, ids)``          whole-prompt prefill, one program
+  * ``prefill_chunk(sid, ids, t0)``chunked prefill: attend past pages,
+                                   write the chunk's KV at offset t0
+  * ``decode(sids)``               one greedy token for an explicit
+                                   batch of slots
+  * ``decode_n(sids, n)``          n greedy tokens, feedback on device
+
+The legacy :class:`~paddle_tpu.inference.serving.PagedLlamaEngine`
+manual API is a thin shim over this class, so the hand-driven and the
+scheduled paths execute byte-identical programs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.nn_ops import _rms_norm_plain, _rope_plain
+from ..paged import PagedKVCache, paged_decode_attention
+
+
+class PagedExecutor:
+    """Execution backend over the paged KV cache.
+
+    ``num_pages=None`` sizes the pool so every slot can reach
+    ``max_len`` (the legacy engine's sizing).  A serving deployment
+    passes a smaller pool to oversubscribe: the per-seq page budget
+    stays ``max_len // page_size`` but the POOL can run dry, which is
+    what makes admission control and preemption meaningful.
+    """
+
+    def __init__(self, model, max_seqs=4, page_size=16, max_len=256,
+                 dtype=jnp.float32, num_pages=None):
+        from ...models.generation import _stack_layer_params
+        from ...models.llama import _rope_tables
+
+        cfg = model.config
+        self.config = cfg
+        self.max_len = int(max_len)
+        state = {k: v._data for k, v in model.state_dict().items()}
+        self.layers = _stack_layer_params(state, cfg.num_hidden_layers)
+        embed = jnp.asarray(state["llama.embed_tokens.weight"])
+        cos, sin = _rope_tables(cfg)
+        # non-layer weights travel as jit ARGUMENTS: closed-over arrays
+        # are baked into the HLO as literals, and multi-MB constants
+        # (embed/head at vocab 32k) choke the remote AOT compiler — the
+        # r5 root cause of the serving prefill "hang"
+        # tied embeddings: alias the SAME buffer and transpose in-graph
+        # (embed.T here would materialize a duplicate vocab x hidden
+        # array in HBM); _head() applies the orientation.
+        self._tied = bool(cfg.tie_word_embeddings)
+        self.tops = {
+            "embed": embed,
+            "norm_w": jnp.asarray(state["llama.norm.weight"]),
+            "head_w": (embed if self._tied
+                       else jnp.asarray(state["lm_head.weight"])),
+            "cos": jnp.asarray(cos),
+            "sin": jnp.asarray(sin),
+        }
+
+        pages_per_seq = -(-max_len // page_size)
+        self.cache = PagedKVCache(
+            n_layers=cfg.num_hidden_layers,
+            n_kv_heads=cfg.num_key_value_heads, head_dim=cfg.head_dim,
+            num_pages=(max_seqs * pages_per_seq if num_pages is None
+                       else int(num_pages)),
+            page_size=page_size, max_seqs=max_seqs, dtype=dtype,
+            max_pages_per_seq=pages_per_seq)
+        self.last_token = {}
+        self._jit_prefill = jax.jit(self._prefill_fwd)
+        self._jit_chunk = jax.jit(self._chunk_fwd)
+        # donate the pools: decode() immediately replaces them with the
+        # outputs, so XLA updates in place instead of copying GBs of KV
+        self._jit_decode = jax.jit(self._decode_fwd,
+                                   donate_argnums=(4, 5))
+        self._jit_decode_n = None
+
+    def _head(self, x, tops):
+        w = tops["head_w"]
+        return x @ (w.T if self._tied else w)
+
+    # -- pure forwards --------------------------------------------------
+
+    def _prefill_fwd(self, layers, tops, ids):
+        """[1, S] prompt -> (last-token logits [V], k [L,KV,S,D],
+        v [L,KV,S,D]) — plain causal attention, KV returned for the
+        page writer."""
+        cfg = self.config
+        nh, nkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                      cfg.head_dim)
+        B, S = ids.shape
+        x = tops["embed"][ids]
+        pos = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
+        scale = 1.0 / np.sqrt(d)
+
+        def block(x, lp):
+            h = _rms_norm_plain(x, lp["input_layernorm.weight"],
+                                epsilon=cfg.rms_norm_eps)
+            q = (h @ lp["self_attn.q_proj.weight"]).reshape(B, S, nh, d)
+            k = (h @ lp["self_attn.k_proj.weight"]).reshape(B, S, nkv, d)
+            v = (h @ lp["self_attn.v_proj.weight"]).reshape(B, S, nkv, d)
+            q, k = _rope_plain(q, k, tops["cos"], tops["sin"],
+                               position_ids=pos)
+            g = nh // nkv
+            qt = jnp.swapaxes(q, 1, 2)              # [B, nh, S, d]
+            kt = jnp.swapaxes(k, 1, 2)              # [B, nkv, S, d]
+            vt = jnp.swapaxes(v, 1, 2)
+            if g > 1:                               # GQA: expand KV heads
+                kt = jnp.repeat(kt, g, axis=1)
+                vt = jnp.repeat(vt, g, axis=1)
+            # standard 4-D attention: the 5-D grouped einsum + rank-5
+            # masked-broadcast variant compiled pathologically slowly on
+            # the TPU AOT path (95s+ for 2 layers; minutes at vocab 32k)
+            logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+            causal = jnp.tril(jnp.ones((S, S), bool))
+            logits = jnp.where(causal[None, None], logits,
+                               jnp.finfo(logits.dtype).min)
+            p = jax.nn.softmax(logits.astype(jnp.float32), -1) \
+                .astype(x.dtype)
+            o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+            o = jnp.swapaxes(o, 1, 2).reshape(B, S, nh * d)
+            x = x + o @ lp["self_attn.o_proj.weight"]
+            h2 = _rms_norm_plain(x, lp["post_attention_layernorm.weight"],
+                                 epsilon=cfg.rms_norm_eps)
+            gate = h2 @ lp["mlp.gate_proj.weight"]
+            up = h2 @ lp["mlp.up_proj.weight"]
+            x = x + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
+            return x, (jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2))
+
+        x, (ks, vs) = jax.lax.scan(block, x, layers)
+        x = _rms_norm_plain(x, tops["norm_w"], epsilon=cfg.rms_norm_eps)
+        return self._head(x[:, -1], tops)[0], ks[:, 0], vs[:, 0]
+
+    def _chunk_fwd(self, layers, tops, ids, pos0, past_k, past_v,
+                   past_len):
+        """Chunked-prefill forward: ids [1, C] at positions
+        ``pos0..pos0+C-1``; past_k/past_v [L, KV, P, D] are the
+        sequence's already-written KV gathered dense (P = page-multiple
+        cover, positions >= past_len masked).  Returns (last-position
+        logits [V], chunk k [L,KV,C,D], chunk v [L,KV,C,D]).
+
+        This is what lets the scheduler interleave one long prompt's
+        prefill with in-flight decodes: each scheduler iteration runs
+        ONE chunk, so a 10k-token prompt never stalls the decode batch
+        for its whole prefill."""
+        cfg = self.config
+        nh, nkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                      cfg.head_dim)
+        B, C = ids.shape
+        P = past_k.shape[2]
+        x = tops["embed"][ids]
+        pos = pos0 + jnp.broadcast_to(jnp.arange(C)[None], (B, C))
+        scale = 1.0 / np.sqrt(d)
+        # past cols valid below past_len; chunk cols causal within chunk
+        mask = jnp.concatenate(
+            [jnp.broadcast_to((jnp.arange(P) < past_len)[None], (C, P)),
+             jnp.tril(jnp.ones((C, C), bool))], axis=1)  # [C, P+C]
+
+        def block(x, lp_kv):
+            lp, pk, pv = lp_kv
+            h = _rms_norm_plain(x, lp["input_layernorm.weight"],
+                                epsilon=cfg.rms_norm_eps)
+            q = (h @ lp["self_attn.q_proj.weight"]).reshape(B, C, nh, d)
+            k = (h @ lp["self_attn.k_proj.weight"]).reshape(B, C, nkv, d)
+            v = (h @ lp["self_attn.v_proj.weight"]).reshape(B, C, nkv, d)
+            q, k = _rope_plain(q, k, tops["cos"], tops["sin"],
+                               position_ids=pos)
+            g = nh // nkv
+            qt = jnp.swapaxes(q, 1, 2)              # [B, nh, C, d]
+            kt = jnp.swapaxes(k, 1, 2)              # [B, nkv, C, d]
+            vt = jnp.swapaxes(v, 1, 2)
+            kf = jnp.concatenate([pk[None].astype(kt.dtype), kt], axis=2)
+            vf = jnp.concatenate([pv[None].astype(vt.dtype), vt], axis=2)
+            if g > 1:                               # GQA: expand KV heads
+                kf = jnp.repeat(kf, g, axis=1)
+                vf = jnp.repeat(vf, g, axis=1)
+            logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kf) * scale
+            logits = jnp.where(mask[None, None], logits,
+                               jnp.finfo(logits.dtype).min)
+            p = jax.nn.softmax(logits.astype(jnp.float32), -1) \
+                .astype(x.dtype)
+            o = jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+            o = jnp.swapaxes(o, 1, 2).reshape(B, C, nh * d)
+            x = x + o @ lp["self_attn.o_proj.weight"]
+            h2 = _rms_norm_plain(x, lp["post_attention_layernorm.weight"],
+                                 epsilon=cfg.rms_norm_eps)
+            gate = h2 @ lp["mlp.gate_proj.weight"]
+            up = h2 @ lp["mlp.up_proj.weight"]
+            x = x + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
+            return x, (jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2))
+
+        x, (ks, vs) = jax.lax.scan(block, x, (layers, past_k, past_v))
+        x = _rms_norm_plain(x, tops["norm_w"], epsilon=cfg.rms_norm_eps)
+        return self._head(x[:, -1], tops)[0], ks[:, 0], vs[:, 0]
+
+    def _decode_fwd(self, layers, tops, ids, positions, k_pages, v_pages,
+                    lengths, page_tables):
+        """One token per active sequence: ids [B], positions [B] (the
+        token's position).  Each layer writes the new token's KV into
+        its page (write-then-attend, so the paged attention over
+        lengths+1 includes the self term), then attends over the pool.
+        Returns (logits [B, V], k_pages', v_pages')."""
+        cfg = self.config
+        nh, nkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                      cfg.head_dim)
+        ps = self.cache.page_size
+        B = ids.shape[0]
+        x = tops["embed"][ids][:, None]           # [B, 1, h]
+        pos = positions[:, None]
+        pids = page_tables[jnp.arange(B), positions // ps]  # [B]
+        offs = positions % ps
+
+        def block(x, lp_kv):
+            lp, kp, vp = lp_kv
+            h = _rms_norm_plain(x, lp["input_layernorm.weight"],
+                                epsilon=cfg.rms_norm_eps)
+            q = (h @ lp["self_attn.q_proj.weight"]).reshape(B, 1, nh, d)
+            k = (h @ lp["self_attn.k_proj.weight"]).reshape(B, 1, nkv, d)
+            v = (h @ lp["self_attn.v_proj.weight"]).reshape(B, 1, nkv, d)
+            q, k = _rope_plain(q, k, tops["cos"], tops["sin"],
+                               position_ids=pos)
+            kh = jnp.swapaxes(k, 1, 2)[:, :, 0]   # [B, nkv, d]
+            vh = jnp.swapaxes(v, 1, 2)[:, :, 0]
+            kp = kp.at[:, pids, offs].set(
+                jnp.swapaxes(kh, 0, 1).astype(kp.dtype))
+            vp = vp.at[:, pids, offs].set(
+                jnp.swapaxes(vh, 0, 1).astype(vp.dtype))
+            o = paged_decode_attention(
+                jnp.swapaxes(q, 1, 2)[:, :, 0], kp, vp, lengths + 1,
+                page_tables)                      # [B, nh, d]
+            o = o.reshape(B, 1, nh * d).astype(x.dtype)
+            x = x + o @ lp["self_attn.o_proj.weight"]
+            h2 = _rms_norm_plain(x, lp["post_attention_layernorm.weight"],
+                                 epsilon=cfg.rms_norm_eps)
+            gate = h2 @ lp["mlp.gate_proj.weight"]
+            up = h2 @ lp["mlp.up_proj.weight"]
+            x = x + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
+            return x, (kp, vp)
+
+        x, (kps, vps) = jax.lax.scan(
+            block, x, (layers, k_pages, v_pages))
+        x = _rms_norm_plain(x, tops["norm_w"], epsilon=cfg.rms_norm_eps)
+        return self._head(x[:, 0], tops), kps, vps
+
+    def _decode_n_fwd(self, layers, tops, ids, positions, k_pages,
+                      v_pages, lengths, page_tables, n):
+        """``n`` greedy steps in ONE dispatched program: the argmax
+        feedback stays on device (greedy needs no host), so the
+        per-token tunnel/dispatch cost is amortized n ways — the decode
+        analog of CompiledTrainStep.multi_step."""
+
+        def body(carry, _):
+            ids, positions, kp, vp, lengths = carry
+            logits, kp, vp = self._decode_fwd(
+                layers, tops, ids, positions, kp, vp, lengths,
+                page_tables)
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            return (nxt, positions + 1, kp, vp, lengths + 1), nxt
+
+        carry, toks = jax.lax.scan(
+            body, (ids, positions, k_pages, v_pages, lengths), None,
+            length=n)
+        _ids, _pos, kp, vp, _len = carry
+        return toks, kp, vp
+
+    # -- slot-granular control plane ------------------------------------
+
+    @property
+    def free_slots(self) -> int:
+        return self.cache.free_slots
+
+    @property
+    def free_pages(self) -> int:
+        return self.cache.free_pages
+
+    def pages_for(self, tokens: int) -> int:
+        return -(-int(tokens) // self.cache.page_size)
+
+    def alloc_slot(self) -> int:
+        return self.cache.allocate()
+
+    def free_slot(self, sid: int) -> None:
+        self.cache.free(sid)
+        self.last_token.pop(sid, None)
+
+    def prefill(self, sid: int, prompt_ids) -> int:
+        """Whole-prompt prefill into an allocated slot; returns the
+        first greedy token."""
+        ids = jnp.asarray(np.asarray(prompt_ids)[None], jnp.int32)
+        logits, k, v = self._jit_prefill(self.layers, self.tops, ids)
+        self.cache.prefill(sid, k, v)
+        tok = int(jnp.argmax(logits))
+        self.last_token[sid] = tok
+        return tok
+
+    def prefill_chunk(self, sid: int, chunk_ids, start: int,
+                      final: bool) -> int | None:
+        """One prefill chunk at position ``start``; attends the slot's
+        already-written pages.  When ``final``, records and returns the
+        prompt's first greedy token; else returns None."""
+        past_k, past_v = self.cache.gather_dense(sid, start)
+        ids = jnp.asarray(np.asarray(chunk_ids)[None], jnp.int32)
+        logits, k, v = self._jit_chunk(
+            self.layers, self.tops, ids, jnp.int32(start), past_k,
+            past_v, jnp.int32(start))
+        self.cache.write_at(sid, k, v, start)
+        if not final:
+            return None
+        tok = int(jnp.argmax(logits))
+        self.last_token[sid] = tok
+        return tok
+
+    def decode(self, sids) -> dict:
+        """One greedy decode step over an explicit batch of slots.
+        Returns {sid: next_token}."""
+        sids = list(sids)
+        if not sids:
+            return {}
+        cache = self.cache
+        # batch-atomic page reservation BEFORE the jitted
+        # write-then-attend: a per-sequence loop would strand earlier
+        # sequences' fresh pages when a later one exhausts the pool
+        cache.reserve(sids, extra_tokens=1)
+        ids = jnp.asarray([self.last_token[s] for s in sids], jnp.int32)
+        positions = jnp.asarray([int(cache.lengths[s]) for s in sids],
+                                jnp.int32)
+        tables = jnp.asarray(np.maximum(cache.page_table[sids], 0))
+        lengths = jnp.asarray(cache.lengths[sids])
+        logits, kps, vps = self._jit_decode(
+            self.layers, self.tops, ids, positions, cache.k_pages,
+            cache.v_pages, lengths, tables)
+        cache.k_pages = kps
+        cache.v_pages = vps
+        for s in sids:
+            cache.lengths[s] += 1
+        # single batched argmax + ONE host transfer for the whole step
+        toks = np.asarray(jnp.argmax(logits, axis=-1))
+        out = {}
+        for i, s in enumerate(sids):
+            tok = int(toks[i])
+            self.last_token[s] = tok
+            out[s] = tok
+        return out
+
+    def decode_n(self, sids, n) -> dict:
+        """``n`` greedy tokens per listed slot in one dispatch.
+        Returns {sid: [tok_1..tok_n]}.  Pages for all n tokens are
+        reserved up front (batch-atomic), so the in-graph page writes
+        can never overflow a sequence's table."""
+        sids = list(sids)
+        if not sids:
+            return {}
+        cache = self.cache
+        cache.reserve(sids, extra_tokens=n)
+        ids = jnp.asarray([self.last_token[s] for s in sids], jnp.int32)
+        positions = jnp.asarray([int(cache.lengths[s]) for s in sids],
+                                jnp.int32)
+        tables = jnp.asarray(np.maximum(cache.page_table[sids], 0))
+        lengths = jnp.asarray(cache.lengths[sids])
+        if self._jit_decode_n is None:
+            self._jit_decode_n = jax.jit(self._decode_n_fwd,
+                                         static_argnames=("n",),
+                                         donate_argnums=(4, 5))
+        toks, kps, vps = self._jit_decode_n(
+            self.layers, self.tops, ids, positions, cache.k_pages,
+            cache.v_pages, lengths, tables, n=int(n))
+        cache.k_pages = kps
+        cache.v_pages = vps
+        toks = np.asarray(toks)                     # [n, B]
+        out = {}
+        for i, s in enumerate(sids):
+            cache.lengths[s] += n
+            self.last_token[s] = int(toks[-1, i])
+            out[s] = toks[:, i].tolist()
+        return out

@@ -1,0 +1,302 @@
+"""Iteration-level (continuous-batching) scheduler.
+
+Orca-style [Yu et al., OSDI 2022]: scheduling decisions happen every
+model iteration, not per request.  Each :meth:`step`:
+
+  1. sweeps cancellations and logical deadlines,
+  2. runs ONE batched decode over every RUNNING sequence — preempting
+     the lowest-priority / latest-arrival victim when the page pool
+     cannot cover the batch's next token (freed pages, request
+     re-queued for recompute, vLLM-style),
+  3. admits queued requests while slots AND pages fit (page-aware
+     admission over the PagedKVCache free list),
+  4. advances every PREFILLING request by one chunk, so a long prompt
+     costs each iteration only ``prefill_chunk`` tokens of prefill and
+     in-flight decodes never stall behind it.
+
+Fault points (``paddle_tpu.testing.faults``): ``serve.step`` brackets
+the iteration, ``serve.admit`` brackets one admission (before = no
+slot allocated yet), ``serve.decode`` brackets the batched decode
+dispatch (before = pages reserved, nothing written), and
+``serve.request`` brackets one request's prefill work — an exception
+there is confined to THAT request (state FAILED), which is the
+poisoned-request isolation the tests prove.  Every ``before`` site
+fires with engine state either untouched or already committed, so an
+injected raise never leaves a half-mutated scheduler.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...profiler import RecordEvent
+from ...testing import faults
+from .request import Request, RequestState
+
+_POOL_EXHAUSTED = "KV page pool exhausted"
+
+
+class Scheduler:
+    def __init__(self, executor, metrics, policy="fifo",
+                 prefill_chunk=None, eos_token_id=None,
+                 max_preemptions=4):
+        if policy not in ("fifo", "priority"):
+            raise ValueError(
+                f"policy must be 'fifo' or 'priority', got {policy!r}")
+        self.executor = executor
+        self.metrics = metrics
+        self.policy = policy
+        self.prefill_chunk = (None if prefill_chunk is None
+                              else int(prefill_chunk))
+        self.eos_token_id = eos_token_id
+        self.max_preemptions = int(max_preemptions)
+        self.requests: dict = {}     # rid -> Request (all ever seen)
+        self.queue: list = []        # QUEUED, admission order
+        self.prefilling: list = []   # hold a slot, prompt KV partial
+        self.running: list = []      # hold a slot, decoding
+        self.tick = 0                # logical clock (iterations)
+        self._last_decode_batch = 0
+
+    # -- submission boundary (called by the engine) ---------------------
+
+    def add(self, req: Request) -> None:
+        self.requests[req.rid] = req
+        self.metrics.on_submit(req, self.tick)
+        ex = self.executor
+        budget_tokens = (ex.cache.max_pages_per_seq
+                         * ex.cache.page_size)
+        # +1: the first decode step writes the token AFTER the prompt
+        if (len(req.prompt_ids) + 1 > min(ex.max_len, budget_tokens)
+                or ex.pages_for(len(req.prompt_ids) + 1)
+                > ex.cache.num_pages):
+            self._finish(req, RequestState.EVICTED, "too_large")
+            return
+        self.queue.append(req)
+
+    def has_work(self) -> bool:
+        return bool(self.queue or self.prefilling or self.running)
+
+    # -- the iteration --------------------------------------------------
+
+    def step(self) -> dict:
+        """One scheduler iteration.  Returns {rid: [tokens emitted]}."""
+        faults.fire("serve.step", "before")
+        self.tick += 1
+        emitted: dict = {}
+        with RecordEvent("serve.step"):
+            self._sweep_cancelled()
+            self._sweep_deadlines()
+            self._decode(emitted)
+            self._admit()
+            self._prefill(emitted)
+        self.metrics.on_step(
+            decode_batch=self._last_decode_batch,
+            pages_used=(self.executor.cache.num_pages
+                        - self.executor.free_pages),
+            in_flight=len(self.queue) + len(self.prefilling)
+            + len(self.running))
+        faults.fire("serve.step", "after")
+        return emitted
+
+    # -- sweeps ---------------------------------------------------------
+
+    def _sweep_cancelled(self):
+        for r in [r for r in self.requests.values()
+                  if r.cancel_flag and not r.terminal]:
+            self._finish(r, RequestState.CANCELLED, "cancelled")
+
+    def _sweep_deadlines(self):
+        for r in [r for r in self.requests.values()
+                  if not r.terminal and r.deadline is not None
+                  and self.tick - r.submit_step > r.deadline]:
+            self._finish(r, RequestState.TRUNCATED, "deadline")
+
+    # -- decode with preemption under page pressure ---------------------
+
+    def _decode(self, emitted):
+        run = [r for r in self.running]
+        self._last_decode_batch = 0
+        while run:
+            sids = sorted(r.sid for r in run)
+            try:
+                # batch-atomic page reservation; idempotent, so the
+                # executor's own reserve() inside decode() re-verifies
+                # without re-allocating
+                self.executor.cache.reserve(sids, extra_tokens=1)
+                break
+            except RuntimeError as e:
+                if _POOL_EXHAUSTED not in str(e):
+                    raise
+                victim = self._pick_victim()
+                if victim is None or (len(run) == 1 and victim is run[0]
+                                      and not self.prefilling):
+                    # the lone sequence cannot grow even with the whole
+                    # pool free: the pool is undersized for one request
+                    self._finish(
+                        run[0], RequestState.FAILED, "pool_exhausted",
+                        error=RuntimeError(
+                            f"{_POOL_EXHAUSTED} for a single sequence "
+                            f"(pool {self.executor.cache.num_pages} "
+                            f"pages)"))
+                    run = [r for r in self.running]
+                    continue
+                self._preempt(victim)
+                run = [r for r in self.running]
+        if not run:
+            return
+        sids = sorted(r.sid for r in run)
+        by_sid = {r.sid: r for r in run}
+        faults.fire("serve.decode", "before")
+        with RecordEvent("serve.decode"):
+            toks = self.executor.decode(sids)
+        self._last_decode_batch = len(sids)
+        self.metrics.on_decode_tokens(len(sids))
+        for sid in sids:
+            self._on_token(by_sid[sid], toks[sid], emitted)
+        faults.fire("serve.decode", "after")
+
+    # -- page-aware admission -------------------------------------------
+
+    def _committed_pages(self) -> int:
+        """Pages PROMISED to in-progress prefills but not yet written:
+        free_pages only drops when a chunk lands, so admission must
+        subtract what already-admitted prompts will still consume."""
+        ex = self.executor
+        total = 0
+        for r in self.prefilling:
+            held = int((ex.cache.page_table[r.sid] >= 0).sum())
+            total += max(0, ex.pages_for(len(r.resume_ids) + 1) - held)
+        return total
+
+    def _admit(self):
+        ex = self.executor
+        while self.queue:
+            req = self._pick_next()
+            need = ex.pages_for(len(req.resume_ids) + 1)
+            if (ex.free_slots < 1
+                    or ex.free_pages - self._committed_pages() < need):
+                if self.policy == "priority":
+                    victim = self._pick_victim(below=req.priority)
+                    if victim is not None:
+                        self._preempt(victim)
+                        continue
+                break  # FIFO: head-of-line blocking keeps arrival order
+            faults.fire("serve.admit", "before")
+            req.sid = ex.alloc_slot()
+            req.prefill_done = 0
+            req.state = RequestState.PREFILLING
+            self.queue.remove(req)
+            self.prefilling.append(req)
+            self.metrics.on_sched(req, self.tick)
+            faults.fire("serve.admit", "after")
+
+    def _pick_next(self):
+        if self.policy == "priority":
+            return max(self.queue,
+                       key=lambda r: (r.priority, -r.arrival_seq))
+        return self.queue[0]
+
+    def _pick_victim(self, below=None):
+        """Lowest-priority, latest-arrival slot holder (running or
+        prefilling); ``below`` restricts to strictly lower priority."""
+        cands = self.running + self.prefilling
+        if below is not None:
+            cands = [r for r in cands if r.priority < below]
+        if not cands:
+            return None
+        return min(cands, key=lambda r: (r.priority, -r.arrival_seq))
+
+    # -- chunked prefill -------------------------------------------------
+
+    def _prefill(self, emitted):
+        for req in list(self.prefilling):
+            ids = req.resume_ids
+            total = len(ids)
+            start = req.prefill_done
+            chunk = (total - start if self.prefill_chunk is None
+                     else min(self.prefill_chunk, total - start))
+            final = start + chunk == total
+            try:
+                faults.fire("serve.request", "before")
+                with RecordEvent("serve.prefill"):
+                    if start == 0 and final:
+                        tok = self.executor.prefill(req.sid, ids)
+                    else:
+                        tok = self.executor.prefill_chunk(
+                            req.sid, ids[start:start + chunk], start,
+                            final)
+                faults.fire("serve.request", "after")
+            except RuntimeError as e:
+                if _POOL_EXHAUSTED in str(e):
+                    # decodes ate the pages between admission and this
+                    # chunk: give the slot back and retry via the queue
+                    self._preempt(req)
+                    continue
+                self._fail(req, e)
+                continue
+            except Exception as e:  # poisoned request fails ALONE
+                self._fail(req, e)
+                continue
+            req.prefill_done = start + chunk
+            self.metrics.on_prefill_tokens(chunk)
+            if final:
+                self.prefilling.remove(req)
+                self.running.append(req)
+                req.state = RequestState.RUNNING
+                self._on_token(req, tok, emitted)
+
+    # -- request transitions --------------------------------------------
+
+    def _on_token(self, req, tok, emitted):
+        req.emit(tok)
+        emitted.setdefault(req.rid, []).append(int(tok))
+        if req.first_token_step is None:
+            self.metrics.on_first_token(req, self.tick)
+        if (self.eos_token_id is not None
+                and int(tok) == int(self.eos_token_id)):
+            self._finish(req, RequestState.FINISHED, "eos")
+            return
+        cap = min(req.max_new_tokens,
+                  self.executor.max_len - len(req.prompt_ids))
+        if len(req.generated) >= cap:
+            if cap < req.max_new_tokens:
+                self._finish(req, RequestState.TRUNCATED, "length")
+            else:
+                self._finish(req, RequestState.FINISHED, "length")
+
+    def _preempt(self, req):
+        """Free the victim's pages and re-queue it for recompute: on
+        re-admission the prompt PLUS the already-streamed tokens are
+        prefilled again and decoding resumes where it left off."""
+        self.metrics.on_preempt(req)
+        req.preempt_count += 1
+        self._release(req)
+        if req.preempt_count > self.max_preemptions:
+            self._finish(req, RequestState.EVICTED, "preempt_budget")
+            return
+        req.resume_ids = np.concatenate(
+            [req.prompt_ids,
+             np.asarray(req.generated, np.int32)]).astype(np.int32)
+        req.prefill_done = 0
+        req.state = RequestState.QUEUED
+        self.queue.insert(0, req)  # seniority: re-admitted first
+
+    def _release(self, req):
+        if req.sid is not None:
+            self.executor.free_slot(req.sid)
+            req.sid = None
+        for pool in (self.queue, self.prefilling, self.running):
+            if req in pool:
+                pool.remove(req)
+
+    def _fail(self, req, error):
+        req.error = error
+        self._finish(req, RequestState.FAILED,
+                     f"{type(error).__name__}: {error}")
+
+    def _finish(self, req, state, reason, error=None):
+        if error is not None:
+            req.error = error
+        self._release(req)
+        req.state = state
+        req.finish_reason = reason
+        self.metrics.on_terminal(req, self.tick)

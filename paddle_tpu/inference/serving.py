@@ -1,26 +1,25 @@
-"""Continuous-batching serving engine over the paged KV cache.
+"""Manual (hand-driven) serving API over the paged KV cache.
 
 Reference: the Predictor's serving loop driven by
 ``block_multi_head_attention`` (block-table KV) and
 ``masked_multihead_attention`` (decode step) — the reference's
 continuous-batching inference stack.
 
-TPU-native: prefill computes the prompt's KV in one jitted forward and
-writes whole pages; each decode step is one jitted single-token forward
-whose attention runs ``paged_decode_attention`` (Pallas kernel on TPU)
-over the page pool.  Admission/eviction is a host-side control plane on
-the PagedKVCache block table; sequences of different lengths decode in
-one batch (per-sequence lengths mask the attention).
+The model execution now lives in
+:class:`~paddle_tpu.inference.server.executor.PagedExecutor` (shared
+with the continuous-batching :class:`ServingEngine` scheduler), so the
+hand-driven and the scheduled paths run byte-identical jitted programs.
+This class is the legacy thin shim: explicit ``add_request`` /
+``step`` / ``decode_n`` / ``finish`` with no queueing, admission or
+preemption — the caller is the scheduler.
 """
 from __future__ import annotations
 
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 
-from ..ops.nn_ops import _rms_norm_plain, _rope_plain
-from .paged import PagedKVCache, paged_decode_attention
+from .server.executor import PagedExecutor
 
 
 class PagedLlamaEngine:
@@ -35,249 +34,49 @@ class PagedLlamaEngine:
 
     def __init__(self, model, max_seqs=4, page_size=16, max_len=256,
                  dtype=jnp.float32):
-        from ..models.generation import _stack_layer_params
-        from ..models.llama import _rope_tables
+        self._ex = PagedExecutor(model, max_seqs=max_seqs,
+                                 page_size=page_size, max_len=max_len,
+                                 dtype=dtype)
 
-        cfg = model.config
-        self.config = cfg
-        state = {k: v._data for k, v in model.state_dict().items()}
-        self.layers = _stack_layer_params(state, cfg.num_hidden_layers)
-        embed = jnp.asarray(state["llama.embed_tokens.weight"])
-        cos, sin = _rope_tables(cfg)
-        # non-layer weights travel as jit ARGUMENTS: closed-over arrays
-        # are baked into the HLO as literals, and multi-MB constants
-        # (embed/head at vocab 32k) choke the remote AOT compiler — the
-        # r5 root cause of the serving prefill "hang"
-        # tied embeddings: alias the SAME buffer and transpose in-graph
-        # (embed.T here would materialize a duplicate vocab x hidden
-        # array in HBM); _head() applies the orientation.
-        self._tied = bool(cfg.tie_word_embeddings)
-        self.tops = {
-            "embed": embed,
-            "norm_w": jnp.asarray(state["llama.norm.weight"]),
-            "head_w": (embed if self._tied
-                       else jnp.asarray(state["lm_head.weight"])),
-            "cos": jnp.asarray(cos),
-            "sin": jnp.asarray(sin),
-        }
+    # the shim exposes the executor's state under the historical names
+    @property
+    def config(self):
+        return self._ex.config
 
-        pages_per_seq = -(-max_len // page_size)
-        self.cache = PagedKVCache(
-            n_layers=cfg.num_hidden_layers,
-            n_kv_heads=cfg.num_key_value_heads, head_dim=cfg.head_dim,
-            num_pages=max_seqs * pages_per_seq, page_size=page_size,
-            max_seqs=max_seqs, dtype=dtype)
-        self._last_token = {}
-        self._jit_prefill = jax.jit(self._prefill_fwd)
-        # donate the pools: step() immediately replaces them with the
-        # outputs, so XLA updates in place instead of copying GBs of KV
-        self._jit_decode = jax.jit(self._decode_fwd,
-                                   donate_argnums=(4, 5))
+    @property
+    def cache(self):
+        return self._ex.cache
 
-    def _head(self, x, tops):
-        w = tops["head_w"]
-        return x @ (w.T if self._tied else w)
+    @property
+    def layers(self):
+        return self._ex.layers
 
-    # -- pure forwards --------------------------------------------------
+    @property
+    def tops(self):
+        return self._ex.tops
 
-    def _prefill_fwd(self, layers, tops, ids):
-        """[1, S] prompt -> (last-token logits [V], k [L,KV,S,D],
-        v [L,KV,S,D]) — plain causal attention, KV returned for the
-        page writer."""
-        cfg = self.config
-        nh, nkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
-                      cfg.head_dim)
-        B, S = ids.shape
-        x = tops["embed"][ids]
-        pos = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
-        scale = 1.0 / np.sqrt(d)
-
-        def block(x, lp):
-            h = _rms_norm_plain(x, lp["input_layernorm.weight"],
-                                epsilon=cfg.rms_norm_eps)
-            q = (h @ lp["self_attn.q_proj.weight"]).reshape(B, S, nh, d)
-            k = (h @ lp["self_attn.k_proj.weight"]).reshape(B, S, nkv, d)
-            v = (h @ lp["self_attn.v_proj.weight"]).reshape(B, S, nkv, d)
-            q, k = _rope_plain(q, k, tops["cos"], tops["sin"],
-                               position_ids=pos)
-            g = nh // nkv
-            qt = jnp.swapaxes(q, 1, 2)              # [B, nh, S, d]
-            kt = jnp.swapaxes(k, 1, 2)              # [B, nkv, S, d]
-            vt = jnp.swapaxes(v, 1, 2)
-            if g > 1:                               # GQA: expand KV heads
-                kt = jnp.repeat(kt, g, axis=1)
-                vt = jnp.repeat(vt, g, axis=1)
-            # standard 4-D attention: the 5-D grouped einsum + rank-5
-            # masked-broadcast variant compiled pathologically slowly on
-            # the TPU AOT path (95s+ for 2 layers; minutes at vocab 32k)
-            logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
-            causal = jnp.tril(jnp.ones((S, S), bool))
-            logits = jnp.where(causal[None, None], logits,
-                               jnp.finfo(logits.dtype).min)
-            p = jax.nn.softmax(logits.astype(jnp.float32), -1) \
-                .astype(x.dtype)
-            o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
-            o = jnp.swapaxes(o, 1, 2).reshape(B, S, nh * d)
-            x = x + o @ lp["self_attn.o_proj.weight"]
-            h2 = _rms_norm_plain(x, lp["post_attention_layernorm.weight"],
-                                 epsilon=cfg.rms_norm_eps)
-            gate = h2 @ lp["mlp.gate_proj.weight"]
-            up = h2 @ lp["mlp.up_proj.weight"]
-            x = x + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
-            return x, (jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2))
-
-        x, (ks, vs) = jax.lax.scan(block, x, layers)
-        x = _rms_norm_plain(x, tops["norm_w"], epsilon=cfg.rms_norm_eps)
-        return self._head(x[:, -1], tops)[0], ks[:, 0], vs[:, 0]
-
-    def _decode_fwd(self, layers, tops, ids, positions, k_pages, v_pages,
-                    lengths, page_tables):
-        """One token per active sequence: ids [B], positions [B] (the
-        token's position).  Each layer writes the new token's KV into
-        its page (write-then-attend, so the paged attention over
-        lengths+1 includes the self term), then attends over the pool.
-        Returns (logits [B, V], k_pages', v_pages')."""
-        cfg = self.config
-        nh, nkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
-                      cfg.head_dim)
-        ps = self.cache.page_size
-        B = ids.shape[0]
-        x = tops["embed"][ids][:, None]           # [B, 1, h]
-        pos = positions[:, None]
-        pids = page_tables[jnp.arange(B), positions // ps]  # [B]
-        offs = positions % ps
-
-        def block(x, lp_kv):
-            lp, kp, vp = lp_kv
-            h = _rms_norm_plain(x, lp["input_layernorm.weight"],
-                                epsilon=cfg.rms_norm_eps)
-            q = (h @ lp["self_attn.q_proj.weight"]).reshape(B, 1, nh, d)
-            k = (h @ lp["self_attn.k_proj.weight"]).reshape(B, 1, nkv, d)
-            v = (h @ lp["self_attn.v_proj.weight"]).reshape(B, 1, nkv, d)
-            q, k = _rope_plain(q, k, tops["cos"], tops["sin"],
-                               position_ids=pos)
-            kh = jnp.swapaxes(k, 1, 2)[:, :, 0]   # [B, nkv, d]
-            vh = jnp.swapaxes(v, 1, 2)[:, :, 0]
-            kp = kp.at[:, pids, offs].set(
-                jnp.swapaxes(kh, 0, 1).astype(kp.dtype))
-            vp = vp.at[:, pids, offs].set(
-                jnp.swapaxes(vh, 0, 1).astype(vp.dtype))
-            o = paged_decode_attention(
-                jnp.swapaxes(q, 1, 2)[:, :, 0], kp, vp, lengths + 1,
-                page_tables)                      # [B, nh, d]
-            o = o.reshape(B, 1, nh * d).astype(x.dtype)
-            x = x + o @ lp["self_attn.o_proj.weight"]
-            h2 = _rms_norm_plain(x, lp["post_attention_layernorm.weight"],
-                                 epsilon=cfg.rms_norm_eps)
-            gate = h2 @ lp["mlp.gate_proj.weight"]
-            up = h2 @ lp["mlp.up_proj.weight"]
-            x = x + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
-            return x, (kp, vp)
-
-        x, (kps, vps) = jax.lax.scan(
-            block, x, (layers, k_pages, v_pages))
-        x = _rms_norm_plain(x, tops["norm_w"], epsilon=cfg.rms_norm_eps)
-        return self._head(x[:, 0], tops), kps, vps
-
-    def _decode_n_fwd(self, layers, tops, ids, positions, k_pages,
-                      v_pages, lengths, page_tables, n):
-        """``n`` greedy steps in ONE dispatched program: the argmax
-        feedback stays on device (greedy needs no host), so the
-        per-token tunnel/dispatch cost is amortized n ways — the decode
-        analog of CompiledTrainStep.multi_step."""
-
-        def body(carry, _):
-            ids, positions, kp, vp, lengths = carry
-            logits, kp, vp = self._decode_fwd(
-                layers, tops, ids, positions, kp, vp, lengths,
-                page_tables)
-            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
-            return (nxt, positions + 1, kp, vp, lengths + 1), nxt
-
-        carry, toks = jax.lax.scan(
-            body, (ids, positions, k_pages, v_pages, lengths), None,
-            length=n)
-        _ids, _pos, kp, vp, _len = carry
-        return toks, kp, vp
-
-    # -- control plane --------------------------------------------------
+    @property
+    def _last_token(self):
+        return self._ex.last_token
 
     def add_request(self, prompt_ids) -> int:
         """Prefill one prompt; returns the sequence slot id."""
-        sid = self.cache.allocate()
+        sid = self._ex.alloc_slot()
         try:
-            ids = jnp.asarray(np.asarray(prompt_ids)[None], jnp.int32)
-            logits, k, v = self._jit_prefill(self.layers, self.tops, ids)
-            self.cache.prefill(sid, k, v)
+            self._ex.prefill(sid, np.asarray(prompt_ids))
         except BaseException:
-            self.cache.free(sid)  # don't strand the slot on failure
+            self._ex.free_slot(sid)  # don't strand the slot on failure
             raise
-        self._last_token[sid] = int(jnp.argmax(logits))
         return sid
 
     def finish(self, sid: int):
-        self.cache.free(sid)
-        self._last_token.pop(sid, None)
+        self._ex.free_slot(sid)
 
     def step(self):
         """One greedy decode step over every active sequence."""
-        seqs = sorted(self._last_token)
-        if not seqs:
-            return {}
-        # batch-atomic page reservation BEFORE the jitted
-        # write-then-attend: a per-sequence loop would strand earlier
-        # sequences' fresh pages when a later one exhausts the pool
-        self.cache.reserve(seqs, extra_tokens=1)
-        ids = jnp.asarray([self._last_token[s] for s in seqs], jnp.int32)
-        positions = jnp.asarray([int(self.cache.lengths[s])
-                                 for s in seqs], jnp.int32)
-        tables = jnp.asarray(np.maximum(self.cache.page_table[seqs], 0))
-        lengths = jnp.asarray(self.cache.lengths[seqs])
-        logits, kps, vps = self._jit_decode(
-            self.layers, self.tops, ids, positions, self.cache.k_pages,
-            self.cache.v_pages, lengths, tables)
-        self.cache.k_pages = kps
-        self.cache.v_pages = vps
-        for s in seqs:
-            self.cache.lengths[s] += 1
-        # single batched argmax + ONE host transfer for the whole step
-        toks = np.asarray(jnp.argmax(logits, axis=-1))
-        out = {}
-        for i, s in enumerate(seqs):
-            tok = int(toks[i])
-            self._last_token[s] = tok
-            out[s] = tok
-        return out
+        return self._ex.decode(sorted(self._ex.last_token))
 
     def decode_n(self, n):
         """``n`` greedy tokens per active sequence in one dispatch.
-        Returns {sid: [tok_1..tok_n]}.  Pages for all n tokens are
-        reserved up front (batch-atomic), so the in-graph page writes
-        can never overflow a sequence's table."""
-        seqs = sorted(self._last_token)
-        if not seqs:
-            return {}
-        self.cache.reserve(seqs, extra_tokens=n)
-        ids = jnp.asarray([self._last_token[s] for s in seqs], jnp.int32)
-        positions = jnp.asarray([int(self.cache.lengths[s])
-                                 for s in seqs], jnp.int32)
-        tables = jnp.asarray(np.maximum(self.cache.page_table[seqs], 0))
-        lengths = jnp.asarray(self.cache.lengths[seqs])
-        jitted = getattr(self, "_jit_decode_n", None)
-        if jitted is None:
-            jitted = jax.jit(self._decode_n_fwd,
-                             static_argnames=("n",),
-                             donate_argnums=(4, 5))
-            self._jit_decode_n = jitted
-        toks, kps, vps = jitted(self.layers, self.tops, ids, positions,
-                                self.cache.k_pages, self.cache.v_pages,
-                                lengths, tables, n=int(n))
-        self.cache.k_pages = kps
-        self.cache.v_pages = vps
-        toks = np.asarray(toks)                     # [n, B]
-        out = {}
-        for i, s in enumerate(seqs):
-            self.cache.lengths[s] += n
-            self._last_token[s] = int(toks[-1, i])
-            out[s] = toks[:, i].tolist()
-        return out
+        Returns {sid: [tok_1..tok_n]}."""
+        return self._ex.decode_n(sorted(self._ex.last_token), n)

@@ -390,3 +390,109 @@ def _split(datas, attrs):
         if n_infer == 1 and fixed > xs[ax]:
             _fail("split",
                   f"sections {list(num)} exceed dim {ax} = {xs[ax]}")
+
+
+@register_validator("cumsum")
+def _cumsum(datas, attrs):
+    x = datas[0]
+    axis = attrs.get("axis")
+    if axis is None:
+        return  # reference: None flattens first
+    _axis_in("cumsum", int(axis), max(_ndim(x), 1))
+
+
+@register_validator("argsort")
+def _argsort(datas, attrs):
+    x = datas[0]
+    _axis_in("argsort", int(attrs.get("axis", -1)), max(_ndim(x), 1))
+
+
+@register_validator("topk")
+def _topk(datas, attrs):
+    x = datas[0]
+    k = int(attrs.get("k", 1))
+    xs = _shape(x)
+    nd = max(len(xs), 1)
+    ax = _axis_in("topk", int(attrs.get("axis", -1)), nd)
+    if k < 1:
+        _fail("topk",
+              f"the attribute of k in the topk must be >= 1, but "
+              f"received {k}")
+    if xs and k > xs[ax]:
+        _fail("topk",
+              f"k ({k}) must be <= the input's size along axis {ax} "
+              f"({xs[ax]}); input shape {list(xs)}")
+
+
+@register_validator("clip")
+def _clip(datas, attrs):
+    lo, hi = attrs.get("min"), attrs.get("max")
+    if lo is not None and hi is not None \
+            and not hasattr(lo, "ndim") and not hasattr(hi, "ndim") \
+            and float(lo) > float(hi):
+        _fail("clip",
+              f"max should be greater than or equal to min, but "
+              f"received min = {lo}, max = {hi}")
+
+
+@register_validator("one_hot")
+def _one_hot(datas, attrs):
+    x = datas[0]
+    n = int(attrs.get("num_classes", 0))
+    if n < 1:
+        _fail("one_hot",
+              f"num_classes should be a positive integer, but "
+              f"received {n}")
+    if not _int_dtype(x):
+        _fail("one_hot",
+              f"the input must be an integer dtype, got "
+              f"{getattr(x, 'dtype', None)}")
+
+
+@register_validator("flip")
+def _flip(datas, attrs):
+    x = datas[0]
+    axis = attrs.get("axis")
+    nd = max(_ndim(x), 1)
+    axes = axis if isinstance(axis, (list, tuple)) else (axis,)
+    seen = set()
+    for a in axes:
+        n = _axis_in("flip", int(a), nd)
+        if n in seen:
+            _fail("flip", f"axis {list(axes)} has duplicate entries")
+        seen.add(n)
+
+
+@register_validator("roll")
+def _roll(datas, attrs):
+    x = datas[0]
+    shifts = attrs.get("shifts")
+    axis = attrs.get("axis")
+    if axis is None:
+        return  # reference: roll on the flattened tensor
+    nd = max(_ndim(x), 1)
+    axes = axis if isinstance(axis, (list, tuple)) else (axis,)
+    for a in axes:
+        _axis_in("roll", int(a), nd)
+    if isinstance(shifts, (list, tuple)) \
+            and len(shifts) != len(tuple(axes)):
+        _fail("roll",
+              f"shifts ({list(shifts)}) and axis ({list(axes)}) must "
+              f"have the same length")
+
+
+@register_validator("masked_select")
+def _masked_select(datas, attrs):
+    # host-side op: the wrapper calls validate() directly (it never
+    # goes through registry.apply)
+    x, mask = datas[0], datas[1]
+    dt = getattr(mask, "dtype", None)
+    if dt is not None and np.dtype(str(dt)) != np.bool_:
+        _fail("masked_select",
+              f"the mask must be a bool tensor, got {dt}")
+    try:
+        np.broadcast_shapes(_shape(x), _shape(mask))
+    except ValueError:
+        _fail("masked_select",
+              f"the mask {list(_shape(mask))} is not broadcast-"
+              f"compatible with the input {list(_shape(x))}")

@@ -574,10 +574,14 @@ def masked_select(x, mask, name=None):
     """Differentiable bool-mask selection (concrete mask; grads flow back
     to x via getitem's vjp — scatter-add at the selected positions)."""
     from ..core.tensor import Tensor
+    from . import infermeta
 
     if not isinstance(x, Tensor):
         x = Tensor(jnp.asarray(x))
     m = np.asarray(mask._data if isinstance(mask, Tensor) else mask)
+    # host path (getitem), so it never passes registry.apply's
+    # validator hook — fire the InferMeta check by hand
+    infermeta.validate("masked_select", (x._data, m), {})
     return getitem(x, Tensor(jnp.asarray(m.astype(bool))))
 
 

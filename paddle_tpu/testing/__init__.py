@@ -5,4 +5,6 @@ checkpoint/commit path, the DataLoader worker loop and the train step
 (see ``faults.py`` for the ``PT_FAULTS`` grammar).
 """
 from . import faults  # noqa: F401
+from . import load  # noqa: F401
 from .faults import InjectedFault  # noqa: F401
+from .load import LoadSpec, generate_load, run_load  # noqa: F401

@@ -62,6 +62,14 @@ REGISTERED = {
                       "while the loss stays finite (value site)",
     "guard.loss_spike": "guardian monitor: add a large finite spike to "
                         "the step loss (value site; arg = magnitude)",
+    "serve.step": "serving Scheduler.step (before=iteration not "
+                  "started, after=iteration fully committed)",
+    "serve.admit": "one admission in the serving scheduler (before=no "
+                   "slot allocated yet, after=request PREFILLING)",
+    "serve.decode": "the batched decode dispatch (before=pages "
+                    "reserved, nothing written; after=tokens emitted)",
+    "serve.request": "one request's prefill work — an exception here "
+                     "is confined to that request (state FAILED)",
 }
 
 _PHASES = ("before", "after")

@@ -224,6 +224,121 @@ def test_transpose_rejects_non_permutation():
         paddle.transpose(_f32(2, 3, 4), [0, 0, 2])
 
 
+# -- batch 3 (r10): cumsum / argsort / topk / clip / one_hot / flip /
+# -- roll / masked_select --------------------------------------------------
+
+
+def test_cumsum_accepts_axis_and_none():
+    out = paddle.cumsum(_f32(2, 3), axis=1)
+    assert list(out.shape) == [2, 3]
+    assert paddle.cumsum(_f32(2, 3)).shape[0] == 6  # None flattens
+
+
+def test_cumsum_rejects_axis_out_of_range():
+    with pytest.raises(InvalidArgumentError, match="range"):
+        paddle.cumsum(_f32(2, 3), axis=2)
+
+
+def test_argsort_accepts_negative_axis():
+    assert list(paddle.argsort(_f32(2, 3), axis=-1).shape) == [2, 3]
+
+
+def test_argsort_rejects_axis_out_of_range():
+    with pytest.raises(InvalidArgumentError, match="range"):
+        paddle.argsort(_f32(2, 3), axis=5)
+
+
+def test_topk_accepts_valid_k():
+    vals, idx = paddle.topk(_f32(2, 5), k=3)
+    assert list(vals.shape) == [2, 3] and list(idx.shape) == [2, 3]
+
+
+def test_topk_rejects_k_too_large():
+    with pytest.raises(InvalidArgumentError, match="must be <="):
+        paddle.topk(_f32(2, 5), k=6)
+
+
+def test_topk_rejects_nonpositive_k():
+    with pytest.raises(InvalidArgumentError, match=">= 1"):
+        paddle.topk(_f32(2, 5), k=0)
+
+
+def test_clip_accepts_ordered_bounds():
+    out = paddle.clip(_f32(2, 3), min=0.0, max=1.0)
+    assert list(out.shape) == [2, 3]
+    assert paddle.clip(_f32(2, 3), min=0.5) is not None  # one-sided ok
+
+
+def test_clip_rejects_min_above_max():
+    with pytest.raises(InvalidArgumentError, match="greater than or"):
+        paddle.clip(_f32(2, 3), min=2.0, max=1.0)
+
+
+def test_one_hot_accepts_int_input():
+    ids = paddle.to_tensor(np.array([0, 2, 1], np.int64))
+    assert list(F.one_hot(ids, num_classes=4).shape) == [3, 4]
+
+
+def test_one_hot_rejects_nonpositive_classes():
+    ids = paddle.to_tensor(np.array([0, 1], np.int64))
+    with pytest.raises(InvalidArgumentError, match="positive"):
+        F.one_hot(ids, num_classes=0)
+
+
+def test_one_hot_rejects_float_input():
+    with pytest.raises(InvalidArgumentError, match="integer dtype"):
+        F.one_hot(_f32(3), num_classes=4)
+
+
+def test_flip_accepts_axis_list():
+    assert list(paddle.flip(_f32(2, 3), axis=[0, 1]).shape) == [2, 3]
+
+
+def test_flip_rejects_out_of_range_axis():
+    with pytest.raises(InvalidArgumentError, match="range"):
+        paddle.flip(_f32(2, 3), axis=2)
+
+
+def test_flip_rejects_duplicate_axis():
+    with pytest.raises(InvalidArgumentError, match="duplicate"):
+        paddle.flip(_f32(2, 3), axis=[1, -1])
+
+
+def test_roll_accepts_shifts_axis_pairs():
+    out = paddle.roll(_f32(2, 3), shifts=[1, 2], axis=[0, 1])
+    assert list(out.shape) == [2, 3]
+    assert paddle.roll(_f32(2, 3), shifts=1) is not None  # flattened
+
+
+def test_roll_rejects_mismatched_shifts_axis():
+    with pytest.raises(InvalidArgumentError, match="same length"):
+        paddle.roll(_f32(2, 3), shifts=[1, 2], axis=[0])
+
+
+def test_roll_rejects_axis_out_of_range():
+    with pytest.raises(InvalidArgumentError, match="range"):
+        paddle.roll(_f32(2, 3), shifts=1, axis=3)
+
+
+def test_masked_select_accepts_bool_mask():
+    x = _f32(2, 3)
+    mask = paddle.to_tensor(
+        np.array([[True, False, True], [False, True, False]]))
+    assert list(paddle.masked_select(x, mask).shape) == [3]
+
+
+def test_masked_select_rejects_non_bool_mask():
+    with pytest.raises(InvalidArgumentError, match="bool"):
+        paddle.masked_select(_f32(2, 3), paddle.to_tensor(
+            np.ones((2, 3), np.int32)))
+
+
+def test_masked_select_rejects_shape_mismatch():
+    with pytest.raises(InvalidArgumentError, match="broadcast"):
+        paddle.masked_select(_f32(2, 3), paddle.to_tensor(
+            np.ones((4, 5), bool)))
+
+
 def test_validators_skip_traced_values():
     """Validators are eager-only: a traced call with shapes the eager
     checker would reject at the metadata level must defer to XLA (here

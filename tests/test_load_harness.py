@@ -1,0 +1,116 @@
+"""Deterministic load harness over the serving engine.
+
+Assertions run on the logical clock only: same seed + same engine
+config must reproduce the same workload, the same per-request token
+streams and the same step-level metrics — and an injected serve.*
+fault under load must leave every other request finishing exactly.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.server import RequestState, ServingEngine
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.testing import faults
+from paddle_tpu.testing.load import LoadSpec, generate_load, run_load
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(11)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+SPEC = dict(n_requests=6, mean_interarrival=2.0, prompt_len=(4, 20),
+            max_new=(3, 8), vocab=256, seed=7)
+ENGINE_KW = dict(max_seqs=2, page_size=4, max_len=64, prefill_chunk=8)
+
+
+def _run(model, seed=7, **fault_kw):
+    eng = ServingEngine(model, **ENGINE_KW)
+    work = generate_load(LoadSpec(**dict(SPEC, seed=seed)))
+    return work, run_load(eng, work, **fault_kw)
+
+
+def test_workload_generation_is_seeded():
+    w1 = generate_load(LoadSpec(**SPEC))
+    w2 = generate_load(LoadSpec(**SPEC))
+    assert len(w1) == SPEC["n_requests"]
+    for a, b in zip(w1, w2):
+        assert a["rid"] == b["rid"]
+        assert a["arrival_tick"] == b["arrival_tick"]
+        assert np.array_equal(a["prompt_ids"], b["prompt_ids"])
+        assert a["max_new_tokens"] == b["max_new_tokens"]
+    # arrivals are spread, not all at tick 0
+    assert w1[-1]["arrival_tick"] > 0
+    w3 = generate_load(LoadSpec(**dict(SPEC, seed=8)))
+    assert any(not np.array_equal(a["prompt_ids"], b["prompt_ids"])
+               for a, b in zip(w1, w3))
+
+
+def test_load_run_completes_and_is_deterministic(model):
+    work, r1 = _run(model)
+    _, r2 = _run(model)
+    for w in work:
+        h1, h2 = r1["handles"][w["rid"]], r2["handles"][w["rid"]]
+        assert h1.state is RequestState.FINISHED, (w["rid"], h1.state)
+        assert len(h1.tokens) == w["max_new_tokens"]
+        assert h1.tokens == h2.tokens, w["rid"]
+    # step-level metrics replay exactly (logical-clock fields only)
+    for key in ("steps", "requests", "preemptions", "decode_tokens",
+                "prefill_tokens", "batch_occupancy",
+                "page_utilization", "queue_wait_steps_p50",
+                "ttft_steps_p50"):
+        assert r1["stats"][key] == r2["stats"][key], key
+    assert r1["stats"]["requests"]["finished"] == SPEC["n_requests"]
+
+
+def test_load_matches_sequential_baseline(model):
+    """Interleaved load emits the same per-request tokens as feeding
+    the workload one request at a time."""
+    work, res = _run(model)
+    for w in work:
+        eng = ServingEngine(model, **ENGINE_KW)
+        want = eng.submit(w["prompt_ids"],
+                          max_new_tokens=w["max_new_tokens"]).result()
+        assert res["handles"][w["rid"]].tokens == want, w["rid"]
+
+
+def test_fault_under_load_keeps_engine_serviceable(model):
+    """A serve.step raise mid-load is recorded by on_error='continue'
+    and every request still finishes with exact tokens."""
+    faults.arm("serve.step", "before", 4, "raise")
+    work, res = _run(model, on_error="continue")
+    assert len(res["errors"]) == 1
+    assert isinstance(res["errors"][0], faults.InjectedFault)
+    for w in work:
+        h = res["handles"][w["rid"]]
+        assert h.state is RequestState.FINISHED, (w["rid"], h.state)
+    # tokens unchanged vs the fault-free run
+    faults.reset()
+    _, clean = _run(model)
+    for w in work:
+        assert (res["handles"][w["rid"]].tokens
+                == clean["handles"][w["rid"]].tokens), w["rid"]
+
+
+def test_poisoned_request_under_load_fails_alone(model):
+    """A serve.request fault confines to one request; the rest of the
+    workload drains FINISHED."""
+    faults.arm("serve.request", "before", 3, "raise")
+    work, res = _run(model, on_error="continue")
+    assert res["errors"] == []          # confined, never escapes step()
+    states = [res["handles"][w["rid"]].state for w in work]
+    assert states.count(RequestState.FAILED) == 1
+    assert states.count(RequestState.FINISHED) == len(work) - 1

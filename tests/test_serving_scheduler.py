@@ -1,0 +1,286 @@
+"""Continuous-batching scheduler invariants.
+
+The load-bearing property: per-request greedy tokens under interleaved
+continuous batching (chunked prefill, admission waves, preemption,
+faults) are BIT-IDENTICAL to a sequential one-request-at-a-time run of
+the same engine config.  Everything else — preemption round-trips,
+cancellation, poisoned-request isolation, serve.* crash serviceability
+— is asserted on top of that parity, on the logical clock only (no
+wall-time in any assertion).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.server import (
+    PagedExecutor, RequestState, ServingEngine,
+)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(11)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _prompts(seed, lens):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 256, (n,)).astype(np.int32) for n in lens]
+
+
+def _sequential_baseline(model, prompts, max_new, **engine_kw):
+    """One request at a time through a fresh ServingEngine per request:
+    the no-interleaving reference the batched runs must match."""
+    out = []
+    for p in prompts:
+        eng = ServingEngine(model, **engine_kw)
+        h = eng.submit(p, max_new_tokens=max_new)
+        out.append(h.result())
+    return out
+
+
+ENGINE_KW = dict(max_seqs=2, page_size=4, max_len=64)
+
+
+def test_interleaved_matches_sequential(model):
+    """Requests arriving mid-flight, decoded in shared batches with
+    chunked prefill, emit exactly the sequential tokens (fp32)."""
+    prompts = _prompts(0, (7, 13, 21, 5))
+    want = _sequential_baseline(model, prompts, 8, **ENGINE_KW)
+
+    eng = ServingEngine(model, prefill_chunk=5, **ENGINE_KW)
+    handles = []
+    for i, p in enumerate(prompts):
+        handles.append(eng.submit(p, max_new_tokens=8))
+        eng.step()   # stagger arrivals across iterations
+    eng.run()
+    for h, w in zip(handles, want):
+        assert h.state is RequestState.FINISHED, (h.rid, h.state)
+        assert h.finish_reason == "length"
+        assert h.tokens == w, (h.rid, h.tokens, w)
+
+
+def test_page_exhaustion_preempts_and_recomputes(model):
+    """Oversubscribed pool: mid-decode page exhaustion must preempt a
+    victim (pages freed, request re-queued), and the victim's
+    recomputed continuation must still match the unpressured run."""
+    prompts = _prompts(1, (7, 13, 21))
+    want = _sequential_baseline(model, prompts, 8, **ENGINE_KW)
+
+    # 8 pages < the ~10 the admitted pair grows into -> guaranteed
+    # reserve failure mid-decode
+    eng = ServingEngine(model, num_pages=8, **ENGINE_KW)
+    handles = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    stats = eng.run()
+    assert stats["preemptions"] >= 1, stats
+    assert any(h.num_preemptions >= 1 for h in handles)
+    for h, w in zip(handles, want):
+        assert h.state is RequestState.FINISHED, (h.rid, h.state)
+        assert h.tokens == w, (h.rid, h.tokens, w)
+    # pool fully drained back
+    assert eng.executor.free_pages == 8
+    assert eng.executor.free_slots == 2
+
+
+def test_cancellation_mid_decode(model):
+    """cancel() mid-flight frees the slot at the next step and the
+    other request's stream is unaffected."""
+    prompts = _prompts(2, (7, 9))
+    want = _sequential_baseline(model, prompts, 8, **ENGINE_KW)
+
+    eng = ServingEngine(model, **ENGINE_KW)
+    h0 = eng.submit(prompts[0], max_new_tokens=8)
+    h1 = eng.submit(prompts[1], max_new_tokens=8)
+    while len(h1.tokens) < 3:
+        eng.step()
+    h1.cancel()
+    eng.run()
+    assert h1.state is RequestState.CANCELLED
+    assert h1.finish_reason == "cancelled"
+    partial = h1.tokens
+    assert partial == want[1][:len(partial)]   # prefix of the true stream
+    assert h0.state is RequestState.FINISHED
+    assert h0.tokens == want[0]
+    assert eng.executor.free_slots == 2 and eng.in_flight == 0
+
+
+@pytest.mark.parametrize("point", ["serve.step", "serve.admit",
+                                   "serve.decode", "serve.request"])
+@pytest.mark.parametrize("phase", ["before", "after"])
+def test_crash_at_every_serve_point_leaves_engine_serviceable(
+        model, point, phase):
+    """An injected raise at ANY serve.* site must leave the engine able
+    to finish every request — with the exact sequential tokens.
+    serve.request faults are confined to one request (FAILED); the
+    other sites surface the fault to the caller and stay consistent."""
+    prompts = _prompts(3, (7, 13, 9))
+    want = _sequential_baseline(model, prompts, 6, **ENGINE_KW)
+
+    faults.arm(point, phase, 2, "raise")
+    eng = ServingEngine(model, prefill_chunk=6, **ENGINE_KW)
+    handles = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    tripped = 0
+    guard = 0
+    while eng.in_flight:
+        guard += 1
+        assert guard < 500, f"engine wedged after {point}:{phase}"
+        try:
+            eng.step()
+        except faults.InjectedFault:
+            tripped += 1
+    if point == "serve.request":
+        # confined: at most one request FAILED, the rest exact
+        assert tripped == 0
+        failed = [h for h in handles if h.state is RequestState.FAILED]
+        assert len(failed) <= 1
+        for h, w in zip(handles, want):
+            if h.state is RequestState.FAILED:
+                continue
+            assert h.state is RequestState.FINISHED, (h.rid, h.state)
+            assert h.tokens == w, (h.rid, h.tokens, w)
+        assert any(h.state is RequestState.FINISHED for h in handles)
+    else:
+        assert tripped == 1
+        for h, w in zip(handles, want):
+            assert h.state is RequestState.FINISHED, (h.rid, h.state)
+            assert h.tokens == w, (h.rid, h.tokens, w)
+    # engine still serviceable for NEW work after the fault
+    h = eng.submit(prompts[0], max_new_tokens=6)
+    assert h.result() == want[0]
+    assert eng.executor.free_slots == 2
+
+
+def test_poisoned_request_fails_alone(model):
+    """A request whose prefill raises (out-of-range token -> the
+    executor's embed gather is fine, so poison via serve.request nth
+    targeting ITS chunk) turns FAILED; neighbours are untouched."""
+    prompts = _prompts(4, (7, 9))
+    want = _sequential_baseline(model, prompts, 6, **ENGINE_KW)
+
+    eng = ServingEngine(model, **ENGINE_KW)
+    h0 = eng.submit(prompts[0], max_new_tokens=6)
+    eng.step()                      # h0 admitted + prefilled (hit 1)
+    faults.arm("serve.request", "before", 1, "raise")
+    h1 = eng.submit(prompts[1], max_new_tokens=6)
+    eng.run()
+    assert h1.state is RequestState.FAILED
+    assert isinstance(h1._req.error, faults.InjectedFault)
+    with pytest.raises(faults.InjectedFault):
+        h1.result()
+    assert h0.state is RequestState.FINISHED
+    assert h0.tokens == want[0]
+
+
+def test_deadline_truncates_on_logical_clock(model):
+    prompts = _prompts(5, (7,))
+    eng = ServingEngine(model, **ENGINE_KW)
+    h = eng.submit(prompts[0], max_new_tokens=50, deadline=4)
+    eng.run()
+    assert h.state is RequestState.TRUNCATED
+    assert h.finish_reason == "deadline"
+    assert 0 < len(h.tokens) < 50
+    assert eng.executor.free_slots == 2
+
+
+def test_too_large_request_evicted_at_submit(model):
+    eng = ServingEngine(model, **ENGINE_KW)
+    big = np.arange(1, 65, dtype=np.int32)   # 64 == max_len, +1 overflows
+    h = eng.submit(big, max_new_tokens=4)
+    assert h.state is RequestState.EVICTED
+    assert h.finish_reason == "too_large"
+    ok = eng.submit(_prompts(6, (5,))[0], max_new_tokens=2)
+    eng.run()
+    assert ok.state is RequestState.FINISHED
+
+
+def test_priority_preempts_lower_priority(model):
+    """priority policy: a high-priority arrival evicts the lowest-
+    priority slot holder when the pool can't fit both; the victim
+    recomputes and still finishes with exact tokens."""
+    prompts = _prompts(7, (13, 21, 7))
+    want = _sequential_baseline(model, prompts, 8, **ENGINE_KW)
+
+    # 7 pages: the 21-token prompt alone peaks at exactly 7, so the
+    # (13-token, 7-token) pair in flight together must overflow
+    eng = ServingEngine(model, policy="priority", num_pages=7,
+                        **ENGINE_KW)
+    h_lo = eng.submit(prompts[0], max_new_tokens=8, priority=0)
+    h_lo2 = eng.submit(prompts[1], max_new_tokens=8, priority=0)
+    for _ in range(3):
+        eng.step()
+    h_hi = eng.submit(prompts[2], max_new_tokens=8, priority=5)
+    eng.run()
+    for h, w in zip((h_lo, h_lo2, h_hi), want):
+        assert h.state is RequestState.FINISHED, (h.rid, h.state)
+        assert h.tokens == w, (h.rid, h.tokens, w)
+    # the high-priority request jumped the page queue
+    assert (h_lo.num_preemptions + h_lo2.num_preemptions) >= 1
+
+
+def test_streaming_callback_and_iterator(model):
+    prompts = _prompts(8, (7,))
+    eng = ServingEngine(model, **ENGINE_KW)
+    seen = []
+    h = eng.submit(prompts[0], max_new_tokens=6,
+                   on_token=lambda rid, tok: seen.append((rid, tok)))
+    streamed = list(h.stream())
+    assert streamed == h.tokens and len(streamed) == 6
+    assert [t for _, t in seen] == streamed
+    assert all(rid == h.rid for rid, _ in seen)
+
+
+def test_stats_expose_slo_fields(model):
+    prompts = _prompts(9, (7, 13))
+    eng = ServingEngine(model, prefill_chunk=4, **ENGINE_KW)
+    for p in prompts:
+        eng.submit(p, max_new_tokens=5)
+    stats = eng.run()
+    for key in ("steps", "requests", "preemptions", "decode_tokens",
+                "prefill_tokens", "throughput_tok_s",
+                "batch_occupancy", "page_utilization",
+                "queue_wait_steps_p50", "ttft_steps_p50",
+                "ttft_ms_p50", "ttft_ms_p99", "tpot_ms_p50",
+                "tpot_ms_p99"):
+        assert key in stats, key
+    assert stats["requests"]["finished"] == 2
+    assert stats["requests"]["submitted"] == 2
+    assert 0 < stats["batch_occupancy"] <= 1
+    assert 0 < stats["page_utilization"] <= 1
+    assert stats["ttft_steps_p50"] >= 1
+    assert stats["ttft_ms_p50"] is not None
+    assert stats["decode_tokens"] == 2 * 5 - 2  # first tokens from prefill
+    assert stats["prefill_tokens"] == sum(len(p) for p in prompts)
+
+
+def test_executor_chunked_prefill_matches_whole_prompt(model):
+    """PagedExecutor level: chunked prefill (any chunking) produces the
+    same first token and the same page contents as one-shot prefill."""
+    prompt = _prompts(10, (19,))[0]
+    a = PagedExecutor(model, max_seqs=1, page_size=4, max_len=64)
+    sa = a.alloc_slot()
+    tok_a = a.prefill(sa, prompt)
+
+    b = PagedExecutor(model, max_seqs=1, page_size=4, max_len=64)
+    sb = b.alloc_slot()
+    tok_b = None
+    for start in range(0, len(prompt), 6):
+        chunk = prompt[start:start + 6]
+        tok_b = b.prefill_chunk(sb, chunk, start,
+                                final=start + len(chunk) == len(prompt))
+    assert tok_a == tok_b
+    # decode continuations agree token-for-token
+    assert a.decode([sa])[sa] == b.decode([sb])[sb]
+    assert a.decode_n([sa], 4)[sa] == b.decode_n([sb], 4)[sb]
