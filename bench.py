@@ -676,6 +676,11 @@ def _bench_serving(jax):
             out["scheduler"] = _measure_scheduler(model, cfg, max_seqs)
         except Exception as e:  # same guard as the A/B leg
             out["scheduler"] = {"error": str(e)[:120]}
+    if os.environ.get("PT_BENCH_SERVE_PREFIX", "1") == "1":
+        try:
+            out["prefix_cache"] = _measure_prefix(model, cfg, max_seqs)
+        except Exception as e:  # same guard as the A/B leg
+            out["prefix_cache"] = {"error": str(e)[:120]}
     return out
 
 
@@ -718,6 +723,65 @@ def _measure_scheduler(model, cfg, max_seqs):
         "preemptions": st["preemptions"],
         "requests": n_req,
         "steps": st["steps"],
+    }
+
+
+def _measure_prefix(model, cfg, max_seqs):
+    """Shared-prefix KV cache A/B (r11): the SAME seeded workload at
+    prefix_share >= 0.5 (half the requests extend a common system-
+    prompt-style prefix) through a cached and an uncached engine.  The
+    contract quantities: TTFT percentiles (warm prefill covers only
+    the novel suffix), serving tok/s, and the measured hit rate —
+    PERF.md's capacity-multiplication math starts from these."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.inference.server import ServingEngine
+    from paddle_tpu.testing.load import LoadSpec, generate_load, run_load
+
+    n_req = int(os.environ.get("PT_BENCH_SERVE_REQS", "16"))
+    share = float(os.environ.get("PT_BENCH_PREFIX_SHARE", "0.6"))
+    work = generate_load(LoadSpec(
+        n_requests=n_req, mean_interarrival=1.0, prompt_len=(32, 64),
+        max_new=(16, 32), vocab=cfg.vocab_size, seed=0,
+        prefix_share=share, prefix_len=96, prefix_pool=2))
+
+    def leg(cached):
+        eng = ServingEngine(model, max_seqs=max_seqs, page_size=16,
+                            max_len=512, dtype=jnp.bfloat16,
+                            prefill_chunk=128, prefix_cache=cached)
+        label = "on" if cached else "off"
+        print(f"serving[prefix {label}]: {n_req} seeded requests at "
+              f"share {share}...", file=sys.stderr)
+        st = run_load(eng, work)["stats"]
+        done = st["requests"]["finished"] + st["requests"]["truncated"]
+        if done != n_req:
+            raise RuntimeError(f"prefix load did not finish cleanly: "
+                               f"{st['requests']}")
+        print(f"serving[prefix {label}]: "
+              f"{st['throughput_tok_s']:.0f} tok/s, ttft p50 "
+              f"{st['ttft_ms_p50']} ms, hit rate "
+              f"{st['prefix_hit_rate']}", file=sys.stderr)
+        return {
+            "serving_tok_s": st["throughput_tok_s"],
+            "ttft_ms_p50": st["ttft_ms_p50"],
+            "ttft_ms_p99": st["ttft_ms_p99"],
+            "prefix_hit_rate": st["prefix_hit_rate"],
+            "cached_tokens": st["cached_tokens"],
+            "prefill_tokens": st["prefill_tokens"],
+            "evicted_pages": st["evicted_pages"],
+        }
+
+    on, off = leg(True), leg(False)
+    return {
+        "prefix_share": share,
+        "requests": n_req,
+        "on": on,
+        "off": off,
+        "ttft_p50_speedup": round(
+            (off["ttft_ms_p50"] / on["ttft_ms_p50"])
+            if on["ttft_ms_p50"] else 0.0, 2),
+        "prefill_tokens_saved": off["prefill_tokens"]
+        - on["prefill_tokens"],
     }
 
 

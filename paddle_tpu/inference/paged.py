@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from ..ops import registry as _registry
+from ..testing import faults as _faults
 
 _op = _registry.cached_apply
 
@@ -189,6 +190,16 @@ class PagedKVCache:
     dense slots 0..max_seqs-1 with a fixed-size page table row each —
     static shapes end-to-end, so every compute step is one cached XLA
     program.
+
+    Pages are REFCOUNTED (prefix-cache sharing, r11): a page is either
+    on the free list (refcount 0) or held by one or more owners — slot
+    page-table rows and/or the radix prefix index.  A page with
+    refcount > 1 is read-only; every in-place write path goes through
+    :meth:`make_writable`, which copy-on-writes a shared page into a
+    fresh exclusively-owned one.  ``free()`` decrements instead of
+    returning pages to the pool, so shared prefix pages survive the
+    sequences that used them.  With no prefix cache attached every
+    refcount is 0/1 and the behavior is bit-identical to the r10 code.
     """
 
     def __init__(self, n_layers, n_kv_heads, head_dim, num_pages,
@@ -214,6 +225,13 @@ class PagedKVCache:
                                   -1, np.int32)
         self.lengths = np.zeros((max_seqs,), np.int32)
         self._active = [False] * max_seqs
+        # per-page owner count: slots referencing it + the prefix index
+        self.page_refs = np.zeros((num_pages,), np.int32)
+        self.cow_count = 0         # copy-on-write page copies performed
+        # optional callable(shortfall_pages) that tries to free pages
+        # (the prefix cache's LRU eviction); consulted before any
+        # "pool exhausted" raise
+        self.reclaimer = None
 
     # -- control plane (host) ------------------------------------------
 
@@ -228,15 +246,99 @@ class PagedKVCache:
                            "is full) — free() a finished sequence first")
 
     def free(self, seq: int) -> None:
-        """Return a sequence's pages to the pool — every ASSIGNED slot,
-        not just length-covered ones, so reserved-but-unwritten pages
-        (e.g. from a failed batch step) are recovered too."""
+        """Release a sequence's pages — every ASSIGNED slot, not just
+        length-covered ones, so reserved-but-unwritten pages (e.g. from
+        a failed batch step) are recovered too.  A page returns to the
+        free list only when its LAST owner lets go: pages shared with
+        the prefix index (refcount > 1) merely drop a reference."""
         for pid in self.page_table[seq]:
             if pid >= 0:
-                self._free.append(int(pid))
+                self._deref(int(pid))
         self.page_table[seq] = -1
         self.lengths[seq] = 0
         self._active[seq] = False
+
+    # -- refcounted page pool --------------------------------------------
+
+    def _pop_page(self) -> int:
+        pid = self._free.pop()
+        self.page_refs[pid] = 1
+        return pid
+
+    def _deref(self, pid: int) -> None:
+        self.page_refs[pid] -= 1
+        if self.page_refs[pid] == 0:
+            self._free.append(pid)
+        elif self.page_refs[pid] < 0:
+            raise AssertionError(
+                f"page {pid} refcount went negative (double free)")
+
+    def _reclaim(self, shortfall: int) -> None:
+        """Ask the attached prefix cache (if any) to LRU-evict enough
+        zero-refcount pages to cover ``shortfall`` — tried before any
+        pool-exhausted raise, so eviction replaces preempt-and-recompute
+        whenever cold cache entries are holding the pages."""
+        if self.reclaimer is not None and shortfall > 0:
+            self.reclaimer(shortfall)
+
+    def attach(self, seq: int, page_ids, n_tokens: int) -> None:
+        """Attach already-written pages BY REFERENCE (prefix-cache hit):
+        the slot's first ``len(page_ids)`` table rows point at shared
+        pages and the sequence length starts at ``n_tokens`` — prefill
+        then begins at the first divergent token.  The final page may be
+        partially covered (``n_tokens`` not page-aligned); the first
+        write to it copy-on-writes."""
+        n_pages = len(page_ids)
+        if n_tokens > n_pages * self.page_size:
+            raise ValueError(
+                f"attach: {n_tokens} tokens exceed {n_pages} pages "
+                f"x {self.page_size}")
+        if n_pages > self.max_pages_per_seq:
+            raise RuntimeError(
+                f"sequence {seq} needs {n_pages} pages > per-seq "
+                f"budget {self.max_pages_per_seq}")
+        for i, pid in enumerate(page_ids):
+            if self.page_table[seq, i] >= 0:
+                raise AssertionError(
+                    f"attach over an assigned slot {i} of seq {seq}")
+            self.page_table[seq, i] = int(pid)
+            self.page_refs[int(pid)] += 1
+        self.lengths[seq] = int(n_tokens)
+
+    def make_writable(self, seq: int, start: int, end: int) -> None:
+        """Copy-on-write guard: every page-table slot overlapping token
+        positions [start, end) must be exclusively owned before an
+        in-place write.  Shared pages (refcount > 1) get a fresh page
+        with the prefix-resident contents copied; unshared pages are
+        untouched, so with no prefix cache this is a no-op."""
+        if end <= start:
+            return
+        ps = self.page_size
+        for slot in range(start // ps, -(-end // ps)):
+            pid = int(self.page_table[seq, slot])
+            if pid >= 0 and self.page_refs[pid] > 1:
+                self._cow(seq, slot)
+
+    def _cow(self, seq: int, slot: int) -> None:
+        _faults.fire("prefix.cow", "before")
+        if not self._free:
+            self._reclaim(1)
+        if not self._free:
+            raise RuntimeError("KV page pool exhausted (copy-on-write "
+                               "of a shared prefix page)")
+        old = int(self.page_table[seq, slot])
+        new = self._pop_page()
+        # the prefix-resident slice lives below the write offset; the
+        # whole-page copy is a superset (bytes past it are overwritten
+        # or masked by the length)
+        self.k_pages = self.k_pages.at[:, :, new].set(
+            self.k_pages[:, :, old])
+        self.v_pages = self.v_pages.at[:, :, new].set(
+            self.v_pages[:, :, old])
+        self.page_table[seq, slot] = new
+        self.page_refs[old] -= 1
+        self.cow_count += 1
+        _faults.fire("prefix.cow", "after")
 
     def _plan_missing(self, seq: int, new_len: int):
         """Slot-aware plan (-1 = unset): the list of page-table slots
@@ -253,22 +355,28 @@ class PagedKVCache:
     def _ensure_capacity(self, seq: int, new_len: int) -> None:
         missing = self._plan_missing(seq, new_len)
         if len(missing) > len(self._free):
+            self._reclaim(len(missing) - len(self._free))
+        if len(missing) > len(self._free):
             raise RuntimeError("KV page pool exhausted")
         for i in missing:
-            self.page_table[seq, i] = self._free.pop()
+            self.page_table[seq, i] = self._pop_page()
 
     def reserve(self, seqs, extra_tokens=1) -> None:
         """Batch-atomic capacity reservation: plan every sequence's
         missing slots first, commit only if the WHOLE batch fits (a
         per-sequence loop would leak the earlier sequences' pages on a
-        mid-batch failure)."""
+        mid-batch failure).  Prefix-cache eviction is tried before
+        giving up, so cold cached pages yield to live sequences."""
         plans = [(s, self._plan_missing(
             s, int(self.lengths[s]) + extra_tokens)) for s in seqs]
-        if sum(len(m) for _, m in plans) > len(self._free):
+        need = sum(len(m) for _, m in plans)
+        if need > len(self._free):
+            self._reclaim(need - len(self._free))
+        if need > len(self._free):
             raise RuntimeError("KV page pool exhausted")
         for s, missing in plans:
             for i in missing:
-                self.page_table[s, i] = self._free.pop()
+                self.page_table[s, i] = self._pop_page()
 
     # -- data plane (device) -------------------------------------------
 
@@ -285,6 +393,9 @@ class PagedKVCache:
         v = jnp.asarray(v, self.v_pages.dtype)
         T = k.shape[2]
         self._ensure_capacity(seq, start + T)
+        # shared pages in the write window are read-only: COW them
+        # first (no-op when nothing is shared, i.e. no prefix cache)
+        self.make_writable(seq, start, start + T)
         ps = self.page_size
         t = 0
         while t < T:
@@ -306,7 +417,17 @@ class PagedKVCache:
         and must be masked by the consumer."""
         L = int(self.lengths[seq]) if length is None else int(length)
         n = -(-L // self.page_size)
-        pids = jnp.asarray(np.maximum(self.page_table[seq, :n], 0))
+        row = self.page_table[seq, :n]
+        if (row < 0).any():
+            # an unset (-1) slot inside the requested length used to be
+            # clipped to page 0 — silently serving another sequence's
+            # KV.  That is always a caller bug: fail loudly instead.
+            bad = int(np.argmax(row < 0))
+            raise RuntimeError(
+                f"gather_dense: sequence {seq} page slot {bad} is "
+                f"unset inside the requested length {L} "
+                f"({n} pages) — refusing to read garbage from page 0")
+        pids = jnp.asarray(row)
         k = self.k_pages[:, :, pids]          # [L, KV, n, ps, D]
         v = self.v_pages[:, :, pids]
         sh = (k.shape[0], k.shape[1], n * self.page_size, k.shape[4])
@@ -332,6 +453,9 @@ class PagedKVCache:
         v = jnp.asarray(v, self.v_pages.dtype)
         ps = self.page_size
         self.reserve(seqs, extra_tokens=1)  # batch-atomic
+        for s in seqs:
+            pos = int(self.lengths[s])
+            self.make_writable(s, pos, pos + 1)
         pids, offs = [], []
         for s in seqs:
             pos = int(self.lengths[s])

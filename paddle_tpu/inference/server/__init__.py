@@ -12,10 +12,12 @@ Layering (host control plane / device data plane):
 from .engine import ServingEngine
 from .executor import PagedExecutor
 from .metrics import EngineMetrics
+from .prefix_cache import PrefixCache, check_pool_invariants
 from .request import Request, RequestHandle, RequestState, TERMINAL
 from .scheduler import Scheduler
 
 __all__ = [
     "ServingEngine", "PagedExecutor", "EngineMetrics", "Request",
     "RequestHandle", "RequestState", "TERMINAL", "Scheduler",
+    "PrefixCache", "check_pool_invariants",
 ]

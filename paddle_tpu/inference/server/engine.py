@@ -14,28 +14,52 @@ front-end would do per tick.
 """
 from __future__ import annotations
 
+import os
+
 import jax.numpy as jnp
 
 from .executor import PagedExecutor
 from .metrics import EngineMetrics
+from .prefix_cache import PrefixCache
 from .request import Request, RequestHandle, RequestState
 from .scheduler import Scheduler
+
+
+def _prefix_cache_enabled() -> bool:
+    mode = os.environ.get("PT_PREFIX_CACHE", "off").lower()
+    if mode not in ("off", "on"):
+        raise ValueError(
+            f"PT_PREFIX_CACHE={mode!r}: expected off|on")
+    return mode == "on"
 
 
 class ServingEngine:
     def __init__(self, model, max_seqs=4, page_size=16, max_len=256,
                  dtype=jnp.float32, num_pages=None, policy="fifo",
                  prefill_chunk=None, eos_token_id=None,
-                 max_preemptions=4):
+                 max_preemptions=4, prefix_cache=None):
         self.executor = PagedExecutor(
             model, max_seqs=max_seqs, page_size=page_size,
             max_len=max_len, dtype=dtype, num_pages=num_pages)
         self.metrics = EngineMetrics(
             max_seqs=max_seqs, num_pages=self.executor.cache.num_pages)
+        # prefix_cache: None = follow PT_PREFIX_CACHE (default off,
+        # bit-exact legacy path); True/False force it (bench A/B)
+        if prefix_cache is None:
+            prefix_cache = _prefix_cache_enabled()
+        self.prefix = None
+        if prefix_cache:
+            self.prefix = PrefixCache(
+                self.executor.cache,
+                on_evict=self.metrics.on_prefix_evict)
+            # allocation shortfalls try LRU eviction of cold cached
+            # pages before raising pool-exhausted (eviction is cheaper
+            # than preempt-and-recompute)
+            self.executor.cache.reclaimer = self.prefix.evict
         self.scheduler = Scheduler(
             self.executor, self.metrics, policy=policy,
             prefill_chunk=prefill_chunk, eos_token_id=eos_token_id,
-            max_preemptions=max_preemptions)
+            max_preemptions=max_preemptions, prefix_cache=self.prefix)
         self._next_rid = 0
 
     # -- submission ------------------------------------------------------

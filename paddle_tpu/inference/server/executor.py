@@ -76,6 +76,10 @@ class PagedExecutor:
             page_size=page_size, max_seqs=max_seqs, dtype=dtype,
             max_pages_per_seq=pages_per_seq)
         self.last_token = {}
+        # (sid, n_tokens) per prefill dispatch — the audit trail the
+        # prefix-cache tests use to assert prefill FLOPs covered only
+        # the novel suffix of a warm request
+        self.prefill_events = []
         self._jit_prefill = jax.jit(self._prefill_fwd)
         self._jit_chunk = jax.jit(self._chunk_fwd)
         # donate the pools: decode() immediately replaces them with the
@@ -292,10 +296,28 @@ class PagedExecutor:
         self.cache.free(sid)
         self.last_token.pop(sid, None)
 
+    def attach_prefix(self, sid: int, page_ids, n_tokens: int) -> None:
+        """Point a fresh slot's page table at already-computed prefix
+        pages (cache hit): chunked prefill then starts at token
+        ``n_tokens`` instead of 0."""
+        self.cache.attach(sid, page_ids, n_tokens)
+
+    def prepare_write(self, sid: int, start: int, n_tokens: int) -> None:
+        """Pre-commit the page work for a prefill chunk covering
+        positions [start, start + n_tokens): allocate missing pages
+        (prefix eviction is tried before pool-exhausted) and
+        copy-on-write any shared page in the window.  The scheduler
+        calls this BEFORE its per-request fault bracket so a pool raise
+        or an injected ``prefix.cow`` fault preempts/retries cleanly
+        instead of failing the request."""
+        self.cache._ensure_capacity(sid, start + n_tokens)
+        self.cache.make_writable(sid, start, start + n_tokens)
+
     def prefill(self, sid: int, prompt_ids) -> int:
         """Whole-prompt prefill into an allocated slot; returns the
         first greedy token."""
         ids = jnp.asarray(np.asarray(prompt_ids)[None], jnp.int32)
+        self.prefill_events.append((sid, int(ids.shape[1])))
         logits, k, v = self._jit_prefill(self.layers, self.tops, ids)
         self.cache.prefill(sid, k, v)
         tok = int(jnp.argmax(logits))
@@ -309,6 +331,7 @@ class PagedExecutor:
         prompt's first greedy token; else returns None."""
         past_k, past_v = self.cache.gather_dense(sid, start)
         ids = jnp.asarray(np.asarray(chunk_ids)[None], jnp.int32)
+        self.prefill_events.append((sid, int(ids.shape[1])))
         logits, k, v = self._jit_chunk(
             self.layers, self.tops, ids, jnp.int32(start), past_k,
             past_v, jnp.int32(start))
@@ -330,6 +353,10 @@ class PagedExecutor:
         # write-then-attend: a per-sequence loop would strand earlier
         # sequences' fresh pages when a later one exhausts the pool
         cache.reserve(sids, extra_tokens=1)
+        # the in-graph page write must never land on a shared page
+        for s in sids:
+            pos = int(cache.lengths[s])
+            cache.make_writable(s, pos, pos + 1)
         ids = jnp.asarray([self.last_token[s] for s in sids], jnp.int32)
         positions = jnp.asarray([int(cache.lengths[s]) for s in sids],
                                 jnp.int32)
@@ -361,6 +388,9 @@ class PagedExecutor:
             return {}
         cache = self.cache
         cache.reserve(sids, extra_tokens=n)
+        for s in sids:
+            pos = int(cache.lengths[s])
+            cache.make_writable(s, pos, pos + n)
         ids = jnp.asarray([self.last_token[s] for s in sids], jnp.int32)
         positions = jnp.asarray([int(cache.lengths[s]) for s in sids],
                                 jnp.int32)

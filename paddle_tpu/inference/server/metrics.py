@@ -35,6 +35,9 @@ class EngineMetrics:
         self.prefill_tokens = 0
         self.preemptions = 0
         self.submitted = 0
+        self.prefix_hits = 0          # admissions that attached pages
+        self.cached_tokens = 0        # prompt tokens served from cache
+        self.evicted_pages = 0        # prefix-tree pages LRU-evicted
         self.occupancy_sum = 0.0      # decode-batch fill over busy steps
         self.page_util_sum = 0.0      # pool occupancy over busy steps
         self.state_counts = {s.value: 0 for s in RequestState
@@ -67,6 +70,13 @@ class EngineMetrics:
 
     def on_preempt(self, req):
         self.preemptions += 1
+
+    def on_prefix_hit(self, tokens):
+        self.prefix_hits += 1
+        self.cached_tokens += int(tokens)
+
+    def on_prefix_evict(self, n_pages):
+        self.evicted_pages += int(n_pages)
 
     def on_terminal(self, req, step):
         req.finish_step = step
@@ -110,6 +120,13 @@ class EngineMetrics:
             "preemptions": self.preemptions,
             "decode_tokens": self.decode_tokens,
             "prefill_tokens": self.prefill_tokens,
+            # prefix-cache effectiveness: what fraction of prompt
+            # tokens were served from shared pages instead of prefilled
+            "cached_tokens": self.cached_tokens,
+            "prefix_hit_rate": round(
+                self.cached_tokens
+                / max(self.cached_tokens + self.prefill_tokens, 1), 4),
+            "evicted_pages": self.evicted_pages,
             "throughput_tok_s": round(self.decode_tokens / wall, 2),
             "batch_occupancy": round(self.occupancy_sum / busy, 4),
             "page_utilization": round(self.page_util_sum / busy, 4),

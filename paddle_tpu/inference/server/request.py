@@ -51,6 +51,7 @@ class Request:
         "preempt_count", "submit_step", "submit_time", "sched_step",
         "first_token_step", "first_token_time", "finish_step",
         "finish_time", "last_token_time", "decode_time_s",
+        "cached_tokens",
     )
 
     def __init__(self, rid, prompt_ids, max_new_tokens=16, priority=0,
@@ -72,6 +73,7 @@ class Request:
         self.generated = []        # streamed output tokens
         self.cancel_flag = False
         self.preempt_count = 0
+        self.cached_tokens = 0     # prompt tokens attached from cache
 
         self.submit_step = None
         self.submit_time = None
@@ -179,6 +181,7 @@ class RequestHandle:
                        / (len(r.generated) - 1)),
             "tokens": len(r.generated),
             "preemptions": r.preempt_count,
+            "cached_tokens": r.cached_tokens,
         }
 
     def __repr__(self):

@@ -38,12 +38,13 @@ _POOL_EXHAUSTED = "KV page pool exhausted"
 class Scheduler:
     def __init__(self, executor, metrics, policy="fifo",
                  prefill_chunk=None, eos_token_id=None,
-                 max_preemptions=4):
+                 max_preemptions=4, prefix_cache=None):
         if policy not in ("fifo", "priority"):
             raise ValueError(
                 f"policy must be 'fifo' or 'priority', got {policy!r}")
         self.executor = executor
         self.metrics = metrics
+        self.prefix = prefix_cache   # radix prefix index (None = off)
         self.policy = policy
         self.prefill_chunk = (None if prefill_chunk is None
                               else int(prefill_chunk))
@@ -171,9 +172,23 @@ class Scheduler:
         ex = self.executor
         while self.queue:
             req = self._pick_next()
-            need = ex.pages_for(len(req.resume_ids) + 1)
-            if (ex.free_slots < 1
-                    or ex.free_pages - self._committed_pages() < need):
+            hit_tokens, hit_pages = 0, []
+            if self.prefix is not None:
+                faults.fire("prefix.match", "before")
+                hit_tokens, hit_pages = self.prefix.match(req.resume_ids)
+                faults.fire("prefix.match", "after")
+            # admission pays only for NOVEL pages: matched pages are
+            # attached by reference.  A mid-page hit budgets one extra
+            # page for the copy-on-write of the partial page, and cold
+            # cached pages count as available (eviction frees them).
+            need = ex.pages_for(len(req.resume_ids) + 1) - len(hit_pages)
+            if hit_tokens % ex.cache.page_size:
+                need += 1
+            avail = ex.free_pages - self._committed_pages()
+            if self.prefix is not None:
+                avail += max(
+                    0, self.prefix.evictable_pages() - len(hit_pages))
+            if ex.free_slots < 1 or avail < need:
                 if self.policy == "priority":
                     victim = self._pick_victim(below=req.priority)
                     if victim is not None:
@@ -183,6 +198,11 @@ class Scheduler:
             faults.fire("serve.admit", "before")
             req.sid = ex.alloc_slot()
             req.prefill_done = 0
+            if hit_tokens:
+                ex.attach_prefix(req.sid, hit_pages, hit_tokens)
+                req.prefill_done = hit_tokens
+                req.cached_tokens = hit_tokens
+                self.metrics.on_prefix_hit(hit_tokens)
             req.state = RequestState.PREFILLING
             self.queue.remove(req)
             self.prefilling.append(req)
@@ -216,6 +236,17 @@ class Scheduler:
                      else min(self.prefill_chunk, total - start))
             final = start + chunk == total
             try:
+                # page work FIRST, outside the per-request bracket: a
+                # pool-exhausted raise preempts (not fails) the request,
+                # and an injected prefix.cow fault escapes step() with
+                # the pool consistent — the next step() retries cleanly
+                self.executor.prepare_write(req.sid, start, chunk)
+            except RuntimeError as e:
+                if _POOL_EXHAUSTED not in str(e):
+                    raise
+                self._preempt(req)
+                continue
+            try:
                 faults.fire("serve.request", "before")
                 with RecordEvent("serve.prefill"):
                     if start == 0 and final:
@@ -242,6 +273,12 @@ class Scheduler:
                 self.prefilling.remove(req)
                 self.running.append(req)
                 req.state = RequestState.RUNNING
+                if self.prefix is not None:
+                    # publish BEFORE the first token can finish the
+                    # request: _finish frees the slot, and the tree's
+                    # reference is what keeps the pages alive past it
+                    self.prefix.insert(
+                        ids, self.executor.cache.page_table[req.sid])
                 self._on_token(req, tok, emitted)
 
     # -- request transitions --------------------------------------------

@@ -481,6 +481,119 @@ def _roll(datas, attrs):
               f"have the same length")
 
 
+@register_validator("diag")
+def _diag(datas, attrs):
+    nd = _ndim(datas[0])
+    if nd not in (1, 2):
+        _fail("diag",
+              f"the input must be a 1-D or 2-D tensor, but received "
+              f"rank {nd} (shape {list(_shape(datas[0]))})")
+
+
+@register_validator("diagonal")
+def _diagonal(datas, attrs):
+    xs = _shape(datas[0])
+    nd = len(xs)
+    if nd < 2:
+        _fail("diagonal",
+              f"the input must have rank >= 2, but received rank {nd} "
+              f"(shape {list(xs)})")
+    a1 = _axis_in("diagonal", int(attrs.get("axis1", 0)), nd)
+    a2 = _axis_in("diagonal", int(attrs.get("axis2", 1)), nd)
+    if a1 == a2:
+        _fail("diagonal",
+              f"axis1 and axis2 must refer to different dimensions, "
+              f"but both resolve to {a1}")
+
+
+@register_validator("tril")
+def _tril(datas, attrs):
+    nd = _ndim(datas[0])
+    if nd < 2:
+        _fail("tril",
+              f"the input must have rank >= 2, but received rank {nd} "
+              f"(shape {list(_shape(datas[0]))})")
+
+
+@register_validator("triu")
+def _triu(datas, attrs):
+    nd = _ndim(datas[0])
+    if nd < 2:
+        _fail("triu",
+              f"the input must have rank >= 2, but received rank {nd} "
+              f"(shape {list(_shape(datas[0]))})")
+
+
+@register_validator("repeat_interleave")
+def _repeat_interleave(datas, attrs):
+    xs = _shape(datas[0])
+    repeats = attrs.get("repeats")
+    axis = attrs.get("axis")
+    if axis is not None:
+        ax = _axis_in("repeat_interleave", int(axis), max(len(xs), 1))
+    if isinstance(repeats, (list, tuple)):
+        if any(int(r) < 0 for r in repeats):
+            _fail("repeat_interleave",
+                  f"repeats must all be non-negative, got "
+                  f"{list(repeats)}")
+        size = (int(np.prod(xs)) if axis is None
+                else (xs[ax] if xs else 1))
+        if len(repeats) not in (1, size):
+            _fail("repeat_interleave",
+                  f"repeats has {len(repeats)} entries but the "
+                  f"repeated dimension has size {size}")
+    elif repeats is not None and int(repeats) < 0:
+        _fail("repeat_interleave",
+              f"repeats must be non-negative, got {repeats}")
+
+
+@register_validator("cross")
+def _cross(datas, attrs):
+    xs, ys = _shape(datas[0]), _shape(datas[1])
+    if xs != ys:
+        _fail("cross",
+              f"the inputs must have the same shape, but received "
+              f"x{list(xs)} vs y{list(ys)}")
+    ax = _axis_in("cross", int(attrs.get("axis", 0)), max(len(xs), 1))
+    if xs and xs[ax] != 3:
+        _fail("cross",
+              f"the size along the cross axis must be 3, but "
+              f"dimension {ax} of {list(xs)} is {xs[ax]}")
+
+
+@register_validator("moveaxis")
+def _moveaxis(datas, attrs):
+    nd = max(_ndim(datas[0]), 1)
+    src = attrs.get("source")
+    dst = attrs.get("destination")
+    srcs = src if isinstance(src, (list, tuple)) else (src,)
+    dsts = dst if isinstance(dst, (list, tuple)) else (dst,)
+    if len(srcs) != len(dsts):
+        _fail("moveaxis",
+              f"source ({list(srcs)}) and destination ({list(dsts)}) "
+              f"must have the same number of axes")
+    for name, axes in (("source", srcs), ("destination", dsts)):
+        seen = set()
+        for a in axes:
+            n = _axis_in("moveaxis", int(a), nd)
+            if n in seen:
+                _fail("moveaxis",
+                      f"{name} axes {list(axes)} have duplicates")
+            seen.add(n)
+
+
+@register_validator("meshgrid")
+def _meshgrid(datas, attrs):
+    # host-side op: the wrapper calls validate() directly
+    if not datas:
+        _fail("meshgrid", "meshgrid expects at least one input")
+    for i, d in enumerate(datas):
+        if _ndim(d) > 1:
+            _fail("meshgrid",
+                  f"each input must be 0-D or 1-D, but input {i} has "
+                  f"shape {list(_shape(d))}")
+
+
 @register_validator("masked_select")
 def _masked_select(datas, attrs):
     # host-side op: the wrapper calls validate() directly (it never

@@ -749,7 +749,11 @@ diagonal_op = register_op(
 
 repeat_interleave_op = register_op(
     "repeat_interleave",
-    lambda x, repeats, axis=None: jnp.repeat(x, repeats, axis=axis),
+    # per-element repeats ride as a tuple (static args must hash);
+    # jnp.repeat wants an array back
+    lambda x, repeats, axis=None: jnp.repeat(
+        x, np.asarray(repeats) if isinstance(repeats, tuple)
+        else repeats, axis=axis),
     static_argnames=("repeats", "axis"))
 
 
@@ -775,11 +779,15 @@ one_hot_op = register_op(
 
 def meshgrid(*args, **kwargs):
     from ..core.tensor import Tensor
+    from . import infermeta
 
     if len(args) == 1 and isinstance(args[0], (list, tuple)):
         args = args[0]
-    outs = jnp.meshgrid(*[a._data if isinstance(a, Tensor) else a
-                          for a in args], indexing="ij")
+    datas = [a._data if isinstance(a, Tensor) else a for a in args]
+    # host path (list-of-Tensors out), so it never passes
+    # registry.apply's validation hook — validate here
+    infermeta.validate("meshgrid", datas, {})
+    outs = jnp.meshgrid(*datas, indexing="ij")
     return [Tensor(o) for o in outs]
 
 

@@ -70,6 +70,14 @@ REGISTERED = {
                     "reserved, nothing written; after=tokens emitted)",
     "serve.request": "one request's prefill work — an exception here "
                      "is confined to that request (state FAILED)",
+    "prefix.match": "one admission-time radix-tree prefix lookup "
+                    "(before=tree untouched, after=match computed but "
+                    "nothing attached)",
+    "prefix.cow": "one copy-on-write of a shared KV page (before=no "
+                  "page popped, after=table repointed at the copy)",
+    "prefix.evict": "one LRU eviction of a zero-refcount prefix-tree "
+                    "leaf (before=node still linked, after=pages back "
+                    "on the free list)",
 }
 
 _PHASES = ("before", "after")

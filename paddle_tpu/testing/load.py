@@ -27,7 +27,8 @@ class LoadSpec:
 
     def __init__(self, n_requests=8, mean_interarrival=2.0,
                  prompt_len=(4, 24), max_new=(4, 12),
-                 priorities=(0,), vocab=256, seed=0):
+                 priorities=(0,), vocab=256, seed=0,
+                 prefix_share=0.0, prefix_len=16, prefix_pool=2):
         self.n_requests = int(n_requests)
         self.mean_interarrival = float(mean_interarrival)
         self.prompt_len = tuple(prompt_len)
@@ -35,6 +36,13 @@ class LoadSpec:
         self.priorities = tuple(priorities)
         self.vocab = int(vocab)
         self.seed = int(seed)
+        # shared-prefix traffic shape (exercises the prefix cache):
+        # a `prefix_share` fraction of requests prepend one of
+        # `prefix_pool` seeded common prefixes of `prefix_len` tokens
+        # (system prompts / few-shot templates in miniature)
+        self.prefix_share = float(prefix_share)
+        self.prefix_len = int(prefix_len)
+        self.prefix_pool = int(prefix_pool)
 
 
 def generate_load(spec: LoadSpec) -> list:
@@ -42,17 +50,27 @@ def generate_load(spec: LoadSpec) -> list:
     priority}, ...] sorted by arrival tick (Poisson-ish arrivals via
     geometric inter-arrival gaps so ticks stay integral)."""
     rng = np.random.RandomState(spec.seed)
+    # the prefix pool is drawn FIRST and only when enabled, so existing
+    # seeds with prefix_share=0 produce byte-identical workloads
+    prefixes = None
+    if spec.prefix_share > 0.0:
+        prefixes = [rng.randint(1, spec.vocab,
+                                size=spec.prefix_len).astype(np.int32)
+                    for _ in range(spec.prefix_pool)]
     work, tick = [], 0
     p_step = 1.0 / max(spec.mean_interarrival, 1e-9)
     for i in range(spec.n_requests):
         if i:
             tick += int(rng.geometric(min(p_step, 1.0)))
         plen = int(rng.randint(spec.prompt_len[0], spec.prompt_len[1] + 1))
+        prompt = rng.randint(1, spec.vocab, size=plen).astype(np.int32)
+        if prefixes is not None and rng.rand() < spec.prefix_share:
+            prompt = np.concatenate(
+                [prefixes[rng.randint(len(prefixes))], prompt])
         work.append({
             "rid": f"load-{i}",
             "arrival_tick": tick,
-            "prompt_ids": rng.randint(
-                1, spec.vocab, size=plen).astype(np.int32),
+            "prompt_ids": prompt,
             "max_new_tokens": int(rng.randint(
                 spec.max_new[0], spec.max_new[1] + 1)),
             "priority": int(spec.priorities[

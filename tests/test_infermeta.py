@@ -352,3 +352,121 @@ def test_validators_skip_traced_values():
     x = _f32(4, 3)
     idx = paddle.to_tensor(np.array([1, 2], np.int64))
     assert list(f(x, idx).shape) == [2, 3]
+
+
+# -- batch 4: diag/diagonal/tril/triu/repeat_interleave/cross/moveaxis/
+#    meshgrid ------------------------------------------------------------
+
+
+def test_diag_accepts_1d_and_2d():
+    assert list(paddle.diag(_f32(4)).shape) == [4, 4]
+    assert list(paddle.diag(_f32(3, 3)).shape) == [3]
+
+
+def test_diag_rejects_rank3():
+    with pytest.raises(InvalidArgumentError, match="1-D or 2-D"):
+        paddle.diag(_f32(2, 3, 4))
+
+
+def test_diagonal_accepts_rank2_and_axes():
+    assert list(paddle.diagonal(_f32(3, 4)).shape) == [3]
+    assert list(paddle.diagonal(_f32(2, 3, 4), axis1=1,
+                                axis2=2).shape) == [2, 3]
+
+
+def test_diagonal_rejects_rank1():
+    with pytest.raises(InvalidArgumentError, match="rank >= 2"):
+        paddle.diagonal(_f32(5))
+
+
+def test_diagonal_rejects_equal_axes():
+    with pytest.raises(InvalidArgumentError, match="different"):
+        paddle.diagonal(_f32(3, 4), axis1=1, axis2=-1)
+
+
+def test_tril_triu_accept_rank2():
+    x = _f32(3, 3)
+    np.testing.assert_allclose(
+        paddle.tril(x).numpy(), np.tril(x.numpy()))
+    np.testing.assert_allclose(
+        paddle.triu(x).numpy(), np.triu(x.numpy()))
+
+
+def test_tril_rejects_rank1():
+    with pytest.raises(InvalidArgumentError, match="rank >= 2"):
+        paddle.tril(_f32(4))
+
+
+def test_triu_rejects_rank1():
+    with pytest.raises(InvalidArgumentError, match="rank >= 2"):
+        paddle.triu(_f32(4))
+
+
+def test_repeat_interleave_accepts_scalar_and_per_element():
+    assert list(paddle.repeat_interleave(_f32(2, 3), 2,
+                                         axis=1).shape) == [2, 6]
+    reps = paddle.to_tensor(np.array([1, 2, 3], np.int64))
+    out = paddle.repeat_interleave(_f32(3), reps, axis=0)
+    assert list(out.shape) == [6]
+
+
+def test_repeat_interleave_rejects_negative():
+    with pytest.raises(InvalidArgumentError, match="non-negative"):
+        paddle.repeat_interleave(_f32(2, 3), -1, axis=0)
+
+
+def test_repeat_interleave_rejects_length_mismatch():
+    reps = paddle.to_tensor(np.array([1, 2], np.int64))
+    with pytest.raises(InvalidArgumentError, match="entries"):
+        paddle.repeat_interleave(_f32(3), reps, axis=0)
+
+
+def test_repeat_interleave_rejects_bad_axis():
+    with pytest.raises(InvalidArgumentError, match="range"):
+        paddle.repeat_interleave(_f32(2, 3), 2, axis=4)
+
+
+def test_cross_accepts_3vectors():
+    a = paddle.to_tensor(np.array([1.0, 0.0, 0.0], np.float32))
+    b = paddle.to_tensor(np.array([0.0, 1.0, 0.0], np.float32))
+    np.testing.assert_allclose(paddle.cross(a, b).numpy(),
+                               [0.0, 0.0, 1.0])
+
+
+def test_cross_rejects_shape_mismatch():
+    with pytest.raises(InvalidArgumentError, match="same shape"):
+        paddle.cross(_f32(3), _f32(4))
+
+
+def test_cross_rejects_non3_axis():
+    with pytest.raises(InvalidArgumentError, match="must be 3"):
+        paddle.cross(_f32(4), _f32(4), axis=0)
+
+
+def test_moveaxis_accepts_swap():
+    assert list(paddle.moveaxis(_f32(2, 3, 4), 0, 2).shape) == [3, 4, 2]
+
+
+def test_moveaxis_rejects_length_mismatch():
+    with pytest.raises(InvalidArgumentError, match="same number"):
+        paddle.moveaxis(_f32(2, 3, 4), (0, 1), (1,))
+
+
+def test_moveaxis_rejects_duplicate_axes():
+    with pytest.raises(InvalidArgumentError, match="duplicates"):
+        paddle.moveaxis(_f32(2, 3, 4), (0, 0), (0, 1))
+
+
+def test_moveaxis_rejects_out_of_range():
+    with pytest.raises(InvalidArgumentError, match="range"):
+        paddle.moveaxis(_f32(2, 3), 5, 0)
+
+
+def test_meshgrid_accepts_1d_inputs():
+    a, b = paddle.meshgrid(_f32(2), _f32(3))
+    assert list(a.shape) == [2, 3] and list(b.shape) == [2, 3]
+
+
+def test_meshgrid_rejects_rank2_input():
+    with pytest.raises(InvalidArgumentError, match="0-D or 1-D"):
+        paddle.meshgrid(_f32(2), _f32(2, 3))

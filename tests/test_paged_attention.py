@@ -182,9 +182,9 @@ def test_reserve_is_batch_atomic_and_retry_safe():
     a = cache.allocate()
     b = cache.allocate()
     cache.lengths[a] = 1
-    cache.page_table[a, 0] = cache._free.pop()
+    cache.page_table[a, 0] = cache._pop_page()  # refcounted pop (r11)
     cache.lengths[b] = 1
-    cache.page_table[b, 0] = cache._free.pop()
+    cache.page_table[b, 0] = cache._pop_page()
     cache._free = cache._free[:1]  # one page for two crossings
     with pytest.raises(RuntimeError, match="exhausted"):
         cache.reserve([a, b])

@@ -1,0 +1,409 @@
+"""Shared-prefix KV cache: radix tree + refcounted copy-on-write pages.
+
+The load-bearing contracts, all on the logical clock in fp32 greedy:
+
+  * a warm request (prompt extends a cached prefix) streams tokens
+    BIT-IDENTICAL to a cold-cache run, while its prefill dispatches
+    cover only the novel suffix (asserted on the executor's per-step
+    prefill-token audit trail);
+  * a mid-page divergence copy-on-writes the shared partial page —
+    never writes it in place;
+  * the refcount invariant (every page is on the free list XOR
+    referenced; refcounts == slot references + tree references) holds
+    after EVERY scheduler step under the seeded load harness with
+    preemption and eviction in play;
+  * eviction only ever reclaims pages no live sequence references;
+  * PT_PREFIX_CACHE=off is the exact r10 path, and an injected raise
+    at prefix.match / prefix.cow / prefix.evict leaves the engine
+    serviceable with exact streams.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.paged import PagedKVCache
+from paddle_tpu.inference.server import (
+    PrefixCache, RequestState, ServingEngine, check_pool_invariants,
+)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.testing import faults
+from paddle_tpu.testing.load import LoadSpec, generate_load
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(11)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+ENGINE_KW = dict(max_seqs=2, page_size=4, max_len=64)
+
+
+def _prompts_sharing_prefix(seed=0, prefix_len=18, suffix_lens=(7, 9)):
+    rng = np.random.RandomState(seed)
+    prefix = rng.randint(1, 256, (prefix_len,)).astype(np.int32)
+    return [np.concatenate(
+        [prefix, rng.randint(1, 256, (n,)).astype(np.int32)])
+        for n in suffix_lens]
+
+
+def _cold(model, prompt, max_new=8, **kw):
+    eng = ServingEngine(model, prefix_cache=False, **dict(ENGINE_KW, **kw))
+    return eng.submit(prompt, max_new_tokens=max_new).result()
+
+
+# -- radix tree unit level (no model) ----------------------------------
+
+
+def _bare_cache(num_pages=16, page_size=4, max_seqs=4):
+    return PagedKVCache(n_layers=1, n_kv_heads=1, head_dim=4,
+                        num_pages=num_pages, page_size=page_size,
+                        max_seqs=max_seqs,
+                        max_pages_per_seq=num_pages)
+
+
+def _fill(cache, seq, n_tokens):
+    """Simulate a prefill: allocate pages + set the length."""
+    cache._ensure_capacity(seq, n_tokens)
+    cache.lengths[seq] = n_tokens
+
+
+def test_tree_match_insert_roundtrip():
+    cache = _bare_cache()
+    tree = PrefixCache(cache)
+    s = cache.allocate()
+    ids = np.arange(100, 112, dtype=np.int32)        # 3 full pages
+    _fill(cache, s, 12)
+    assert tree.insert(ids, cache.page_table[s]) == 3
+    # identical prompt: match is capped at len-1 (the last token is
+    # always recomputed so prefill still emits the first-token logits)
+    n, pages = tree.match(ids)
+    assert n == 11 and len(pages) == 3
+    # an extension matches every full page it shares
+    ext = np.concatenate([ids, [7, 8, 9]]).astype(np.int32)
+    n, pages = tree.match(ext)
+    assert n == 12 and len(pages) == 3
+    # a divergent prompt matches up to the divergence (mid-page)
+    div = ids.copy()
+    div[6] = 250
+    n, pages = tree.match(div)
+    assert n == 6 and len(pages) == 2  # page 1 attached partially
+    check_pool_invariants(cache, tree)
+
+
+def test_tree_split_shares_common_run():
+    cache = _bare_cache()
+    tree = PrefixCache(cache)
+    a = cache.allocate()
+    ids_a = np.arange(50, 62, dtype=np.int32)
+    _fill(cache, a, 12)
+    tree.insert(ids_a, cache.page_table[a])
+    # second prompt shares pages 0-1, diverges at page 2
+    b = cache.allocate()
+    ids_b = ids_a.copy()
+    ids_b[8:] = [200, 201, 202, 203]
+    _fill(cache, b, 12)
+    added = tree.insert(ids_b, cache.page_table[b])
+    assert added == 1                   # only the divergent page
+    n_a, pg_a = tree.match(np.concatenate([ids_a, [1]]).astype(np.int32))
+    n_b, pg_b = tree.match(np.concatenate([ids_b, [1]]).astype(np.int32))
+    assert n_a == 12 and n_b == 12
+    assert pg_a[:2] == pg_b[:2] and pg_a[2] != pg_b[2]
+    check_pool_invariants(cache, tree)
+
+
+def test_tree_eviction_lru_and_refcount_pinning():
+    cache = _bare_cache()
+    tree = PrefixCache(cache)
+    a = cache.allocate()
+    ids_a = np.arange(10, 18, dtype=np.int32)
+    _fill(cache, a, 8)
+    tree.insert(ids_a, cache.page_table[a])
+    b = cache.allocate()
+    ids_b = np.arange(60, 68, dtype=np.int32)
+    _fill(cache, b, 8)
+    tree.insert(ids_b, cache.page_table[b])
+    # both sequences still hold their pages: nothing is evictable
+    assert tree.evictable_pages() == 0
+    assert tree.evict(99) == 0
+    # free A: its tree pages drop to refcount 1 -> evictable
+    cache.free(a)
+    assert tree.evictable_pages() == 2
+    freed = tree.evict(1)
+    assert freed == 2                   # whole leaf goes at once
+    assert tree.evicted_pages == 2
+    # B's pages were never touched (still live)
+    assert all(cache.page_refs[p] == 2
+               for p in tree.pages())
+    check_pool_invariants(cache, tree)
+
+
+def test_attach_and_cow_isolate_shared_page():
+    cache = _bare_cache()
+    tree = PrefixCache(cache)
+    a = cache.allocate()
+    ids = np.arange(30, 38, dtype=np.int32)
+    _fill(cache, a, 8)
+    tree.insert(ids, cache.page_table[a])
+    # warm consumer attaches both pages, second one partially (6 < 8)
+    b = cache.allocate()
+    n, pages = tree.match(
+        np.concatenate([ids[:6], [240, 241]]).astype(np.int32))
+    assert n == 6 and len(pages) == 2
+    cache.attach(b, pages, n)
+    shared = int(cache.page_table[b, 1])
+    assert cache.page_refs[shared] == 3      # A + tree + B
+    # the first write into the partial page must COW, not mutate
+    k = np.zeros((1, 1, 2, 4), np.float32)
+    cache.write_at(b, k, k, 6)
+    assert cache.cow_count == 1
+    assert int(cache.page_table[b, 1]) != shared
+    assert cache.page_refs[shared] == 2      # B let go of the original
+    check_pool_invariants(cache, tree)
+
+
+def test_gather_dense_raises_on_unset_slot():
+    """Satellite bugfix: an unset (-1) page slot inside the requested
+    length used to be clipped to page 0 — silently reading another
+    sequence's KV.  It must raise."""
+    cache = _bare_cache()
+    s = cache.allocate()
+    _fill(cache, s, 4)                  # one page assigned
+    cache.lengths[s] = 8                # lie: second page never set
+    with pytest.raises(RuntimeError, match="unset"):
+        cache.gather_dense(s, 8)
+
+
+# -- engine level ------------------------------------------------------
+
+
+def test_warm_request_bit_identical_and_prefills_only_suffix(model):
+    pa, pb = _prompts_sharing_prefix(0, 18, (7, 9))
+    want_a = _cold(model, pa)
+    want_b = _cold(model, pb)
+
+    eng = ServingEngine(model, prefix_cache=True, **ENGINE_KW)
+    assert eng.submit(pa, max_new_tokens=8).result() == want_a
+    check_pool_invariants(eng.executor.cache, eng.prefix)
+    n_events = len(eng.executor.prefill_events)
+    hb = eng.submit(pb, max_new_tokens=8)
+    assert hb.result() == want_b
+    check_pool_invariants(eng.executor.cache, eng.prefix)
+    # prefill FLOPs covered only the novel suffix: 18 shared tokens
+    # were attached, so the warm dispatch saw 27 - 18 = 9 tokens
+    warm = eng.executor.prefill_events[n_events:]
+    assert sum(n for _, n in warm) == len(pb) - 18
+    assert hb.metrics()["cached_tokens"] == 18
+    s = eng.stats()
+    assert s["cached_tokens"] == 18
+    assert s["prefix_hit_rate"] > 0
+    assert eng.executor.cache.cow_count >= 1   # 18 % 4 != 0: mid-page
+
+
+def test_cow_divergence_mid_page_streams_exact(model):
+    """Two prompts that diverge INSIDE a page: the second must COW the
+    partial page and still match its cold-cache stream."""
+    rng = np.random.RandomState(3)
+    base = rng.randint(1, 256, (14,)).astype(np.int32)  # 14 % 4 = 2
+    pa = np.concatenate([base, rng.randint(1, 256, (6,)).astype(np.int32)])
+    pb = np.concatenate([base, rng.randint(1, 256, (6,)).astype(np.int32)])
+    assert pa[14] != pb[14]
+    want_b = _cold(model, pb, max_new=6)
+
+    eng = ServingEngine(model, prefix_cache=True, **ENGINE_KW)
+    eng.submit(pa, max_new_tokens=6).result()
+    cow0 = eng.executor.cache.cow_count
+    assert eng.submit(pb, max_new_tokens=6).result() == want_b
+    assert eng.executor.cache.cow_count > cow0
+    check_pool_invariants(eng.executor.cache, eng.prefix)
+
+
+def test_off_mode_is_bit_exact_and_reports_zeros(model):
+    """prefix_cache=False engines report the new metrics fields as
+    zeros and match the cached engine's streams exactly."""
+    pa, pb = _prompts_sharing_prefix(5, 16, (5, 8))
+    off = ServingEngine(model, prefix_cache=False, **ENGINE_KW)
+    on = ServingEngine(model, prefix_cache=True, **ENGINE_KW)
+    for p in (pa, pb):
+        assert (on.submit(p, max_new_tokens=6).result()
+                == off.submit(p, max_new_tokens=6).result())
+    s_off, s_on = off.stats(), on.stats()
+    assert s_off["cached_tokens"] == 0
+    assert s_off["prefix_hit_rate"] == 0.0
+    assert s_off["evicted_pages"] == 0
+    assert s_on["cached_tokens"] > 0
+    assert off.prefix is None
+    # off-mode refcounts stay 0/1: the invariant audit passes with no
+    # tree attached
+    check_pool_invariants(off.executor.cache)
+
+
+def test_env_gate(model, monkeypatch):
+    monkeypatch.setenv("PT_PREFIX_CACHE", "on")
+    assert ServingEngine(model, **ENGINE_KW).prefix is not None
+    monkeypatch.setenv("PT_PREFIX_CACHE", "off")
+    assert ServingEngine(model, **ENGINE_KW).prefix is None
+    monkeypatch.delenv("PT_PREFIX_CACHE")
+    assert ServingEngine(model, **ENGINE_KW).prefix is None  # default off
+    monkeypatch.setenv("PT_PREFIX_CACHE", "maybe")
+    with pytest.raises(ValueError, match="PT_PREFIX_CACHE"):
+        ServingEngine(model, **ENGINE_KW)
+
+
+def _drive_load(model, spec, engine_kw, check_invariants=False,
+                on_error="raise"):
+    """run_load with an invariant audit after every step."""
+    eng = ServingEngine(model, **engine_kw)
+    work = generate_load(spec)
+    pending = sorted(work, key=lambda w: (w["arrival_tick"], w["rid"]))
+    handles, errors = {}, []
+    while pending or eng.in_flight:
+        assert eng.tick < 3000, "load did not drain"
+        while pending and pending[0]["arrival_tick"] <= eng.tick:
+            w = pending.pop(0)
+            handles[w["rid"]] = eng.submit(
+                w["prompt_ids"], max_new_tokens=w["max_new_tokens"],
+                rid=w["rid"])
+        try:
+            eng.step()
+        except faults.InjectedFault as e:
+            if on_error != "continue":
+                raise
+            errors.append(e)
+        if check_invariants:
+            check_pool_invariants(eng.executor.cache, eng.prefix)
+    return eng, work, handles, errors
+
+
+PREFIX_SPEC = LoadSpec(n_requests=8, mean_interarrival=2.0,
+                       prompt_len=(4, 12), max_new=(6, 10), vocab=256,
+                       seed=21, prefix_share=0.6, prefix_len=10,
+                       prefix_pool=2)
+# undersized pool: 11 pages for 2 slots x 16-page budget, so decode
+# growth forces preemption AND cached pages must be LRU-evicted
+TIGHT_KW = dict(max_seqs=2, page_size=4, max_len=64, num_pages=11,
+                prefill_chunk=8, prefix_cache=True)
+
+
+def test_refcount_invariant_under_seeded_load(model):
+    """The pool audit passes after EVERY scheduler step of a seeded
+    prefix-heavy load on an undersized pool (preemption + eviction both
+    fire), and every request still finishes."""
+    eng, work, handles, _ = _drive_load(
+        model, PREFIX_SPEC, TIGHT_KW, check_invariants=True)
+    for w in work:
+        h = handles[w["rid"]]
+        assert h.state is RequestState.FINISHED, (w["rid"], h.state)
+        assert len(h.tokens) == w["max_new_tokens"]
+    s = eng.stats()
+    assert s["cached_tokens"] > 0          # the prefix pool was shared
+    assert s["evicted_pages"] > 0          # pressure evicted cold pages
+    # streams equal the cache-off run of the same workload
+    eng2, _, handles2, _ = _drive_load(
+        model, PREFIX_SPEC, dict(TIGHT_KW, prefix_cache=False))
+    for w in work:
+        assert handles[w["rid"]].tokens == handles2[w["rid"]].tokens, \
+            w["rid"]
+
+
+def test_eviction_never_reclaims_live_pages(model):
+    """Force direct eviction pressure while a request is mid-flight:
+    pages referenced by a live slot survive any evict() demand."""
+    pa, pb = _prompts_sharing_prefix(9, 16, (6, 7))
+    eng = ServingEngine(model, prefix_cache=True, **ENGINE_KW)
+    eng.submit(pa, max_new_tokens=6).result()
+    h = eng.submit(pb, max_new_tokens=12)
+    eng.step(); eng.step()                 # admitted, mid-flight
+    assert not eng.request(h.rid).terminal
+    cache = eng.executor.cache
+    live = [int(p) for p in cache.page_table[eng.request(h.rid).sid]
+            if p >= 0]
+    eng.prefix.evict(cache.num_pages)      # demand more than exists
+    for p in live:
+        assert cache.page_refs[p] >= 1     # never freed under a slot
+    check_pool_invariants(cache, eng.prefix)
+    want = _cold(model, pb, max_new=12)
+    assert h.result() == want
+
+
+# -- fault points ------------------------------------------------------
+
+
+def test_prefix_match_fault_leaves_engine_serviceable(model):
+    pa, pb = _prompts_sharing_prefix(13, 18, (7, 9))
+    want = [_cold(model, pa), _cold(model, pb)]
+    for phase in ("before", "after"):
+        faults.reset()
+        faults.arm("prefix.match", phase, 2, "raise")
+        eng = ServingEngine(model, prefix_cache=True, **ENGINE_KW)
+        ha = eng.submit(pa, max_new_tokens=8)
+        hb = eng.submit(pb, max_new_tokens=8)
+        errors = 0
+        while not (ha.state is RequestState.FINISHED
+                   and hb.state is RequestState.FINISHED):
+            assert eng.tick < 500
+            try:
+                eng.step()
+            except faults.InjectedFault:
+                errors += 1
+                check_pool_invariants(eng.executor.cache, eng.prefix)
+        assert errors == 1, phase
+        assert ha.tokens == want[0] and hb.tokens == want[1], phase
+        check_pool_invariants(eng.executor.cache, eng.prefix)
+
+
+def test_prefix_cow_fault_leaves_engine_serviceable(model):
+    pa, pb = _prompts_sharing_prefix(14, 18, (7, 9))  # 18 % 4 -> COW
+    want_b = _cold(model, pb)
+    for phase in ("before", "after"):
+        faults.reset()
+        eng = ServingEngine(model, prefix_cache=True, **ENGINE_KW)
+        eng.submit(pa, max_new_tokens=8).result()  # seed the tree
+        faults.arm("prefix.cow", phase, 1, "raise")
+        hb = eng.submit(pb, max_new_tokens=8)
+        errors = 0
+        while hb.state is not RequestState.FINISHED:
+            assert eng.tick < 500
+            try:
+                eng.step()
+            except faults.InjectedFault:
+                errors += 1
+                check_pool_invariants(eng.executor.cache, eng.prefix)
+        assert errors == 1, phase
+        assert eng.executor.cache.cow_count == 1, phase
+        assert eng.stats()["cached_tokens"] > 0, phase
+        assert hb.tokens == want_b, phase
+        check_pool_invariants(eng.executor.cache, eng.prefix)
+
+
+def test_prefix_evict_fault_leaves_engine_serviceable(model):
+    """An injected raise mid-eviction (either phase) escapes the step
+    with the pool consistent; the retry completes every request with
+    exact streams."""
+    for phase in ("before", "after"):
+        faults.reset()
+        faults.arm("prefix.evict", phase, 1, "raise")
+        eng, work, handles, errors = _drive_load(
+            model, PREFIX_SPEC, TIGHT_KW, check_invariants=True,
+            on_error="continue")
+        assert len(errors) == 1, phase
+        for w in work:
+            h = handles[w["rid"]]
+            assert h.state is RequestState.FINISHED, (phase, w["rid"])
+        faults.reset()
+        _, _, clean, _ = _drive_load(
+            model, PREFIX_SPEC, TIGHT_KW)
+        for w in work:
+            assert handles[w["rid"]].tokens == clean[w["rid"]].tokens, \
+                (phase, w["rid"])
