@@ -38,7 +38,8 @@ smoke:
 		tests/test_moe_ep.py \
 		tests/test_serving_scheduler.py \
 		tests/test_load_harness.py \
-		tests/test_prefix_cache.py
+		tests/test_prefix_cache.py \
+		tests/test_spec_decode.py
 
 # Fast lane — must be green before any snapshot commit (see README).
 test-fast:

@@ -681,6 +681,11 @@ def _bench_serving(jax):
             out["prefix_cache"] = _measure_prefix(model, cfg, max_seqs)
         except Exception as e:  # same guard as the A/B leg
             out["prefix_cache"] = {"error": str(e)[:120]}
+    if os.environ.get("PT_BENCH_SERVE_SPEC", "1") == "1":
+        try:
+            out["spec"] = _measure_spec(model, cfg, max_seqs)
+        except Exception as e:  # same guard as the A/B leg
+            out["spec"] = {"error": str(e)[:120]}
     return out
 
 
@@ -782,6 +787,68 @@ def _measure_prefix(model, cfg, max_seqs):
             if on["ttft_ms_p50"] else 0.0, 2),
         "prefill_tokens_saved": off["prefill_tokens"]
         - on["prefill_tokens"],
+    }
+
+
+def _measure_spec(model, cfg, max_seqs):
+    """Speculative-decode A/B (r12): the SAME seeded repetitive
+    workload (repeat_share tiles prompts from a short period — the
+    templated/structured traffic where prompt-lookup drafting pays
+    off) through `PT_SPEC_DECODE=ngram` and the plain greedy engine.
+    Exactness is a test contract (streams bit-identical,
+    tests/test_spec_decode.py); this leg records the perf contract:
+    decode steps, tokens per decode step, acceptance rate, tok/s."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.inference.server import ServingEngine
+    from paddle_tpu.testing.load import LoadSpec, generate_load, run_load
+
+    n_req = int(os.environ.get("PT_BENCH_SERVE_REQS", "16"))
+    share = float(os.environ.get("PT_BENCH_SPEC_SHARE", "0.75"))
+    work = generate_load(LoadSpec(
+        n_requests=n_req, mean_interarrival=1.0, prompt_len=(32, 64),
+        max_new=(32, 64), vocab=cfg.vocab_size, seed=0,
+        repeat_share=share, repeat_period=4))
+
+    def leg(mode):
+        eng = ServingEngine(model, max_seqs=max_seqs, page_size=16,
+                            max_len=512, dtype=jnp.bfloat16,
+                            prefill_chunk=128, spec_decode=mode)
+        print(f"serving[spec {mode}]: {n_req} seeded requests at "
+              f"repeat share {share}...", file=sys.stderr)
+        st = run_load(eng, work)["stats"]
+        done = st["requests"]["finished"] + st["requests"]["truncated"]
+        if done != n_req:
+            raise RuntimeError(f"spec load did not finish cleanly: "
+                               f"{st['requests']}")
+        print(f"serving[spec {mode}]: {st['throughput_tok_s']:.0f} "
+              f"tok/s, {st['steps']} steps, "
+              f"{st['tokens_per_decode_step']} tok/decode-step, "
+              f"acceptance {st['draft_acceptance_rate']}",
+              file=sys.stderr)
+        return {
+            "serving_tok_s": st["throughput_tok_s"],
+            "steps": st["steps"],
+            "decode_tokens": st["decode_tokens"],
+            "tokens_per_decode_step": st["tokens_per_decode_step"],
+            "draft_acceptance_rate": st["draft_acceptance_rate"],
+            "tpot_ms_p50": st["tpot_ms_p50"],
+            "tpot_ms_p99": st["tpot_ms_p99"],
+            "tpot_steps_p50": st["tpot_steps_p50"],
+            "tpot_steps_p99": st["tpot_steps_p99"],
+        }
+
+    ng, off = leg("ngram"), leg("off")
+    return {
+        "repeat_share": share,
+        "requests": n_req,
+        "ngram": ng,
+        "off": off,
+        "step_reduction": round(
+            (off["steps"] / ng["steps"]) if ng["steps"] else 0.0, 2),
+        "tok_s_speedup": round(
+            (ng["serving_tok_s"] / off["serving_tok_s"])
+            if off["serving_tok_s"] else 0.0, 2),
     }
 
 

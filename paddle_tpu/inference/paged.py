@@ -366,9 +366,18 @@ class PagedKVCache:
         missing slots first, commit only if the WHOLE batch fits (a
         per-sequence loop would leak the earlier sequences' pages on a
         mid-batch failure).  Prefix-cache eviction is tried before
-        giving up, so cold cached pages yield to live sequences."""
+        giving up, so cold cached pages yield to live sequences.
+
+        ``extra_tokens`` is one int for the whole batch or a per-seq
+        sequence aligned with ``seqs`` (speculative decode reserves a
+        clamped lookahead per sequence)."""
+        seqs = list(seqs)
+        extras = (list(extra_tokens)
+                  if isinstance(extra_tokens, (list, tuple, np.ndarray))
+                  else [extra_tokens] * len(seqs))
         plans = [(s, self._plan_missing(
-            s, int(self.lengths[s]) + extra_tokens)) for s in seqs]
+            s, int(self.lengths[s]) + int(e)))
+            for s, e in zip(seqs, extras)]
         need = sum(len(m) for _, m in plans)
         if need > len(self._free):
             self._reclaim(need - len(self._free))
@@ -377,6 +386,23 @@ class PagedKVCache:
         for s, missing in plans:
             for i in missing:
                 self.page_table[s, i] = self._pop_page()
+
+    def trim(self, seq: int) -> int:
+        """Release every assigned page-table slot past the page cover of
+        the sequence's CURRENT length (the rollback half of speculative
+        decode: pages reserved for a draft window whose tail was
+        rejected go back to the pool/refcount pool).  Returns the number
+        of slots released.  Refcount-safe: a shared page merely drops
+        this slot's reference."""
+        keep = -(-int(self.lengths[seq]) // self.page_size)
+        freed = 0
+        for slot in range(keep, self.max_pages_per_seq):
+            pid = int(self.page_table[seq, slot])
+            if pid >= 0:
+                self._deref(pid)
+                self.page_table[seq, slot] = -1
+                freed += 1
+        return freed
 
     # -- data plane (device) -------------------------------------------
 

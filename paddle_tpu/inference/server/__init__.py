@@ -15,9 +15,11 @@ from .metrics import EngineMetrics
 from .prefix_cache import PrefixCache, check_pool_invariants
 from .request import Request, RequestHandle, RequestState, TERMINAL
 from .scheduler import Scheduler
+from .spec_decode import NGramProposer, SpecDecode, spec_mode
 
 __all__ = [
     "ServingEngine", "PagedExecutor", "EngineMetrics", "Request",
     "RequestHandle", "RequestState", "TERMINAL", "Scheduler",
     "PrefixCache", "check_pool_invariants",
+    "NGramProposer", "SpecDecode", "spec_mode",
 ]

@@ -23,6 +23,7 @@ from .metrics import EngineMetrics
 from .prefix_cache import PrefixCache
 from .request import Request, RequestHandle, RequestState
 from .scheduler import Scheduler
+from .spec_decode import SpecDecode, spec_mode
 
 
 def _prefix_cache_enabled() -> bool:
@@ -37,7 +38,8 @@ class ServingEngine:
     def __init__(self, model, max_seqs=4, page_size=16, max_len=256,
                  dtype=jnp.float32, num_pages=None, policy="fifo",
                  prefill_chunk=None, eos_token_id=None,
-                 max_preemptions=4, prefix_cache=None):
+                 max_preemptions=4, prefix_cache=None,
+                 spec_decode=None):
         self.executor = PagedExecutor(
             model, max_seqs=max_seqs, page_size=page_size,
             max_len=max_len, dtype=dtype, num_pages=num_pages)
@@ -56,10 +58,23 @@ class ServingEngine:
             # pages before raising pool-exhausted (eviction is cheaper
             # than preempt-and-recompute)
             self.executor.cache.reclaimer = self.prefix.evict
+        # spec_decode: None = follow PT_SPEC_DECODE (default off,
+        # bit-exact legacy path); "off"/"ngram" or False/True force it
+        # (bench A/B).  "ngram" drafts from each request's own
+        # prompt+generated history — no second model.
+        if spec_decode is None:
+            spec_decode = spec_mode() == "ngram"
+        elif isinstance(spec_decode, str):
+            if spec_decode not in ("off", "ngram"):
+                raise ValueError(
+                    f"spec_decode={spec_decode!r}: expected off|ngram")
+            spec_decode = spec_decode == "ngram"
+        self.spec = SpecDecode() if spec_decode else None
         self.scheduler = Scheduler(
             self.executor, self.metrics, policy=policy,
             prefill_chunk=prefill_chunk, eos_token_id=eos_token_id,
-            max_preemptions=max_preemptions, prefix_cache=self.prefix)
+            max_preemptions=max_preemptions, prefix_cache=self.prefix,
+            spec=self.spec)
         self._next_rid = 0
 
     # -- submission ------------------------------------------------------

@@ -87,6 +87,14 @@ class PagedExecutor:
         self._jit_decode = jax.jit(self._decode_fwd,
                                    donate_argnums=(4, 5))
         self._jit_decode_n = None
+        self._jit_verify = None
+        # speculative-decode audit counters: traces counts how many
+        # times _verify_fwd was TRACED (re-traces mean shape churn),
+        # dispatches how many verify steps ran — the no-host-loop test
+        # asserts dispatches >> traces while tokens >> dispatches
+        self.verify_traces = 0
+        self.verify_dispatches = 0
+        self.rollback_pages = 0
 
     def _head(self, x, tops):
         w = tops["head_w"]
@@ -255,6 +263,102 @@ class PagedExecutor:
         x = _rms_norm_plain(x, tops["norm_w"], epsilon=cfg.rms_norm_eps)
         return self._head(x[:, 0], tops), kps, vps
 
+    def _verify_fwd(self, layers, tops, ids, k_pages, v_pages, lengths,
+                    page_tables, limits):
+        """Speculative-verify forward: every running sequence's draft
+        window in ONE program.  ``ids`` [B, W] is each sequence's last
+        committed token followed by its (padded) draft; window token w
+        sits at position ``lengths[b] + w``.  ``limits`` [B] caps how
+        many window tokens each sequence may commit (page budget /
+        length cap / actual draft length), 1 <= limit <= W.
+
+        Write-then-attend like _decode_fwd, widened to the window: each
+        layer scatters all valid window KV into the pages (positions
+        past a sequence's limit are pushed out of bounds and dropped),
+        then attends with B*W query rows through the SAME
+        paged_decode_attention — row (b, w) masked to lengths[b]+w+1
+        keys, so causality inside the window comes from the length
+        mask, not a new kernel.
+
+        Greedy acceptance in-graph: with t = argmax(logits) per window
+        position, draft token w+1 is accepted iff every earlier draft
+        token matched the model's choice — so the committed stream is
+        bit-identical to plain greedy decode by construction.  The
+        ragged accepted prefixes are packed with one variadic
+        ``lax.sort`` (the MoE-dispatch trick): valid (b, w) cells keep
+        their rank key, invalid cells sort to the tail, and the host
+        reads ONE dense token vector + per-seq counts — no [B, k] host
+        loop anywhere.
+
+        Returns (packed_tokens [B*W], emit_n [B], k_pages', v_pages').
+        """
+        cfg = self.config
+        nh, nkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                      cfg.head_dim)
+        ps = self.cache.page_size
+        B, W = ids.shape
+        pps = page_tables.shape[1]
+        num_pages = k_pages.shape[2]
+        self.verify_traces += 1          # host effect: counts traces
+        x = tops["embed"][ids]                         # [B, W, h]
+        pos = lengths[:, None] + jnp.arange(W)[None]   # [B, W]
+        slot = pos // ps
+        pids = jnp.take_along_axis(page_tables,
+                                   jnp.minimum(slot, pps - 1), axis=1)
+        # invalid window cells (past the commit limit, or past the
+        # per-seq page budget) write out of bounds -> mode='drop'
+        valid_w = ((jnp.arange(W)[None] < limits[:, None])
+                   & (slot < pps))
+        pids = jnp.where(valid_w, pids, num_pages).reshape(-1)
+        offs = (pos % ps).reshape(-1)
+        # one attention row per window cell; the +w+1 length mask is
+        # the in-window causal mask
+        lens_f = (lengths[:, None] + jnp.arange(W)[None] + 1).reshape(-1)
+        tables_f = jnp.repeat(page_tables, W, axis=0)  # [B*W, pps]
+
+        def block(x, lp_kv):
+            lp, kp, vp = lp_kv
+            h = _rms_norm_plain(x, lp["input_layernorm.weight"],
+                                epsilon=cfg.rms_norm_eps)
+            q = (h @ lp["self_attn.q_proj.weight"]).reshape(B, W, nh, d)
+            k = (h @ lp["self_attn.k_proj.weight"]).reshape(B, W, nkv, d)
+            v = (h @ lp["self_attn.v_proj.weight"]).reshape(B, W, nkv, d)
+            q, k = _rope_plain(q, k, tops["cos"], tops["sin"],
+                               position_ids=pos)
+            kf = jnp.swapaxes(k.reshape(B * W, nkv, d), 0, 1)
+            vf = jnp.swapaxes(v.reshape(B * W, nkv, d), 0, 1)
+            kp = kp.at[:, pids, offs].set(kf.astype(kp.dtype),
+                                          mode="drop")
+            vp = vp.at[:, pids, offs].set(vf.astype(vp.dtype),
+                                          mode="drop")
+            o = paged_decode_attention(
+                q.reshape(B * W, nh, d), kp, vp, lens_f, tables_f)
+            o = o.reshape(B, W, nh * d).astype(x.dtype)
+            x = x + o @ lp["self_attn.o_proj.weight"]
+            h2 = _rms_norm_plain(x, lp["post_attention_layernorm.weight"],
+                                 epsilon=cfg.rms_norm_eps)
+            gate = h2 @ lp["mlp.gate_proj.weight"]
+            up = h2 @ lp["mlp.up_proj.weight"]
+            x = x + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
+            return x, (kp, vp)
+
+        x, (kps, vps) = jax.lax.scan(
+            block, x, (layers, k_pages, v_pages))
+        x = _rms_norm_plain(x, tops["norm_w"], epsilon=cfg.rms_norm_eps)
+        t = jnp.argmax(self._head(x, tops), -1).astype(jnp.int32)
+        # accepted = longest prefix of drafts matching the model's own
+        # greedy choices; always commit 1 + accepted (the model's next
+        # token after the accepted run), clamped to the per-seq limit
+        m = (ids[:, 1:] == t[:, :-1]).astype(jnp.int32)
+        acc = jnp.sum(jnp.cumprod(m, axis=1), axis=1)
+        emit_n = jnp.minimum(acc + 1, limits)
+        rank = jnp.arange(B * W, dtype=jnp.int32).reshape(B, W)
+        key = jnp.where(jnp.arange(W)[None] < emit_n[:, None],
+                        rank, B * W).reshape(-1)
+        _, packed = jax.lax.sort((key, t.reshape(-1)), num_keys=1,
+                                 is_stable=True)
+        return packed, emit_n, kps, vps
+
     def _decode_n_fwd(self, layers, tops, ids, positions, k_pages,
                       v_pages, lengths, page_tables, n):
         """``n`` greedy steps in ONE dispatched program: the argmax
@@ -377,6 +481,71 @@ class PagedExecutor:
             self.last_token[s] = tok
             out[s] = tok
         return out
+
+    def verify(self, sids, drafts, limits, k):
+        """Speculative decode step: run each listed slot's draft window
+        through one jitted verify forward and commit the longest
+        model-agreed prefix plus the model's own next token.
+
+        ``drafts`` and ``limits`` align with ``sids``: up to ``k``
+        proposed tokens and the per-seq commit cap (>= 1; the caller
+        clamps it to the page budget, the remaining generation cap and
+        the actual draft length).  Returns ({sid: [tokens...]},
+        {sid: accepted_draft_tokens}); every sequence advances by
+        1 + accepted tokens, exactly the greedy stream.
+        """
+        sids = list(sids)
+        if not sids:
+            return {}, {}
+        cache = self.cache
+        W = int(k) + 1
+        limits = [int(x) for x in limits]
+        # batch-atomic per-seq lookahead reservation, then the COW
+        # guard over each window — same write discipline as decode()
+        cache.reserve(sids, extra_tokens=limits)
+        for s, lim in zip(sids, limits):
+            pos = int(cache.lengths[s])
+            cache.make_writable(s, pos, pos + lim)
+        ids = np.zeros((len(sids), W), np.int32)
+        for i, (s, dr) in enumerate(zip(sids, drafts)):
+            ids[i, 0] = self.last_token[s]
+            dr = np.asarray(dr, np.int32).reshape(-1)[:k]
+            ids[i, 1:1 + len(dr)] = dr
+        tables = jnp.asarray(np.maximum(cache.page_table[sids], 0))
+        lengths = jnp.asarray(cache.lengths[sids])
+        if self._jit_verify is None:
+            self._jit_verify = jax.jit(self._verify_fwd,
+                                       donate_argnums=(3, 4))
+        packed, emit_n, kps, vps = self._jit_verify(
+            self.layers, self.tops, jnp.asarray(ids), cache.k_pages,
+            cache.v_pages, lengths, tables,
+            jnp.asarray(limits, jnp.int32))
+        cache.k_pages = kps
+        cache.v_pages = vps
+        self.verify_dispatches += 1
+        # ONE host transfer: the sort-packed token block + counts;
+        # splitting it is per-SEQUENCE host work, never per-token-cell
+        packed = np.asarray(packed)
+        counts = np.asarray(emit_n)
+        out, accepted = {}, {}
+        off = 0
+        for i, s in enumerate(sids):
+            n = int(counts[i])
+            toks = [int(t) for t in packed[off:off + n]]
+            off += n
+            cache.lengths[s] += n
+            self.last_token[s] = toks[-1]
+            out[s] = toks
+            accepted[s] = n - 1
+        return out, accepted
+
+    def rollback(self, sids) -> int:
+        """Release pages reserved for rejected draft positions: trim
+        every listed slot's page table back to its committed length.
+        Returns total pages released."""
+        freed = sum(self.cache.trim(s) for s in sids)
+        self.rollback_pages += freed
+        return freed
 
     def decode_n(self, sids, n) -> dict:
         """``n`` greedy tokens per listed slot in one dispatch.

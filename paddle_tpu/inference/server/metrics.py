@@ -32,8 +32,12 @@ class EngineMetrics:
         self.steps = 0
         self.busy_steps = 0           # steps with >= 1 in-flight request
         self.decode_tokens = 0
+        self.decode_slot_steps = 0    # sum of decode batch sizes
         self.prefill_tokens = 0
         self.preemptions = 0
+        self.draft_proposed = 0       # speculative draft tokens offered
+        self.draft_accepted = 0       # ...committed by verification
+        self.spec_steps = 0           # verify dispatches
         self.submitted = 0
         self.prefix_hits = 0          # admissions that attached pages
         self.cached_tokens = 0        # prompt tokens served from cache
@@ -63,7 +67,17 @@ class EngineMetrics:
             req.first_token_step = step
 
     def on_decode_tokens(self, n):
-        self.decode_tokens += n
+        # legacy one-token-per-slot decode: slots == tokens
+        self.on_decode_step(slots=n, tokens=n)
+
+    def on_decode_step(self, slots, tokens):
+        self.decode_tokens += tokens
+        self.decode_slot_steps += slots
+
+    def on_spec(self, proposed, accepted):
+        self.spec_steps += 1
+        self.draft_proposed += int(proposed)
+        self.draft_accepted += int(accepted)
 
     def on_prefill_tokens(self, n):
         self.prefill_tokens += n
@@ -94,6 +108,13 @@ class EngineMetrics:
                        or req.last_token_time is None
                        else (req.last_token_time - req.first_token_time)
                        / (len(req.generated) - 1)),
+            # logical-clock TPOT: scheduler iterations per generated
+            # token.  1.0 for plain decode; < 1.0 once speculative
+            # steps commit multiple tokens per iteration.
+            "tpot_steps": (None if len(req.generated) < 2
+                           or req.first_token_step is None
+                           else (req.finish_step - req.first_token_step)
+                           / (len(req.generated) - 1)),
             "tokens": len(req.generated),
         })
 
@@ -128,6 +149,13 @@ class EngineMetrics:
                 / max(self.cached_tokens + self.prefill_tokens, 1), 4),
             "evicted_pages": self.evicted_pages,
             "throughput_tok_s": round(self.decode_tokens / wall, 2),
+            # speculative decode effectiveness: fraction of drafted
+            # tokens committed, and how far each sequence advances per
+            # decode slot-step (1.0 = plain greedy; > 1.0 = spec wins)
+            "draft_acceptance_rate": round(
+                self.draft_accepted / max(self.draft_proposed, 1), 4),
+            "tokens_per_decode_step": round(
+                self.decode_tokens / max(self.decode_slot_steps, 1), 4),
             "batch_occupancy": round(self.occupancy_sum / busy, 4),
             "page_utilization": round(self.page_util_sum / busy, 4),
             "queue_wait_steps_p50": _pct(
@@ -139,6 +167,8 @@ class EngineMetrics:
             "ttft_ms_p99": _ms(_pct([d["ttft_s"] for d in done], 99)),
             "tpot_ms_p50": _ms(_pct([d["tpot_s"] for d in done], 50)),
             "tpot_ms_p99": _ms(_pct([d["tpot_s"] for d in done], 99)),
+            "tpot_steps_p50": _pct([d["tpot_steps"] for d in done], 50),
+            "tpot_steps_p99": _pct([d["tpot_steps"] for d in done], 99),
         }
 
 

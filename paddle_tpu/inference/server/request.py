@@ -51,7 +51,7 @@ class Request:
         "preempt_count", "submit_step", "submit_time", "sched_step",
         "first_token_step", "first_token_time", "finish_step",
         "finish_time", "last_token_time", "decode_time_s",
-        "cached_tokens",
+        "cached_tokens", "draft_proposed", "draft_accepted",
     )
 
     def __init__(self, rid, prompt_ids, max_new_tokens=16, priority=0,
@@ -74,6 +74,8 @@ class Request:
         self.cancel_flag = False
         self.preempt_count = 0
         self.cached_tokens = 0     # prompt tokens attached from cache
+        self.draft_proposed = 0    # speculative draft tokens offered
+        self.draft_accepted = 0    # ...committed by verification
 
         self.submit_step = None
         self.submit_time = None
@@ -182,6 +184,8 @@ class RequestHandle:
             "tokens": len(r.generated),
             "preemptions": r.preempt_count,
             "cached_tokens": r.cached_tokens,
+            "draft_proposed": r.draft_proposed,
+            "draft_accepted": r.draft_accepted,
         }
 
     def __repr__(self):

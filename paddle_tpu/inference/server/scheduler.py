@@ -20,9 +20,12 @@ slot allocated yet), ``serve.decode`` brackets the batched decode
 dispatch (before = pages reserved, nothing written), and
 ``serve.request`` brackets one request's prefill work — an exception
 there is confined to THAT request (state FAILED), which is the
-poisoned-request isolation the tests prove.  Every ``before`` site
-fires with engine state either untouched or already committed, so an
-injected raise never leaves a half-mutated scheduler.
+poisoned-request isolation the tests prove.  Under speculative decode
+(``PT_SPEC_DECODE=ngram``) ``spec.draft`` / ``spec.verify`` /
+``spec.rollback`` bracket the three phases of :meth:`_decode_spec`
+with the same discipline.  Every ``before`` site fires with engine
+state either untouched or already committed, so an injected raise
+never leaves a half-mutated scheduler.
 """
 from __future__ import annotations
 
@@ -38,13 +41,14 @@ _POOL_EXHAUSTED = "KV page pool exhausted"
 class Scheduler:
     def __init__(self, executor, metrics, policy="fifo",
                  prefill_chunk=None, eos_token_id=None,
-                 max_preemptions=4, prefix_cache=None):
+                 max_preemptions=4, prefix_cache=None, spec=None):
         if policy not in ("fifo", "priority"):
             raise ValueError(
                 f"policy must be 'fifo' or 'priority', got {policy!r}")
         self.executor = executor
         self.metrics = metrics
         self.prefix = prefix_cache   # radix prefix index (None = off)
+        self.spec = spec             # SpecDecode bundle (None = off)
         self.policy = policy
         self.prefill_chunk = (None if prefill_chunk is None
                               else int(prefill_chunk))
@@ -114,6 +118,9 @@ class Scheduler:
     # -- decode with preemption under page pressure ---------------------
 
     def _decode(self, emitted):
+        if self.spec is not None:
+            self._decode_spec(emitted)
+            return
         run = [r for r in self.running]
         self._last_decode_batch = 0
         while run:
@@ -155,6 +162,99 @@ class Scheduler:
             self._on_token(by_sid[sid], toks[sid], emitted)
         faults.fire("serve.decode", "after")
 
+    # -- speculative decode (draft -> batched verify -> rollback) -------
+
+    def _spec_limit(self, req, draft_len):
+        """How many window tokens this sequence may COMMIT this step:
+        1 (the plain greedy token) plus at most ``draft_len`` accepted
+        drafts, clamped to the per-seq page budget and the remaining
+        generation cap — so a verify step can never overshoot
+        ``max_new_tokens``/``max_len`` or write past the page table."""
+        ex = self.executor
+        budget = ex.cache.max_pages_per_seq * ex.cache.page_size
+        cap = min(req.max_new_tokens,
+                  ex.max_len - len(req.prompt_ids))
+        return max(1, min(self.spec.k + 1, int(draft_len) + 1,
+                          cap - len(req.generated),
+                          budget - int(ex.cache.lengths[req.sid])))
+
+    def _decode_spec(self, emitted):
+        """Spec-mode decode iteration: propose per-request drafts from
+        the n-gram index, reserve each sequence's clamped lookahead
+        (same preemption-under-pressure loop as plain decode, just a
+        wider ask), verify every window in ONE jitted call, emit
+        ``1 + accepted`` tokens per sequence, then trim the pages the
+        rejected tail had reserved.
+
+        Fault points: ``spec.draft`` brackets the (pure) draft sweep,
+        ``spec.verify`` brackets dispatch-through-emission (before =
+        pages reserved, nothing written — a raise retries cleanly next
+        step), ``spec.rollback`` brackets the page trim (a raise leaves
+        pages assigned-but-unused, which free()/the next trim recovers).
+        """
+        ex = self.executor
+        run = [r for r in self.running]
+        self._last_decode_batch = 0
+        if not run:
+            return
+        # draft sweep: pure reads of the per-request n-gram index —
+        # an injected raise here escapes step() with nothing mutated
+        faults.fire("spec.draft", "before")
+        drafts = {r.rid: self.spec.propose(r) for r in run}
+        faults.fire("spec.draft", "after")
+        while run:
+            sids = sorted(r.sid for r in run)
+            by_sid = {r.sid: r for r in run}
+            lims = [self._spec_limit(by_sid[s],
+                                     len(drafts[by_sid[s].rid]))
+                    for s in sids]
+            try:
+                ex.cache.reserve(sids, extra_tokens=lims)
+                break
+            except RuntimeError as e:
+                if _POOL_EXHAUSTED not in str(e):
+                    raise
+                victim = self._pick_victim()
+                if victim is None or (len(run) == 1 and victim is run[0]
+                                      and not self.prefilling):
+                    self._finish(
+                        run[0], RequestState.FAILED, "pool_exhausted",
+                        error=RuntimeError(
+                            f"{_POOL_EXHAUSTED} for a single sequence "
+                            f"(pool {ex.cache.num_pages} pages)"))
+                    run = [r for r in self.running]
+                    continue
+                self._preempt(victim)
+                run = [r for r in self.running]
+        if not run:
+            return
+        sids = sorted(r.sid for r in run)
+        by_sid = {r.sid: r for r in run}
+        lims = [self._spec_limit(by_sid[s], len(drafts[by_sid[s].rid]))
+                for s in sids]
+        dr = [drafts[by_sid[s].rid][:lim - 1]
+              for s, lim in zip(sids, lims)]
+        faults.fire("spec.verify", "before")
+        with RecordEvent("serve.decode"):
+            toks, accepted = ex.verify(sids, dr, lims, self.spec.k)
+        self._last_decode_batch = len(sids)
+        self.metrics.on_decode_step(
+            slots=len(sids), tokens=sum(len(v) for v in toks.values()))
+        self.metrics.on_spec(proposed=sum(len(d) for d in dr),
+                             accepted=sum(accepted.values()))
+        for i, sid in enumerate(sids):
+            req = by_sid[sid]
+            req.draft_proposed += len(dr[i])
+            req.draft_accepted += accepted[sid]
+            for tok in toks[sid]:
+                if req.terminal:
+                    break   # tokens past eos/cap are dropped
+                self._on_token(req, tok, emitted)
+        faults.fire("spec.verify", "after")
+        faults.fire("spec.rollback", "before")
+        ex.rollback([r.sid for r in run if r.sid is not None])
+        faults.fire("spec.rollback", "after")
+
     # -- page-aware admission -------------------------------------------
 
     def _committed_pages(self) -> int:
@@ -165,8 +265,19 @@ class Scheduler:
         total = 0
         for r in self.prefilling:
             held = int((ex.cache.page_table[r.sid] >= 0).sum())
-            total += max(0, ex.pages_for(len(r.resume_ids) + 1) - held)
+            total += max(0, ex.pages_for(
+                self._token_target(len(r.resume_ids))) - held)
         return total
+
+    def _token_target(self, prompt_tokens: int) -> int:
+        """Tokens a request must be able to hold right after prefill:
+        prompt + 1 for plain decode, prompt + worst-case ``k + 1``
+        window under speculative decode (clamped to the per-seq
+        budget, which bounds every sequence anyway)."""
+        ex = self.executor
+        lookahead = 1 if self.spec is None else self.spec.k + 1
+        budget = ex.cache.max_pages_per_seq * ex.cache.page_size
+        return min(prompt_tokens + lookahead, budget)
 
     def _admit(self):
         ex = self.executor
@@ -181,7 +292,8 @@ class Scheduler:
             # attached by reference.  A mid-page hit budgets one extra
             # page for the copy-on-write of the partial page, and cold
             # cached pages count as available (eviction frees them).
-            need = ex.pages_for(len(req.resume_ids) + 1) - len(hit_pages)
+            need = (ex.pages_for(self._token_target(len(req.resume_ids)))
+                    - len(hit_pages))
             if hit_tokens % ex.cache.page_size:
                 need += 1
             avail = ex.free_pages - self._committed_pages()
@@ -279,12 +391,18 @@ class Scheduler:
                     # reference is what keeps the pages alive past it
                     self.prefix.insert(
                         ids, self.executor.cache.page_table[req.sid])
+                if self.spec is not None:
+                    # seed the draft index from prompt + generated
+                    # BEFORE the first token extends it
+                    self.spec.on_running(req)
                 self._on_token(req, tok, emitted)
 
     # -- request transitions --------------------------------------------
 
     def _on_token(self, req, tok, emitted):
         req.emit(tok)
+        if self.spec is not None:
+            self.spec.on_token(req, tok)
         emitted.setdefault(req.rid, []).append(int(tok))
         if req.first_token_step is None:
             self.metrics.on_first_token(req, self.tick)
@@ -318,6 +436,8 @@ class Scheduler:
         self.queue.insert(0, req)  # seniority: re-admitted first
 
     def _release(self, req):
+        if self.spec is not None:
+            self.spec.on_release(req)
         if req.sid is not None:
             self.executor.free_slot(req.sid)
             req.sid = None

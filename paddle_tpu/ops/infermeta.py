@@ -594,6 +594,109 @@ def _meshgrid(datas, attrs):
                   f"shape {list(_shape(d))}")
 
 
+@register_validator("sort")
+def _sort(datas, attrs):
+    _axis_in("sort", int(attrs.get("axis", -1)),
+             max(_ndim(datas[0]), 1))
+
+
+@register_validator("masked_fill")
+def _masked_fill(datas, attrs):
+    x, mask, value = datas[0], datas[1], datas[2]
+    dt = getattr(mask, "dtype", None)
+    if dt is not None and np.dtype(str(dt)) != np.bool_:
+        _fail("masked_fill",
+              f"the mask must be a bool tensor, got {dt}")
+    try:
+        np.broadcast_shapes(_shape(x), _shape(mask), _shape(value))
+    except ValueError:
+        _fail("masked_fill",
+              f"the mask {list(_shape(mask))} / value "
+              f"{list(_shape(value))} are not broadcast-compatible "
+              f"with the input {list(_shape(x))}")
+
+
+@register_validator("put_along_axis")
+def _put_along_axis(datas, attrs):
+    x, indices = datas[0], datas[1]
+    if not _int_dtype(indices):
+        _fail("put_along_axis",
+              f"the indices must be an integer dtype, got "
+              f"{getattr(indices, 'dtype', None)}")
+    if _ndim(indices) != _ndim(x):
+        _fail("put_along_axis",
+              f"indices rank ({_ndim(indices)}) must equal input rank "
+              f"({_ndim(x)}); input {list(_shape(x))}, indices "
+              f"{list(_shape(indices))}")
+    _axis_in("put_along_axis", int(attrs.get("axis", 0)),
+             max(_ndim(x), 1))
+    reduce = attrs.get("reduce", "assign")
+    if reduce not in ("assign", "add", "mul", "multiply"):
+        _fail("put_along_axis",
+              f"the reduce should be one of 'assign', 'add', 'mul' / "
+              f"'multiply', but received {reduce!r}")
+
+
+@register_validator("nonzero")
+def _nonzero(datas, attrs):
+    # host-side op: the wrapper calls validate() directly
+    if _ndim(datas[0]) < 1:
+        _fail("nonzero",
+              f"the input must have rank >= 1, but received rank "
+              f"{_ndim(datas[0])}")
+
+
+@register_validator("unique")
+def _unique(datas, attrs):
+    # host-side op: the wrapper calls validate() directly
+    axis = attrs.get("axis")
+    if axis is not None:
+        _axis_in("unique", int(axis), max(_ndim(datas[0]), 1))
+
+
+@register_validator("flatten")
+def _flatten(datas, attrs):
+    # host-side op (rides reshape): the wrapper calls validate() first
+    nd = max(_ndim(datas[0]), 1)
+    start = _axis_in("flatten", int(attrs.get("start_axis", 0)), nd)
+    stop = _axis_in("flatten", int(attrs.get("stop_axis", -1)), nd)
+    if start > stop:
+        _fail("flatten",
+              f"the start_axis ({attrs.get('start_axis')}) should be "
+              f"no greater than stop_axis ({attrs.get('stop_axis')}) "
+              f"for input rank {nd}")
+
+
+@register_validator("unbind")
+def _unbind(datas, attrs):
+    # host-side op (split + squeeze): the wrapper calls validate() first
+    _axis_in("unbind", int(attrs.get("axis", 0)),
+             max(_ndim(datas[0]), 1))
+
+
+@register_validator("bincount")
+def _bincount(datas, attrs):
+    # host-side op: the wrapper calls validate() directly
+    x = datas[0]
+    if _ndim(x) != 1:
+        _fail("bincount",
+              f"the input must be a 1-D tensor, but received shape "
+              f"{list(_shape(x))}")
+    if not _int_dtype(x):
+        _fail("bincount",
+              f"the input must be an integer dtype, got "
+              f"{getattr(x, 'dtype', None)}")
+    w = datas[1] if len(datas) > 1 else None
+    if w is not None and _shape(w) != _shape(x):
+        _fail("bincount",
+              f"the weights {list(_shape(w))} must have the same shape "
+              f"as the input {list(_shape(x))}")
+    if int(attrs.get("minlength", 0)) < 0:
+        _fail("bincount",
+              f"minlength should be non-negative, but received "
+              f"{attrs.get('minlength')}")
+
+
 @register_validator("masked_select")
 def _masked_select(datas, attrs):
     # host-side op: the wrapper calls validate() directly (it never

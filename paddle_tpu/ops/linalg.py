@@ -305,10 +305,13 @@ def histogram(input, bins=100, min=0, max=0, name=None):
 
 def bincount(x, weights=None, minlength=0, name=None):
     from ..core.tensor import Tensor
+    from . import infermeta
     import numpy as np
 
     arr = np.asarray(x._data if isinstance(x, Tensor) else x)
     w = np.asarray(weights._data) if isinstance(weights, Tensor) else weights
+    # host path, so it never passes registry.apply's validator hook
+    infermeta.validate("bincount", (arr, w), {"minlength": minlength})
     return Tensor(jnp.asarray(np.bincount(arr, w, minlength)))
 
 

@@ -121,9 +121,15 @@ def unsqueeze(x, axis, name=None):
 
 
 def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    from . import infermeta
+
     nd = x.ndim
     if nd == 0:
         return reshape(x, [1])
+    # host path (rides reshape with a precomputed shape), so the axis
+    # attrs never reach registry.apply's validator hook — check by hand
+    infermeta.validate("flatten", (x,), {"start_axis": start_axis,
+                                         "stop_axis": stop_axis})
     start = start_axis % nd
     stop = stop_axis % nd
     shape = x.shape
@@ -273,6 +279,11 @@ def unstack(x, axis=0, num=None, name=None):
 
 
 def unbind(x, axis=0):
+    from . import infermeta
+
+    # host path (split + squeeze), so the axis attr never reaches
+    # registry.apply's validator hook — check by hand before the % wrap
+    infermeta.validate("unbind", (x,), {"axis": axis})
     return unstack(x, axis)
 
 
@@ -561,8 +572,11 @@ def where(condition, x=None, y=None, name=None):
 
 def nonzero(x, as_tuple=False):
     from ..core.tensor import Tensor
+    from . import infermeta
 
     arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    # host path, so it never passes registry.apply's validator hook
+    infermeta.validate("nonzero", (arr,), {})
     nz = np.nonzero(arr)
     if as_tuple:
         return tuple(Tensor(jnp.asarray(v[:, None], dtype=jnp.int64))
@@ -655,8 +669,11 @@ def argsort(x, axis=-1, descending=False, stable=False, name=None):
 def unique(x, return_index=False, return_inverse=False, return_counts=False,
            axis=None, dtype="int64", name=None):
     from ..core.tensor import Tensor
+    from . import infermeta
 
     arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    # host path, so it never passes registry.apply's validator hook
+    infermeta.validate("unique", (arr,), {"axis": axis})
     res = np.unique(arr, return_index=return_index,
                     return_inverse=return_inverse,
                     return_counts=return_counts, axis=axis)

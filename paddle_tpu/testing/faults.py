@@ -78,6 +78,14 @@ REGISTERED = {
     "prefix.evict": "one LRU eviction of a zero-refcount prefix-tree "
                     "leaf (before=node still linked, after=pages back "
                     "on the free list)",
+    "spec.draft": "the per-step n-gram draft sweep (pure index reads: "
+                  "before and after both fire with nothing mutated)",
+    "spec.verify": "the batched draft-window verification (before="
+                   "pages reserved, nothing written; after=accepted "
+                   "tokens committed and emitted)",
+    "spec.rollback": "the post-verify page trim (before=rejected-"
+                     "draft pages still assigned, after=pages back on "
+                     "the free list)",
 }
 
 _PHASES = ("before", "after")

@@ -28,7 +28,8 @@ class LoadSpec:
     def __init__(self, n_requests=8, mean_interarrival=2.0,
                  prompt_len=(4, 24), max_new=(4, 12),
                  priorities=(0,), vocab=256, seed=0,
-                 prefix_share=0.0, prefix_len=16, prefix_pool=2):
+                 prefix_share=0.0, prefix_len=16, prefix_pool=2,
+                 repeat_share=0.0, repeat_period=4):
         self.n_requests = int(n_requests)
         self.mean_interarrival = float(mean_interarrival)
         self.prompt_len = tuple(prompt_len)
@@ -43,6 +44,12 @@ class LoadSpec:
         self.prefix_share = float(prefix_share)
         self.prefix_len = int(prefix_len)
         self.prefix_pool = int(prefix_pool)
+        # repetitive traffic shape (exercises n-gram speculative
+        # decode): a `repeat_share` fraction of requests tile their
+        # prompt from its first `repeat_period` tokens — the structured
+        # /templated workloads where prompt-lookup drafting pays off
+        self.repeat_share = float(repeat_share)
+        self.repeat_period = int(repeat_period)
 
 
 def generate_load(spec: LoadSpec) -> list:
@@ -64,6 +71,12 @@ def generate_load(spec: LoadSpec) -> list:
             tick += int(rng.geometric(min(p_step, 1.0)))
         plen = int(rng.randint(spec.prompt_len[0], spec.prompt_len[1] + 1))
         prompt = rng.randint(1, spec.vocab, size=plen).astype(np.int32)
+        # gated EXACTLY like the prefix branch: with repeat_share=0 no
+        # extra rng draw happens, so legacy seeds replay byte-identically
+        if spec.repeat_share > 0.0 and rng.rand() < spec.repeat_share:
+            period = max(1, min(spec.repeat_period, plen))
+            prompt = np.tile(prompt[:period],
+                             -(-plen // period))[:plen].astype(np.int32)
         if prefixes is not None and rng.rand() < spec.prefix_share:
             prompt = np.concatenate(
                 [prefixes[rng.randint(len(prefixes))], prompt])

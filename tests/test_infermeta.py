@@ -470,3 +470,135 @@ def test_meshgrid_accepts_1d_inputs():
 def test_meshgrid_rejects_rank2_input():
     with pytest.raises(InvalidArgumentError, match="0-D or 1-D"):
         paddle.meshgrid(_f32(2), _f32(2, 3))
+
+
+# -- batch 5 (r12): sort / masked_fill / put_along_axis / nonzero /
+#    unique / flatten / unbind / bincount ------------------------------------
+
+
+def _i64(*vals):
+    return paddle.to_tensor(np.array(vals, np.int64))
+
+
+def test_sort_accepts_negative_axis():
+    out = paddle.sort(_f32(2, 3), axis=-1)
+    assert list(out.shape) == [2, 3]
+
+
+def test_sort_rejects_axis_out_of_range():
+    with pytest.raises(InvalidArgumentError, match="range"):
+        paddle.sort(_f32(2, 3), axis=3)
+
+
+def test_masked_fill_accepts_broadcast_mask():
+    x = _f32(2, 3)
+    mask = paddle.to_tensor(np.array([True, False, True]))
+    out = paddle.masked_fill(x, mask, 0.0)
+    assert float(out.numpy()[0, 0]) == 0.0
+    assert float(out.numpy()[1, 1]) == float(x.numpy()[1, 1])
+
+
+def test_masked_fill_rejects_nonbool_mask():
+    with pytest.raises(InvalidArgumentError, match="bool"):
+        paddle.masked_fill(_f32(2, 3), _i64(1, 0, 1), 0.0)
+
+
+def test_masked_fill_rejects_incompatible_mask():
+    mask = paddle.to_tensor(np.ones((4,), np.bool_))
+    with pytest.raises(InvalidArgumentError, match="broadcast"):
+        paddle.masked_fill(_f32(2, 3), mask, 0.0)
+
+
+def test_put_along_axis_accepts_assign():
+    x = _f32(2, 3)
+    idx = paddle.to_tensor(np.zeros((2, 1), np.int64))
+    out = paddle.put_along_axis(x, idx, 7.0, axis=1)
+    np.testing.assert_allclose(out.numpy()[:, 0], [7.0, 7.0])
+
+
+def test_put_along_axis_rejects_float_indices():
+    with pytest.raises(InvalidArgumentError, match="integer"):
+        paddle.put_along_axis(_f32(2, 3), _f32(2, 1), 7.0, axis=1)
+
+
+def test_put_along_axis_rejects_rank_mismatch():
+    idx = paddle.to_tensor(np.zeros((2,), np.int64))
+    with pytest.raises(InvalidArgumentError, match="rank"):
+        paddle.put_along_axis(_f32(2, 3), idx, 7.0, axis=1)
+
+
+def test_put_along_axis_rejects_unknown_reduce():
+    idx = paddle.to_tensor(np.zeros((2, 1), np.int64))
+    with pytest.raises(InvalidArgumentError, match="reduce"):
+        paddle.put_along_axis(_f32(2, 3), idx, 7.0, axis=1,
+                              reduce="median")
+
+
+def test_nonzero_accepts_1d():
+    out = paddle.nonzero(_i64(0, 3, 0, 5))
+    np.testing.assert_array_equal(out.numpy(), [[1], [3]])
+
+
+def test_nonzero_rejects_scalar():
+    with pytest.raises(InvalidArgumentError, match="rank"):
+        paddle.nonzero(paddle.to_tensor(np.float32(1.0)))
+
+
+def test_unique_accepts_axis():
+    out = paddle.unique(_i64(3, 1, 3, 1))
+    np.testing.assert_array_equal(out.numpy(), [1, 3])
+
+
+def test_unique_rejects_bad_axis():
+    with pytest.raises(InvalidArgumentError, match="range"):
+        paddle.unique(_f32(2, 3), axis=2)
+
+
+def test_flatten_accepts_middle_range():
+    assert list(paddle.flatten(_f32(2, 3, 4), 1, 2).shape) == [2, 12]
+
+
+def test_flatten_rejects_axis_out_of_range():
+    with pytest.raises(InvalidArgumentError, match="range"):
+        paddle.flatten(_f32(2, 3), start_axis=3)
+
+
+def test_flatten_rejects_start_after_stop():
+    with pytest.raises(InvalidArgumentError, match="no greater"):
+        paddle.flatten(_f32(2, 3, 4), start_axis=2, stop_axis=0)
+
+
+def test_unbind_accepts_valid_axis():
+    parts = paddle.unbind(_f32(2, 3), axis=0)
+    assert len(parts) == 2 and list(parts[0].shape) == [3]
+
+
+def test_unbind_rejects_axis_out_of_range():
+    with pytest.raises(InvalidArgumentError, match="range"):
+        paddle.unbind(_f32(2, 3), axis=2)
+
+
+def test_bincount_accepts_weights():
+    out = paddle.bincount(_i64(0, 1, 1), minlength=4)
+    np.testing.assert_array_equal(out.numpy(), [1, 2, 0, 0])
+
+
+def test_bincount_rejects_2d_input():
+    x = paddle.to_tensor(np.zeros((2, 2), np.int64))
+    with pytest.raises(InvalidArgumentError, match="1-D"):
+        paddle.bincount(x)
+
+
+def test_bincount_rejects_float_input():
+    with pytest.raises(InvalidArgumentError, match="integer"):
+        paddle.bincount(_f32(3))
+
+
+def test_bincount_rejects_weight_shape_mismatch():
+    with pytest.raises(InvalidArgumentError, match="weights"):
+        paddle.bincount(_i64(0, 1, 1), weights=_f32(2))
+
+
+def test_bincount_rejects_negative_minlength():
+    with pytest.raises(InvalidArgumentError, match="minlength"):
+        paddle.bincount(_i64(0, 1), minlength=-1)

@@ -167,3 +167,50 @@ def test_prefix_load_runs_with_cache_on_and_off(model):
                 == off["handles"][w["rid"]].tokens), w["rid"]
     assert on["stats"]["prefix_hit_rate"] > 0
     assert off["stats"]["prefix_hit_rate"] == 0.0
+
+
+# -- repetitive workloads (speculative decode exercise) -----------------
+
+
+def test_repeat_share_generates_repetitive_prompts():
+    spec = LoadSpec(**dict(SPEC, repeat_share=1.0, repeat_period=3,
+                           prompt_len=(9, 12), n_requests=6))
+    work = generate_load(spec)
+    for w in work:
+        p = w["prompt_ids"]
+        assert np.array_equal(p, np.tile(p[:3], -(-len(p) // 3))[:len(p)])
+    # deterministic replay
+    again = generate_load(LoadSpec(**dict(SPEC, repeat_share=1.0,
+                                          repeat_period=3,
+                                          prompt_len=(9, 12),
+                                          n_requests=6)))
+    for a, b in zip(work, again):
+        assert np.array_equal(a["prompt_ids"], b["prompt_ids"])
+
+
+def test_repeat_share_zero_keeps_legacy_stream():
+    """repeat_share=0 must not consume any rng draws: old seeds keep
+    producing byte-identical workloads."""
+    legacy = generate_load(LoadSpec(**SPEC))
+    explicit = generate_load(LoadSpec(**dict(SPEC, repeat_share=0.0,
+                                             repeat_period=7)))
+    for a, b in zip(legacy, explicit):
+        assert np.array_equal(a["prompt_ids"], b["prompt_ids"])
+        assert a["max_new_tokens"] == b["max_new_tokens"]
+        assert a["arrival_tick"] == b["arrival_tick"]
+
+
+def test_repeat_share_composes_with_prefix_share():
+    """Both branches draw only when enabled; repetitive bodies can
+    still carry a shared prefix."""
+    spec = LoadSpec(**dict(SPEC, repeat_share=1.0, repeat_period=2,
+                           prefix_share=1.0, prefix_len=6,
+                           prefix_pool=1, prompt_len=(8, 8),
+                           n_requests=4))
+    work = generate_load(spec)
+    heads = {tuple(w["prompt_ids"][:6]) for w in work}
+    assert len(heads) == 1               # the one shared prefix
+    for w in work:
+        body = w["prompt_ids"][6:]
+        assert np.array_equal(
+            body, np.tile(body[:2], -(-len(body) // 2))[:len(body)])

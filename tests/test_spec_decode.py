@@ -1,0 +1,309 @@
+"""Speculative decoding (PT_SPEC_DECODE=ngram) invariants.
+
+The load-bearing property is EXACTNESS: greedy acceptance commits a
+draft token only when every earlier window position fed the model the
+token it would have chosen itself, so the speculative stream is
+bit-identical to plain greedy decode — asserted here at the executor
+level, at the engine level, under a seeded load with preemption,
+eviction and prefix-cache hits all firing, and across injected raises
+at every spec.* fault point.  The perf claim (multi-token steps) is
+asserted on the logical clock: fewer scheduler iterations and
+tokens_per_decode_step > 1 on a cycling stream, with the verify path
+dispatching ONE jitted call per step (trace/dispatch counters).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.server import (
+    NGramProposer, RequestState, ServingEngine, check_pool_invariants,
+)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.testing import faults
+from paddle_tpu.testing.load import LoadSpec, generate_load
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(11)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+ENGINE_KW = dict(max_seqs=2, page_size=4, max_len=128)
+
+# seed-2 prompt drives this model into a 4-token greedy cycle — the
+# structured-output regime where prompt-lookup drafting pays off
+CYCLING_PROMPT = np.random.RandomState(2).randint(
+    1, 256, (8,)).astype(np.int32)
+
+
+def _cold(model, prompt, max_new=8, **kw):
+    eng = ServingEngine(model, **dict(ENGINE_KW, **kw))
+    return eng.submit(prompt, max_new_tokens=max_new).result()
+
+
+# -- proposer unit level ------------------------------------------------
+
+
+def test_proposer_matches_tail_against_history():
+    p = NGramProposer(max_ngram=3)
+    p.begin("r", [5, 6, 7, 8, 5, 6, 7])
+    # tail (5,6,7) recurs at the start; continuation there was 8,5,6,7
+    assert p.propose("r", 4).tolist() == [8, 5, 6, 7]
+    assert p.propose("r", 2).tolist() == [8, 5]
+
+
+def test_proposer_no_match_returns_empty():
+    p = NGramProposer(max_ngram=3)
+    p.begin("r", [1, 2, 3, 4, 5])
+    assert p.propose("r", 4).size == 0          # nothing recurs
+    assert p.propose("missing", 4).size == 0    # unknown rid
+
+
+def test_proposer_tail_never_matches_itself():
+    p = NGramProposer(max_ngram=2)
+    p.begin("r", [9, 1, 2])
+    # (1, 2) occurs exactly once — as the tail; it must not self-match
+    assert p.propose("r", 4).size == 0
+
+
+def test_proposer_incremental_equals_rebuilt():
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, 6, (40,)).tolist()    # tiny vocab: collisions
+    inc = NGramProposer(max_ngram=3)
+    inc.begin("r", toks[:10])
+    for t in toks[10:]:
+        inc.extend("r", t)
+    fresh = NGramProposer(max_ngram=3)
+    fresh.begin("r", toks)
+    assert (inc.propose("r", 4).tolist()
+            == fresh.propose("r", 4).tolist())
+    assert inc._index["r"] == fresh._index["r"]
+
+
+def test_proposer_drop_releases_state():
+    p = NGramProposer()
+    p.begin("r", [1, 2, 3])
+    p.drop("r")
+    assert p.history_len("r") == 0
+    assert p.propose("r", 4).size == 0
+
+
+# -- engine-level parity ------------------------------------------------
+
+
+def test_off_mode_is_legacy_path(model):
+    """spec_decode='off' (and the default) never builds a SpecDecode
+    and never dispatches a verify — the r11 code path untouched."""
+    eng = ServingEngine(model, spec_decode="off", **ENGINE_KW)
+    dflt = ServingEngine(model, **ENGINE_KW)
+    assert eng.spec is None and dflt.spec is None
+    want = _cold(model, CYCLING_PROMPT, max_new=12)
+    assert eng.submit(CYCLING_PROMPT, max_new_tokens=12).result() == want
+    assert eng.executor.verify_dispatches == 0
+    assert eng.stats()["tokens_per_decode_step"] == 1.0
+
+
+def test_ngram_stream_bit_identical_and_faster_steps(model):
+    """On a cycling stream the speculative engine emits the EXACT
+    greedy tokens in fewer scheduler iterations, with acceptance and
+    tokens_per_decode_step both measurably above the floor."""
+    off = ServingEngine(model, spec_decode="off", **ENGINE_KW)
+    t_off = off.submit(CYCLING_PROMPT, max_new_tokens=60).result()
+    ng = ServingEngine(model, spec_decode="ngram", **ENGINE_KW)
+    h = ng.submit(CYCLING_PROMPT, max_new_tokens=60)
+    assert h.result() == t_off
+    s = ng.stats()
+    assert s["draft_acceptance_rate"] > 0.2
+    assert s["tokens_per_decode_step"] > 1.1
+    assert s["steps"] < off.stats()["steps"]
+    assert s["tpot_steps_p50"] < 1.0
+    m = h.metrics()
+    assert m["draft_accepted"] > 0
+    assert m["draft_proposed"] >= m["draft_accepted"]
+
+
+def test_exact_token_budget_no_overshoot(model):
+    """A verify window can propose past the generation cap; the commit
+    clamp must stop the stream at exactly max_new_tokens."""
+    for max_new in (5, 7, 11):
+        eng = ServingEngine(model, spec_decode="ngram", **ENGINE_KW)
+        h = eng.submit(CYCLING_PROMPT, max_new_tokens=max_new)
+        toks = h.result()
+        assert len(toks) == max_new
+        assert toks == _cold(model, CYCLING_PROMPT, max_new=max_new)
+        assert h.state is RequestState.FINISHED
+
+
+def test_rollback_returns_all_pages(model):
+    """Rejected draft windows really free their pages: after a run the
+    pool is whole and the trim counter saw traffic."""
+    eng = ServingEngine(model, spec_decode="ngram", **ENGINE_KW)
+    hs = [eng.submit(CYCLING_PROMPT, max_new_tokens=40),
+          eng.submit(np.random.RandomState(5).randint(
+              1, 256, (9,)).astype(np.int32), max_new_tokens=40)]
+    eng.run()
+    assert all(h.state is RequestState.FINISHED for h in hs)
+    ex = eng.executor
+    assert ex.rollback_pages > 0
+    assert ex.free_pages == ex.cache.num_pages
+    check_pool_invariants(ex.cache)
+
+
+def test_env_gate(model, monkeypatch):
+    monkeypatch.setenv("PT_SPEC_DECODE", "ngram")
+    assert ServingEngine(model, **ENGINE_KW).spec is not None
+    monkeypatch.setenv("PT_SPEC_DECODE", "off")
+    assert ServingEngine(model, **ENGINE_KW).spec is None
+    monkeypatch.delenv("PT_SPEC_DECODE")
+    assert ServingEngine(model, **ENGINE_KW).spec is None
+    monkeypatch.setenv("PT_SPEC_DECODE", "medusa")
+    with pytest.raises(ValueError, match="PT_SPEC_DECODE"):
+        ServingEngine(model, **ENGINE_KW)
+    monkeypatch.delenv("PT_SPEC_DECODE")
+    with pytest.raises(ValueError, match="spec_decode"):
+        ServingEngine(model, spec_decode="eagle", **ENGINE_KW)
+
+
+# -- no host loop in the verify path ------------------------------------
+
+
+def test_verify_is_one_jitted_call_per_step(model):
+    """The whole draft-window verification is ONE jitted dispatch per
+    scheduler iteration: dispatch count == speculative steps, token
+    count well above it (multi-token steps), and the program is traced
+    at most once per distinct batch size — nothing retraces per token,
+    which is what a hidden [B, k] host loop would do."""
+    eng = ServingEngine(model, spec_decode="ngram", **ENGINE_KW)
+    eng.submit(CYCLING_PROMPT, max_new_tokens=50)
+    eng.submit(np.tile(CYCLING_PROMPT, 2), max_new_tokens=50)
+    eng.run()
+    ex = eng.executor
+    assert ex.verify_dispatches == eng.metrics.spec_steps
+    assert ex.verify_dispatches > 0
+    # one trace per distinct running-batch size [1..max_seqs], ever
+    assert ex.verify_traces <= ENGINE_KW["max_seqs"]
+    assert eng.metrics.decode_tokens > ex.verify_dispatches
+
+
+# -- seeded load: preemption + eviction + prefix hits + spec ------------
+
+LOAD_SPEC = LoadSpec(n_requests=8, mean_interarrival=2.0,
+                     prompt_len=(4, 12), max_new=(6, 10), vocab=256,
+                     seed=21, prefix_share=0.6, prefix_len=10,
+                     prefix_pool=2, repeat_share=0.5, repeat_period=3)
+# undersized pool: decode growth forces preemption AND cached pages
+# must be LRU-evicted (same shape as the prefix-cache pressure test)
+TIGHT_KW = dict(max_seqs=2, page_size=4, max_len=64, num_pages=11,
+                prefill_chunk=8, prefix_cache=True)
+
+
+def _drive_load(model, spec, engine_kw, check_invariants=False,
+                on_error="raise"):
+    eng = ServingEngine(model, **engine_kw)
+    work = generate_load(spec)
+    pending = sorted(work, key=lambda w: (w["arrival_tick"], w["rid"]))
+    handles, errors = {}, []
+    while pending or eng.in_flight:
+        assert eng.tick < 3000, "load did not drain"
+        while pending and pending[0]["arrival_tick"] <= eng.tick:
+            w = pending.pop(0)
+            handles[w["rid"]] = eng.submit(
+                w["prompt_ids"], max_new_tokens=w["max_new_tokens"],
+                rid=w["rid"])
+        try:
+            eng.step()
+        except faults.InjectedFault as e:
+            if on_error != "continue":
+                raise
+            errors.append(e)
+        if check_invariants:
+            check_pool_invariants(eng.executor.cache, eng.prefix)
+    return eng, work, handles, errors
+
+
+def test_spec_under_load_with_preemption_eviction_prefix(model):
+    """The acceptance-criteria run: seeded load on an undersized pool
+    with the prefix cache on and ngram drafting on — preemption,
+    eviction and prefix hits all fire, the refcount audit is green
+    after EVERY step, and every stream is bit-identical to the same
+    load through the non-speculative engine."""
+    eng, work, handles, _ = _drive_load(
+        model, LOAD_SPEC, dict(TIGHT_KW, spec_decode="ngram"),
+        check_invariants=True)
+    s = eng.stats()
+    assert s["preemptions"] > 0
+    assert s["evicted_pages"] > 0
+    assert s["cached_tokens"] > 0
+    assert eng.metrics.draft_proposed > 0
+    for w in work:
+        assert handles[w["rid"]].state is RequestState.FINISHED
+    _, _, base, _ = _drive_load(
+        model, LOAD_SPEC, dict(TIGHT_KW, spec_decode="off"))
+    for w in work:
+        assert handles[w["rid"]].tokens == base[w["rid"]].tokens, \
+            w["rid"]
+
+
+def test_warm_prefix_spec_matches_cold_nonspec(model):
+    """Spec-decode x prefix-cache interaction: a warm-prefix request
+    under PT_SPEC_DECODE=ngram emits exactly the cold non-speculative
+    stream, with the pool audit green after every step."""
+    seed = np.tile(CYCLING_PROMPT, 2)[:12]
+    tail = np.asarray([3, 1, 4, 1, 5], np.int32)
+    warm_prompt = np.concatenate([seed, tail])
+    want = _cold(model, warm_prompt, max_new=24, spec_decode="off",
+                 prefix_cache=False)
+    eng = ServingEngine(model, spec_decode="ngram", prefix_cache=True,
+                        **ENGINE_KW)
+    eng.submit(seed, max_new_tokens=24).result()   # plant the prefix
+    h = eng.submit(warm_prompt, max_new_tokens=24)
+    while not h.state in (RequestState.FINISHED,):
+        assert eng.tick < 500
+        eng.step()
+        check_pool_invariants(eng.executor.cache, eng.prefix)
+    assert h.tokens == want
+    assert h.metrics()["cached_tokens"] > 0        # the hit fired
+    assert eng.executor.verify_dispatches > 0      # spec path ran
+
+
+# -- fault points -------------------------------------------------------
+
+
+@pytest.mark.parametrize("point", ["spec.draft", "spec.verify",
+                                   "spec.rollback"])
+@pytest.mark.parametrize("phase", ["before", "after"])
+def test_spec_fault_leaves_engine_serviceable(model, point, phase):
+    """An injected raise at every spec point x phase escapes step()
+    with the pool consistent; retries finish every request with the
+    exact greedy stream, and the engine accepts new work after."""
+    want = _cold(model, CYCLING_PROMPT, max_new=16)
+    faults.reset()
+    faults.arm(point, phase, 2, "raise")
+    eng = ServingEngine(model, spec_decode="ngram", **ENGINE_KW)
+    h = eng.submit(CYCLING_PROMPT, max_new_tokens=16)
+    errors = 0
+    while h.state is not RequestState.FINISHED:
+        assert eng.tick < 500
+        try:
+            eng.step()
+        except faults.InjectedFault:
+            errors += 1
+            check_pool_invariants(eng.executor.cache)
+    assert errors == 1, (point, phase)
+    assert h.tokens == want, (point, phase)
+    faults.reset()
+    h2 = eng.submit(CYCLING_PROMPT, max_new_tokens=16)
+    assert h2.result() == want                     # still serviceable
+    assert eng.executor.free_pages == eng.executor.cache.num_pages
