@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: smoke test test-fast verify-fast bench
+.PHONY: smoke test test-fast verify-fast lint-graph bench
 
 # <3 min sanity gate: import + one eager op, one jitted llama forward
 # step (the driver's entry()), and a 2-virtual-device multichip train
@@ -34,6 +34,7 @@ smoke:
 		tests/test_watchdog.py \
 		tests/test_dataloader_hardening.py \
 		tests/test_grouped_gemm.py \
+		tests/test_graph_lint.py \
 		tests/test_infermeta.py \
 		tests/test_moe_ep.py \
 		tests/test_serving_scheduler.py \
@@ -50,10 +51,18 @@ test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 		--continue-on-collection-errors -p no:cacheprovider
 
+# Graph-contract linter (paddle_tpu/analysis): traces every registered
+# hot program (train step, five serving programs, fused-MoE body) on
+# CPU and enforces its contract — dense-materialization ceiling,
+# host-sync ban, donation coverage, dtype-upcast floor, collective
+# inventory — plus the lowered-HLO host-sync scan.
+lint-graph:
+	JAX_PLATFORMS=cpu $(PY) tools/lint_graph.py
+
 # Fast lane + regression gate: fails ONLY on failures not recorded in
 # tools/fastlane_baseline.txt, so a dirty-but-known lane never blocks
 # unrelated work while any NEW breakage does.
-verify-fast:
+verify-fast: lint-graph
 	$(PY) tools/check_fastlane.py
 
 bench:

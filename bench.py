@@ -246,6 +246,8 @@ def main():
     # least predictable, so it must only ever cost itself.  Cold-cost
     # estimates from the r4/r5 runs; warm estimates assume the
     # persistent compile cache holds the programs.
+    _extend("graph_lint", "PT_BENCH_SKIP_LINT", _bench_graph_lint,
+            120, 40)
     _extend("resnet50", "PT_BENCH_SKIP_RESNET", _bench_resnet, 150, 40)
     _extend("bert_base_squad", "PT_BENCH_SKIP_BERT", _bench_bert, 200, 50)
     _extend("detection_amp_o2", "PT_BENCH_SKIP_DET", _bench_detection,
@@ -575,6 +577,33 @@ def _guarded(time_fn, flops_per_step, tag):
         if reason is not None:
             raise RuntimeError(f"implausible measurement: {reason}")
     return dt, lv
+
+
+def _bench_graph_lint(jax):
+    """Graph-contract linter over the hot-program registry: rebuilds
+    the tiny hot programs the way tools/lint_graph.py does and times a
+    full lint sweep (jaxpr checks + HLO host-sync scan).  Violations in
+    the artifact mean a hot program drifted from its contract on this
+    backend."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lint_graph", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tools", "lint_graph.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    owners = mod.build_programs()
+    from paddle_tpu import analysis
+
+    t0 = time.perf_counter()
+    report = analysis.lint_all(hlo=True)
+    dt = time.perf_counter() - t0
+    del owners
+    return {"programs": len(report.linted),
+            "violations": len(report.violations),
+            "skipped": len(report.skipped),
+            "lint_s": round(dt, 2)}
 
 
 def _bench_serving(jax):

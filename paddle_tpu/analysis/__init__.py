@@ -1,0 +1,40 @@
+"""Graph-contract linter — static analysis over jaxprs and lowered HLO.
+
+The reference framework's static-graph stack runs IR passes and
+verifiers over every program before execution (PIR pass infrastructure,
+memory-optim passes).  The jax_graft analog: every hot program (the
+compiled train step, the five serving executor programs, the fused-MoE
+shard_map body) registers a :class:`ProgramContract` at build time, and
+the linter walks the program's jaxpr — through ``pjit``/``scan``/
+``custom_vjp``/``shard_map`` sub-jaxprs — evaluating pluggable
+:class:`Check`s:
+
+* **dense-materialization** — no intermediate larger than the
+  contract's byte ceiling (generalizes the MoE dense-mask assertion);
+* **host-sync** — no ``debug_callback``/``pure_callback``/infeed inside
+  a step program;
+* **donation-miss** — large inputs re-emitted as same-shaped outputs
+  must be donated;
+* **dtype-upcast** — no big f32 intermediates in bf16 programs;
+* **collective audit** — exact all-to-all/psum equation inventory, so a
+  refactor that silently adds a collective fails lint;
+* **retrace/dispatch audit** — :class:`DispatchAuditor` over
+  :class:`CountedJit` programs (the runtime-side sixth check).
+
+``PT_LINT={off,warn,error}`` gates lint at registration time;
+``make lint-graph`` (tools/lint_graph.py) lints every registered
+program on CPU regardless of the gate.
+"""
+from .audit import CountedJit, DispatchAuditor  # noqa: F401
+from .checks import (  # noqa: F401
+    DEFAULT_CHECKS, Check, CollectiveAuditCheck, DenseMaterializationCheck,
+    DonationMissCheck, DtypeUpcastCheck, HostSyncCheck,
+)
+from .contract import (  # noqa: F401
+    GraphContractError, LintReport, ProgramContract, Violation,
+)
+from .registry import (  # noqa: F401
+    lint_all, lint_contract, lint_mode, lint_program, register_program,
+    registered, unregister_program,
+)
+from . import walker  # noqa: F401

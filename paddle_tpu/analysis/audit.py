@@ -1,0 +1,142 @@
+"""Runtime retrace/dispatch audit — the sixth check.
+
+``CountedJit`` is a drop-in ``jax.jit`` replacement that counts how
+many times the wrapped function was TRACED (re-traces mean shape churn)
+and how many times it was DISPATCHED — replacing the hand-rolled
+``verify_traces``/``verify_dispatches`` counters the serving executor
+carried.  ``DispatchAuditor`` is the context manager that asserts the
+counts over a block: an extra dispatch (a hidden host loop) or an extra
+trace (a shape leak) raises :class:`GraphContractError`.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from .contract import GraphContractError
+
+
+class CountedJit:
+    """jax.jit wrapper with trace/dispatch counters.
+
+    The trace counter is bumped by a host-side effect INSIDE the traced
+    body (it runs once per trace, never per dispatch — the same trick
+    the executor's verify program used); the dispatch counter is bumped
+    per call.  ``fn`` exposes the unjitted callable for ProgramContract
+    registration, so the lint path and the execution path share one
+    function object.
+    """
+
+    def __init__(self, fn, *, name=None, donate_argnums=(),
+                 static_argnames=(), **jit_kwargs):
+        self.name = name or getattr(fn, "__name__", "program")
+        self.traces = 0
+        self.dispatches = 0
+        self._fn = fn
+        self.donate_argnums = tuple(donate_argnums)
+
+        @functools.wraps(fn)
+        def counted(*args, **kwargs):
+            self.traces += 1
+            return fn(*args, **kwargs)
+
+        self._jit = jax.jit(counted,
+                            donate_argnums=self.donate_argnums,
+                            static_argnames=tuple(static_argnames),
+                            **jit_kwargs)
+
+    @property
+    def fn(self):
+        return self._fn
+
+    def __call__(self, *args, **kwargs):
+        self.dispatches += 1
+        return self._jit(*args, **kwargs)
+
+    def lower(self, *args, **kwargs):
+        return self._jit.lower(*args, **kwargs)
+
+    def __repr__(self):
+        return (f"CountedJit({self.name}, traces={self.traces}, "
+                f"dispatches={self.dispatches})")
+
+
+class DispatchAuditor:
+    """Assert trace/dispatch counts of CountedJit programs over a block.
+
+    ::
+
+        with DispatchAuditor(ex.programs["verify"],
+                             max_traces=max_seqs) as aud:
+            eng.run()
+        assert aud.dispatches == eng.metrics.spec_steps
+
+    Exact expectations (``dispatches=``, ``traces=``) and ceilings
+    (``max_dispatches=``, ``max_traces=``) are checked at block exit;
+    a mismatch raises GraphContractError naming the program set.  The
+    live ``dispatches``/``traces`` properties report the block's deltas
+    for assertions that need runtime quantities (e.g. scheduler-step
+    counts only known after the run).
+    """
+
+    def __init__(self, *programs, dispatches=None, max_dispatches=None,
+                 traces=None, max_traces=None):
+        if not programs:
+            raise ValueError("DispatchAuditor needs at least one "
+                             "CountedJit program")
+        self.programs = programs
+        self._expect = dict(dispatches=dispatches,
+                            max_dispatches=max_dispatches,
+                            traces=traces, max_traces=max_traces)
+        self._t0 = self._d0 = 0
+
+    def _sums(self):
+        return (sum(p.traces for p in self.programs),
+                sum(p.dispatches for p in self.programs))
+
+    @property
+    def traces(self):
+        return self._sums()[0] - self._t0
+
+    @property
+    def dispatches(self):
+        return self._sums()[1] - self._d0
+
+    def expect(self, **kwargs):
+        """Set/override expectations mid-block, for quantities only
+        known after the audited work ran (they are enforced at exit)."""
+        for k, v in kwargs.items():
+            if k not in self._expect:
+                raise TypeError(f"unknown expectation {k!r}")
+            self._expect[k] = v
+
+    def __enter__(self):
+        self._t0, self._d0 = self._sums()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            return False
+        names = ", ".join(p.name for p in self.programs)
+        t, d = self.traces, self.dispatches
+        e = self._expect
+        if e["dispatches"] is not None and d != e["dispatches"]:
+            raise GraphContractError(
+                f"[{names}] dispatch audit: {d} dispatches in block, "
+                f"expected exactly {e['dispatches']}")
+        if e["max_dispatches"] is not None and d > e["max_dispatches"]:
+            raise GraphContractError(
+                f"[{names}] dispatch audit: {d} dispatches in block "
+                f"exceed the ceiling {e['max_dispatches']} — a hidden "
+                f"host loop is dispatching per item")
+        if e["traces"] is not None and t != e["traces"]:
+            raise GraphContractError(
+                f"[{names}] retrace audit: {t} traces in block, "
+                f"expected exactly {e['traces']}")
+        if e["max_traces"] is not None and t > e["max_traces"]:
+            raise GraphContractError(
+                f"[{names}] retrace audit: {t} traces in block exceed "
+                f"the ceiling {e['max_traces']} — shapes are churning "
+                f"and every change recompiles")
+        return False

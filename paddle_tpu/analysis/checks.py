@@ -1,0 +1,173 @@
+"""Pluggable jaxpr-level checks evaluated against a ProgramContract.
+
+Each check is stateless: ``run(contract, closed_jaxpr) -> [Violation]``.
+A check that the contract does not configure (ceiling unset, no
+expected collectives, ...) returns no violations — contracts opt into
+exactly the invariants they can promise.  The sixth check of the suite,
+the retrace/dispatch audit, is runtime-side and lives in ``audit.py``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .contract import ProgramContract, Violation
+from . import walker
+
+
+class Check:
+    name = "check"
+
+    def run(self, contract: ProgramContract, jaxpr) -> list[Violation]:
+        raise NotImplementedError
+
+    def _v(self, contract, msg):
+        return Violation(contract.name, self.name, msg)
+
+
+class DenseMaterializationCheck(Check):
+    """No intermediate at or above the contract's byte ceiling — the
+    generalization of the MoE dense-[T,E,C]-mask assertion."""
+
+    name = "dense-materialization"
+
+    def run(self, contract, jaxpr):
+        ceil = contract.max_intermediate_bytes
+        if ceil is None:
+            return []
+        nb, shape, dtype, prim = walker.max_intermediate_bytes(jaxpr)
+        if nb >= ceil:
+            return [self._v(
+                contract,
+                f"intermediate {list(shape)} {dtype} ({nb} bytes, from "
+                f"'{prim}') reaches the declared ceiling of {ceil} "
+                f"bytes")]
+        return []
+
+
+class HostSyncCheck(Check):
+    """No callback/infeed primitive inside a step program: every one is
+    a device->host round-trip serialized into the step."""
+
+    name = "host-sync"
+
+    def run(self, contract, jaxpr):
+        if contract.allow_host_sync:
+            return []
+        inv = walker.primitive_inventory(jaxpr)
+        out = []
+        for prim in sorted(set(inv) & walker.HOST_SYNC_PRIMS):
+            out.append(self._v(
+                contract,
+                f"{inv[prim]} '{prim}' equation(s) force a host sync "
+                f"inside the program (set allow_host_sync=True only "
+                f"for debug programs)"))
+        return out
+
+
+class DonationMissCheck(Check):
+    """A large input whose (shape, dtype) is re-emitted as an output
+    should be donated — XLA then updates it in place instead of holding
+    both copies live (the KV-pool / optimizer-state pattern)."""
+
+    name = "donation-miss"
+
+    def run(self, contract, jaxpr):
+        if contract.donation_floor_bytes is None:
+            return []  # donation N/A (eager-dispatched op: inputs are
+            # live Tensor buffers, aliasing would corrupt them)
+        avals, donated = contract.flat_input_layout()
+        if avals is None:
+            return []
+        # Claim one output per donated input first, so an aliasable
+        # output can't be double-counted against an undonated input.
+        outs = []
+        for aval in jaxpr.out_avals:
+            if hasattr(aval, "shape") and hasattr(aval, "dtype"):
+                outs.append((tuple(aval.shape), np.dtype(aval.dtype)))
+        for aval, don in zip(avals, donated):
+            if don and hasattr(aval, "shape"):
+                key = (tuple(aval.shape), np.dtype(aval.dtype))
+                if key in outs:
+                    outs.remove(key)
+        viols = []
+        for idx, (aval, don) in enumerate(zip(avals, donated)):
+            if don or not hasattr(aval, "shape"):
+                continue
+            key = (tuple(aval.shape), np.dtype(aval.dtype))
+            nbytes = int(np.prod(key[0] or (1,))) * key[1].itemsize
+            if nbytes < contract.donation_floor_bytes:
+                continue
+            if key in outs:
+                outs.remove(key)
+                viols.append(self._v(
+                    contract,
+                    f"input leaf #{idx} {list(key[0])} {key[1]} "
+                    f"({nbytes} bytes) is re-emitted as a same-shaped "
+                    f"output but not donated — add it to "
+                    f"donate_argnums so XLA can alias the buffer"))
+        return viols
+
+
+class DtypeUpcastCheck(Check):
+    """In a bf16/f16 program, f32 intermediates above the size floor
+    are unintended upcasts (the floor exempts scalar losses, norms and
+    softmax statistics, which upcast on purpose)."""
+
+    name = "dtype-upcast"
+
+    def run(self, contract, jaxpr):
+        cd = contract.compute_dtype
+        if cd is None:
+            return []
+        cd = np.dtype(cd)
+        if cd.itemsize >= 4:
+            return []
+        viols = []
+        seen = set()
+        for eqn, v, aval in walker.iter_vars(jaxpr):
+            if v in eqn.invars:
+                continue  # flag the producing equation once
+            dt = np.dtype(aval.dtype) if hasattr(aval, "dtype") else None
+            if dt is None or dt.kind != "f" or dt.itemsize < 4:
+                continue
+            nb = int(np.prod(aval.shape or (1,))) * dt.itemsize
+            key = (tuple(aval.shape), dt, eqn.primitive.name)
+            if nb >= contract.f32_floor_bytes and key not in seen:
+                seen.add(key)
+                viols.append(self._v(
+                    contract,
+                    f"{dt} intermediate {list(aval.shape)} ({nb} bytes, "
+                    f"from '{eqn.primitive.name}') in a {cd} program — "
+                    f"unintended upcast above the "
+                    f"{contract.f32_floor_bytes}-byte floor"))
+        return viols
+
+
+class CollectiveAuditCheck(Check):
+    """Exact collective inventory per program: a refactor that silently
+    adds (or drops) an all-to-all/psum fails lint until the contract is
+    updated on purpose."""
+
+    name = "collective-audit"
+
+    def run(self, contract, jaxpr):
+        expected = contract.expected_collectives
+        if expected is None:
+            return []
+        expected = {k: int(v) for k, v in expected.items() if int(v)}
+        actual = walker.collective_inventory(jaxpr)
+        if actual != expected:
+            return [self._v(
+                contract,
+                f"collective inventory drifted: expected {expected!r}, "
+                f"traced {actual!r}")]
+        return []
+
+
+DEFAULT_CHECKS: tuple = (
+    DenseMaterializationCheck(),
+    HostSyncCheck(),
+    DonationMissCheck(),
+    DtypeUpcastCheck(),
+    CollectiveAuditCheck(),
+)

@@ -1,0 +1,185 @@
+"""ProgramContract — the declared invariants of one hot program.
+
+A contract names a traceable callable, its example arguments (shapes
+only — everything is reduced to ``jax.ShapeDtypeStruct`` before
+tracing, so linting never touches device memory), and the invariants
+the checks enforce.  Contracts hold their program WEAKLY: registering
+the train step must not keep a dead trainer (and its parameter trees)
+alive, so the registry drops entries whose program has been collected.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import inspect
+import weakref
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+
+class GraphContractError(AssertionError):
+    """A lint violation escalated to an error (PT_LINT=error, a failed
+    DispatchAuditor block, or tools/lint_graph.py)."""
+
+
+@dataclasses.dataclass
+class Violation:
+    program: str
+    check: str
+    message: str
+
+    def __str__(self):
+        return f"[{self.program}] {self.check}: {self.message}"
+
+
+class LintReport:
+    """Violations (and skipped programs) from one lint run."""
+
+    def __init__(self):
+        self.violations: list[Violation] = []
+        self.linted: list[str] = []
+        self.skipped: list[str] = []   # args not captured yet / fn dead
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def merge(self, other: "LintReport"):
+        self.violations.extend(other.violations)
+        self.linted.extend(other.linted)
+        self.skipped.extend(other.skipped)
+        return self
+
+    def __str__(self):
+        lines = [f"graph lint: {len(self.linted)} program(s), "
+                 f"{len(self.violations)} violation(s)"
+                 + (f", {len(self.skipped)} skipped" if self.skipped
+                    else "")]
+        lines += [f"  {v}" for v in self.violations]
+        return "\n".join(lines)
+
+
+def _weak(fn):
+    """Weak handle on a program callable; call it to resolve (None when
+    the owner died)."""
+    if inspect.ismethod(fn):
+        return weakref.WeakMethod(fn)
+    try:
+        return weakref.ref(fn)
+    except TypeError:  # builtins / partials: keep a strong ref
+        return lambda: fn
+
+
+def _to_sds(leaf):
+    import jax
+
+    if isinstance(leaf, jax.ShapeDtypeStruct):
+        return leaf
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
+    return leaf  # python scalar: traced as a weak-typed constant
+
+
+@dataclasses.dataclass
+class ProgramContract:
+    """Invariants for one program.
+
+    ``args`` is a tuple of example arguments (arrays / ShapeDtypeStructs
+    / pytrees of either) or a zero-arg callable returning one — the lazy
+    form lets the train step register at build time and capture its
+    batch shapes on the first real step (``None`` from the thunk means
+    "not ready yet"; the program is reported as skipped).  ``kwargs``
+    are static keywords (e.g. ``n=2`` for the multi-token decode).
+
+    Check knobs (``None`` disables the corresponding check):
+
+    * ``donate_argnums`` + ``donation_floor_bytes``: inputs >= the floor
+      whose (shape, dtype) reappears as an output must be listed in
+      ``donate_argnums``.
+    * ``max_intermediate_bytes``: byte ceiling; any array in the jaxpr
+      of at least this size is a dense-materialization violation.
+    * ``compute_dtype``: when bf16/f16, f32 intermediates of at least
+      ``f32_floor_bytes`` are dtype-upcast violations.
+    * ``allow_host_sync``: permit callback/infeed primitives.
+    * ``expected_collectives``: exact {collective: count} inventory
+      ({} asserts a collective-free program).
+    """
+
+    name: str
+    fn: Callable
+    args: Any = ()
+    kwargs: Optional[dict] = None
+    donate_argnums: tuple = ()
+    donation_floor_bytes: int = 1024
+    max_intermediate_bytes: Optional[int] = None
+    compute_dtype: Any = None
+    f32_floor_bytes: int = 1 << 20
+    allow_host_sync: bool = False
+    expected_collectives: Optional[dict] = None
+
+    def __post_init__(self):
+        self.donate_argnums = tuple(int(i) for i in self.donate_argnums)
+        self._fn_ref = _weak(self.fn)
+        self.fn = None  # weak only: the contract must not pin the owner
+
+    def resolve_fn(self):
+        return self._fn_ref()
+
+    def example_args(self):
+        """Concrete args -> ShapeDtypeStruct pytrees, or None when the
+        lazy thunk has not captured shapes yet."""
+        import jax
+
+        args = self.args() if callable(self.args) else self.args
+        if args is None:
+            return None
+        return tuple(jax.tree.map(_to_sds, a) for a in args)
+
+    def make_jaxpr(self):
+        """ClosedJaxpr of the program at the contract's shapes, or None
+        when the fn is dead / args unavailable."""
+        import jax
+
+        fn = self.resolve_fn()
+        if fn is None:
+            return None
+        args = self.example_args()
+        if args is None:
+            return None
+        if self.kwargs:
+            fn = functools.partial(fn, **self.kwargs)
+        return jax.make_jaxpr(fn)(*args)
+
+    def lower_text(self):
+        """Lowered (StableHLO) text at the contract's shapes, for the
+        HLO-level host-sync scan; None when unavailable."""
+        import jax
+
+        fn = self.resolve_fn()
+        if fn is None:
+            return None
+        args = self.example_args()
+        if args is None:
+            return None
+        if self.kwargs:
+            fn = functools.partial(fn, **self.kwargs)
+        return jax.jit(fn).lower(*args).as_text()
+
+    def flat_input_layout(self):
+        """(flat_avals, donated_flags): the jaxpr's flat input avals and
+        which of them fall inside a donated top-level argument."""
+        import jax
+
+        args = self.example_args()
+        if args is None:
+            return None, None
+        donated = set(self.donate_argnums)
+        avals, flags = [], []
+        for i, a in enumerate(args):
+            leaves = jax.tree.leaves(a)
+            avals.extend(leaves)
+            flags.extend([i in donated] * len(leaves))
+        return avals, flags

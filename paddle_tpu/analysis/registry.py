@@ -1,0 +1,108 @@
+"""ProgramRegistry — every hot program's contract, in one table.
+
+The train step, the five serving executor programs and the fused-MoE
+shard_map body register here at build time.  ``PT_LINT`` gates what
+registration does:
+
+* ``off``  (default) — store only; ``make lint-graph`` /
+  ``lint_all()`` lint on demand.
+* ``warn`` — lint at registration, report violations as warnings.
+* ``error`` — lint at registration, raise GraphContractError.
+
+Registration is replace-by-name (rebuilding an engine re-registers its
+programs) and entries hold their program weakly — a dead owner's entry
+is dropped at the next lint sweep, so the registry never pins model
+state.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+
+from .checks import DEFAULT_CHECKS
+from .contract import GraphContractError, LintReport, ProgramContract
+
+_REGISTRY: dict[str, ProgramContract] = {}
+
+_MODES = ("off", "warn", "error")
+
+
+def lint_mode() -> str:
+    mode = os.environ.get("PT_LINT", "off").strip().lower()
+    if mode not in _MODES:
+        raise ValueError(
+            f"PT_LINT must be one of {_MODES}, got {mode!r}")
+    return mode
+
+
+def lint_contract(contract: ProgramContract, *, checks=None,
+                  hlo=False) -> LintReport:
+    """Lint one contract (registered or not).  ``hlo=True`` adds the
+    lowered-HLO host-sync scan on top of the jaxpr checks."""
+    report = LintReport()
+    jaxpr = contract.make_jaxpr()
+    if jaxpr is None:
+        report.skipped.append(contract.name)
+        return report
+    report.linted.append(contract.name)
+    for check in (checks if checks is not None else DEFAULT_CHECKS):
+        report.violations.extend(check.run(contract, jaxpr))
+    if hlo and not contract.allow_host_sync:
+        # Callbacks lower to custom_call @xla_python_*_callback (and
+        # host transfers to send/recv-to-host ops) — scanning the
+        # lowered text catches a host sync even if a future jax version
+        # renames the jaxpr-level primitive.
+        text = contract.lower_text()
+        if text is not None:
+            from .contract import Violation
+
+            for marker in ("_callback", "send_to_host",
+                           "recv_from_host"):
+                if marker in text:
+                    report.violations.append(Violation(
+                        contract.name, "host-sync",
+                        f"lowered HLO contains a '{marker}' call — "
+                        f"host round-trip survives lowering"))
+    return report
+
+
+def register_program(contract: ProgramContract, *, replace=True):
+    """Register (or replace) a program contract; under PT_LINT=warn/
+    error the program is linted immediately (skipped silently when its
+    lazy args are not captured yet)."""
+    if not replace and contract.name in _REGISTRY:
+        raise ValueError(f"program {contract.name!r} already registered")
+    _REGISTRY[contract.name] = contract
+    mode = lint_mode()
+    if mode == "off":
+        return contract
+    report = lint_contract(contract)
+    if report.violations:
+        if mode == "error":
+            raise GraphContractError(str(report))
+        warnings.warn(str(report), stacklevel=2)
+    return contract
+
+
+def unregister_program(name: str):
+    _REGISTRY.pop(name, None)
+
+
+def registered() -> dict:
+    return dict(_REGISTRY)
+
+
+def lint_program(name: str, *, hlo=False) -> LintReport:
+    return lint_contract(_REGISTRY[name], hlo=hlo)
+
+
+def lint_all(*, hlo=False) -> LintReport:
+    """Lint every registered program; entries whose program has been
+    garbage-collected are dropped, not failed."""
+    report = LintReport()
+    for name, contract in list(_REGISTRY.items()):
+        if contract.resolve_fn() is None:
+            del _REGISTRY[name]
+            continue
+        report.merge(lint_contract(contract, hlo=hlo))
+    return report

@@ -170,7 +170,46 @@ class MoELayer(Layer):
             return mapped(tokens, wg, w1, b1, w2, b2)
 
         self._ep_op = OpDef("moe_ep_alltoall", fn, n_outputs=2)
+        self._register_contract(fn, n, E, k, cf, gate_kind)
         return self._ep_op
+
+    def _register_contract(self, fn, n, E, k, cf, gate_kind):
+        """Graph contract for the EP shard_map body (analysis/):
+        collective inventory is pinned (2 all-to-alls from the
+        scatter/gather exchange + 2 psums from the load-balance pmean
+        when the gate has one), and with moe_impl='fused' the dense
+        [T, E, C] dispatch-mask ceiling is declared — the lint-level
+        version of the no-dense-mask jaxpr test."""
+        from .....analysis import ProgramContract, register_program
+
+        e = self.experts
+        H = self.d_model
+        # T_local sized so the dense-mask bytes T_local*E*C strictly
+        # dominate every legitimate linear-size buffer: >= 2H covers
+        # the [E, C, H] expert buckets, >= 2nH/(cf*k) covers the global
+        # [T, H] token array.
+        T_local = max(64, 2 * H,
+                      int(math.ceil(2 * n * H / (cf * max(1, k)))))
+        T = n * T_local
+        sds = lambda p: jax.ShapeDtypeStruct(  # noqa: E731
+            tuple(p.shape), jnp.float32)
+        args = (jax.ShapeDtypeStruct((T, H), jnp.float32),
+                jax.ShapeDtypeStruct((H, E), jnp.float32),
+                sds(e.w1), sds(e.b1), sds(e.w2), sds(e.b2))
+        ceiling = None
+        if self.moe_impl == "fused":
+            C = min(T_local, max(1, int(math.ceil(T_local * cf * k / E))))
+            ceiling = T_local * E * C * 4
+        collectives = {"all_to_all": 2}
+        if gate_kind in ("gshard", "switch"):
+            collectives["psum"] = 2
+        register_program(ProgramContract(
+            name="moe.ep_alltoall", fn=fn, args=args,
+            max_intermediate_bytes=ceiling,
+            # Eager-dispatched op: inputs are live Tensor buffers, so
+            # buffer donation is not applicable here.
+            donation_floor_bytes=None,
+            expected_collectives=collectives))
 
     def _forward_alltoall(self, x):
         """Explicit expert-parallel forward (all-to-all token exchange)."""

@@ -19,11 +19,14 @@ scheduled paths execute byte-identical programs.
 """
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+from ...analysis import CountedJit, ProgramContract, register_program
 from ...ops.nn_ops import _rms_norm_plain, _rope_plain
 from ..paged import PagedKVCache, paged_decode_attention
 
@@ -80,21 +83,109 @@ class PagedExecutor:
         # prefix-cache tests use to assert prefill FLOPs covered only
         # the novel suffix of a warm request
         self.prefill_events = []
-        self._jit_prefill = jax.jit(self._prefill_fwd)
-        self._jit_chunk = jax.jit(self._chunk_fwd)
-        # donate the pools: decode() immediately replaces them with the
-        # outputs, so XLA updates in place instead of copying GBs of KV
-        self._jit_decode = jax.jit(self._decode_fwd,
-                                   donate_argnums=(4, 5))
-        self._jit_decode_n = None
-        self._jit_verify = None
-        # speculative-decode audit counters: traces counts how many
-        # times _verify_fwd was TRACED (re-traces mean shape churn),
-        # dispatches how many verify steps ran — the no-host-loop test
-        # asserts dispatches >> traces while tokens >> dispatches
-        self.verify_traces = 0
-        self.verify_dispatches = 0
+        # every program is a CountedJit (analysis/audit.py): trace and
+        # dispatch counters come with the jit wrapper, and the unjitted
+        # fn doubles as the lint registration target below
+        self._jit_prefill = CountedJit(self._prefill_fwd,
+                                       name="serve.prefill")
+        # donate the pools (and the chunk's dense past-KV gather, which
+        # is a fresh copy the caller never reuses): the call sites
+        # immediately replace them with the outputs, so XLA updates in
+        # place instead of copying GBs of KV — the donation-miss lint
+        # check flagged the chunk program's past_k/past_v
+        self._jit_chunk = CountedJit(self._chunk_fwd,
+                                     name="serve.prefill_chunk",
+                                     donate_argnums=(4, 5))
+        self._jit_decode = CountedJit(self._decode_fwd,
+                                      name="serve.decode",
+                                      donate_argnums=(4, 5))
+        self._jit_decode_n = CountedJit(self._decode_n_fwd,
+                                        name="serve.decode_n",
+                                        static_argnames=("n",),
+                                        donate_argnums=(4, 5))
+        self._jit_verify = CountedJit(self._verify_fwd,
+                                      name="serve.verify",
+                                      donate_argnums=(3, 4))
         self.rollback_pages = 0
+        self._register_contracts()
+
+    @property
+    def programs(self) -> dict:
+        """The five jitted programs, by contract name suffix."""
+        return {"prefill": self._jit_prefill,
+                "prefill_chunk": self._jit_chunk,
+                "decode": self._jit_decode,
+                "decode_n": self._jit_decode_n,
+                "verify": self._jit_verify}
+
+    # speculative-decode audit counters, kept as properties over the
+    # CountedJit wrapper: traces counts how many times _verify_fwd was
+    # TRACED (re-traces mean shape churn), dispatches how many verify
+    # steps ran — the no-host-loop test asserts dispatches >> traces
+    # while tokens >> dispatches
+    @property
+    def verify_traces(self) -> int:
+        return self._jit_verify.traces
+
+    @property
+    def verify_dispatches(self) -> int:
+        return self._jit_verify.dispatches
+
+    def _register_contracts(self):
+        """Register the five serving programs' graph contracts at
+        representative shapes (lint traces ShapeDtypeStructs only — no
+        device work).  Chunk shapes pick past cover == chunk length so
+        the donation aliasing opportunity is visible to the checker."""
+        cache = self.cache
+        cfg = self.config
+        L = cfg.num_hidden_layers
+        KV, D = cfg.num_key_value_heads, cfg.head_dim
+        ps, B, pps = cache.page_size, cache.max_seqs, \
+            cache.max_pages_per_seq
+
+        def sds(tree):
+            return jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(jnp.shape(a), a.dtype),
+                tree)
+
+        def i32(*shape):
+            return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+        layers, tops = sds(self.layers), sds(self.tops)
+        kp = jax.ShapeDtypeStruct(jnp.shape(cache.k_pages),
+                                  cache.k_pages.dtype)
+        past = jax.ShapeDtypeStruct((L, KV, ps, D), cache.k_pages.dtype)
+        # reduced-precision pool => bf16 serving build: flag big f32
+        # intermediates as upcasts (f32 pools skip the check)
+        pool_dt = np.dtype(cache.k_pages.dtype)
+        common = dict(
+            compute_dtype=str(pool_dt) if pool_dt.itemsize < 4 else None,
+            # single-device programs must stay collective-free
+            expected_collectives={},
+        )
+        register_program(ProgramContract(
+            name="serve.prefill", fn=self._prefill_fwd,
+            args=(layers, tops, i32(1, 2 * ps)), **common))
+        register_program(ProgramContract(
+            name="serve.prefill_chunk", fn=self._chunk_fwd,
+            args=(layers, tops, i32(1, ps), i32(), past, past, i32()),
+            donate_argnums=self._jit_chunk.donate_argnums, **common))
+        register_program(ProgramContract(
+            name="serve.decode", fn=self._decode_fwd,
+            args=(layers, tops, i32(B), i32(B), kp, kp, i32(B),
+                  i32(B, pps)),
+            donate_argnums=self._jit_decode.donate_argnums, **common))
+        register_program(ProgramContract(
+            name="serve.decode_n", fn=self._decode_n_fwd,
+            args=(layers, tops, i32(B), i32(B), kp, kp, i32(B),
+                  i32(B, pps)),
+            kwargs={"n": 2},
+            donate_argnums=self._jit_decode_n.donate_argnums, **common))
+        register_program(ProgramContract(
+            name="serve.verify", fn=self._verify_fwd,
+            args=(layers, tops, i32(B, 2), kp, kp, i32(B), i32(B, pps),
+                  i32(B)),
+            donate_argnums=self._jit_verify.donate_argnums, **common))
 
     def _head(self, x, tops):
         w = tops["head_w"]
@@ -299,7 +390,6 @@ class PagedExecutor:
         B, W = ids.shape
         pps = page_tables.shape[1]
         num_pages = k_pages.shape[2]
-        self.verify_traces += 1          # host effect: counts traces
         x = tops["embed"][ids]                         # [B, W, h]
         pos = lengths[:, None] + jnp.arange(W)[None]   # [B, W]
         slot = pos // ps
@@ -436,9 +526,17 @@ class PagedExecutor:
         past_k, past_v = self.cache.gather_dense(sid, start)
         ids = jnp.asarray(np.asarray(chunk_ids)[None], jnp.int32)
         self.prefill_events.append((sid, int(ids.shape[1])))
-        logits, k, v = self._jit_chunk(
-            self.layers, self.tops, ids, jnp.int32(start), past_k,
-            past_v, jnp.int32(start))
+        # past_k/past_v are donated: gather_dense returns fresh dense
+        # copies nothing else references, and when the past cover
+        # equals the chunk length XLA writes the chunk KV in place.
+        # Shapes where the alias is impossible (cover != chunk) would
+        # warn once per compile — expected, so silenced here.
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            logits, k, v = self._jit_chunk(
+                self.layers, self.tops, ids, jnp.int32(start), past_k,
+                past_v, jnp.int32(start))
         self.cache.write_at(sid, k, v, start)
         if not final:
             return None
@@ -513,16 +611,12 @@ class PagedExecutor:
             ids[i, 1:1 + len(dr)] = dr
         tables = jnp.asarray(np.maximum(cache.page_table[sids], 0))
         lengths = jnp.asarray(cache.lengths[sids])
-        if self._jit_verify is None:
-            self._jit_verify = jax.jit(self._verify_fwd,
-                                       donate_argnums=(3, 4))
         packed, emit_n, kps, vps = self._jit_verify(
             self.layers, self.tops, jnp.asarray(ids), cache.k_pages,
             cache.v_pages, lengths, tables,
             jnp.asarray(limits, jnp.int32))
         cache.k_pages = kps
         cache.v_pages = vps
-        self.verify_dispatches += 1
         # ONE host transfer: the sort-packed token block + counts;
         # splitting it is per-SEQUENCE host work, never per-token-cell
         packed = np.asarray(packed)
@@ -565,10 +659,6 @@ class PagedExecutor:
                                 jnp.int32)
         tables = jnp.asarray(np.maximum(cache.page_table[sids], 0))
         lengths = jnp.asarray(cache.lengths[sids])
-        if self._jit_decode_n is None:
-            self._jit_decode_n = jax.jit(self._decode_n_fwd,
-                                         static_argnames=("n",),
-                                         donate_argnums=(4, 5))
         toks, kps, vps = self._jit_decode_n(
             self.layers, self.tops, ids, positions, cache.k_pages,
             cache.v_pages, lengths, tables, n=int(n))

@@ -357,6 +357,42 @@ class CompiledTrainStep:
                 guarded_kwargs["out_shardings"][:-1] + (None, None, None)
         self._guarded = jax.jit(guarded, **guarded_kwargs)
 
+        # -- graph contracts (analysis/) ---------------------------------
+        # Registered at build; batch shapes are captured lazily on the
+        # first real step (the contract thunk returns None until then,
+        # which lint reports as "skipped").  The donation-miss check
+        # audits params + fp32 master + BOTH optimizer-moment trees:
+        # with donate=False every re-emitted state tree is flagged.
+        from ..analysis import ProgramContract, register_program
+
+        self._lint_batch = None
+        self._guarded_fn = guarded  # keep the raw fn alive for weakref
+        donated = jit_kwargs.get("donate_argnums", ())
+
+        def _state_avals():
+            def tree(t):
+                return jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), t)
+            scalar = jax.ShapeDtypeStruct((), jnp.float32)
+            return (tree(self.params), tree(self._master), tree(self._m),
+                    tree(self._v), scalar, scalar)
+
+        def _args(with_gate):
+            def thunk():
+                if self._lint_batch is None:
+                    return None
+                gate = ((jax.ShapeDtypeStruct((3,), jnp.float32),)
+                        if with_gate else ())
+                return _state_avals() + gate + self._lint_batch
+            return thunk
+
+        register_program(ProgramContract(
+            name="train.step", fn=step, args=_args(False),
+            donate_argnums=donated))
+        register_program(ProgramContract(
+            name="train.guarded_step", fn=guarded, args=_args(True),
+            donate_argnums=donated))
+
     def _zero_sharding(self, name, value, rules, dp_axis):
         """Opt-state sharding: param's TP sharding + dp over the first
         still-replicated dim that divides evenly (ZeRO partitioning);
@@ -373,6 +409,14 @@ class CompiledTrainStep:
             if dim is not None and free[dim] > 0:
                 spec[dim] = dp_axis
         return NamedSharding(self.mesh.jax_mesh, PartitionSpec(*spec))
+
+    def _capture_lint_batch(self, batch):
+        """First-step shape capture for the lazily-argumented train
+        contracts (the placed batch is already jnp arrays)."""
+        if self._lint_batch is None:
+            self._lint_batch = tuple(
+                jax.ShapeDtypeStruct(jnp.shape(b), jnp.asarray(b).dtype)
+                for b in batch)
 
     def _place_batch(self, arr):
         arr = jnp.asarray(arr)
@@ -487,6 +531,7 @@ class CompiledTrainStep:
         # int64 indices that global x64 mode would introduce).
         with jax.enable_x64(False):
             batch = [self._place_batch(b) for b in batch]
+            self._capture_lint_batch(batch)
             (self.params, self._master, self._m, self._v, loss) = \
                 self._step(self.params, self._master, self._m, self._v,
                            jnp.asarray(self._t, jnp.float32), lr_val, *batch)
@@ -535,6 +580,7 @@ class CompiledTrainStep:
         batch = [b._data if isinstance(b, Tensor) else b for b in batch]
         with jax.enable_x64(False):
             batch = [self._place_batch(b) for b in batch]
+            self._capture_lint_batch(batch)
             gate = jnp.asarray([threshold, l_inj, g_inj], jnp.float32)
             (self.params, self._master, self._m, self._v, loss, gnorm,
              ok) = self._guarded(

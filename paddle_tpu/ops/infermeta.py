@@ -697,6 +697,155 @@ def _bincount(datas, attrs):
               f"{attrs.get('minlength')}")
 
 
+@register_validator("logsumexp")
+def _logsumexp(datas, attrs):
+    x = datas[0]
+    axis = attrs.get("axis")
+    if axis is None:
+        return  # reference: None reduces over all dims
+    nd = max(_ndim(x), 1)
+    axes = axis if isinstance(axis, (list, tuple)) else (axis,)
+    seen = set()
+    for a in axes:
+        n = _axis_in("logsumexp", int(a), nd)
+        if n in seen:
+            _fail("logsumexp",
+                  f"axis {list(axes)} has duplicate entries")
+        seen.add(n)
+
+
+@register_validator("cumprod")
+def _cumprod(datas, attrs):
+    dim = attrs.get("dim")
+    if dim is None:
+        return  # reference: None multiplies the flattened tensor
+    _axis_in("cumprod", int(dim), max(_ndim(datas[0]), 1))
+
+
+@register_validator("strided_slice")
+def _strided_slice(datas, attrs):
+    x = datas[0]
+    axes = tuple(attrs.get("axes", ()))
+    starts = tuple(attrs.get("starts", ()))
+    ends = tuple(attrs.get("ends", ()))
+    strides = tuple(attrs.get("strides", ()))
+    if not (len(axes) == len(starts) == len(ends) == len(strides)):
+        _fail("strided_slice",
+              f"the lengths of axes ({len(axes)}), starts "
+              f"({len(starts)}), ends ({len(ends)}) and strides "
+              f"({len(strides)}) must be equal")
+    nd = max(_ndim(x), 1)
+    seen = set()
+    for a in axes:
+        n = _axis_in("strided_slice", int(a), nd)
+        if n in seen:
+            _fail("strided_slice",
+                  f"axes {list(axes)} have duplicate entries")
+        seen.add(n)
+    for st in strides:
+        if int(st) == 0:
+            _fail("strided_slice",
+                  f"stride must be non-zero, got strides "
+                  f"{list(strides)}")
+
+
+@register_validator("gather_nd")
+def _gather_nd(datas, attrs):
+    x, index = datas[0], datas[1]
+    if not _int_dtype(index):
+        _fail("gather_nd",
+              f"the index must be an integer dtype, got "
+              f"{getattr(index, 'dtype', None)}")
+    xs, ixs = _shape(x), _shape(index)
+    if not ixs:
+        _fail("gather_nd",
+              f"the index must have rank >= 1, but received rank 0")
+    if ixs[-1] > len(xs):
+        _fail("gather_nd",
+              f"the last dimension of index ({ixs[-1]}) must be <= "
+              f"the input's rank ({len(xs)}); input {list(xs)}, "
+              f"index {list(ixs)}")
+
+
+@register_validator("dot")
+def _dot(datas, attrs):
+    xs, ys = _shape(datas[0]), _shape(datas[1])
+    if len(xs) not in (1, 2) or len(ys) not in (1, 2):
+        _fail("dot",
+              f"the inputs must be 1-D or 2-D tensors, but received "
+              f"x{list(xs)} . y{list(ys)}")
+    if xs != ys:
+        _fail("dot",
+              f"the inputs must have the same shape, but received "
+              f"x{list(xs)} vs y{list(ys)}")
+
+
+@register_validator("addmm")
+def _addmm(datas, attrs):
+    inp, x, y = datas[0], datas[1], datas[2]
+    ins, xs, ys = _shape(inp), _shape(x), _shape(y)
+    if len(xs) != 2 or len(ys) != 2:
+        _fail("addmm",
+              f"the tensors x and y must be 2-D, but received "
+              f"x{list(xs)}, y{list(ys)}")
+    if xs[1] != ys[0]:
+        _fail("addmm",
+              f"Input X's width should be equal to Y's height, but "
+              f"received X'shape: {list(xs)}, Y'shape: {list(ys)}")
+    out = (xs[0], ys[1])
+    try:
+        ok = np.broadcast_shapes(ins, out) == out
+    except ValueError:
+        ok = False
+    if not ok:
+        _fail("addmm",
+              f"the input {list(ins)} is not broadcast-compatible "
+              f"with the x @ y result shape {list(out)}")
+
+
+@register_validator("searchsorted")
+def _searchsorted(datas, attrs):
+    ss = datas[0]
+    if _ndim(ss) != 1:
+        _fail("searchsorted",
+              f"sorted_sequence must be a 1-D tensor here, but "
+              f"received shape {list(_shape(ss))}")
+
+
+@register_validator("index_add")
+def _index_add(datas, attrs):
+    # positional signature (x, index, axis, value) — ADVICE r3; axis
+    # rides in datas unless the caller passed it by keyword.
+    x, index = datas[0], datas[1]
+    if "axis" in attrs:
+        axis = int(attrs["axis"])
+        value = datas[2] if len(datas) > 2 else None
+    elif len(datas) > 3:
+        axis, value = int(datas[2]), datas[3]
+    else:
+        return
+    if not _int_dtype(index):
+        _fail("index_add",
+              f"the index must be an integer dtype, got "
+              f"{getattr(index, 'dtype', None)}")
+    if _ndim(index) > 1:
+        _fail("index_add",
+              f"the index should be a 0-D or 1-D tensor, got rank "
+              f"{_ndim(index)}")
+    nd = max(_ndim(x), 1)
+    ax = _axis_in("index_add", axis, nd)
+    xs, vs = _shape(x), _shape(value)
+    if value is not None and len(vs) == len(xs) and xs:
+        n_idx = _shape(index)[0] if _ndim(index) == 1 else 1
+        expect = xs[:ax] + (n_idx,) + xs[ax + 1:]
+        if vs != expect:
+            _fail("index_add",
+                  f"the value's shape {list(vs)} must match the "
+                  f"input's except along axis {ax} where it must "
+                  f"equal the index length ({n_idx}); expected "
+                  f"{list(expect)}")
+
+
 @register_validator("masked_select")
 def _masked_select(datas, attrs):
     # host-side op: the wrapper calls validate() directly (it never

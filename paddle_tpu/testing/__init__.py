@@ -6,5 +6,6 @@ checkpoint/commit path, the DataLoader worker loop and the train step
 """
 from . import faults  # noqa: F401
 from . import load  # noqa: F401
+from ..analysis import CountedJit, DispatchAuditor  # noqa: F401
 from .faults import InjectedFault  # noqa: F401
 from .load import LoadSpec, generate_load, run_load  # noqa: F401

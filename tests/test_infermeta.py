@@ -602,3 +602,143 @@ def test_bincount_rejects_weight_shape_mismatch():
 def test_bincount_rejects_negative_minlength():
     with pytest.raises(InvalidArgumentError, match="minlength"):
         paddle.bincount(_i64(0, 1), minlength=-1)
+
+
+# -- batch 6 (r13): logsumexp / cumprod / strided_slice / gather_nd /
+#    dot / addmm / searchsorted / index_add ----------------------------------
+
+
+def test_logsumexp_accepts_axis_tuple():
+    out = paddle.logsumexp(_f32(2, 3, 4), axis=(0, 2))
+    assert list(out.shape) == [3]
+
+
+def test_logsumexp_rejects_axis_out_of_range():
+    with pytest.raises(InvalidArgumentError, match="range"):
+        paddle.logsumexp(_f32(2, 3), axis=2)
+
+
+def test_logsumexp_rejects_duplicate_axes():
+    with pytest.raises(InvalidArgumentError, match="duplicate"):
+        paddle.logsumexp(_f32(2, 3), axis=(1, -1))
+
+
+def test_cumprod_accepts_valid_dim():
+    x = np.random.rand(2, 3).astype(np.float32) + 0.5
+    out = paddle.cumprod(paddle.to_tensor(x), dim=1)
+    np.testing.assert_allclose(out.numpy(), np.cumprod(x, 1), rtol=1e-6)
+
+
+def test_cumprod_rejects_dim_out_of_range():
+    with pytest.raises(InvalidArgumentError, match="range"):
+        paddle.cumprod(_f32(2, 3), dim=-3)
+
+
+def test_strided_slice_accepts_valid_slices():
+    out = paddle.strided_slice(_f32(4, 6), axes=[0, 1], starts=[0, 1],
+                               ends=[4, 6], strides=[2, 2])
+    assert list(out.shape) == [2, 3]
+
+
+def test_strided_slice_rejects_length_mismatch():
+    with pytest.raises(InvalidArgumentError, match="lengths"):
+        paddle.strided_slice(_f32(4, 6), axes=[0, 1], starts=[0],
+                             ends=[4, 6], strides=[1, 1])
+
+
+def test_strided_slice_rejects_zero_stride():
+    with pytest.raises(InvalidArgumentError, match="non-zero"):
+        paddle.strided_slice(_f32(4), axes=[0], starts=[0], ends=[4],
+                             strides=[0])
+
+
+def test_strided_slice_rejects_duplicate_axes():
+    with pytest.raises(InvalidArgumentError, match="duplicate"):
+        paddle.strided_slice(_f32(4, 6), axes=[1, -1], starts=[0, 0],
+                             ends=[2, 2], strides=[1, 1])
+
+
+def test_gather_nd_accepts_valid_index():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    idx = paddle.to_tensor(np.array([[0, 1], [2, 3]], np.int64))
+    out = paddle.gather_nd(paddle.to_tensor(x), idx)
+    np.testing.assert_array_equal(out.numpy(), [1.0, 11.0])
+
+
+def test_gather_nd_rejects_float_index():
+    with pytest.raises(InvalidArgumentError, match="integer"):
+        paddle.gather_nd(_f32(3, 4), _f32(2, 2))
+
+
+def test_gather_nd_rejects_wide_index_tail():
+    idx = paddle.to_tensor(np.zeros((2, 3), np.int64))
+    with pytest.raises(InvalidArgumentError, match="last dimension"):
+        paddle.gather_nd(_f32(3, 4), idx)
+
+
+def test_dot_accepts_matching_1d():
+    x = np.random.randn(5).astype(np.float32)
+    y = np.random.randn(5).astype(np.float32)
+    out = paddle.dot(paddle.to_tensor(x), paddle.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), np.dot(x, y), rtol=1e-5)
+
+
+def test_dot_rejects_shape_mismatch():
+    with pytest.raises(InvalidArgumentError, match="same shape"):
+        paddle.dot(_f32(5), _f32(4))
+
+
+def test_dot_rejects_3d_input():
+    with pytest.raises(InvalidArgumentError, match="1-D or 2-D"):
+        paddle.dot(_f32(2, 3, 4), _f32(2, 3, 4))
+
+
+def test_addmm_accepts_broadcast_bias():
+    out = paddle.addmm(_f32(1, 4), _f32(2, 3), _f32(3, 4),
+                       beta=0.5, alpha=2.0)
+    assert list(out.shape) == [2, 4]
+
+
+def test_addmm_rejects_contraction_mismatch():
+    with pytest.raises(InvalidArgumentError, match="width"):
+        paddle.addmm(_f32(2, 4), _f32(2, 3), _f32(5, 4))
+
+
+def test_addmm_rejects_unbroadcastable_input():
+    with pytest.raises(InvalidArgumentError, match="broadcast"):
+        paddle.addmm(_f32(3, 4), _f32(2, 3), _f32(3, 4))
+
+
+def test_searchsorted_accepts_1d_sequence():
+    seq = np.array([1.0, 3.0, 5.0], np.float32)
+    vals = np.array([0.0, 4.0], np.float32)
+    out = paddle.searchsorted(paddle.to_tensor(seq),
+                              paddle.to_tensor(vals))
+    np.testing.assert_array_equal(out.numpy(), np.searchsorted(seq, vals))
+
+
+def test_searchsorted_rejects_2d_sequence():
+    with pytest.raises(InvalidArgumentError, match="1-D"):
+        paddle.searchsorted(_f32(2, 3), _f32(2))
+
+
+def test_index_add_accepts_valid_call():
+    x = np.zeros((3, 2), np.float32)
+    out = paddle.index_add(paddle.to_tensor(x), _i64(1, 1), 0,
+                           paddle.to_tensor(np.ones((2, 2), np.float32)))
+    np.testing.assert_array_equal(out.numpy(), [[0, 0], [2, 2], [0, 0]])
+
+
+def test_index_add_rejects_float_index():
+    with pytest.raises(InvalidArgumentError, match="integer"):
+        paddle.index_add(_f32(3, 2), _f32(2), 0, _f32(2, 2))
+
+
+def test_index_add_rejects_axis_out_of_range():
+    with pytest.raises(InvalidArgumentError, match="range"):
+        paddle.index_add(_f32(3, 2), _i64(0, 1), 2, _f32(2, 2))
+
+
+def test_index_add_rejects_value_shape_mismatch():
+    with pytest.raises(InvalidArgumentError, match="index length"):
+        paddle.index_add(_f32(3, 2), _i64(0, 1), 0, _f32(3, 2))

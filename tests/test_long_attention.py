@@ -87,11 +87,16 @@ def test_sdpa_auto_routes_long_kernel():
     q, k, v = mk(0), mk(1), mk(2)
     from paddle_tpu.ops.nn_ops import _sdpa_plain
 
-    jaxpr = str(jax.make_jaxpr(
+    from paddle_tpu.analysis import walker
+
+    jaxpr = jax.make_jaxpr(
         lambda qd, kd, vd: _sdpa_plain(qd, kd, vd, causal=True,
                                        impl="auto"))(
-        q._data, k._data, v._data))
-    assert "long_attention" in jaxpr
+        q._data, k._data, v._data)
+    # The kernel announces itself via pallas_call's name_and_src_info;
+    # walker.name_inventory surfaces it without string-ifying the jaxpr.
+    names = walker.name_inventory(jaxpr)
+    assert any("long_attention" in s for s in names), sorted(names)
     out_auto = F.scaled_dot_product_attention(q, k, v, is_causal=True)
     out_ein = F.scaled_dot_product_attention(q, k, v, is_causal=True,
                                              impl="einsum")

@@ -225,21 +225,10 @@ def test_ep_alltoall_fused_matches_einsum():
 
 
 # -- HLO/jaxpr inspection: no dense [T, E, C] mask anywhere -----------------
-
-def _max_var_size(jaxpr):
-    """Largest intermediate array size anywhere in the jaxpr tree."""
-    best = 0
-    for eqn in jaxpr.eqns:
-        for v in list(eqn.invars) + list(eqn.outvars):
-            aval = getattr(v, "aval", None)
-            if aval is not None and hasattr(aval, "shape"):
-                best = max(best, int(np.prod(aval.shape or (1,))))
-        for sub in eqn.params.values():
-            inner = getattr(sub, "jaxpr", None)
-            if inner is not None:
-                best = max(best, _max_var_size(inner))
-    return best
-
+#
+# The jaxpr walk itself now lives in paddle_tpu.analysis (walker +
+# DenseMaterializationCheck); these tests drive the shared analyzer
+# instead of a hand-rolled tree walk.
 
 def test_fused_dispatch_has_no_dense_mask_intermediate():
     """The acceptance-criteria assertion: tracing the fused body at
@@ -247,6 +236,7 @@ def test_fused_dispatch_has_no_dense_mask_intermediate():
     (the einsum path's dispatch [T,E,C] / slot_mask [T,k,E,C] would
     be exactly that); the einsum trace trips the same detector, which
     proves the detector sees through the whole jaxpr tree."""
+    from paddle_tpu.analysis import walker
     T, H, E, k, C, F = 96, 16, 8, 2, 5, 24
     tokens = jnp.asarray(np.random.RandomState(1).randn(T, H), jnp.float32)
     wg = jnp.asarray(np.random.RandomState(2).randn(H, E), jnp.float32)
@@ -261,8 +251,47 @@ def test_fused_dispatch_has_no_dense_mask_intermediate():
                 *args, axis_name=None, n=1, num_experts=E, top_k=k,
                 capacity=C, activation="gelu", gate_kind="gshard",
                 impl=impl)
-        return jax.make_jaxpr(f)(tokens, wg, w1, b1, w2, b2).jaxpr
+        return jax.make_jaxpr(f)(tokens, wg, w1, b1, w2, b2)
 
     dense_mask = T * E * C
-    assert _max_var_size(run("einsum")) >= dense_mask  # detector sanity
-    assert _max_var_size(run("fused")) < dense_mask
+    assert walker.max_intermediate_elems(run("einsum")) >= dense_mask
+    assert walker.max_intermediate_elems(run("fused")) < dense_mask
+
+
+def test_registered_moe_contract_flags_einsum_dense_mask():
+    """lint-level version: the 'moe.ep_alltoall' contract an EP layer
+    registers at build time carries the dense-mask ceiling when
+    moe_impl='fused' (clean lint), and linting the einsum body against
+    that same ceiling fires the dense-materialization check."""
+    from paddle_tpu import analysis
+
+    mesh = ProcessMesh(list(range(8)), dim_names=["ep"])
+    paddle.seed(30)
+    layer = MoELayer(d_model=16, d_hidden=32, num_experts=8,
+                     gate="gshard", top_k=2, capacity_factor=1.25,
+                     mesh=mesh, ep_axis="ep", dispatch_mode="alltoall",
+                     moe_impl="fused")
+    layer(paddle.randn([2, 8, 16]))
+    contract = analysis.registered()["moe.ep_alltoall"]
+    assert contract.max_intermediate_bytes is not None
+    report = analysis.lint_contract(contract)
+    assert report.ok, str(report)
+
+    # Same ceiling, einsum body: the dense [T, E, C] dispatch mask is
+    # exactly the intermediate the check exists to reject.
+    layer_e = MoELayer(d_model=16, d_hidden=32, num_experts=8,
+                       gate="gshard", top_k=2, capacity_factor=1.25,
+                       mesh=mesh, ep_axis="ep", dispatch_mode="alltoall",
+                       moe_impl="einsum")
+    layer_e(paddle.randn([2, 8, 16]))
+    einsum_contract = analysis.registered()["moe.ep_alltoall"]
+    bad = analysis.ProgramContract(
+        name="moe.ep_alltoall.einsum", fn=einsum_contract.resolve_fn(),
+        args=einsum_contract.args,
+        max_intermediate_bytes=contract.max_intermediate_bytes,
+        donation_floor_bytes=None,
+        expected_collectives=einsum_contract.expected_collectives)
+    report = analysis.lint_contract(bad)
+    assert not report.ok
+    assert any(v.check == "dense-materialization"
+               for v in report.violations), str(report)

@@ -185,16 +185,20 @@ def test_verify_is_one_jitted_call_per_step(model):
     count well above it (multi-token steps), and the program is traced
     at most once per distinct batch size — nothing retraces per token,
     which is what a hidden [B, k] host loop would do."""
+    from paddle_tpu.analysis import DispatchAuditor
+
     eng = ServingEngine(model, spec_decode="ngram", **ENGINE_KW)
     eng.submit(CYCLING_PROMPT, max_new_tokens=50)
     eng.submit(np.tile(CYCLING_PROMPT, 2), max_new_tokens=50)
-    eng.run()
-    ex = eng.executor
-    assert ex.verify_dispatches == eng.metrics.spec_steps
-    assert ex.verify_dispatches > 0
-    # one trace per distinct running-batch size [1..max_seqs], ever
-    assert ex.verify_traces <= ENGINE_KW["max_seqs"]
-    assert eng.metrics.decode_tokens > ex.verify_dispatches
+    # DispatchAuditor owns the counting now — one trace per distinct
+    # running-batch size [1..max_seqs] ever, and the dispatch total is
+    # checked against the engine's own spec-step metric on exit.
+    with DispatchAuditor(eng.executor.programs["verify"],
+                         max_traces=ENGINE_KW["max_seqs"]) as audit:
+        eng.run()
+        assert audit.dispatches > 0
+        audit.expect(dispatches=eng.metrics.spec_steps)
+    assert eng.metrics.decode_tokens > eng.metrics.spec_steps
 
 
 # -- seeded load: preemption + eviction + prefix hits + spec ------------

@@ -1,0 +1,79 @@
+"""make lint-graph — lint every registered hot program on CPU.
+
+Builds the framework's hot programs exactly the way the tests do (tiny
+llama + CompiledTrainStep, the serving engine's five executor programs,
+the fused-MoE all-to-all body), then runs the graph-contract linter
+(paddle_tpu.analysis) over the whole registry, HLO host-sync scan
+included.  Exits non-zero on any violation — wired into verify-fast.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+
+
+def build_programs():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import ProcessMesh
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+    from paddle_tpu.inference.server import ServingEngine
+    from paddle_tpu.models import (
+        CompiledTrainStep, LlamaConfig, LlamaForCausalLM)
+
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+
+    # train.step / train.guarded_step — one real step captures the
+    # batch shapes the lazy contract args wait for.
+    step = CompiledTrainStep(model, lr=1e-3)
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (4, 32)).astype(np.int64)
+    step.step(ids, ids)
+
+    # serve.prefill / prefill_chunk / decode / decode_n / verify —
+    # contracts register inside the executor's constructor.
+    engine = ServingEngine(model, max_seqs=2, page_size=4, max_len=128)
+
+    # moe.ep_alltoall — the fused shard_map body over the ep=8 mesh.
+    mesh = ProcessMesh(list(range(8)), dim_names=["ep"])
+    moe = MoELayer(d_model=16, d_hidden=32, num_experts=8,
+                   gate="gshard", top_k=2, capacity_factor=1.25,
+                   mesh=mesh, ep_axis="ep", dispatch_mode="alltoall",
+                   moe_impl="fused")
+    moe._ep_opdef()
+    return step, engine, moe  # keep owners alive through the lint
+
+
+def main():
+    owners = build_programs()
+    from paddle_tpu import analysis
+
+    report = analysis.lint_all(hlo=True)
+    print(report)
+    for name in sorted(analysis.registered()):
+        mark = ("SKIP" if name in report.skipped else
+                "FAIL" if any(v.program == name
+                              for v in report.violations) else "ok")
+        print(f"  [{mark:>4}] {name}")
+    del owners
+    if report.skipped:
+        print(f"error: {len(report.skipped)} program(s) skipped "
+              f"(shapes never captured)", file=sys.stderr)
+        return 1
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
