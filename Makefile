@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: smoke test test-fast verify-fast lint-graph bench
+.PHONY: smoke test test-fast verify-fast lint-graph obs-check bench
 
 # <3 min sanity gate: import + one eager op, one jitted llama forward
 # step (the driver's entry()), and a 2-virtual-device multichip train
@@ -40,7 +40,9 @@ smoke:
 		tests/test_serving_scheduler.py \
 		tests/test_load_harness.py \
 		tests/test_prefix_cache.py \
-		tests/test_spec_decode.py
+		tests/test_spec_decode.py \
+		tests/test_obs.py
+	$(MAKE) obs-check
 
 # Fast lane — must be green before any snapshot commit (see README).
 test-fast:
@@ -58,6 +60,12 @@ test:
 # inventory — plus the lowered-HLO host-sync scan.
 lint-graph:
 	JAX_PLATFORMS=cpu $(PY) tools/lint_graph.py
+
+# Telemetry end-to-end smoke: guarded train step + seeded serving load
+# under PT_OBS=on, then schema checks over the Prometheus exposition,
+# the Chrome trace (trace IDs across a preemption) and a flight dump.
+obs-check:
+	JAX_PLATFORMS=cpu $(PY) tools/obs_dump.py
 
 # Fast lane + regression gate: fails ONLY on failures not recorded in
 # tools/fastlane_baseline.txt, so a dirty-but-known lane never blocks

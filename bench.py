@@ -248,6 +248,8 @@ def main():
     # persistent compile cache holds the programs.
     _extend("graph_lint", "PT_BENCH_SKIP_LINT", _bench_graph_lint,
             120, 40)
+    _extend("obs_overhead", "PT_BENCH_SKIP_OBS", _bench_obs_overhead,
+            120, 40)
     _extend("resnet50", "PT_BENCH_SKIP_RESNET", _bench_resnet, 150, 40)
     _extend("bert_base_squad", "PT_BENCH_SKIP_BERT", _bench_bert, 200, 50)
     _extend("detection_amp_o2", "PT_BENCH_SKIP_DET", _bench_detection,
@@ -604,6 +606,50 @@ def _bench_graph_lint(jax):
             "violations": len(report.violations),
             "skipped": len(report.skipped),
             "lint_s": round(dt, 2)}
+
+
+def _bench_obs_overhead(jax):
+    """Telemetry tax A/B: identical tiny-llama train steps with the
+    obs plane off vs on (wall clock, real producers — spans, counters,
+    step-wall histogram).  The acceptance target for the unified
+    telemetry layer is on/off <= 1.03; a larger ratio in the artifact
+    means a producer left allocation or a clock read on the hot path."""
+    import gc
+
+    import paddle_tpu as paddle
+    from paddle_tpu import obs
+    from paddle_tpu.models import (
+        CompiledTrainStep, LlamaConfig, LlamaForCausalLM)
+
+    ids = np.random.RandomState(0).randint(
+        0, 2048, (8, 128)).astype(np.int64)
+
+    def _measure(mode):
+        obs.configure(mode=mode)   # producers cache at construction
+        paddle.seed(0)
+        cfg = LlamaConfig(vocab_size=2048, hidden_size=256,
+                          intermediate_size=704, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=4,
+                          max_position_embeddings=256)
+        step = CompiledTrainStep(LlamaForCausalLM(cfg), lr=1e-3)
+        step.step(ids, ids)        # compile + settle
+        n = 30
+        t0 = time.perf_counter()
+        for _ in range(n):
+            step.step(ids, ids)
+        dt = (time.perf_counter() - t0) / n
+        del step
+        gc.collect()
+        return dt
+
+    try:
+        off_s = _measure("off")
+        on_s = _measure("on")
+    finally:
+        obs.reset()                # back to the PT_OBS env default
+    return {"step_off_ms": round(off_s * 1e3, 3),
+            "step_on_ms": round(on_s * 1e3, 3),
+            "on_off_ratio": round(on_s / off_s, 4)}
 
 
 def _bench_serving(jax):

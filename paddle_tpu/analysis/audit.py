@@ -14,6 +14,7 @@ import functools
 
 import jax
 
+from .. import obs
 from .contract import GraphContractError
 
 
@@ -35,10 +36,21 @@ class CountedJit:
         self.dispatches = 0
         self._fn = fn
         self.donate_argnums = tuple(donate_argnums)
+        self._obs = obs.handle()
 
         @functools.wraps(fn)
         def counted(*args, **kwargs):
             self.traces += 1
+            h = self._obs
+            if h is not None:
+                # a (re)trace is the compile event production debugging
+                # cares about: journal it and count per program
+                h.recorder.record("jit.trace", program=self.name,
+                                  traces=self.traces)
+                h.registry.counter(
+                    "jit_traces_total",
+                    "XLA traces (compiles/retraces) per program",
+                    labels=("program",)).labels(program=self.name).inc()
             return fn(*args, **kwargs)
 
         self._jit = jax.jit(counted,
@@ -52,6 +64,12 @@ class CountedJit:
 
     def __call__(self, *args, **kwargs):
         self.dispatches += 1
+        h = self._obs
+        if h is not None:
+            h.registry.counter(
+                "jit_dispatches_total",
+                "Jitted program dispatches per program",
+                labels=("program",)).labels(program=self.name).inc()
         return self._jit(*args, **kwargs)
 
     def lower(self, *args, **kwargs):

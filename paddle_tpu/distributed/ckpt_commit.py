@@ -190,11 +190,29 @@ class CheckpointManager:
             if self.rank == self.coordinator_rank:
                 self._commit(step)
 
+        from .. import obs
+
+        h = obs.handle()
+        if h is not None:
+            h.recorder.record("ckpt.save", step=int(step),
+                              async_save=bool(async_save))
+            h.registry.counter(
+                "ckpt_saves_total",
+                "Checkpoint saves entering the commit protocol").inc()
         if async_save:
             handle = AsyncSaveHandle(_job)
             self._inflight = handle
             return handle
-        _job()
+        t0 = h.clock() if h is not None else None
+        sp = (h.tracer.span("ckpt.save", cat="train", step=int(step))
+              if h is not None else obs.NULL_SPAN)
+        with sp:
+            _job()
+        if h is not None:
+            h.registry.histogram(
+                "ckpt_save_wall_s",
+                "Host wall time of a synchronous checkpoint "
+                "save+commit").observe(h.clock() - t0)
         return _DoneHandle()
 
     def _clear_rank_files(self, tmp):

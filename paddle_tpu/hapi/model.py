@@ -13,10 +13,29 @@ import os
 
 import numpy as np
 
+from .. import obs
 from ..core.tensor import Tensor
 from ..metric import Metric
 from ..nn.layers import Layer
 from .callbacks import Callback, CallbackList, ModelCheckpoint, ProgBarLogger
+
+
+def _timed_batches(loader):
+    """Iterate ``loader``, timing each ``next()`` under a
+    ``train.data_wait`` span when telemetry is on — input starvation
+    becomes visible as wide data-wait slices in the trace."""
+    it = iter(loader)
+    while True:
+        h = obs.handle()
+        try:
+            if h is not None:
+                with h.tracer.span("train.data_wait", cat="train"):
+                    batch = next(it)
+            else:
+                batch = next(it)
+        except StopIteration:
+            return
+        yield batch
 
 
 def _to_list(x):
@@ -270,14 +289,20 @@ class Model:
             cbk.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
-            for step, batch in enumerate(loader):
+            for step, batch in enumerate(_timed_batches(loader)):
                 cbk.on_train_batch_begin(step)
                 ins, labs = self._split_batch(batch)
-                if guardian is not None:
-                    loss, metrics = self._guarded_train_batch(
-                        guardian, ins, labs)
-                else:
-                    loss, metrics = self.train_batch(ins, labs)
+                h = obs.handle()
+                sp = (h.tracer.span("train.fit_step", cat="train",
+                                    epoch=epoch, step=step)
+                      if h is not None else obs.NULL_SPAN)
+                with sp:
+                    if guardian is not None:
+                        loss, metrics = self._guarded_train_batch(
+                            guardian, ins, labs)
+                    else:
+                        loss, metrics = self.train_batch(ins, labs)
+                    sp.set(loss=float(loss))
                 logs = {"loss": loss, **metrics}
                 cbk.on_train_batch_end(step, logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:

@@ -338,6 +338,15 @@ class PagedKVCache:
         self.page_table[seq, slot] = new
         self.page_refs[old] -= 1
         self.cow_count += 1
+        from .. import obs as _obs
+
+        h = _obs.handle()
+        if h is not None:
+            h.recorder.record("kv.cow", seq=seq, slot=slot,
+                              old_page=old, new_page=new)
+            h.registry.counter(
+                "kv_cow_copies_total",
+                "Copy-on-write duplications of shared KV pages").inc()
         _faults.fire("prefix.cow", "after")
 
     def _plan_missing(self, seq: int, new_len: int):

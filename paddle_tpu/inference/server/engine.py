@@ -39,12 +39,16 @@ class ServingEngine:
                  dtype=jnp.float32, num_pages=None, policy="fifo",
                  prefill_chunk=None, eos_token_id=None,
                  max_preemptions=4, prefix_cache=None,
-                 spec_decode=None):
+                 spec_decode=None, clock=None):
         self.executor = PagedExecutor(
             model, max_seqs=max_seqs, page_size=page_size,
             max_len=max_len, dtype=dtype, num_pages=num_pages)
+        # clock: injectable wall-clock source for the SLO metrics and
+        # per-request timestamps (default time.perf_counter; seeded
+        # tests pass obs.LogicalClock() for exact ms percentiles)
         self.metrics = EngineMetrics(
-            max_seqs=max_seqs, num_pages=self.executor.cache.num_pages)
+            max_seqs=max_seqs, num_pages=self.executor.cache.num_pages,
+            clock=clock)
         # prefix_cache: None = follow PT_PREFIX_CACHE (default off,
         # bit-exact legacy path); True/False force it (bench A/B)
         if prefix_cache is None:
@@ -92,7 +96,8 @@ class ServingEngine:
             raise ValueError(f"duplicate request id {rid!r}")
         req = Request(rid, prompt_ids, max_new_tokens=max_new_tokens,
                       priority=priority, deadline=deadline,
-                      on_token=on_token, arrival_seq=self._next_rid)
+                      on_token=on_token, arrival_seq=self._next_rid,
+                      clock=self.metrics.clock)
         self._next_rid += 1
         if len(req.prompt_ids) == 0:
             raise ValueError("prompt_ids must be non-empty")

@@ -13,6 +13,7 @@ import time
 
 import numpy as np
 
+from ... import obs
 from .request import RequestState
 
 
@@ -23,12 +24,33 @@ def _pct(values, q):
     return float(np.percentile(np.asarray(vals, np.float64), q))
 
 
-class EngineMetrics:
-    """Accumulates per-request and engine-level serving statistics."""
+#: logical-step buckets for the step-denominated histograms (a tick is
+#: an iteration, not a duration — latency buckets would be nonsense).
+_STEP_BUCKETS = (0, 1, 2, 4, 8, 16, 32, 64, 128, 256)
 
-    def __init__(self, max_seqs: int, num_pages: int):
+
+class EngineMetrics:
+    """Accumulates per-request and engine-level serving statistics.
+
+    ``clock`` is injectable (default ``time.perf_counter``) so seeded
+    load tests can assert the ms percentiles exactly — pass
+    ``obs.LogicalClock()`` and every TTFT/TPOT read is deterministic.
+    When telemetry is on and no clock is given, the obs bundle's clock
+    is used so the SLO numbers and the trace timestamps share one
+    timeline.  When telemetry is on, every hook also publishes into
+    the process-wide metric registry (``serve_*`` families); the
+    ``stats()`` dict API is unchanged.
+    """
+
+    def __init__(self, max_seqs: int, num_pages: int, clock=None):
         self.max_seqs = max_seqs
         self.num_pages = num_pages
+        self._obs = obs.handle()
+        if clock is None:
+            clock = (self._obs.clock if self._obs is not None
+                     else time.perf_counter)
+        self.clock = clock
+        self._declare_metrics()
         self.steps = 0
         self.busy_steps = 0           # steps with >= 1 in-flight request
         self.decode_tokens = 0
@@ -48,15 +70,77 @@ class EngineMetrics:
                              if s.value not in ("queued", "prefilling",
                                                 "running")}
         self._completed = []          # per-request metric dicts
-        self._t_start = time.perf_counter()
+        self._t_start = self.clock()
         self._t_last = self._t_start
+
+    def _declare_metrics(self):
+        """Declare the serve_* registry families once (idempotent —
+        several engines in one process share the counters)."""
+        h = self._obs
+        if h is None:
+            return
+        r = h.registry
+        self._m = {
+            "submitted": r.counter(
+                "serve_requests_submitted_total",
+                "Requests accepted by ServingEngine.submit"),
+            "terminal": r.counter(
+                "serve_requests_total",
+                "Requests reaching a terminal state", labels=("state",)),
+            "steps": r.counter(
+                "serve_steps_total", "Scheduler iterations"),
+            "decode_tokens": r.counter(
+                "serve_decode_tokens_total", "Tokens emitted by decode"),
+            "prefill_tokens": r.counter(
+                "serve_prefill_tokens_total", "Prompt tokens prefilled"),
+            "cached_tokens": r.counter(
+                "serve_cached_tokens_total",
+                "Prompt tokens attached from the prefix cache"),
+            "prefix_hits": r.counter(
+                "serve_prefix_hits_total",
+                "Admissions that attached cached prefix pages"),
+            "evicted_pages": r.counter(
+                "serve_evicted_pages_total",
+                "Prefix-tree pages LRU-evicted"),
+            "preemptions": r.counter(
+                "serve_preemptions_total",
+                "Requests preempted for recompute"),
+            "spec_steps": r.counter(
+                "serve_spec_steps_total", "Speculative verify steps"),
+            "draft_proposed": r.counter(
+                "serve_draft_proposed_total",
+                "Speculative draft tokens offered"),
+            "draft_accepted": r.counter(
+                "serve_draft_accepted_total",
+                "Speculative draft tokens committed"),
+            "occupancy": r.gauge(
+                "serve_batch_occupancy",
+                "Decode batch fill fraction (last busy step)"),
+            "page_util": r.gauge(
+                "serve_page_utilization",
+                "KV page pool occupancy (last busy step)"),
+            "ttft_s": r.histogram(
+                "serve_ttft_seconds", "Time to first token"),
+            "tpot_s": r.histogram(
+                "serve_tpot_seconds", "Time per output token"),
+            "queue_wait": r.histogram(
+                "serve_queue_wait_steps",
+                "Scheduler iterations queued before admission",
+                buckets=_STEP_BUCKETS),
+            "ttft_steps": r.histogram(
+                "serve_ttft_steps",
+                "Scheduler iterations from submit to first token",
+                buckets=_STEP_BUCKETS),
+        }
 
     # -- event hooks (called by the scheduler) --------------------------
 
     def on_submit(self, req, step):
         self.submitted += 1
         req.submit_step = step
-        req.submit_time = time.perf_counter()
+        req.submit_time = self.clock()
+        if self._obs is not None:
+            self._m["submitted"].inc()
 
     def on_sched(self, req, step):
         if req.sched_step is None:
@@ -73,29 +157,46 @@ class EngineMetrics:
     def on_decode_step(self, slots, tokens):
         self.decode_tokens += tokens
         self.decode_slot_steps += slots
+        if self._obs is not None:
+            self._m["decode_tokens"].inc(tokens)
 
     def on_spec(self, proposed, accepted):
         self.spec_steps += 1
         self.draft_proposed += int(proposed)
         self.draft_accepted += int(accepted)
+        if self._obs is not None:
+            self._m["spec_steps"].inc()
+            self._m["draft_proposed"].inc(int(proposed))
+            self._m["draft_accepted"].inc(int(accepted))
 
     def on_prefill_tokens(self, n):
         self.prefill_tokens += n
+        if self._obs is not None:
+            self._m["prefill_tokens"].inc(n)
 
     def on_preempt(self, req):
         self.preemptions += 1
+        if self._obs is not None:
+            self._m["preemptions"].inc()
 
     def on_prefix_hit(self, tokens):
         self.prefix_hits += 1
         self.cached_tokens += int(tokens)
+        if self._obs is not None:
+            self._m["prefix_hits"].inc()
+            self._m["cached_tokens"].inc(int(tokens))
 
     def on_prefix_evict(self, n_pages):
         self.evicted_pages += int(n_pages)
+        if self._obs is not None:
+            self._m["evicted_pages"].inc(int(n_pages))
 
     def on_terminal(self, req, step):
         req.finish_step = step
-        req.finish_time = time.perf_counter()
+        req.finish_time = self.clock()
         self.state_counts[req.state.value] += 1
+        if self._obs is not None:
+            self._m["terminal"].labels(state=req.state.value).inc()
         self._completed.append({
             "queue_wait_steps": (None if req.sched_step is None
                                  or req.submit_step is None
@@ -117,15 +218,30 @@ class EngineMetrics:
                            / (len(req.generated) - 1)),
             "tokens": len(req.generated),
         })
+        if self._obs is not None:
+            d = self._completed[-1]
+            for key, hist in (("ttft_s", "ttft_s"),
+                              ("tpot_s", "tpot_s"),
+                              ("queue_wait_steps", "queue_wait"),
+                              ("ttft_steps", "ttft_steps")):
+                if d[key] is not None:
+                    self._m[hist].observe(d[key])
 
     def on_step(self, decode_batch: int, pages_used: int,
                 in_flight: int):
         self.steps += 1
-        self._t_last = time.perf_counter()
+        self._t_last = self.clock()
+        if self._obs is not None:
+            self._m["steps"].inc()
         if in_flight:
             self.busy_steps += 1
-            self.occupancy_sum += decode_batch / max(self.max_seqs, 1)
-            self.page_util_sum += pages_used / max(self.num_pages, 1)
+            occ = decode_batch / max(self.max_seqs, 1)
+            util = pages_used / max(self.num_pages, 1)
+            self.occupancy_sum += occ
+            self.page_util_sum += util
+            if self._obs is not None:
+                self._m["occupancy"].set(occ)
+                self._m["page_util"].set(util)
 
     # -- report ---------------------------------------------------------
 

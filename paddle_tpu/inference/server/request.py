@@ -51,11 +51,16 @@ class Request:
         "preempt_count", "submit_step", "submit_time", "sched_step",
         "first_token_step", "first_token_time", "finish_step",
         "finish_time", "last_token_time", "decode_time_s",
-        "cached_tokens", "draft_proposed", "draft_accepted",
+        "cached_tokens", "draft_proposed", "draft_accepted", "clock",
     )
 
     def __init__(self, rid, prompt_ids, max_new_tokens=16, priority=0,
-                 deadline=None, on_token=None, arrival_seq=0):
+                 deadline=None, on_token=None, arrival_seq=0,
+                 clock=None):
+        # same injectable clock as EngineMetrics: first/last token
+        # timestamps must come off the identical timeline the SLO
+        # percentiles are computed on
+        self.clock = time.perf_counter if clock is None else clock
         self.rid = rid
         self.prompt_ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -94,7 +99,7 @@ class Request:
     def emit(self, tok: int) -> None:
         """Record one generated token and stream it to the callback."""
         self.generated.append(int(tok))
-        now = time.perf_counter()
+        now = self.clock()
         if self.first_token_time is None:
             self.first_token_time = now
         self.last_token_time = now

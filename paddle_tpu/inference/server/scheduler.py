@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ... import obs
 from ...profiler import RecordEvent
 from ...testing import faults
 from .request import Request, RequestState
@@ -60,12 +61,20 @@ class Scheduler:
         self.running: list = []      # hold a slot, decoding
         self.tick = 0                # logical clock (iterations)
         self._last_decode_batch = 0
+        # telemetry handle cached at construction: the off path is one
+        # None check per site, and tests reconfigure obs BEFORE
+        # building the engine under test
+        self._obs = obs.handle()
 
     # -- submission boundary (called by the engine) ---------------------
 
     def add(self, req: Request) -> None:
         self.requests[req.rid] = req
         self.metrics.on_submit(req, self.tick)
+        if self._obs is not None:
+            self._obs.tracer.instant(
+                "req.submit", cat="serve", trace_id=req.rid,
+                prompt_tokens=len(req.prompt_ids), tick=self.tick)
         ex = self.executor
         budget_tokens = (ex.cache.max_pages_per_seq
                          * ex.cache.page_size)
@@ -87,7 +96,10 @@ class Scheduler:
         faults.fire("serve.step", "before")
         self.tick += 1
         emitted: dict = {}
-        with RecordEvent("serve.step"):
+        h = self._obs
+        sp = (h.tracer.span("serve.step", cat="serve", tick=self.tick)
+              if h is not None else obs.NULL_SPAN)
+        with sp, RecordEvent("serve.step"):
             self._sweep_cancelled()
             self._sweep_deadlines()
             self._decode(emitted)
@@ -154,7 +166,11 @@ class Scheduler:
         sids = sorted(r.sid for r in run)
         by_sid = {r.sid: r for r in run}
         faults.fire("serve.decode", "before")
-        with RecordEvent("serve.decode"):
+        h = self._obs
+        sp = (h.tracer.span("serve.decode", cat="serve",
+                            batch=len(sids), tick=self.tick)
+              if h is not None else obs.NULL_SPAN)
+        with sp, RecordEvent("serve.decode"):
             toks = self.executor.decode(sids)
         self._last_decode_batch = len(sids)
         self.metrics.on_decode_tokens(len(sids))
@@ -235,7 +251,12 @@ class Scheduler:
         dr = [drafts[by_sid[s].rid][:lim - 1]
               for s, lim in zip(sids, lims)]
         faults.fire("spec.verify", "before")
-        with RecordEvent("serve.decode"):
+        h = self._obs
+        sp = (h.tracer.span("serve.verify", cat="serve",
+                            batch=len(sids), tick=self.tick,
+                            drafted=sum(len(v) for v in dr))
+              if h is not None else obs.NULL_SPAN)
+        with sp, RecordEvent("serve.decode"):
             toks, accepted = ex.verify(sids, dr, lims, self.spec.k)
         self._last_decode_batch = len(sids)
         self.metrics.on_decode_step(
@@ -253,6 +274,18 @@ class Scheduler:
         faults.fire("spec.verify", "after")
         faults.fire("spec.rollback", "before")
         ex.rollback([r.sid for r in run if r.sid is not None])
+        if h is not None:
+            # per-request rollback journal: the rejected-draft tail of
+            # every verified window is trimmed here
+            for i, sid in enumerate(sids):
+                rejected = len(dr[i]) - accepted[sid]
+                if rejected > 0:
+                    h.recorder.record("spec.rollback",
+                                      rid=by_sid[sid].rid,
+                                      rejected=rejected, tick=self.tick)
+                    h.tracer.instant("req.spec_rollback", cat="serve",
+                                     trace_id=by_sid[sid].rid,
+                                     rejected=rejected)
         faults.fire("spec.rollback", "after")
 
     # -- page-aware admission -------------------------------------------
@@ -319,6 +352,12 @@ class Scheduler:
             self.queue.remove(req)
             self.prefilling.append(req)
             self.metrics.on_sched(req, self.tick)
+            if self._obs is not None:
+                self._obs.tracer.instant(
+                    "req.admit", cat="serve", trace_id=req.rid,
+                    sid=req.sid, tick=self.tick,
+                    cached_tokens=int(hit_tokens),
+                    resume=int(req.preempt_count > 0))
             faults.fire("serve.admit", "after")
 
     def _pick_next(self):
@@ -360,7 +399,13 @@ class Scheduler:
                 continue
             try:
                 faults.fire("serve.request", "before")
-                with RecordEvent("serve.prefill"):
+                h = self._obs
+                sp = (h.tracer.span("req.prefill", cat="serve",
+                                    trace_id=req.rid, start=start,
+                                    tokens=chunk, final=final,
+                                    tick=self.tick)
+                      if h is not None else obs.NULL_SPAN)
+                with sp, RecordEvent("serve.prefill"):
                     if start == 0 and final:
                         tok = self.executor.prefill(req.sid, ids)
                     else:
@@ -406,6 +451,10 @@ class Scheduler:
         emitted.setdefault(req.rid, []).append(int(tok))
         if req.first_token_step is None:
             self.metrics.on_first_token(req, self.tick)
+            if self._obs is not None:
+                self._obs.tracer.instant(
+                    "req.first_token", cat="serve", trace_id=req.rid,
+                    tick=self.tick)
         if (self.eos_token_id is not None
                 and int(tok) == int(self.eos_token_id)):
             self._finish(req, RequestState.FINISHED, "eos")
@@ -424,6 +473,14 @@ class Scheduler:
         prefilled again and decoding resumes where it left off."""
         self.metrics.on_preempt(req)
         req.preempt_count += 1
+        if self._obs is not None:
+            self._obs.recorder.record(
+                "serve.preempt", rid=req.rid, tick=self.tick,
+                preempt_count=req.preempt_count,
+                generated=len(req.generated))
+            self._obs.tracer.instant(
+                "req.preempt", cat="serve", trace_id=req.rid,
+                tick=self.tick, preempt_count=req.preempt_count)
         self._release(req)
         if req.preempt_count > self.max_preemptions:
             self._finish(req, RequestState.EVICTED, "preempt_budget")
@@ -457,3 +514,14 @@ class Scheduler:
         req.state = state
         req.finish_reason = reason
         self.metrics.on_terminal(req, self.tick)
+        if self._obs is not None:
+            self._obs.tracer.instant(
+                "req.finish", cat="serve", trace_id=req.rid,
+                tick=self.tick, state=state.value, reason=reason,
+                tokens=len(req.generated))
+            if state is RequestState.FAILED:
+                self._obs.recorder.record(
+                    "serve.request_failed", rid=req.rid,
+                    tick=self.tick, reason=reason)
+                obs.auto_dump(f"request-failed-{req.rid}",
+                              extra={"rid": req.rid, "reason": reason})

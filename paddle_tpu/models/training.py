@@ -511,6 +511,7 @@ class CompiledTrainStep:
         return loss
 
     def step(self, *batch):
+        from .. import obs
         from ..core.tensor import Tensor
         from ..optimizer.lr import LRScheduler
         from ..testing import faults
@@ -518,6 +519,8 @@ class CompiledTrainStep:
         # Host-boundary fault point: kill-and-resume tests arm this to
         # preempt the train loop between (not inside) XLA dispatches.
         faults.fire("train.step", "before")
+        h = obs.handle()
+        t0 = h.clock() if h is not None else None
         self._t += 1
         if isinstance(self.lr, LRScheduler):
             lr_val = float(self.lr())
@@ -532,9 +535,20 @@ class CompiledTrainStep:
         with jax.enable_x64(False):
             batch = [self._place_batch(b) for b in batch]
             self._capture_lint_batch(batch)
-            (self.params, self._master, self._m, self._v, loss) = \
-                self._step(self.params, self._master, self._m, self._v,
-                           jnp.asarray(self._t, jnp.float32), lr_val, *batch)
+            sp = (h.tracer.span("train.step", cat="train", t=self._t)
+                  if h is not None else obs.NULL_SPAN)
+            with sp:
+                (self.params, self._master, self._m, self._v, loss) = \
+                    self._step(self.params, self._master, self._m,
+                               self._v, jnp.asarray(self._t, jnp.float32),
+                               lr_val, *batch)
+        if h is not None:
+            h.registry.counter(
+                "train_steps_total", "Optimizer steps dispatched").inc()
+            h.registry.histogram(
+                "train_step_wall_s",
+                "Host wall time of one train step").observe(
+                    h.clock() - t0)
         faults.fire("train.step", "after")
         return loss
 
@@ -557,11 +571,14 @@ class CompiledTrainStep:
         poison the loss/grads INSIDE the gated program, so harness tests
         exercise the exact production skip path.
         """
+        from .. import obs
         from ..core.tensor import Tensor
         from ..optimizer.lr import LRScheduler
         from ..testing import faults
 
         faults.fire("train.step", "before")
+        h = obs.handle()
+        t0 = h.clock() if h is not None else None
         l_inj = 0.0
         if faults.poll("guard.nan_loss") is not None:
             l_inj = float("nan")
@@ -582,12 +599,25 @@ class CompiledTrainStep:
             batch = [self._place_batch(b) for b in batch]
             self._capture_lint_batch(batch)
             gate = jnp.asarray([threshold, l_inj, g_inj], jnp.float32)
-            (self.params, self._master, self._m, self._v, loss, gnorm,
-             ok) = self._guarded(
-                self.params, self._master, self._m, self._v,
-                jnp.asarray(self._t, jnp.float32), lr_val, gate, *batch)
+            sp = (h.tracer.span("train.guarded_step", cat="train",
+                                t=self._t)
+                  if h is not None else obs.NULL_SPAN)
+            with sp:
+                (self.params, self._master, self._m, self._v, loss,
+                 gnorm, ok) = self._guarded(
+                    self.params, self._master, self._m, self._v,
+                    jnp.asarray(self._t, jnp.float32), lr_val, gate,
+                    *batch)
         faults.fire("train.step", "after")
         loss_f, gnorm_f, ok_b = float(loss), float(gnorm), bool(ok)
+        if h is not None:
+            sp.set(loss=loss_f, ok=ok_b)
+            h.registry.counter(
+                "train_steps_total", "Optimizer steps dispatched").inc()
+            h.registry.histogram(
+                "train_step_wall_s",
+                "Host wall time of one train step").observe(
+                    h.clock() - t0)
         if not ok_b:
             # The gate kept the old state; the Adam step counter must
             # not advance either (found_inf semantics).

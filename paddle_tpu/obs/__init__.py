@@ -1,0 +1,184 @@
+"""Unified telemetry plane: metric registry + trace spans + flight
+recorder, gated by ``PT_OBS={off,on}``.
+
+One process-wide bundle (:func:`handle`) holds the three surfaces; the
+whole layer is OFF by default and the off path is one cached ``None``
+check per producer site — no allocation, no clock read, bit-identical
+behavior (asserted by tests/test_obs.py's parity test).
+
+Producer idiom (hot paths cache the handle)::
+
+    from paddle_tpu import obs
+
+    h = obs.handle()
+    if h is not None:
+        h.recorder.record("serve.preempt", rid=req.rid)
+        h.registry.counter("serve_preemptions_total").inc()
+
+    with obs.span("train.step", cat="train"):   # null ctx when off
+        ...
+
+Export surfaces:
+
+- ``obs.handle().registry.prometheus_text()`` / ``.snapshot()``
+- ``obs.handle().tracer.export_chrome(path)`` — Perfetto-viewable
+- ``obs.dump(path)`` — flight-recorder JSON lines; crash paths
+  (``GuardianAbort``, request failure) call :func:`auto_dump`, which
+  also writes a file per dump under ``$PT_OBS_DUMP_DIR`` when set.
+
+Tests swap the layer on/off in-process via :func:`configure`
+(optionally with a deterministic :class:`LogicalClock`); ``reset()``
+returns to the environment-driven default.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from .flight import FlightRecorder
+from .registry import MetricRegistry
+from .trace import LogicalClock, Span, Tracer
+
+__all__ = [
+    "FlightRecorder", "LogicalClock", "MetricRegistry", "Span",
+    "Tracer", "auto_dump", "configure", "dump", "enabled", "event",
+    "handle", "instant", "reset", "span",
+]
+
+_MODES = ("off", "on")
+
+_lock = threading.Lock()
+_handle = None        # _Obs | None (None = telemetry off)
+_initialized = False  # PT_OBS read yet?
+
+
+class _Obs:
+    """The live telemetry bundle: one clock feeding one registry, one
+    tracer, and one flight recorder."""
+
+    def __init__(self, clock=None, flight_capacity=512,
+                 trace_capacity=65536, annotate=True):
+        import time
+
+        self.clock = clock if clock is not None else time.perf_counter
+        self.registry = MetricRegistry()
+        self.tracer = Tracer(clock=self.clock, capacity=trace_capacity,
+                             annotate=annotate)
+        self.recorder = FlightRecorder(clock=self.clock,
+                                       capacity=flight_capacity)
+
+
+def _env_mode():
+    mode = os.environ.get("PT_OBS", "off").lower()
+    if mode not in _MODES:
+        raise ValueError(f"PT_OBS={mode!r}: expected off|on")
+    return mode
+
+
+def handle():
+    """The live :class:`_Obs` bundle, or ``None`` when telemetry is
+    off — the single branch every producer pays on the off path."""
+    global _handle, _initialized
+    if not _initialized:
+        with _lock:
+            if not _initialized:
+                _handle = _Obs() if _env_mode() == "on" else None
+                _initialized = True
+    return _handle
+
+
+def enabled():
+    return handle() is not None
+
+
+def configure(mode="on", clock=None, flight_capacity=512,
+              trace_capacity=65536, annotate=True):
+    """Programmatic gate (tests / bench A/B): rebuild the bundle
+    regardless of ``PT_OBS``.  Returns the new handle (None for
+    ``mode="off"``).  Producers that cached a handle at construction
+    (EngineMetrics, Scheduler) keep the old one — reconfigure BEFORE
+    building the objects under test."""
+    global _handle, _initialized
+    if mode not in _MODES:
+        raise ValueError(f"obs.configure mode={mode!r}: expected off|on")
+    with _lock:
+        _handle = (_Obs(clock=clock, flight_capacity=flight_capacity,
+                        trace_capacity=trace_capacity, annotate=annotate)
+                   if mode == "on" else None)
+        _initialized = True
+    return _handle
+
+
+def reset():
+    """Drop all telemetry state; the next :func:`handle` re-reads
+    ``PT_OBS``."""
+    global _handle, _initialized
+    with _lock:
+        _handle = None
+        _initialized = False
+
+
+# -- thin producer helpers (no-ops when off) ----------------------------
+
+class _NullSpan:
+    """Stands in for a live span when telemetry is off."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **kv):
+        return self
+
+
+NULL_SPAN = _NullSpan()
+
+
+def span(name, cat="host", trace_id=None, **args):
+    h = handle()
+    if h is None:
+        return NULL_SPAN
+    return h.tracer.span(name, cat=cat, trace_id=trace_id, **args)
+
+
+def instant(name, cat="host", trace_id=None, **args):
+    h = handle()
+    if h is not None:
+        h.tracer.instant(name, cat=cat, trace_id=trace_id, **args)
+
+
+def event(kind, **fields):
+    h = handle()
+    if h is not None:
+        h.recorder.record(kind, **fields)
+
+
+def dump(path=None, reason="manual"):
+    """Explicit flight-recorder dump; returns the JSON-lines text, or
+    ``None`` when telemetry is off."""
+    h = handle()
+    if h is None:
+        return None
+    return h.recorder.dump(path=path, reason=reason)
+
+
+def auto_dump(reason, extra=None):
+    """Crash-path dump (GuardianAbort, request failure).  Keeps the
+    text on ``recorder.last_dump``; additionally writes one file per
+    dump under ``$PT_OBS_DUMP_DIR`` when that is set."""
+    h = handle()
+    if h is None:
+        return None
+    path = None
+    dump_dir = os.environ.get("PT_OBS_DUMP_DIR")
+    if dump_dir:
+        os.makedirs(dump_dir, exist_ok=True)
+        safe = "".join(c if c.isalnum() or c in "-_." else "-"
+                       for c in reason)
+        path = os.path.join(dump_dir,
+                            f"flight-{h.recorder.dumps}-{safe}.jsonl")
+    return h.recorder.dump(path=path, reason=reason, extra=extra)

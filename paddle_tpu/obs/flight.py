@@ -1,0 +1,71 @@
+"""Flight recorder: a bounded ring-buffer journal of structured events.
+
+The black-box recorder pattern: producers append cheap dict events
+(guardian skips/rollbacks, preemptions, evictions, COW copies,
+retraces, fault firings) into a ``deque(maxlen=capacity)``; nothing is
+written anywhere until something goes wrong.  On ``GuardianAbort``, a
+request failure, or an explicit ``obs.dump()`` the ring is serialized
+as JSON lines — one header line naming the dump reason, then the last
+N events oldest-first.
+
+``seq`` increments monotonically for the life of the recorder and
+SURVIVES ring overflow, so a dump proves both the bound (at most
+``capacity`` events) and the ordering (strictly increasing ``seq``,
+ending at the global event count).
+"""
+from __future__ import annotations
+
+import json
+from collections import deque
+
+
+class FlightRecorder:
+    def __init__(self, clock, capacity=512):
+        self._clock = clock
+        self.capacity = int(capacity)
+        if self.capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._events = deque(maxlen=self.capacity)
+        self.seq = 0              # total events ever recorded
+        self.dumps = 0
+        self.last_dump = None     # text of the most recent dump
+
+    def record(self, kind, **fields):
+        self.seq += 1
+        ev = {"seq": self.seq, "ts": round(self._clock(), 6),
+              "kind": kind}
+        ev.update(fields)
+        self._events.append(ev)
+        return ev
+
+    def events(self):
+        return list(self._events)
+
+    def __len__(self):
+        return len(self._events)
+
+    def dump(self, path=None, reason="manual", extra=None):
+        """Serialize the ring as JSON lines; returns the text.  Writes
+        to ``path`` when given.  Bracketed by the ``obs.dump`` fault
+        point so crash-during-dump is itself testable."""
+        from ..testing import faults
+
+        faults.fire("obs.dump", "before", path=path)
+        header = {"flight_recorder": {
+            "reason": reason,
+            "capacity": self.capacity,
+            "total_events": self.seq,
+            "dumped": len(self._events),
+        }}
+        if extra:
+            header["flight_recorder"]["extra"] = extra
+        lines = [json.dumps(header, default=str)]
+        lines.extend(json.dumps(ev, default=str) for ev in self._events)
+        text = "\n".join(lines) + "\n"
+        if path is not None:
+            with open(path, "w") as f:
+                f.write(text)
+        self.last_dump = text
+        self.dumps += 1
+        faults.fire("obs.dump", "after", path=path)
+        return text

@@ -1,0 +1,155 @@
+"""Structured trace spans with per-request trace IDs.
+
+Spans are host-side (name, cat, ts, dur, args) records kept in a
+bounded deque and exported as Chrome-trace JSON (``{"traceEvents":
+[...]}``, timestamps in microseconds) — the format Perfetto and
+``chrome://tracing`` open directly.  Every live span also enters a
+``jax.profiler.TraceAnnotation`` so the same names appear on the
+device timeline when a ``jax.profiler.start_trace`` session is
+running: load both files in Perfetto and the host span brackets its
+device work.
+
+The clock is injectable.  ``LogicalClock`` is a deterministic
+auto-advancing counter so seeded tests assert exact timestamps and
+durations; production uses ``time.perf_counter``.
+"""
+from __future__ import annotations
+
+import json
+from collections import deque
+
+import jax
+
+
+class LogicalClock:
+    """Deterministic clock for seeded tests: every read advances by
+    ``tick``, so the n-th read is exactly ``start + n * tick`` and any
+    derived duration/percentile is a closed-form number."""
+
+    def __init__(self, start=0.0, tick=0.001):
+        self.t = float(start)
+        self.tick = float(tick)
+        self.reads = 0
+
+    def __call__(self):
+        self.reads += 1
+        self.t += self.tick
+        return self.t
+
+
+class Span:
+    """One completed span (``dur`` in seconds) or instant (``dur``
+    None).  ``args`` carries structured payload — ``trace_id`` rides
+    there so Perfetto shows it on every slice."""
+
+    __slots__ = ("name", "cat", "ts", "dur", "args")
+
+    def __init__(self, name, cat, ts, dur, args):
+        self.name = name
+        self.cat = cat
+        self.ts = ts
+        self.dur = dur
+        self.args = args
+
+    def __repr__(self):
+        kind = "instant" if self.dur is None else f"dur={self.dur:.6f}"
+        return f"Span({self.name}, {kind}, args={self.args})"
+
+
+class _LiveSpan:
+    """Context manager handed out by :meth:`Tracer.span`; completes
+    into the tracer's ring on exit.  ``set(**kv)`` attaches args only
+    known mid-span (e.g. the step's loss)."""
+
+    __slots__ = ("_tracer", "name", "cat", "args", "_t0", "_ann")
+
+    def __init__(self, tracer, name, cat, args):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._t0 = None
+        self._ann = None
+
+    def set(self, **kv):
+        self.args.update(kv)
+        return self
+
+    def __enter__(self):
+        if self._tracer.annotate:
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        self._t0 = self._tracer._clock()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = self._tracer._clock()
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+        self._tracer._push(Span(self.name, self.cat, self._t0,
+                                t1 - self._t0, self.args))
+        return False
+
+
+class Tracer:
+    """Bounded span collector + Chrome-trace exporter."""
+
+    def __init__(self, clock, capacity=65536, annotate=True):
+        self._clock = clock
+        self.capacity = int(capacity)
+        self.annotate = bool(annotate)
+        self.spans = deque(maxlen=self.capacity)
+        self.dropped = 0
+        self.pid = 0
+
+    def _push(self, span):
+        if len(self.spans) == self.spans.maxlen:
+            self.dropped += 1
+        self.spans.append(span)
+
+    def span(self, name, cat="host", trace_id=None, **args):
+        if trace_id is not None:
+            args["trace_id"] = trace_id
+        return _LiveSpan(self, name, cat, args)
+
+    def instant(self, name, cat="host", trace_id=None, **args):
+        if trace_id is not None:
+            args["trace_id"] = trace_id
+        self._push(Span(name, cat, self._clock(), None, args))
+
+    # -- export ----------------------------------------------------------
+
+    def to_chrome_events(self):
+        """Spans as Chrome-trace event dicts (ts/dur in microseconds).
+        Training spans land on tid 0, serving on tid 1, so the two
+        subsystems render as separate rows in Perfetto."""
+        events = [{"ph": "M", "name": "process_name", "pid": self.pid,
+                   "tid": 0,
+                   "args": {"name": "paddle_tpu host telemetry"}}]
+        for s in self.spans:
+            tid = 1 if s.cat.startswith("serve") else 0
+            ev = {"name": s.name, "cat": s.cat, "pid": self.pid,
+                  "tid": tid, "ts": round(s.ts * 1e6, 3),
+                  "args": dict(s.args)}
+            if s.dur is None:
+                ev["ph"] = "i"
+                ev["s"] = "t"  # thread-scoped instant
+            else:
+                ev["ph"] = "X"
+                ev["dur"] = round(s.dur * 1e6, 3)
+            events.append(ev)
+        return events
+
+    def export_chrome(self, path):
+        """Write the Chrome-trace JSON; returns ``path``.  Bracketed by
+        the ``obs.export`` fault point (serviceability tests inject a
+        raise/crash here)."""
+        from ..testing import faults
+
+        faults.fire("obs.export", "before", path=path)
+        doc = {"traceEvents": self.to_chrome_events(),
+               "displayTimeUnit": "ms"}
+        with open(path, "w") as f:
+            json.dump(doc, f, default=str)
+        faults.fire("obs.export", "after", path=path)
+        return path

@@ -861,3 +861,141 @@ def _masked_select(datas, attrs):
         _fail("masked_select",
               f"the mask {list(_shape(mask))} is not broadcast-"
               f"compatible with the input {list(_shape(x))}")
+
+
+# -- batch 7 (r14): math/selection tail toward the top-50 -------------------
+
+@register_validator("trace")
+def _trace(datas, attrs):
+    # unary.cc TraceInferMeta
+    x = datas[0]
+    nd = _ndim(x)
+    if nd < 2:
+        _fail("trace",
+              f"Input's dim is out of range (expected at least 2, but "
+              f"got {nd})")
+    a1 = _axis_in("trace", int(attrs.get("axis1", 0)), nd)
+    a2 = _axis_in("trace", int(attrs.get("axis2", 1)), nd)
+    if a1 == a2:
+        _fail("trace",
+              f"The dimensions should not be identical "
+              f"{attrs.get('axis1', 0)} vs {attrs.get('axis2', 1)}")
+
+
+@register_validator("kthvalue")
+def _kthvalue(datas, attrs):
+    # unary.cc KthvalueInferMeta
+    x = datas[0]
+    nd = max(_ndim(x), 1)
+    ax = _axis_in("kthvalue", int(attrs.get("axis", -1)), nd)
+    k = int(attrs.get("k", 1))
+    if k < 1:
+        _fail("kthvalue",
+              f"the k in the kthvalue must >= 1, but received {k}")
+    xs = _shape(x)
+    if xs and k > xs[ax]:
+        _fail("kthvalue",
+              f"the k in the kthvalue must less equal than the size of "
+              f"axis {ax} ({xs[ax]}), but received {k}")
+
+
+@register_validator("mode")
+def _mode(datas, attrs):
+    # unary.cc ModeInferMeta
+    x = datas[0]
+    _axis_in("mode", int(attrs.get("axis", -1)), max(_ndim(x), 1))
+
+
+@register_validator("index_sample")
+def _index_sample(datas, attrs):
+    # binary.cc IndexSampleInferMeta
+    x, index = datas[0], datas[1]
+    if _ndim(x) != 2:
+        _fail("index_sample",
+              f"Inputs(X) shape of IndexSample op should be 2-D, but "
+              f"got X's shape = {list(_shape(x))}")
+    if _ndim(index) != 2:
+        _fail("index_sample",
+              f"Inputs(Index) shape of IndexSample op should be 2-D, "
+              f"but got Index's shape = {list(_shape(index))}")
+    if not _int_dtype(index):
+        _fail("index_sample",
+              f"the index must be an integer dtype, got "
+              f"{getattr(index, 'dtype', None)}")
+    if _shape(x)[0] != _shape(index)[0]:
+        _fail("index_sample",
+              f"Inputs(X)'s value of dimension 0 must same with "
+              f"Inputs(Index), but X's batch is {_shape(x)[0]} and "
+              f"Index's batch is {_shape(index)[0]}")
+
+
+@register_validator("renorm")
+def _renorm(datas, attrs):
+    # unary.cc RenormInferMeta (+ the p > 0 contract of the p-norm)
+    x = datas[0]
+    _axis_in("renorm", int(attrs.get("axis", -1)), max(_ndim(x), 1))
+    p = float(attrs.get("p", 2.0))
+    if p <= 0:
+        _fail("renorm",
+              f"the p of the renorm p-norm must be positive, but "
+              f"received {p}")
+    max_norm = float(attrs.get("max_norm", 0.0))
+    if max_norm < 0:
+        _fail("renorm",
+              f"the max_norm must be non-negative, but received "
+              f"{max_norm}")
+
+
+@register_validator("cdist")
+def _cdist(datas, attrs):
+    # binary.cc CdistInferMeta
+    x, y = datas[0], datas[1]
+    if _ndim(x) < 2 or _ndim(y) < 2:
+        _fail("cdist",
+              f"the x and y must have at least 2 dimensions, got "
+              f"x{list(_shape(x))} and y{list(_shape(y))}")
+    if _shape(x)[-1] != _shape(y)[-1]:
+        _fail("cdist",
+              f"the x and y should have same value at dim -1, but got "
+              f"{_shape(x)[-1]} and {_shape(y)[-1]}")
+    p = float(attrs.get("p", 2.0))
+    if p < 0:
+        _fail("cdist",
+              f"the p must be non-negative, but received {p}")
+
+
+@register_validator("multinomial")
+def _multinomial(datas, attrs):
+    # unary.cc MultinomialInferMeta — host-side op: the wrapper calls
+    # validate() directly (sampling never goes through registry.apply)
+    x = datas[0]
+    nd = _ndim(x)
+    if nd < 1 or nd > 2:
+        _fail("multinomial",
+              f"The number of dimensions of the input probability "
+              f"distribution should be > 0 and <= 2, but got {nd}")
+    n = int(attrs.get("num_samples", 1))
+    if n < 1:
+        _fail("multinomial",
+              f"The number of samples should be > 0, but got {n}")
+    if not attrs.get("replacement", False):
+        cats = _shape(x)[-1]
+        if n > cats:
+            _fail("multinomial",
+                  f"When replacement is False, number of samples "
+                  f"should be less than or equal to the number of "
+                  f"categories ({cats}), but got {n}")
+
+
+@register_validator("histogram")
+def _histogram(datas, attrs):
+    # unary.cc HistogramInferMeta — host-side op, wrapper-invoked
+    bins = int(attrs.get("bins", 100))
+    if bins < 1:
+        _fail("histogram",
+              f"the bins should be >= 1, but received {bins}")
+    lo, hi = attrs.get("min", 0), attrs.get("max", 0)
+    if float(hi) < float(lo):
+        _fail("histogram",
+              f"max must be larger or equal to min, but received "
+              f"min {lo} and max {hi}")

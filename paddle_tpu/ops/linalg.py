@@ -295,9 +295,13 @@ _cross_op = register_op(
 
 def histogram(input, bins=100, min=0, max=0, name=None):
     from ..core.tensor import Tensor
+    from . import infermeta
     import numpy as np
 
     arr = np.asarray(input._data if isinstance(input, Tensor) else input)
+    # host path, so it never passes registry.apply's validator hook
+    infermeta.validate("histogram", (arr,),
+                       {"bins": int(bins), "min": min, "max": max})
     lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
     hist, _ = np.histogram(arr, bins=bins, range=(lo, hi))
     return Tensor(jnp.asarray(hist, dtype=jnp.int64))

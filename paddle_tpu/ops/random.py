@@ -220,7 +220,13 @@ def poisson(x, name=None):
 
 
 def multinomial(x, num_samples=1, replacement=False, name=None):
+    from ..ops import infermeta
+
     p = x._data if isinstance(x, Tensor) else x
+    # host path, so it never passes registry.apply's validator hook
+    infermeta.validate("multinomial", (p,),
+                       {"num_samples": int(num_samples),
+                        "replacement": bool(replacement)})
     key = default_generator.next_key()
     if replacement:
         idx = jax.random.categorical(

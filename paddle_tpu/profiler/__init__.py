@@ -51,14 +51,23 @@ def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
 
 
 def export_chrome_tracing(dir_name, worker_name=None):
+    """Reference profiler.export_chrome_tracing: an ``on_trace_ready``
+    handler that writes the session's host-span table as Chrome-trace
+    JSON under ``dir_name`` (one file per stop)."""
     def handler(prof):
-        pass
+        import os
+
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        prof.export(os.path.join(
+            dir_name, f"{name}_step{prof._step}.pt.trace.json"))
 
     handler._dir = dir_name
     return handler
 
 
 _EVENT_STATS = None  # {name: [count, total_s, min_s, max_s]} when active
+_EVENT_SPANS = None  # [(name, t0_s, dur_s)] while a Profiler is active
 
 
 class RecordEvent:
@@ -85,14 +94,18 @@ class RecordEvent:
 
     def end(self):
         self._ann.__exit__(None, None, None)
-        if _EVENT_STATS is not None and self._t0 is not None:
-            dt = time.perf_counter() - self._t0
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        if _EVENT_STATS is not None:
             rec = _EVENT_STATS.setdefault(self.name,
                                           [0, 0.0, float("inf"), 0.0])
             rec[0] += 1
             rec[1] += dt
             rec[2] = min(rec[2], dt)
             rec[3] = max(rec[3], dt)
+        if _EVENT_SPANS is not None:
+            _EVENT_SPANS.append((self.name, self._t0, dt))
 
 
 class Profiler:
@@ -118,11 +131,14 @@ class Profiler:
         return False
 
     def start(self):
-        global _EVENT_STATS
+        global _EVENT_STATS, _EVENT_SPANS
         _EVENT_STATS = {}
+        _EVENT_SPANS = []
         self._event_stats = None  # a restarted session must not show the
         self._step_times = []     # previous run's table/timings
-        self._last = time.perf_counter()
+        self._spans = None
+        self._t_origin = time.perf_counter()
+        self._last = self._t_origin
         if not self._timer_only:
             try:
                 jax.profiler.start_trace(self._dir)
@@ -132,9 +148,11 @@ class Profiler:
                 self._recording = False
 
     def stop(self):
-        global _EVENT_STATS
+        global _EVENT_STATS, _EVENT_SPANS
         self._event_stats = _EVENT_STATS or {}
         _EVENT_STATS = None
+        self._spans = _EVENT_SPANS or []
+        _EVENT_SPANS = None
         if self._recording:
             try:
                 jax.profiler.stop_trace()
@@ -200,11 +218,81 @@ class Profiler:
         return out
 
     def export(self, path, format="json"):
-        pass
+        """Write the session's RecordEvent span table as Chrome-trace
+        JSON (reference profiler.export; ``chrome://tracing`` /
+        Perfetto open it directly).  Spans captured live (between
+        start() and export()) are included too, so exporting inside a
+        running session works.  Returns ``path``."""
+        import json
+        import os
+
+        if format != "json":
+            raise ValueError(
+                f"unsupported export format {format!r}; only 'json' "
+                f"(chrome tracing) is implemented")
+        spans = getattr(self, "_spans", None)
+        if spans is None:
+            spans = _EVENT_SPANS or []
+        origin = getattr(self, "_t_origin", None)
+        if origin is None:
+            origin = min((t0 for _, t0, _ in spans), default=0.0)
+        events = [{"ph": "M", "name": "process_name", "pid": 0,
+                   "tid": 0, "args": {"name": "paddle.profiler host"}}]
+        for name, t0, dur in spans:
+            events.append({
+                "name": name, "ph": "X", "cat": "host", "pid": 0,
+                "tid": 0, "ts": round((t0 - origin) * 1e6, 3),
+                "dur": round(dur * 1e6, 3), "args": {},
+            })
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+        return path
+
+
+class ProfilerResult:
+    """What :func:`load_profiler_result` returns: the parsed trace with
+    the complete-event table re-exposed as ``(name, ts_us, dur_us)``
+    rows, plus a ``save`` that round-trips the file byte-compatibly."""
+
+    def __init__(self, raw):
+        self._raw = raw
+
+    @property
+    def events(self):
+        return [e for e in self._raw.get("traceEvents", [])
+                if e.get("ph") != "M"]
+
+    def span_table(self):
+        return [(e["name"], e.get("ts", 0.0), e.get("dur", 0.0))
+                for e in self.events if e.get("ph") == "X"]
+
+    def save(self, path):
+        import json
+
+        with open(path, "w") as f:
+            json.dump(self._raw, f)
+        return path
+
+    def __len__(self):
+        return len(self.events)
 
 
 def load_profiler_result(path):
-    return None
+    """Round-trip a file written by :meth:`Profiler.export` (reference
+    profiler.load_profiler_result)."""
+    import json
+
+    with open(path) as f:
+        raw = json.load(f)
+    if "traceEvents" not in raw:
+        raise ValueError(
+            f"{path!r} is not a chrome-trace export: missing "
+            f"'traceEvents'")
+    return ProfilerResult(raw)
 
 
 from .statistics import (  # noqa: E402,F401

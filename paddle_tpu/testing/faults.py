@@ -86,6 +86,10 @@ REGISTERED = {
     "spec.rollback": "the post-verify page trim (before=rejected-"
                      "draft pages still assigned, after=pages back on "
                      "the free list)",
+    "obs.dump": "one flight-recorder dump (before=ring intact, nothing "
+                "serialized; after=dump text retained/written)",
+    "obs.export": "one Chrome-trace export (before=no file, after=file "
+                  "on disk)",
 }
 
 _PHASES = ("before", "after")
@@ -189,6 +193,27 @@ def _flip_bit(path):
         os.fsync(f.fileno())
 
 
+def _journal(point, phase, action):
+    """Record a fault firing/injection into the flight recorder (when
+    telemetry is on).  Lazy import: obs imports this module at top
+    level.  obs.* points are skipped — journaling a fault fired inside
+    the dump/export path would mutate the ring mid-serialization."""
+    if point.startswith("obs."):
+        return
+    try:
+        from .. import obs
+    except ImportError:  # partial-init during interpreter teardown
+        return
+    h = obs.handle()
+    if h is not None:
+        h.recorder.record("fault.fired", point=point, phase=phase,
+                          action=action)
+        h.registry.counter(
+            "fault_fired_total",
+            "Armed PT_FAULTS specs that tripped or injected",
+            labels=("point",)).labels(point=point).inc()
+
+
 def _trip(spec, path):
     if spec.action == "delay":
         time.sleep(float(spec.arg) if spec.arg is not None else 0.05)
@@ -234,6 +259,7 @@ def fire(point, phase, path=None):
                 tripped = spec
                 break
     if tripped is not None:
+        _journal(point, phase, tripped.action)
         _trip(tripped, path)
 
 
@@ -247,6 +273,7 @@ def poll(point, phase="before"):
     if not specs:
         return None
     assert point in REGISTERED, f"unregistered fault point {point!r}"
+    hit = None
     with _lock:
         for spec in specs:
             if spec.point != point or spec.phase != phase \
@@ -254,8 +281,11 @@ def poll(point, phase="before"):
                 continue
             spec.hits += 1
             if spec.nth == "*" or spec.hits == spec.nth:
-                return spec.arg if spec.arg is not None else True
-    return None
+                hit = spec.arg if spec.arg is not None else True
+                break
+    if hit is not None:
+        _journal(point, phase, "inject")
+    return hit
 
 
 def registered_points():

@@ -41,6 +41,8 @@ from collections import deque
 
 import numpy as np
 
+from .. import obs
+
 
 class Decision(enum.Enum):
     OK = "ok"
@@ -201,8 +203,21 @@ class TrainingGuardian:
         self.total_anomalies += 1
         self._anomaly_run += 1
         self.events.append((step, monitor, float(loss)))
+        h = obs.handle()
+        if h is not None:
+            h.registry.counter(
+                "guardian_anomalies_total",
+                "Anomalous train steps by offending monitor",
+                labels=("monitor",)).labels(monitor=monitor).inc()
         if self._anomaly_run <= self._skip_budget:
             self.skips += 1
+            if h is not None:
+                h.recorder.record("guardian.skip", step=step,
+                                  monitor=monitor, loss=float(loss),
+                                  anomaly_run=self._anomaly_run)
+                h.registry.counter(
+                    "guardian_skips_total",
+                    "Train steps dropped with found_inf semantics").inc()
             return Decision.SKIP
         if self.rollbacks >= self.policy.rollback_budget \
                 or not self._can_rollback():
@@ -232,6 +247,16 @@ class TrainingGuardian:
         # The window predates the anomaly burst; after restoring to a
         # committed step those losses are the right baseline again.
         self.events.append((self.steps_seen, "rollback", committed))
+        h = obs.handle()
+        if h is not None:
+            h.recorder.record("guardian.rollback",
+                              step=self.steps_seen,
+                              committed_step=int(committed),
+                              rollbacks=self.rollbacks,
+                              skip_budget=self._skip_budget)
+            h.registry.counter(
+                "guardian_rollbacks_total",
+                "Restores to the last committed checkpoint").inc()
         print(f"[guardian] rolled back to committed step {committed} "
               f"(rollback {self.rollbacks}/"
               f"{self.policy.rollback_budget}; skip budget now "
@@ -298,6 +323,21 @@ class TrainingGuardian:
             "rollbacks": self.rollbacks,
             "events": list(self.events),
         }
+        h = obs.handle()
+        if h is not None:
+            # record the abort itself, then snapshot the ring — the
+            # flight recorder is the black box this crash is FOR
+            h.recorder.record("guardian.abort", step=step,
+                              monitor=monitor,
+                              loss=bundle["loss"],
+                              skips=self.skips,
+                              rollbacks=self.rollbacks)
+            h.registry.counter(
+                "guardian_aborts_total",
+                "GuardianAbort escalations").inc()
+            obs.auto_dump("guardian-abort",
+                          extra={"step": step, "monitor": monitor,
+                                 "loss": bundle["loss"]})
         raise GuardianAbort(diag, bundle)
 
 

@@ -742,3 +742,125 @@ def test_index_add_rejects_axis_out_of_range():
 def test_index_add_rejects_value_shape_mismatch():
     with pytest.raises(InvalidArgumentError, match="index length"):
         paddle.index_add(_f32(3, 2), _i64(0, 1), 0, _f32(3, 2))
+
+
+# -- batch 7 (r14): trace / kthvalue / mode / index_sample / renorm /
+#    cdist / multinomial / histogram -----------------------------------------
+
+
+def test_trace_accepts_offset_and_axes():
+    out = paddle.trace(_f32(3, 4), offset=1, axis1=0, axis2=1)
+    assert list(out.shape) == []
+
+
+def test_trace_rejects_1d_input():
+    with pytest.raises(InvalidArgumentError, match="at least 2"):
+        paddle.trace(_f32(3))
+
+
+def test_trace_rejects_identical_axes():
+    with pytest.raises(InvalidArgumentError, match="identical"):
+        paddle.trace(_f32(3, 4), axis1=1, axis2=-1)
+
+
+def test_kthvalue_accepts_valid_k():
+    vals, idx = paddle.kthvalue(_f32(2, 5), k=3, axis=1)
+    assert list(vals.shape) == [2]
+    assert list(idx.shape) == [2]
+
+
+def test_kthvalue_rejects_k_beyond_axis():
+    with pytest.raises(InvalidArgumentError, match="less equal"):
+        paddle.kthvalue(_f32(2, 5), k=6, axis=1)
+
+
+def test_kthvalue_rejects_nonpositive_k():
+    with pytest.raises(InvalidArgumentError, match=">= 1"):
+        paddle.kthvalue(_f32(2, 5), k=0)
+
+
+def test_mode_accepts_negative_axis():
+    vals, idx = paddle.mode(_f32(2, 5), axis=-1)
+    assert list(vals.shape) == [2]
+
+
+def test_mode_rejects_axis_out_of_range():
+    with pytest.raises(InvalidArgumentError, match="range"):
+        paddle.mode(_f32(2, 5), axis=2)
+
+
+def test_index_sample_accepts_valid_call():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    idx = paddle.to_tensor(np.array([[0, 2], [1, 1]], np.int64))
+    out = paddle.index_sample(x, idx)
+    np.testing.assert_array_equal(out.numpy(), [[0, 2], [4, 4]])
+
+
+def test_index_sample_rejects_batch_mismatch():
+    with pytest.raises(InvalidArgumentError, match="dimension 0"):
+        paddle.index_sample(_f32(3, 4),
+                            paddle.to_tensor(np.zeros((2, 2), np.int64)))
+
+
+def test_index_sample_rejects_float_index():
+    with pytest.raises(InvalidArgumentError, match="integer"):
+        paddle.index_sample(_f32(3, 4), _f32(3, 2))
+
+
+def test_renorm_accepts_valid_call():
+    out = paddle.renorm(_f32(3, 4), p=2.0, axis=0, max_norm=1.0)
+    assert list(out.shape) == [3, 4]
+
+
+def test_renorm_rejects_nonpositive_p():
+    with pytest.raises(InvalidArgumentError, match="positive"):
+        paddle.renorm(_f32(3, 4), p=0.0, axis=0, max_norm=1.0)
+
+
+def test_cdist_accepts_matching_last_dim():
+    out = paddle.cdist(_f32(3, 4), _f32(5, 4))
+    assert list(out.shape) == [3, 5]
+
+
+def test_cdist_rejects_last_dim_mismatch():
+    with pytest.raises(InvalidArgumentError, match="dim -1"):
+        paddle.cdist(_f32(3, 4), _f32(5, 3))
+
+
+def test_cdist_rejects_1d_input():
+    with pytest.raises(InvalidArgumentError, match="2 dimensions"):
+        paddle.cdist(_f32(4), _f32(5, 4))
+
+
+def test_multinomial_accepts_with_replacement():
+    p = paddle.to_tensor(np.array([0.2, 0.3, 0.5], np.float32))
+    out = paddle.multinomial(p, num_samples=5, replacement=True)
+    assert list(out.shape) == [5]
+    assert int(out.numpy().max()) <= 2
+
+
+def test_multinomial_rejects_oversampling_without_replacement():
+    p = paddle.to_tensor(np.array([0.2, 0.3, 0.5], np.float32))
+    with pytest.raises(InvalidArgumentError, match="categories"):
+        paddle.multinomial(p, num_samples=5, replacement=False)
+
+
+def test_multinomial_rejects_3d_distribution():
+    with pytest.raises(InvalidArgumentError, match="<= 2"):
+        paddle.multinomial(_f32(2, 2, 2), num_samples=1)
+
+
+def test_histogram_accepts_explicit_range():
+    x = paddle.to_tensor(np.array([0.0, 1.0, 2.0, 2.0], np.float32))
+    out = paddle.histogram(x, bins=3, min=0, max=3)
+    np.testing.assert_array_equal(out.numpy(), [1, 1, 2])
+
+
+def test_histogram_rejects_zero_bins():
+    with pytest.raises(InvalidArgumentError, match=">= 1"):
+        paddle.histogram(_f32(4), bins=0)
+
+
+def test_histogram_rejects_inverted_range():
+    with pytest.raises(InvalidArgumentError, match="larger or equal"):
+        paddle.histogram(_f32(4), bins=5, min=2, max=1)

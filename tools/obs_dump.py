@@ -1,0 +1,136 @@
+"""make obs-check — end-to-end telemetry smoke on CPU.
+
+Runs a guarded train step and a seeded serving load with PT_OBS on
+(logical clock), then validates the three export surfaces the README
+promises:
+
+1. Prometheus exposition — serving SLO, guardian, and compile/retrace
+   families present with sane values;
+2. Chrome trace — a preempted request's trace ID threads
+   submit -> admit -> prefill -> preempt -> re-admit -> finish;
+3. flight recorder — a dump carries the preemption and retrace events
+   in seq order.
+
+Exits non-zero naming every violated check — wired into ``make smoke``.
+"""
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+
+FAILURES = []
+
+
+def check(ok, what):
+    print(f"  {'ok' if ok else 'FAIL'}: {what}")
+    if not ok:
+        FAILURES.append(what)
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu import obs
+    from paddle_tpu.inference.server import RequestState, ServingEngine
+    from paddle_tpu.models import (
+        CompiledTrainStep, LlamaConfig, LlamaForCausalLM)
+
+    h = obs.configure(mode="on", clock=obs.LogicalClock())
+
+    paddle.seed(11)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+
+    # -- a couple of train steps (train.* spans + step metrics) ---------
+    step = CompiledTrainStep(model, lr=1e-3)
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (2, 16)).astype(np.int64)
+    for _ in range(2):
+        step.step(ids, ids)
+
+    # -- seeded serving load with a forced preemption -------------------
+    rng = np.random.RandomState(1)
+    eng = ServingEngine(model, max_seqs=2, page_size=4, max_len=64,
+                        num_pages=8)
+    handles = [eng.submit(rng.randint(1, 256, (n,)).astype(np.int32),
+                          max_new_tokens=8) for n in (7, 13, 21)]
+    stats = eng.run()
+
+    print("== run ==")
+    check(all(hd.state is RequestState.FINISHED for hd in handles),
+          "all requests finished")
+    check(stats["preemptions"] >= 1, "page pressure forced a preemption")
+
+    # -- 1. Prometheus exposition ---------------------------------------
+    print("== prometheus exposition ==")
+    prom = h.registry.prometheus_text()
+    for fam in ("serve_requests_submitted_total",
+                "serve_requests_total",
+                "serve_preemptions_total",
+                "serve_ttft_steps_bucket",
+                "serve_queue_wait_steps_bucket",
+                "train_steps_total",
+                "train_step_wall_s_count",
+                "jit_traces_total",
+                "jit_dispatches_total"):
+        check(fam in prom, f"family {fam}")
+    check("serve_requests_submitted_total 3" in prom,
+          "submitted counter == 3")
+    check("train_steps_total 2" in prom, "train step counter == 2")
+
+    # -- 2. Chrome trace with trace IDs across a preemption -------------
+    print("== chrome trace ==")
+    victim = next(hd for hd in handles if hd.num_preemptions >= 1)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "trace.json")
+        h.tracer.export_chrome(path)
+        doc = json.loads(open(path).read())
+    evs = doc.get("traceEvents", [])
+    check(bool(evs) and evs[0].get("ph") == "M", "meta event present")
+    names = [e["name"] for e in evs
+             if e.get("args", {}).get("trace_id") == victim.rid]
+    check(names[:1] == ["req.submit"], f"{victim.rid} starts at submit")
+    check(names[-1:] == ["req.finish"], f"{victim.rid} ends at finish")
+    want = ["req.submit", "req.admit", "req.prefill", "req.preempt",
+            "req.admit", "req.finish"]
+    it = iter(names)
+    check(all(any(n == w for n in it) for w in want),
+          f"{victim.rid} lifecycle order {want}")
+    check(any(e["name"] == "train.step" for e in evs),
+          "train.step spans exported")
+
+    # -- 3. flight recorder dump ----------------------------------------
+    print("== flight recorder ==")
+    text = obs.dump(reason="obs-check")
+    lines = text.splitlines()
+    head = json.loads(lines[0])["flight_recorder"]
+    check(head["reason"] == "obs-check", "dump header reason")
+    events = [json.loads(ln) for ln in lines[1:]]
+    kinds = [e["kind"] for e in events]
+    check("serve.preempt" in kinds, "preemption journaled")
+    check("jit.trace" in kinds, "retraces journaled")
+    seqs = [e["seq"] for e in events]
+    check(seqs == sorted(seqs), "events in seq order")
+
+    if FAILURES:
+        print(f"\nobs-check: {len(FAILURES)} check(s) FAILED")
+        for f in FAILURES:
+            print(f"  - {f}")
+        return 1
+    print(f"\nobs-check: all checks passed "
+          f"({len(evs)} trace events, {len(events)} flight events)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
