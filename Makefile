@@ -3,7 +3,8 @@
 
 PY ?= python
 
-.PHONY: smoke test test-fast verify-fast lint-graph obs-check bench
+.PHONY: smoke test test-fast verify-fast lint-graph obs-check \
+	perf-report perf-check bench
 
 # <3 min sanity gate: import + one eager op, one jitted llama forward
 # step (the driver's entry()), and a 2-virtual-device multichip train
@@ -41,7 +42,8 @@ smoke:
 		tests/test_load_harness.py \
 		tests/test_prefix_cache.py \
 		tests/test_spec_decode.py \
-		tests/test_obs.py
+		tests/test_obs.py \
+		tests/test_perf.py
 	$(MAKE) obs-check
 
 # Fast lane — must be green before any snapshot commit (see README).
@@ -67,10 +69,21 @@ lint-graph:
 obs-check:
 	JAX_PLATFORMS=cpu $(PY) tools/obs_dump.py
 
+# Per-program roofline table: analytical cost (FLOPs / HBM bytes /
+# intensity from the jaxpr cost model) vs achieved wall time for every
+# registered hot program, built live on CPU like lint-graph.
+perf-report:
+	JAX_PLATFORMS=cpu $(PY) tools/perf_report.py
+
+# Bench regression gate: newest usable BENCH_r*.json vs the previous
+# one, per-metric tolerances; fails on any regressed metric.
+perf-check:
+	$(PY) tools/check_perf.py
+
 # Fast lane + regression gate: fails ONLY on failures not recorded in
 # tools/fastlane_baseline.txt, so a dirty-but-known lane never blocks
 # unrelated work while any NEW breakage does.
-verify-fast: lint-graph
+verify-fast: lint-graph perf-check
 	$(PY) tools/check_fastlane.py
 
 bench:

@@ -66,19 +66,11 @@ def _cache_report(tag):
 
 
 def _peak_flops_per_chip():
-    import jax
+    # Single source of truth for peak figures: the perf plane's table
+    # (obs/perf.py) — bench and the runtime MFU gauges must agree.
+    from paddle_tpu.obs import perf
 
-    kind = jax.devices()[0].device_kind.lower()
-    # bf16 peak per chip.
-    table = {
-        "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
-        "v5p": 459e12, "v4": 275e12, "v6": 918e12, "v6e": 918e12,
-        "cpu": 1e12,
-    }
-    for key, val in table.items():
-        if key in kind:
-            return val
-    return 197e12
+    return perf.peak_flops_per_chip()
 
 
 def main():
@@ -258,6 +250,7 @@ def main():
     _extend("moe", "PT_BENCH_SKIP_MOE", _bench_moe, 150, 40)
     _extend("large", "PT_BENCH_SKIP_LARGE", _bench_large, 500, 120)
     _extend("sd_unet", "PT_BENCH_SKIP_UNET", _bench_unet, 250, 60)
+    return result
 
 
 def _bench_detection(jax):
@@ -457,7 +450,9 @@ def _bench_resnet(jax):
         if isinstance(ca, list):
             ca = ca[0]
         bytes_step = float(ca.get("bytes accessed", 0.0))
-        hbm_peak = 819e9  # v5e
+        from paddle_tpu.obs import perf
+
+        hbm_peak = perf.peak_hbm_bytes_s()
         out["roofline"] = {
             "xla_bytes_accessed_gb": round(bytes_step / 1e9, 2),
             "achieved_hbm_gb_s": round(bytes_step / dt / 1e9, 1),
@@ -1094,5 +1089,72 @@ def _bench_large(jax):
                        "vocab": cfg.vocab_size}}
 
 
+def _perf_md_section(n, parsed):
+    """Markdown block appended to PERF.md for one recorded round."""
+    lines = [f"\n## Round-{n} bench artifact (auto-recorded)\n"]
+    if parsed is None:
+        lines.append("Run FAILED — see `BENCH_r%02d.json` tail.\n" % n)
+        return "\n".join(lines)
+    lines.append("| metric | value |")
+    lines.append("|---|---|")
+
+    def _row(key, val):
+        lines.append(f"| {key} | {val} |")
+
+    for key in ("metric", "value", "unit", "mfu", "vs_baseline"):
+        if key in parsed:
+            _row(key, parsed[key])
+    for key, sub in sorted(parsed.items()):
+        if isinstance(sub, dict) and "value" in sub:
+            _row(f"{key}.value", sub["value"])
+        elif isinstance(sub, dict) and ("skipped" in sub
+                                        or "error" in sub):
+            _row(key, sub.get("skipped") or "ERROR")
+    lines.append("")
+    lines.append(f"Full payload: `BENCH_r{n:02d}.json` "
+                 f"(schema at the top of this file).")
+    return "\n".join(lines) + "\n"
+
+
+def _write_round(n, parsed, rc=0, tail="", root=None):
+    """Record one bench round: write ``BENCH_rNN.json`` in the driver
+    wrapper schema ({n, cmd, rc, tail, parsed}) and append the round's
+    summary section to PERF.md.  Both used to be manual — which is how
+    the trajectory went stale after r05."""
+    if root is None:
+        root = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(root, f"BENCH_r{n:02d}.json")
+    doc = {"n": int(n), "cmd": f"python bench.py --round {n}",
+           "rc": int(rc), "tail": tail[-2000:], "parsed": parsed}
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+    with open(os.path.join(root, "PERF.md"), "a") as f:
+        f.write(_perf_md_section(n, parsed))
+    print(f"wrote {path} + PERF.md section", file=sys.stderr)
+    return path
+
+
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--round", type=int, default=None, metavar="N",
+                    help="record this run as BENCH_rNN.json and append "
+                         "the PERF.md section (the first-BENCH-run-"
+                         "after-any-PR rule in README)")
+    args = ap.parse_args()
+    if args.round is None:
+        main()
+    else:
+        import traceback
+
+        rc, parsed, tail = 0, None, ""
+        try:
+            parsed = main()
+            tail = json.dumps(parsed)
+        except BaseException:
+            rc = 1
+            tail = traceback.format_exc()
+            traceback.print_exc()
+        _write_round(args.round, parsed, rc=rc, tail=tail)
+        sys.exit(rc)

@@ -33,6 +33,9 @@ from .checks import (  # noqa: F401
 from .contract import (  # noqa: F401
     GraphContractError, LintReport, ProgramContract, Violation,
 )
+from .cost import (  # noqa: F401
+    CostReport, estimate_cost, estimate_fn_cost, transformer_flops_per_token,
+)
 from .registry import (  # noqa: F401
     lint_all, lint_contract, lint_mode, lint_program, register_program,
     registered, unregister_program,

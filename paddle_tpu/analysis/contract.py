@@ -124,6 +124,7 @@ class ProgramContract:
         self.donate_argnums = tuple(int(i) for i in self.donate_argnums)
         self._fn_ref = _weak(self.fn)
         self.fn = None  # weak only: the contract must not pin the owner
+        self._cost = None
 
     def resolve_fn(self):
         return self._fn_ref()
@@ -152,6 +153,21 @@ class ProgramContract:
         if self.kwargs:
             fn = functools.partial(fn, **self.kwargs)
         return jax.make_jaxpr(fn)(*args)
+
+    def cost(self, refresh: bool = False):
+        """Analytical :class:`~paddle_tpu.analysis.cost.CostReport` at
+        the contract's shapes, cached after the first trace; None while
+        the lazy args thunk has not captured shapes (ask again after the
+        first real step) or once the program is dead."""
+        if self._cost is not None and not refresh:
+            return self._cost
+        from .cost import estimate_cost
+
+        jaxpr = self.make_jaxpr()
+        if jaxpr is None:
+            return None
+        self._cost = estimate_cost(jaxpr)
+        return self._cost
 
     def lower_text(self):
         """Lowered (StableHLO) text at the contract's shapes, for the

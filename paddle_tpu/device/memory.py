@@ -106,6 +106,24 @@ def max_memory_reserved(device=None):
     return memory_reserved(device)
 
 
+def watermarks(device=None):
+    """One-call HBM snapshot for the perf plane: current / peak /
+    limit bytes.  Costs one ``memory_stats()`` on PJRT backends; on
+    backends without stats it walks ``jax.live_arrays()`` — callers on
+    hot paths must throttle (obs.perf samples every N steps)."""
+    dev = _device(device)
+    cur, st = _bytes_in_use(dev)
+    key = repr(dev)
+    _peak[key] = max(_peak.get(key, 0), cur)
+    if st and key not in _baseline_active:
+        peak = int(st.get("peak_bytes_in_use", cur))
+    else:
+        peak = _peak[key]
+    limit = int(st.get("bytes_limit", 0)) if st else 0
+    return {"bytes_in_use": int(cur), "peak_bytes_in_use": peak,
+            "bytes_limit": limit}
+
+
 def get_device_properties(device=None):
     dev = _device(device)
     st = dev.memory_stats() or {}

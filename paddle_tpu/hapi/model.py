@@ -20,19 +20,24 @@ from ..nn.layers import Layer
 from .callbacks import Callback, CallbackList, ModelCheckpoint, ProgBarLogger
 
 
-def _timed_batches(loader):
+def _timed_batches(loader, timer=None):
     """Iterate ``loader``, timing each ``next()`` under a
     ``train.data_wait`` span when telemetry is on — input starvation
-    becomes visible as wide data-wait slices in the trace."""
+    becomes visible as wide data-wait slices in the trace.  ``timer``
+    (an ``obs.perf.StepTimer``) additionally accumulates the wait into
+    the step's ``data_wait`` phase."""
     it = iter(loader)
     while True:
         h = obs.handle()
         try:
-            if h is not None:
-                with h.tracer.span("train.data_wait", cat="train"):
+            ph = (timer.phase("data_wait") if timer is not None
+                  else obs.NULL_SPAN)
+            with ph:
+                if h is not None:
+                    with h.tracer.span("train.data_wait", cat="train"):
+                        batch = next(it)
+                else:
                     batch = next(it)
-            else:
-                batch = next(it)
         except StopIteration:
             return
         yield batch
@@ -283,13 +288,14 @@ class Model:
             # Rollback must always have a committed source.
             guardian.commit(0)
         logs = {}
+        timer = obs.perf.StepTimer("train.step")
         for epoch in range(epochs):
             if self.stop_training:
                 break
             cbk.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
-            for step, batch in enumerate(_timed_batches(loader)):
+            for step, batch in enumerate(_timed_batches(loader, timer)):
                 cbk.on_train_batch_begin(step)
                 ins, labs = self._split_batch(batch)
                 h = obs.handle()
@@ -297,14 +303,19 @@ class Model:
                                     epoch=epoch, step=step)
                       if h is not None else obs.NULL_SPAN)
                 with sp:
-                    if guardian is not None:
-                        loss, metrics = self._guarded_train_batch(
-                            guardian, ins, labs)
-                    else:
-                        loss, metrics = self.train_batch(ins, labs)
+                    with timer.phase("compute"):
+                        if guardian is not None:
+                            loss, metrics = self._guarded_train_batch(
+                                guardian, ins, labs)
+                        else:
+                            loss, metrics = self.train_batch(ins, labs)
                     sp.set(loss=float(loss))
                 logs = {"loss": loss, **metrics}
-                cbk.on_train_batch_end(step, logs)
+                # Callback flush (progress bars, metric sinks) is the
+                # loop's own telemetry cost — the "obs" phase.
+                with timer.phase("obs"):
+                    cbk.on_train_batch_end(step, logs)
+                timer.end_step()
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_data, batch_size=batch_size,
                                           log_freq=log_freq, verbose=0,
@@ -312,7 +323,10 @@ class Model:
                                           callbacks=cbk)
                 logs.update({f"eval_{k}" if not k.startswith("eval_")
                              else k: v for k, v in eval_logs.items()})
-            cbk.on_epoch_end(epoch, logs)
+            # Epoch-boundary callbacks carry the ModelCheckpoint save.
+            with timer.phase("checkpoint"):
+                cbk.on_epoch_end(epoch, logs)
+            timer.end_step()
         cbk.on_train_end(logs)
         return logs
 

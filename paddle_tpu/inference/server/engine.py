@@ -143,4 +143,14 @@ class ServingEngine:
         return self.scheduler.requests.get(rid)
 
     def stats(self) -> dict:
-        return self.metrics.stats()
+        out = self.metrics.stats()
+        from paddle_tpu import obs
+
+        if obs.handle() is not None:
+            # Pull-model roofline join over the scheduler's spans —
+            # stats() time only, never on the per-step hot path.  The
+            # scheduler's span names differ from the executor's program
+            # names where one span covers several programs.
+            out["roofline"] = obs.perf.attribute_from_tracer(
+                mapping={"req.prefill": "serve.prefill_chunk"})
+        return out

@@ -109,11 +109,14 @@ class BertModel(nn.Layer):
                        for _, p in self.named_parameters()))
 
     def flops_per_token(self, seq_len):
-        """6N + attention, fwd+bwd (same convention as llama.py)."""
+        """6N + attention, fwd+bwd (same convention as llama.py; the
+        tied embedding does not GEMM per token, so N excludes it)."""
+        from ..analysis.cost import transformer_flops_per_token
+
         cfg = self.config
         n = self.num_params() - cfg.vocab_size * cfg.hidden_size
-        attn = (12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len)
-        return 6 * n + attn
+        return transformer_flops_per_token(
+            n, cfg.num_hidden_layers, cfg.hidden_size, seq_len)
 
 
 class BertForSequenceClassification(nn.Layer):

@@ -389,10 +389,12 @@ class LlamaForCausalLM(nn.Layer):
 
     def flops_per_token(self, seq_len):
         """Standard 6N + attention FLOPs estimate (for MFU)."""
-        n = self.num_params()
+        from ..analysis.cost import transformer_flops_per_token
+
         cfg = self.config
-        attn = (12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len)
-        return 6 * n + attn
+        return transformer_flops_per_token(
+            self.num_params(), cfg.num_hidden_layers, cfg.hidden_size,
+            seq_len)
 
 
 # -- TP/DP sharding rules (SURVEY.md §2.4 TP row: Megatron-style) -----------

@@ -543,12 +543,13 @@ class CompiledTrainStep:
                                self._v, jnp.asarray(self._t, jnp.float32),
                                lr_val, *batch)
         if h is not None:
+            wall = h.clock() - t0
             h.registry.counter(
                 "train_steps_total", "Optimizer steps dispatched").inc()
             h.registry.histogram(
                 "train_step_wall_s",
-                "Host wall time of one train step").observe(
-                    h.clock() - t0)
+                "Host wall time of one train step").observe(wall)
+            obs.perf.on_program("train.step", wall)
         faults.fire("train.step", "after")
         return loss
 
@@ -612,12 +613,13 @@ class CompiledTrainStep:
         loss_f, gnorm_f, ok_b = float(loss), float(gnorm), bool(ok)
         if h is not None:
             sp.set(loss=loss_f, ok=ok_b)
+            wall = h.clock() - t0
             h.registry.counter(
                 "train_steps_total", "Optimizer steps dispatched").inc()
             h.registry.histogram(
                 "train_step_wall_s",
-                "Host wall time of one train step").observe(
-                    h.clock() - t0)
+                "Host wall time of one train step").observe(wall)
+            obs.perf.on_program("train.guarded_step", wall)
         if not ok_b:
             # The gate kept the old state; the Adam step counter must
             # not advance either (found_inf semantics).

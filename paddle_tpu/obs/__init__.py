@@ -42,7 +42,7 @@ from .trace import LogicalClock, Span, Tracer
 __all__ = [
     "FlightRecorder", "LogicalClock", "MetricRegistry", "Span",
     "Tracer", "auto_dump", "configure", "dump", "enabled", "event",
-    "handle", "instant", "reset", "span",
+    "handle", "instant", "perf", "reset", "span",
 ]
 
 _MODES = ("off", "on")
@@ -116,6 +116,7 @@ def reset():
     with _lock:
         _handle = None
         _initialized = False
+    perf.reset()
 
 
 # -- thin producer helpers (no-ops when off) ----------------------------
@@ -182,3 +183,6 @@ def auto_dump(reason, extra=None):
         path = os.path.join(dump_dir,
                             f"flight-{h.recorder.dumps}-{safe}.jsonl")
     return h.recorder.dump(path=path, reason=reason, extra=extra)
+
+
+from . import perf  # noqa: E402,F401  (imports obs lazily; keep last)

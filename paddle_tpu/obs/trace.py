@@ -38,21 +38,25 @@ class LogicalClock:
 
 
 class Span:
-    """One completed span (``dur`` in seconds) or instant (``dur``
-    None).  ``args`` carries structured payload — ``trace_id`` rides
-    there so Perfetto shows it on every slice."""
+    """One completed span (``dur`` in seconds), instant (``dur`` None)
+    or counter sample (``ph="C"``; ``args`` holds the series values).
+    ``args`` carries structured payload — ``trace_id`` rides there so
+    Perfetto shows it on every slice."""
 
-    __slots__ = ("name", "cat", "ts", "dur", "args")
+    __slots__ = ("name", "cat", "ts", "dur", "args", "ph")
 
-    def __init__(self, name, cat, ts, dur, args):
+    def __init__(self, name, cat, ts, dur, args, ph=None):
         self.name = name
         self.cat = cat
         self.ts = ts
         self.dur = dur
         self.args = args
+        self.ph = ph
 
     def __repr__(self):
-        kind = "instant" if self.dur is None else f"dur={self.dur:.6f}"
+        kind = ("counter" if self.ph == "C"
+                else "instant" if self.dur is None
+                else f"dur={self.dur:.6f}")
         return f"Span({self.name}, {kind}, args={self.args})"
 
 
@@ -117,6 +121,12 @@ class Tracer:
             args["trace_id"] = trace_id
         self._push(Span(name, cat, self._clock(), None, args))
 
+    def counter(self, name, cat="host", **values):
+        """One counter-track sample (Chrome ``"ph": "C"``): each kwarg
+        becomes a named series on the track, so Perfetto renders e.g.
+        MFU / HBM-GB/s as stacked graphs above the span rows."""
+        self._push(Span(name, cat, self._clock(), None, values, ph="C"))
+
     # -- export ----------------------------------------------------------
 
     def to_chrome_events(self):
@@ -126,12 +136,18 @@ class Tracer:
         events = [{"ph": "M", "name": "process_name", "pid": self.pid,
                    "tid": 0,
                    "args": {"name": "paddle_tpu host telemetry"}}]
+        for tid, label in ((0, "train"), (1, "serving")):
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": self.pid, "tid": tid,
+                           "args": {"name": label}})
         for s in self.spans:
             tid = 1 if s.cat.startswith("serve") else 0
             ev = {"name": s.name, "cat": s.cat, "pid": self.pid,
                   "tid": tid, "ts": round(s.ts * 1e6, 3),
                   "args": dict(s.args)}
-            if s.dur is None:
+            if s.ph == "C":
+                ev["ph"] = "C"
+            elif s.dur is None:
                 ev["ph"] = "i"
                 ev["s"] = "t"  # thread-scoped instant
             else:

@@ -999,3 +999,54 @@ def _histogram(datas, attrs):
         _fail("histogram",
               f"max must be larger or equal to min, but received "
               f"min {lo} and max {hi}")
+
+
+def _reduce_axes(op, datas, attrs):
+    """Shared unary-reduction check (unary.cc ReduceInferMetaBase):
+    ``axis`` None / int / tuple, every entry in range, no duplicates."""
+    axis = attrs.get("axis")
+    if axis is None:
+        return
+    nd = max(_ndim(datas[0]), 1)
+    axes = axis if isinstance(axis, (list, tuple)) else (axis,)
+    seen = set()
+    for a in axes:
+        n = _axis_in(op, int(a), nd)
+        if n in seen:
+            _fail(op, f"axis {list(axes)} has duplicate entries")
+        seen.add(n)
+
+
+@register_validator("reduce_prod")
+def _reduce_prod(datas, attrs):
+    _reduce_axes("reduce_prod", datas, attrs)
+
+
+@register_validator("amax")
+def _amax(datas, attrs):
+    _reduce_axes("amax", datas, attrs)
+
+
+@register_validator("amin")
+def _amin(datas, attrs):
+    _reduce_axes("amin", datas, attrs)
+
+
+@register_validator("median")
+def _median(datas, attrs):
+    _reduce_axes("median", datas, attrs)
+
+
+@register_validator("nanmedian")
+def _nanmedian(datas, attrs):
+    _reduce_axes("nanmedian", datas, attrs)
+
+
+@register_validator("logcumsumexp")
+def _logcumsumexp(datas, attrs):
+    # unary.cc CumInferMeta; without this check the kernel's ``axis %
+    # ndim`` silently WRAPS an out-of-range axis instead of failing.
+    axis = attrs.get("axis")
+    if axis is None:
+        return
+    _axis_in("logcumsumexp", int(axis), max(_ndim(datas[0]), 1))

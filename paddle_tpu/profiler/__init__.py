@@ -115,6 +115,7 @@ class Profiler:
         self._scheduler = scheduler
         self._on_trace_ready = on_trace_ready
         self._timer_only = timer_only
+        self._with_flops = bool(with_flops)
         self._dir = getattr(on_trace_ready, "_dir", "./profiler_log")
         self._step = 0
         self._recording = False
@@ -213,9 +214,40 @@ class Profiler:
             dev = format_tables(self._recorded_dir)
             if dev:
                 lines.append(dev)
+        if self._with_flops:
+            flops = self._flops_table()
+            if flops:
+                lines.append(flops)
         out = "\n".join(lines) if lines else self.step_info()
         print(out)
         return out
+
+    @staticmethod
+    def _flops_table():
+        """Analytical cost rows (reference ``with_flops=True`` op FLOP
+        column) for every registered program whose shapes are known."""
+        try:
+            from ..analysis import registered
+        except Exception:
+            return ""
+        rows = []
+        for name in sorted(registered()):
+            try:
+                from ..obs import perf
+
+                c = perf.program_cost(name)
+            except Exception:
+                c = None
+            if c is None:
+                continue
+            rows.append(f"{name:<28}{c.flops / 1e9:>14.3f}"
+                        f"{c.hbm_bytes / 1e9:>14.3f}"
+                        f"{c.arithmetic_intensity:>12.1f}")
+        if not rows:
+            return ""
+        head = (f"{'Program':<28}{'GFLOPs':>14}{'HBM GB':>14}"
+                f"{'FLOP/B':>12}")
+        return "\n".join([head] + rows)
 
     def export(self, path, format="json"):
         """Write the session's RecordEvent span table as Chrome-trace

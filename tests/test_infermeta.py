@@ -864,3 +864,72 @@ def test_histogram_rejects_zero_bins():
 def test_histogram_rejects_inverted_range():
     with pytest.raises(InvalidArgumentError, match="larger or equal"):
         paddle.histogram(_f32(4), bins=5, min=2, max=1)
+
+
+# -- batch 8: unary reductions + cumulative log-sum-exp -----------------
+
+
+def test_prod_accepts_axis_and_keepdim():
+    out = paddle.prod(_f32(2, 3, 4), axis=1, keepdim=True)
+    assert list(out.shape) == [2, 1, 4]
+
+
+def test_prod_rejects_out_of_range_axis():
+    with pytest.raises(InvalidArgumentError, match=r"range of \[-3, 3\)"):
+        paddle.prod(_f32(2, 3, 4), axis=3)
+
+
+def test_amax_accepts_axis_tuple():
+    out = paddle.amax(_f32(2, 3, 4), axis=(0, 2))
+    assert list(out.shape) == [3]
+
+
+def test_amax_rejects_duplicate_axes():
+    with pytest.raises(InvalidArgumentError, match="duplicate"):
+        paddle.amax(_f32(2, 3, 4), axis=(1, -2))
+
+
+def test_amin_accepts_negative_axis():
+    out = paddle.amin(_f32(2, 3, 4), axis=-1)
+    assert list(out.shape) == [2, 3]
+
+
+def test_amin_rejects_out_of_range_axis():
+    with pytest.raises(InvalidArgumentError, match=r"range of \[-3, 3\)"):
+        paddle.amin(_f32(2, 3, 4), axis=-4)
+
+
+def test_median_accepts_valid_axis():
+    x = paddle.to_tensor(np.array([[1., 5., 2.], [3., 4., 9.]],
+                                  np.float32))
+    out = paddle.median(x, axis=1)
+    np.testing.assert_allclose(out.numpy(), [2.0, 4.0])
+
+
+def test_median_rejects_out_of_range_axis():
+    with pytest.raises(InvalidArgumentError, match=r"range of \[-2, 2\)"):
+        paddle.median(_f32(2, 3), axis=2)
+
+
+def test_nanmedian_accepts_and_skips_nans():
+    x = paddle.to_tensor(np.array([[np.nan, 1., 3.], [2., 2., 2.]],
+                                  np.float32))
+    out = paddle.nanmedian(x, axis=1)
+    np.testing.assert_allclose(out.numpy(), [2.0, 2.0])
+
+
+def test_nanmedian_rejects_duplicate_axes():
+    with pytest.raises(InvalidArgumentError, match="duplicate"):
+        paddle.nanmedian(_f32(2, 3, 4), axis=(0, 0))
+
+
+def test_logcumsumexp_accepts_valid_axis():
+    out = paddle.logcumsumexp(_f32(2, 3), axis=1)
+    assert list(out.shape) == [2, 3]
+
+
+def test_logcumsumexp_rejects_wrapping_axis():
+    # Without the validator, the kernel's ``axis % ndim`` silently
+    # wrapped axis=2 on a rank-2 input to axis 0.
+    with pytest.raises(InvalidArgumentError, match=r"range of \[-2, 2\)"):
+        paddle.logcumsumexp(_f32(2, 3), axis=2)

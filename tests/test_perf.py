@@ -1,0 +1,423 @@
+"""Performance introspection plane: analytical jaxpr cost model with
+exact FLOP/byte counts, bench-vs-cost-model FLOP agreement, roofline
+joins and peak tables, StepTimer phase breakdown on the logical clock,
+Perfetto counter tracks, the bench regression gate, and the PT_OBS=off
+bit-parity contract with the perf layer wired.
+
+Same conventions as test_obs.py: everything runs on
+:class:`obs.LogicalClock`, and producers cache ``obs.handle()`` at
+construction so every on-path test configures the plane BEFORE building
+the engine / train step under test.
+"""
+import importlib.util
+import json
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis, obs
+from paddle_tpu.analysis import (
+    CostReport, estimate_cost, estimate_fn_cost,
+    transformer_flops_per_token,
+)
+from paddle_tpu.inference.server import ServingEngine
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.training import CompiledTrainStep
+from paddle_tpu.obs import perf
+from paddle_tpu.obs.trace import LogicalClock
+from paddle_tpu.testing import faults
+from paddle_tpu.testing.load import LoadSpec, generate_load, run_load
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+f32 = jnp.float32
+
+
+def _sds(*shape, dtype=f32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(11)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.reset()
+    obs.reset()
+    yield
+    faults.reset()
+    obs.reset()
+
+
+def _on(**kw):
+    kw.setdefault("clock", LogicalClock())
+    return obs.configure(mode="on", **kw)
+
+
+# -- cost model: exact FLOP / byte counts -------------------------------------
+
+def test_dot_general_exact_counts():
+    # (4,8) @ (8,16): 2·4·16·8 = 1024 FLOPs, f32 operands 640 B in,
+    # (4,16) f32 out 256 B.
+    rep = estimate_fn_cost(lambda a, b: a @ b, _sds(4, 8), _sds(8, 16))
+    assert rep.flops == 1024
+    assert rep.matmul_flops == 1024
+    assert rep.conv_flops == 0
+    assert rep.elementwise_flops == 0
+    assert rep.bytes_in == 640
+    assert rep.bytes_out == 256
+    assert rep.hbm_bytes == (rep.bytes_in + rep.bytes_out
+                             + rep.bytes_peak_intermediate)
+    assert rep.arithmetic_intensity == rep.flops / rep.hbm_bytes
+    assert rep.by_primitive == {"dot_general": 1024}
+
+
+def test_mlp_decomposes_into_matmul_and_elementwise():
+    # x(2,4)·W1(4,8)+b1 -> max(.,0) -> ·W2(8,4)+b2:
+    # matmul 128+128, add 16+8, max 16 => 296 total.
+    def mlp(x, w1, b1, w2, b2):
+        h = jnp.maximum(x @ w1 + b1, 0.0)
+        return h @ w2 + b2
+
+    rep = estimate_fn_cost(mlp, _sds(2, 4), _sds(4, 8), _sds(8),
+                           _sds(8, 4), _sds(4))
+    assert rep.matmul_flops == 256
+    assert rep.elementwise_flops == 40
+    assert rep.flops == 296
+    assert rep.by_primitive == {"add": 24, "dot_general": 256, "max": 16}
+
+
+def test_reduction_counts_input_elements():
+    rep = estimate_fn_cost(lambda x: jnp.sum(x), _sds(4, 8))
+    assert rep.by_primitive.get("reduce_sum") == 32
+    assert rep.elementwise_flops == 32
+
+
+def test_scan_multiplies_body_by_trip_count():
+    # 2-step scan, body (4,)@(4,4) = 32 FLOPs/step => 64 total.
+    w = jnp.zeros((4, 4), f32)
+
+    def f(x):
+        def body(carry, _):
+            return (carry @ w).astype(f32), None
+
+        out, _ = jax.lax.scan(body, x, None, length=2)
+        return out
+
+    rep = estimate_fn_cost(f, _sds(4))
+    assert rep.matmul_flops == 64
+    assert rep.flops == 64
+
+
+def test_cond_prices_worst_branch():
+    # (4,4)@(4,4) = 128 FLOPs on one branch, identity on the other.
+    w = jnp.zeros((4, 4), f32)
+
+    def f(pred, x):
+        return jax.lax.cond(pred,
+                            lambda v: (v @ w).astype(f32),
+                            lambda v: v, x)
+
+    rep = estimate_fn_cost(f, _sds(dtype=jnp.bool_), _sds(4, 4))
+    assert rep.matmul_flops == 128
+    assert rep.flops == 128
+
+
+def test_pjit_subjaxpr_recursion():
+    rep = estimate_fn_cost(jax.jit(lambda a, b: a @ b),
+                           _sds(4, 8), _sds(8, 16))
+    assert rep.flops == 1024
+
+
+def test_shard_map_subjaxpr_recursion():
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("x",))
+    f = shard_map(lambda a, b: a @ b, mesh=mesh,
+                  in_specs=(P(), P()), out_specs=P())
+    rep = estimate_fn_cost(f, _sds(4, 8), _sds(8, 16))
+    assert rep.flops == 1024
+
+
+def test_estimate_cost_rejects_non_jaxpr():
+    with pytest.raises(TypeError):
+        estimate_cost({"not": "a jaxpr"})
+
+
+def test_report_asdict_carries_derived_fields():
+    rep = estimate_fn_cost(lambda a, b: a @ b, _sds(4, 8), _sds(8, 16))
+    d = rep.asdict()
+    assert d["hbm_bytes"] == rep.hbm_bytes
+    assert d["arithmetic_intensity"] == round(rep.arithmetic_intensity, 4)
+    assert "CostReport" in str(rep)
+
+
+# -- bench-vs-cost-model agreement --------------------------------------------
+
+def test_transformer_flops_closed_form():
+    assert transformer_flops_per_token(10, 2, 4, 8) == 6 * 10 + 12 * 2 * 4 * 8
+
+
+def test_llama_flops_per_token_matches_cost_model_home(model):
+    # bench.py's MFU legs use model.flops_per_token; it must agree with
+    # the single formula home in analysis.cost to the digit.
+    cfg = model.config
+    n = model.num_params()
+    for seq in (16, 512):
+        want = (6 * n + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq)
+        assert model.flops_per_token(seq) == want
+        assert transformer_flops_per_token(
+            n, cfg.num_hidden_layers, cfg.hidden_size, seq) == want
+
+
+# -- ProgramContract.cost(): every hot program priced -------------------------
+
+def test_registered_programs_carry_cost_reports(model):
+    step = CompiledTrainStep(model, lr=1e-3)
+    ids = np.random.RandomState(0).randint(
+        0, 256, (2, 16)).astype(np.int64)
+    step.step(ids, ids)
+    eng = ServingEngine(model, prefill_chunk=8, max_seqs=2, page_size=4,
+                        max_len=64)
+    reg = analysis.registered()
+    for name in ("train.step", "train.guarded_step", "serve.prefill",
+                 "serve.prefill_chunk", "serve.decode", "serve.decode_n",
+                 "serve.verify"):
+        assert name in reg, f"{name} not registered"
+        cost = reg[name].cost()
+        assert isinstance(cost, CostReport), name
+        assert cost.flops > 0 and cost.hbm_bytes > 0, name
+        assert reg[name].cost() is cost, f"{name} cost not cached"
+    del eng, step
+
+
+def test_program_cost_unknown_is_none():
+    assert perf.program_cost("no.such.program") is None
+
+
+# -- roofline join + peak tables ----------------------------------------------
+
+def test_roofline_join_math_and_classification():
+    compute = CostReport(flops=1000, matmul_flops=1000, bytes_in=10)
+    rl = perf.roofline(compute, 0.5, device_kind="cpu")
+    assert rl["mfu"] == 1000 / 0.5 / perf.peak_flops_per_chip("cpu")
+    assert rl["hbm_gbps"] == 10 / 0.5 / 1e9
+    assert rl["bound"] == "compute"          # 100 FLOP/B >= ridge 20
+    bw = CostReport(flops=10, elementwise_flops=10, bytes_in=10)
+    assert perf.roofline(bw, 0.5, device_kind="cpu")["bound"] == "bandwidth"
+    assert perf.roofline(None, 0.5) is None
+    assert perf.roofline(compute, 0.0) is None
+    assert perf.roofline(compute, None) is None
+
+
+def test_peak_tables_substring_lookup():
+    assert perf.peak_flops_per_chip("TPU v5p") == 459e12
+    assert perf.peak_flops_per_chip("TPU v5 lite") == 197e12
+    assert perf.peak_flops_per_chip("TPU v4") == 275e12
+    assert perf.peak_flops_per_chip("mystery-device") == 1e12  # fallback
+    assert perf.ridge_intensity("cpu") == 20.0
+
+
+# -- StepTimer on the logical clock -------------------------------------------
+
+def test_steptimer_phase_breakdown_exact():
+    h = _on(clock=LogicalClock(tick=1.0))
+    t = perf.StepTimer("demo.step")
+    with t.phase("data_wait"):
+        pass
+    with t.phase("compute"):
+        pass
+    assert t.phase_seconds() == {"data_wait": 1.0, "compute": 1.0}
+    out = t.end_step()
+    assert out == {"data_wait": 1.0, "compute": 1.0}
+    assert t.phase_seconds() == {}           # accumulators reset
+    samples = h.registry.snapshot()["step_phase_seconds"]["samples"]
+    got = {s["labels"]["phase"]: s["value"] for s in samples
+           if s["labels"]["program"] == "demo.step"}
+    assert got == {"data_wait": 1.0, "compute": 1.0}
+
+
+def test_steptimer_is_noop_when_obs_off():
+    t = perf.StepTimer()
+    with t.phase("compute"):
+        pass
+    assert t.phase_seconds() == {}
+    assert t.end_step() == {}
+
+
+# -- on_program: producer publishes roofline gauges + counters ---------------
+
+def test_train_step_publishes_roofline_gauges(model):
+    h = _on()
+    step = CompiledTrainStep(model, lr=1e-3)
+    ids = np.random.RandomState(0).randint(
+        0, 256, (2, 16)).astype(np.int64)
+    for _ in range(2):
+        step.step(ids, ids)
+    prom = h.registry.prometheus_text()
+    assert 'program_mfu{program="train.step"}' in prom
+    assert 'program_hbm_gbps{program="train.step"}' in prom
+    assert 'program_flops{program="train.step"}' in prom
+    assert 'roofline_bound{bound="compute",program="train.step"}' in prom
+    assert 'roofline_bound{bound="bandwidth",program="train.step"}' in prom
+    assert "hbm_peak_bytes" in prom
+    assert any(s.ph == "C" and s.name.startswith("perf.")
+               for s in h.tracer.spans)
+
+
+# -- chrome trace: counter tracks + thread metadata ---------------------------
+
+def test_chrome_export_counter_tracks_and_thread_names():
+    h = _on()
+    h.tracer.counter("perf.mfu", cat="perf", demo=0.5)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "trace.json")
+        h.tracer.export_chrome(path)
+        doc = json.loads(open(path).read())
+    evs = doc["traceEvents"]
+    counters = [e for e in evs if e.get("ph") == "C"]
+    assert counters and counters[0]["name"] == "perf.mfu"
+    assert counters[0]["args"] == {"demo": 0.5}
+    threads = {e["args"]["name"] for e in evs
+               if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert {"train", "serving"} <= threads
+
+
+# -- PT_OBS=off bit-parity with the perf layer wired --------------------------
+
+LOAD_SPEC = dict(n_requests=6, mean_interarrival=2.0, prompt_len=(4, 20),
+                 max_new=(3, 8), vocab=256, seed=7)
+LOGICAL_STATS = ("steps", "requests", "preemptions", "decode_tokens",
+                 "prefill_tokens", "batch_occupancy", "page_utilization",
+                 "queue_wait_steps_p50", "ttft_steps_p50")
+
+
+def _seeded_load(model):
+    eng = ServingEngine(model, prefill_chunk=8, max_seqs=2, page_size=4,
+                        max_len=64)
+    work = generate_load(LoadSpec(**LOAD_SPEC))
+    res = run_load(eng, work)
+    toks = {w["rid"]: res["handles"][w["rid"]].tokens for w in work}
+    return (toks, {k: res["stats"][k] for k in LOGICAL_STATS},
+            res["stats"])
+
+
+def test_off_path_is_bit_identical_with_perf_wired(model):
+    toks_off, stats_off, raw_off = _seeded_load(model)
+    assert "roofline" not in raw_off        # off path: no perf join
+    _on()
+    toks_on, stats_on, raw_on = _seeded_load(model)
+    assert toks_on == toks_off
+    assert stats_on == stats_off
+    rl = raw_on.get("roofline", {})
+    assert "serve.decode" in rl and rl["serve.decode"]["mfu"] > 0
+    assert rl["serve.decode"]["bound"] in ("compute", "bandwidth")
+
+
+# -- bench regression gate (tools/check_perf.py) ------------------------------
+
+def _check_perf():
+    spec = importlib.util.spec_from_file_location(
+        "check_perf", os.path.join(REPO, "tools", "check_perf.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _round(tmp_path, n, payload, wrapper=False):
+    doc = {"n": n, "cmd": f"python bench.py --round {n}", "rc": 0,
+           "tail": "", "parsed": payload} if wrapper else payload
+    p = tmp_path / f"BENCH_r{n:02d}.json"
+    p.write_text(json.dumps(doc))
+    return p
+
+
+GOOD = {"value": 100.0, "mfu": 0.4, "serving": {"value": 50.0},
+        "obs_overhead": {"on_off_ratio": 1.01}}
+
+
+def test_check_perf_flags_regression(tmp_path):
+    cp = _check_perf()
+    _round(tmp_path, 1, GOOD)
+    _round(tmp_path, 2, {**GOOD, "value": 80.0})   # -20% > 10% tol
+    assert cp.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_check_perf_flags_overhead_ratio_growth(tmp_path):
+    cp = _check_perf()
+    _round(tmp_path, 1, GOOD)
+    bad = dict(GOOD)
+    bad["obs_overhead"] = {"on_off_ratio": 1.10}   # lower-is-better
+    _round(tmp_path, 2, bad)
+    assert cp.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_check_perf_passes_within_tolerance(tmp_path):
+    cp = _check_perf()
+    _round(tmp_path, 1, GOOD)
+    _round(tmp_path, 2, {**GOOD, "value": 95.0}, wrapper=True)
+    assert cp.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_check_perf_skips_unusable_rounds(tmp_path):
+    cp = _check_perf()
+    _round(tmp_path, 1, GOOD)
+    _round(tmp_path, 2, None, wrapper=True)        # crashed round
+    _round(tmp_path, 3, {**GOOD, "value": 30.0})   # regressed vs r01
+    assert cp.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_check_perf_passes_with_nothing_to_compare(tmp_path):
+    cp = _check_perf()
+    assert cp.main(["--dir", str(tmp_path)]) == 0
+    _round(tmp_path, 1, GOOD)
+    assert cp.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_check_perf_explicit_pair(tmp_path):
+    cp = _check_perf()
+    old = _round(tmp_path, 1, GOOD)
+    new = _round(tmp_path, 2, {**GOOD, "serving": {"value": 10.0}})
+    assert cp.main(["--old", str(old), "--new", str(new)]) == 1
+    assert cp.main(["--old", str(old), "--new", str(old)]) == 0
+
+
+# -- bench round recorder (bench.py --round N) --------------------------------
+
+def _bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_write_round_artifact_and_perf_md(tmp_path):
+    b = _bench()
+    parsed = {"value": 1.5, "serving": {"value": 2.0},
+              "moe": {"skipped": "needs 8 devices"}}
+    path = b._write_round(7, parsed, root=str(tmp_path))
+    doc = json.loads(open(path).read())
+    assert doc == {"n": 7, "cmd": "python bench.py --round 7", "rc": 0,
+                   "tail": "", "parsed": parsed}
+    md = (tmp_path / "PERF.md").read_text()
+    assert "## Round-7 bench artifact" in md
+    assert "serving.value" in md and "BENCH_r07.json" in md
+    # a crashed round records parsed: null and a FAILED section
+    b._write_round(8, None, rc=1, tail="boom", root=str(tmp_path))
+    doc8 = json.loads((tmp_path / "BENCH_r08.json").read_text())
+    assert doc8["rc"] == 1 and doc8["parsed"] is None
+    assert "FAILED" in (tmp_path / "PERF.md").read_text()

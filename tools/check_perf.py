@@ -1,0 +1,149 @@
+"""make perf-check — bench regression gate over the BENCH trajectory.
+
+Compares the newest usable ``BENCH_r*.json`` against the previous one
+with per-metric relative tolerances and exits non-zero on a regression.
+A round is usable when its payload parses to a dict: the driver wrapper
+schema is ``{"n": N, "cmd": ..., "rc": int, "tail": str, "parsed":
+dict|null}`` (a crashed round records ``parsed: null`` and is skipped —
+the gate compares measurements, not failures); raw bench dicts (no
+wrapper) are accepted too.  Fewer than two usable rounds passes with
+"nothing to compare" — the gate must not block the repo before the
+trajectory exists.
+
+Metric paths are dotted into the payload; missing/non-numeric values
+and legs recorded as ``{"skipped": ...}`` / ``{"error": ...}`` are
+skipped (an added or dropped bench leg is not a regression).
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+#: (dotted path, direction, relative tolerance).  "higher" means
+#: bigger-is-better: new < old*(1-tol) is a regression; "lower" means
+#: smaller-is-better: new > old*(1+tol) is a regression.
+METRICS = (
+    ("value", "higher", 0.10),                    # headline tok/s/chip
+    ("mfu", "higher", 0.10),
+    ("bert_base_squad.value", "higher", 0.10),
+    ("bert_base_squad.mfu", "higher", 0.10),
+    ("resnet50.value", "higher", 0.10),
+    ("detection_amp_o2.value", "higher", 0.10),
+    ("serving.value", "higher", 0.10),
+    ("serving.ab_speedup_vs_dense", "higher", 0.15),
+    ("moe.value", "higher", 0.10),
+    ("moe.ab_speedup_vs_einsum", "higher", 0.15),
+    ("large.value", "higher", 0.10),
+    ("sd_unet.value", "higher", 0.10),
+    ("obs_overhead.on_off_ratio", "lower", 0.05),
+)
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def _load_rounds(bench_dir):
+    """[(round_n, payload_dict, path)] sorted by round, usable only."""
+    out = []
+    for path in glob.glob(os.path.join(bench_dir, "BENCH_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        payload = doc.get("parsed") if isinstance(doc, dict) \
+            and "parsed" in doc else doc
+        if isinstance(payload, dict) and payload:
+            out.append((int(m.group(1)), payload, path))
+    return sorted(out)
+
+
+def _get(payload, dotted):
+    cur = payload
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    if isinstance(cur, dict):
+        return None  # leg recorded as {"skipped"/"error": ...}
+    try:
+        return float(cur)
+    except (TypeError, ValueError):
+        return None
+
+
+def compare(old, new):
+    """(regressions, checked) between two payload dicts."""
+    regressions, checked = [], []
+    for path, direction, tol in METRICS:
+        ov, nv = _get(old, path), _get(new, path)
+        if ov is None or nv is None:
+            continue
+        if direction == "higher":
+            bad = nv < ov * (1.0 - tol)
+        else:
+            bad = nv > ov * (1.0 + tol)
+        checked.append((path, ov, nv, bad))
+        if bad:
+            arrow = "<" if direction == "higher" else ">"
+            regressions.append(
+                f"{path}: {nv:g} {arrow} {ov:g} "
+                f"beyond {tol:.0%} tolerance ({direction} is better)")
+    return regressions, checked
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dir", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--old", default=None,
+                    help="explicit older artifact (overrides --dir scan)")
+    ap.add_argument("--new", default=None,
+                    help="explicit newer artifact (overrides --dir scan)")
+    args = ap.parse_args(argv)
+
+    if args.old and args.new:
+        pair = []
+        for path in (args.old, args.new):
+            with open(path) as f:
+                doc = json.load(f)
+            payload = doc.get("parsed") if isinstance(doc, dict) \
+                and "parsed" in doc else doc
+            if not isinstance(payload, dict) or not payload:
+                print(f"perf-check: {path} has no usable payload")
+                return 1
+            pair.append((path, payload))
+        (old_path, old), (new_path, new) = pair
+    else:
+        rounds = _load_rounds(args.dir)
+        if len(rounds) < 2:
+            print(f"perf-check: {len(rounds)} usable round(s) under "
+                  f"{args.dir} — nothing to compare, pass")
+            return 0
+        (_, old, old_path), (_, new, new_path) = rounds[-2], rounds[-1]
+
+    print(f"perf-check: {os.path.basename(new_path)} vs "
+          f"{os.path.basename(old_path)}")
+    regressions, checked = compare(old, new)
+    for path, ov, nv, bad in checked:
+        mark = "REGRESSED" if bad else "ok"
+        print(f"  [{mark:>9}] {path:<34} {ov:>12g} -> {nv:>12g}")
+    if not checked:
+        print("  (no comparable metrics between the two rounds)")
+    if regressions:
+        print(f"perf-check: {len(regressions)} regression(s):",
+              file=sys.stderr)
+        for r in regressions:
+            print(f"  {r}", file=sys.stderr)
+        return 1
+    print(f"perf-check ok: {len(checked)} metric(s) within tolerance")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

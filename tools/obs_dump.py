@@ -88,6 +88,19 @@ def main():
           "submitted counter == 3")
     check("train_steps_total 2" in prom, "train step counter == 2")
 
+    # -- 1b. perf plane: roofline gauges + counter tracks ---------------
+    print("== perf attribution ==")
+    for fam in ("program_mfu", "program_hbm_gbps", "program_flops",
+                "roofline_bound", "hbm_peak_bytes"):
+        check(fam in prom, f"family {fam}")
+    check('program_mfu{program="train.step"}' in prom,
+          "train.step MFU gauge")
+    rl = stats.get("roofline", {})
+    check("serve.decode" in rl and rl["serve.decode"]["mfu"] > 0,
+          "serving stats carry a serve.decode roofline")
+    check(rl.get("serve.decode", {}).get("bound")
+          in ("compute", "bandwidth"), "roofline bound classified")
+
     # -- 2. Chrome trace with trace IDs across a preemption -------------
     print("== chrome trace ==")
     victim = next(hd for hd in handles if hd.num_preemptions >= 1)
@@ -108,6 +121,10 @@ def main():
           f"{victim.rid} lifecycle order {want}")
     check(any(e["name"] == "train.step" for e in evs),
           "train.step spans exported")
+    check(any(e.get("ph") == "C" and e["name"].startswith("perf.")
+              for e in evs), "perf counter tracks exported")
+    check(any(e.get("ph") == "M" and e["name"] == "thread_name"
+              for e in evs), "thread_name metadata exported")
 
     # -- 3. flight recorder dump ----------------------------------------
     print("== flight recorder ==")

@@ -1,0 +1,128 @@
+"""make perf-report — analytical-vs-achieved roofline table.
+
+Builds the framework's hot programs exactly like tools/lint_graph.py
+(tiny llama train step, the serving engine's five executor programs,
+the fused-MoE body), prices each registered ProgramContract with the
+analytical cost model, executes each program once at its contract
+shapes to measure achieved wall time, and prints one roofline row per
+program: GFLOPs, HBM GB, arithmetic intensity, bound classification,
+and achieved GFLOP/s / MFU / HBM GB/s.
+
+Exits non-zero when the train step or any serving executor program is
+missing a cost row — the acceptance contract that every hot program
+stays priceable.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+from lint_graph import build_programs  # noqa: E402
+
+#: Programs that must carry a cost row for the report to pass.
+REQUIRED = (
+    "train.step",
+    "serve.prefill", "serve.prefill_chunk", "serve.decode",
+    "serve.decode_n", "serve.verify",
+)
+
+
+def _materialize(args):
+    """ShapeDtypeStruct pytrees -> concrete zero arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    def conc(leaf):
+        if isinstance(leaf, jax.ShapeDtypeStruct):
+            return jnp.zeros(leaf.shape, leaf.dtype)
+        return leaf
+
+    return tuple(jax.tree.map(conc, a) for a in args)
+
+
+def _measure(contract, repeats=3):
+    """Achieved wall seconds for one call at the contract's shapes
+    (compile excluded), or None when the program can't run here."""
+    import functools
+
+    import jax
+
+    fn = contract.resolve_fn()
+    args = contract.example_args()
+    if fn is None or args is None:
+        return None
+    if contract.kwargs:
+        fn = functools.partial(fn, **contract.kwargs)
+    jitted = jax.jit(fn)
+    try:
+        conc = _materialize(args)
+        jax.block_until_ready(jitted(*conc))  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            jax.block_until_ready(jitted(*conc))
+        return (time.perf_counter() - t0) / repeats
+    except Exception as e:
+        print(f"  ({contract.name}: not runnable here: "
+              f"{str(e)[:100]})", file=sys.stderr)
+        return None
+
+
+def main():
+    owners = build_programs()
+    from paddle_tpu import analysis
+    from paddle_tpu.obs import perf
+
+    kind = perf._device_kind()
+    print(f"device: {kind}  peak {perf.peak_flops_per_chip() / 1e12:.0f}"
+          f" TFLOP/s  {perf.peak_hbm_bytes_s() / 1e9:.0f} GB/s  "
+          f"ridge {perf.ridge_intensity():.1f} FLOP/B\n")
+    head = (f"{'program':<22}{'GFLOPs':>10}{'HBM GB':>10}{'FLOP/B':>8}"
+            f"{'bound':>11}{'wall ms':>10}{'GFLOP/s':>10}{'MFU':>8}"
+            f"{'GB/s':>8}")
+    print(head)
+    print("-" * len(head))
+    costed = set()
+    for name in sorted(analysis.registered()):
+        contract = analysis.registered()[name]
+        try:
+            cost = contract.cost()
+        except Exception as e:
+            print(f"{name:<22}  cost FAILED: {str(e)[:80]}")
+            continue
+        if cost is None:
+            print(f"{name:<22}  (shapes not captured)")
+            continue
+        costed.add(name)
+        wall = _measure(contract)
+        rl = perf.roofline(cost, wall) if wall else None
+        ach = (f"{cost.flops / wall / 1e9:>10.2f}{rl['mfu']:>8.4f}"
+               f"{rl['hbm_gbps']:>8.2f}" if rl else
+               f"{'n/a':>10}{'n/a':>8}{'n/a':>8}")
+        bound = (rl["bound"] if rl else
+                 ("compute" if cost.arithmetic_intensity
+                  >= perf.ridge_intensity() else "bandwidth"))
+        print(f"{name:<22}{cost.flops / 1e9:>10.3f}"
+              f"{cost.hbm_bytes / 1e9:>10.3f}"
+              f"{cost.arithmetic_intensity:>8.1f}{bound:>11}"
+              f"{(wall or 0) * 1e3:>10.2f}{ach}")
+    del owners
+    missing = [n for n in REQUIRED if n not in costed]
+    if missing:
+        print(f"\nerror: no cost row for required program(s): "
+              f"{', '.join(missing)}", file=sys.stderr)
+        return 1
+    print(f"\nperf-report ok: {len(costed)} program(s) priced "
+          f"(all {len(REQUIRED)} required present)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
