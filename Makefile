@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: smoke test test-fast verify-fast lint-graph obs-check \
-	perf-report perf-check bench
+	health-check perf-report perf-check bench
 
 # <3 min sanity gate: import + one eager op, one jitted llama forward
 # step (the driver's entry()), and a 2-virtual-device multichip train
@@ -43,8 +43,10 @@ smoke:
 		tests/test_prefix_cache.py \
 		tests/test_spec_decode.py \
 		tests/test_obs.py \
-		tests/test_perf.py
+		tests/test_perf.py \
+		tests/test_health.py
 	$(MAKE) obs-check
+	$(MAKE) health-check
 
 # Fast lane — must be green before any snapshot commit (see README).
 test-fast:
@@ -68,6 +70,13 @@ lint-graph:
 # the Chrome trace (trace IDs across a preemption) and a flight dump.
 obs-check:
 	JAX_PLATFORMS=cpu $(PY) tools/obs_dump.py
+
+# Health-plane end-to-end smoke: seeded load against a deliberately
+# violated TTFT SLO must fire a PAGE burn-rate alert, journal it,
+# surface it in a live /statusz scrape, and resolve on recovery; plus
+# the endpoint contract and event-journal schema/query checks.
+health-check:
+	JAX_PLATFORMS=cpu $(PY) tools/health_check.py
 
 # Per-program roofline table: analytical cost (FLOPs / HBM bytes /
 # intensity from the jaxpr cost model) vs achieved wall time for every

@@ -153,6 +153,8 @@ def main():
           file=sys.stderr)
 
     result = {
+        # perf-check only auto-compares same-platform rounds
+        "platform": jax.default_backend(),
         "metric": "llama_pretrain_tokens_per_sec_per_chip",
         "value": round(tok_s_chip, 1),
         "unit": "tokens/s/chip",
@@ -608,13 +610,16 @@ def _bench_obs_overhead(jax):
     obs plane off vs on (wall clock, real producers — spans, counters,
     step-wall histogram).  The acceptance target for the unified
     telemetry layer is on/off <= 1.03; a larger ratio in the artifact
-    means a producer left allocation or a clock read on the hot path."""
+    means a producer left allocation or a clock read on the hot path.
+    The on-leg also runs the health plane per step (SLO snapshot +
+    burn windows + heartbeat) so the ratio covers the full r16 tax."""
     import gc
 
     import paddle_tpu as paddle
     from paddle_tpu import obs
     from paddle_tpu.models import (
         CompiledTrainStep, LlamaConfig, LlamaForCausalLM)
+    from paddle_tpu.obs import health
 
     ids = np.random.RandomState(0).randint(
         0, 2048, (8, 128)).astype(np.int64)
@@ -627,11 +632,17 @@ def _bench_obs_overhead(jax):
                           num_attention_heads=4, num_key_value_heads=4,
                           max_position_embeddings=256)
         step = CompiledTrainStep(LlamaForCausalLM(cfg), lr=1e-3)
+        slo = (health.SLOEngine(health.default_train_slos(),
+                                source="train")
+               if obs.handle() is not None else None)
         step.step(ids, ids)        # compile + settle
         n = 30
         t0 = time.perf_counter()
-        for _ in range(n):
+        for i in range(n):
             step.step(ids, ids)
+            if slo is not None:
+                slo.evaluate(step=i)
+                obs.beat("train")
         dt = (time.perf_counter() - t0) / n
         del step
         gc.collect()

@@ -289,6 +289,16 @@ class Model:
             guardian.commit(0)
         logs = {}
         timer = obs.perf.StepTimer("train.step")
+        # health plane: guardian-anomaly SLO + "train" heartbeat,
+        # evaluated once per fit step when telemetry is on
+        health_eng = None
+        if obs.handle() is not None:
+            from ..obs import health as _health
+
+            health_eng = _health.SLOEngine(
+                _health.default_train_slos(), source="train")
+            obs.handle().statusz["train"] = \
+                lambda: {"phase_seconds": timer.phase_seconds()}
         for epoch in range(epochs):
             if self.stop_training:
                 break
@@ -316,6 +326,9 @@ class Model:
                 with timer.phase("obs"):
                     cbk.on_train_batch_end(step, logs)
                 timer.end_step()
+                if health_eng is not None:
+                    health_eng.evaluate(step=step)
+                    obs.beat("train")
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_data, batch_size=batch_size,
                                           log_freq=log_freq, verbose=0,

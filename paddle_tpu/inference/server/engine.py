@@ -39,7 +39,8 @@ class ServingEngine:
                  dtype=jnp.float32, num_pages=None, policy="fifo",
                  prefill_chunk=None, eos_token_id=None,
                  max_preemptions=4, prefix_cache=None,
-                 spec_decode=None, clock=None):
+                 spec_decode=None, clock=None, slos=None,
+                 slo_rules=None):
         self.executor = PagedExecutor(
             model, max_seqs=max_seqs, page_size=page_size,
             max_len=max_len, dtype=dtype, num_pages=num_pages)
@@ -80,6 +81,26 @@ class ServingEngine:
             max_preemptions=max_preemptions, prefix_cache=self.prefix,
             spec=self.spec)
         self._next_rid = 0
+        # health plane: when telemetry is on, the engine owns an SLO
+        # engine evaluated once per step, beats the "serving"
+        # heartbeat, and feeds the /statusz pool/occupancy provider.
+        # slos: None = stock serving objectives; [] disables; a list
+        # of health.*Objective customizes (tests pass tight TTFT
+        # objectives with LogicalClock-scale burn windows).
+        from paddle_tpu import obs
+        from paddle_tpu.obs import health
+
+        self._health = None
+        h = obs.handle()
+        if h is not None:
+            if slos is None:
+                slos = health.default_serving_slos()
+            if slos:
+                self._health = health.SLOEngine(
+                    slos, rules=slo_rules or health.DEFAULT_BURN_RULES,
+                    handle=h, source="serving",
+                    now=self.metrics._t_start)
+            h.statusz["serving"] = self._statusz
 
     # -- submission ------------------------------------------------------
 
@@ -117,7 +138,16 @@ class ServingEngine:
 
     def step(self) -> dict:
         """One scheduler iteration; returns {rid: [new tokens]}."""
-        return self.scheduler.step()
+        out = self.scheduler.step()
+        if self._health is not None:
+            # reuse the timestamp metrics.on_step just read so the
+            # health plane adds no clock reads to the step path
+            self._health.evaluate(step=self.scheduler.tick,
+                                  now=self.metrics._t_last)
+            from paddle_tpu import obs
+
+            obs.beat("serving", now=self.metrics._t_last)
+        return out
 
     def run(self, max_steps=100000) -> dict:
         """Step until no request is in flight; returns stats()."""
@@ -154,3 +184,22 @@ class ServingEngine:
             out["roofline"] = obs.perf.attribute_from_tracer(
                 mapping={"req.prefill": "serve.prefill_chunk"})
         return out
+
+    def _statusz(self) -> dict:
+        """/statusz provider: live pool/occupancy plus the roofline
+        rows and request-state counts from stats()."""
+        cache = self.executor.cache
+        s = self.scheduler
+        return {
+            "tick": s.tick,
+            "in_flight": self.in_flight,
+            "queued": len(s.queue),
+            "prefilling": len(s.prefilling),
+            "running": len(s.running),
+            "pool": {
+                "num_pages": cache.num_pages,
+                "free_pages": cache.free_pages,
+                "used_pages": cache.num_pages - cache.free_pages,
+            },
+            "stats": self.stats(),
+        }

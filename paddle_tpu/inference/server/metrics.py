@@ -190,6 +190,7 @@ class EngineMetrics:
         self.evicted_pages += int(n_pages)
         if self._obs is not None:
             self._m["evicted_pages"].inc(int(n_pages))
+            self._obs.events.log("kv.evict", pages=int(n_pages))
 
     def on_terminal(self, req, step):
         req.finish_step = step

@@ -358,6 +358,10 @@ class Scheduler:
                     sid=req.sid, tick=self.tick,
                     cached_tokens=int(hit_tokens),
                     resume=int(req.preempt_count > 0))
+                self._obs.events.log(
+                    "req.admit", rid=req.rid, tick=self.tick,
+                    cached_tokens=int(hit_tokens),
+                    resume=int(req.preempt_count > 0))
             faults.fire("serve.admit", "after")
 
     def _pick_next(self):
@@ -518,6 +522,10 @@ class Scheduler:
             self._obs.tracer.instant(
                 "req.finish", cat="serve", trace_id=req.rid,
                 tick=self.tick, state=state.value, reason=reason,
+                tokens=len(req.generated))
+            self._obs.events.log(
+                "req.finish", rid=req.rid, tick=self.tick,
+                state=state.value, reason=reason,
                 tokens=len(req.generated))
             if state is RequestState.FAILED:
                 self._obs.recorder.record(

@@ -35,14 +35,15 @@ from __future__ import annotations
 import os
 import threading
 
+from .events import EventLog
 from .flight import FlightRecorder
 from .registry import MetricRegistry
 from .trace import LogicalClock, Span, Tracer
 
 __all__ = [
-    "FlightRecorder", "LogicalClock", "MetricRegistry", "Span",
-    "Tracer", "auto_dump", "configure", "dump", "enabled", "event",
-    "handle", "instant", "perf", "reset", "span",
+    "EventLog", "FlightRecorder", "LogicalClock", "MetricRegistry",
+    "Span", "Tracer", "auto_dump", "beat", "configure", "dump",
+    "enabled", "event", "handle", "instant", "perf", "reset", "span",
 ]
 
 _MODES = ("off", "on")
@@ -54,18 +55,44 @@ _initialized = False  # PT_OBS read yet?
 
 class _Obs:
     """The live telemetry bundle: one clock feeding one registry, one
-    tracer, and one flight recorder."""
+    tracer, one flight recorder, and one structured event log (the
+    flight ring tees into the log), plus the health-plane state
+    (heartbeats, SLO engines, ``/statusz`` providers, HTTP server)."""
 
     def __init__(self, clock=None, flight_capacity=512,
-                 trace_capacity=65536, annotate=True):
+                 trace_capacity=65536, annotate=True, events_path=None,
+                 events_max_bytes=262144, events_max_files=3,
+                 events_capacity=4096):
         import time
 
         self.clock = clock if clock is not None else time.perf_counter
         self.registry = MetricRegistry()
         self.tracer = Tracer(clock=self.clock, capacity=trace_capacity,
                              annotate=annotate)
+        if events_path is None:
+            events_path = os.environ.get("PT_OBS_EVENT_LOG") or None
+        self.events = EventLog(clock=self.clock, path=events_path,
+                               max_bytes=events_max_bytes,
+                               max_files=events_max_files,
+                               capacity=events_capacity)
         self.recorder = FlightRecorder(clock=self.clock,
-                                       capacity=flight_capacity)
+                                       capacity=flight_capacity,
+                                       sink=self.events.from_flight)
+        self.heartbeats = {}    # component -> last-beat timestamp
+        self.slo_engines = []   # live health.SLOEngine instances
+        self.statusz = {}       # provider name -> payload callable
+        self.httpd = None
+        port = os.environ.get("PT_OBS_HTTP")
+        if port:
+            from . import httpd as _httpd
+
+            self.httpd = _httpd.ObsHTTPServer(port=int(port))
+
+    def close(self):
+        if self.httpd is not None:
+            self.httpd.stop()
+            self.httpd = None
+        self.events.close()
 
 
 def _env_mode():
@@ -92,7 +119,9 @@ def enabled():
 
 
 def configure(mode="on", clock=None, flight_capacity=512,
-              trace_capacity=65536, annotate=True):
+              trace_capacity=65536, annotate=True, events_path=None,
+              events_max_bytes=262144, events_max_files=3,
+              events_capacity=4096):
     """Programmatic gate (tests / bench A/B): rebuild the bundle
     regardless of ``PT_OBS``.  Returns the new handle (None for
     ``mode="off"``).  Producers that cached a handle at construction
@@ -102,10 +131,17 @@ def configure(mode="on", clock=None, flight_capacity=512,
     if mode not in _MODES:
         raise ValueError(f"obs.configure mode={mode!r}: expected off|on")
     with _lock:
+        old = _handle
         _handle = (_Obs(clock=clock, flight_capacity=flight_capacity,
-                        trace_capacity=trace_capacity, annotate=annotate)
+                        trace_capacity=trace_capacity, annotate=annotate,
+                        events_path=events_path,
+                        events_max_bytes=events_max_bytes,
+                        events_max_files=events_max_files,
+                        events_capacity=events_capacity)
                    if mode == "on" else None)
         _initialized = True
+    if old is not None:
+        old.close()
     return _handle
 
 
@@ -114,8 +150,11 @@ def reset():
     ``PT_OBS``."""
     global _handle, _initialized
     with _lock:
+        old = _handle
         _handle = None
         _initialized = False
+    if old is not None:
+        old.close()
     perf.reset()
 
 
@@ -156,6 +195,15 @@ def event(kind, **fields):
     h = handle()
     if h is not None:
         h.recorder.record(kind, **fields)
+
+
+def beat(name, now=None):
+    """Heartbeat for ``/healthz`` staleness: stamp component ``name``
+    as alive.  Hot loops pass ``now`` (a timestamp they already read)
+    to avoid an extra clock read."""
+    h = handle()
+    if h is not None:
+        h.heartbeats[name] = h.clock() if now is None else now
 
 
 def dump(path=None, reason="manual"):

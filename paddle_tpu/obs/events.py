@@ -1,0 +1,184 @@
+"""Structured event log: an append-only JSON-lines journal of
+lifecycle events, the queryable superset of the flight ring.
+
+Where the :class:`~paddle_tpu.obs.flight.FlightRecorder` is a crash
+black box (bounded ring, dumped only when something goes wrong), the
+event log is the incident-reconstruction surface: every lifecycle
+event — request admit/finish/fail, preemption, eviction, guardian
+anomaly, checkpoint commit, jit trace, alert transitions — lands here
+as one JSON object per line, with bounded file rotation so a
+long-running replica never fills a disk.
+
+Two inputs feed it:
+
+- direct producers call :meth:`EventLog.log` (bracketed by the
+  ``obs.event`` fault point so crash-during-journal is testable);
+- every flight-recorder event is teed in via :meth:`EventLog.from_flight`
+  (wired as the recorder's sink by ``obs.configure``), reusing the
+  flight event's timestamp so the deterministic clock sequence seen by
+  existing tests is unchanged.
+
+The in-memory tail (``deque(maxlen=capacity)``) is always on; the file
+journal only exists when a path is configured (``PT_OBS_EVENT_LOG`` or
+``obs.configure(events_path=...)``).  Rotation is size-based:
+``path`` -> ``path.1`` -> ... -> ``path.<max_files-1>``, oldest
+dropped.  ``tools/obs_query.py`` reads the rotated set back in order.
+"""
+from __future__ import annotations
+
+import json
+import os
+from collections import deque
+
+#: every journal line carries at least these keys (schema gate in
+#: tools/obs_dump.py checks them).
+SCHEMA_KEYS = ("seq", "ts", "kind")
+
+
+class EventLog:
+    def __init__(self, clock, path=None, max_bytes=262144, max_files=3,
+                 capacity=4096):
+        self._clock = clock
+        self.path = path
+        self.max_bytes = int(max_bytes)
+        self.max_files = int(max_files)
+        if self.max_files < 1:
+            raise ValueError(f"max_files must be >= 1, got {max_files}")
+        self.capacity = int(capacity)
+        self._tail = deque(maxlen=self.capacity)
+        self.seq = 0                  # total events ever journaled
+        self._file = None
+        self._file_bytes = 0
+        if path is not None:
+            self._open()
+
+    # -- producers ------------------------------------------------------
+
+    def log(self, kind, **fields):
+        """Journal one event; returns the event dict.  Bracketed by the
+        ``obs.event`` fault point so a crash mid-journal is itself a
+        testable failure mode."""
+        from ..testing import faults
+
+        faults.fire("obs.event", "before", path=self.path)
+        ev = self._append(kind, round(self._clock(), 6), fields)
+        faults.fire("obs.event", "after", path=self.path)
+        return ev
+
+    def from_flight(self, flight_ev):
+        """Sink for the flight recorder: tee a ring event into the
+        journal.  Reuses the flight event's timestamp (no extra clock
+        read — the deterministic tick sequence is unchanged) and
+        assigns the journal's own ``seq``."""
+        fields = {k: v for k, v in flight_ev.items()
+                  if k not in ("seq", "ts", "kind")}
+        fields["flight_seq"] = flight_ev["seq"]
+        self._append(flight_ev["kind"], flight_ev["ts"], fields)
+
+    def _append(self, kind, ts, fields):
+        self.seq += 1
+        ev = {"seq": self.seq, "ts": ts, "kind": kind}
+        ev.update(fields)
+        self._tail.append(ev)
+        if self._file is not None:
+            line = json.dumps(ev, default=str) + "\n"
+            data = line.encode()
+            if self._file_bytes and \
+                    self._file_bytes + len(data) > self.max_bytes:
+                self._rotate()
+            self._file.write(line)
+            self._file.flush()
+            self._file_bytes += len(data)
+        return ev
+
+    # -- file journal ---------------------------------------------------
+
+    def _open(self):
+        self._file = open(self.path, "a")
+        self._file_bytes = os.path.getsize(self.path)
+
+    def _rotate(self):
+        self._file.close()
+        oldest = f"{self.path}.{self.max_files - 1}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self.max_files - 2, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        if self.max_files > 1:
+            os.replace(self.path, f"{self.path}.1")
+        else:
+            os.remove(self.path)
+        self._file = open(self.path, "a")
+        self._file_bytes = 0
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    # -- consumers ------------------------------------------------------
+
+    def events(self):
+        """The in-memory tail, oldest-first."""
+        return list(self._tail)
+
+    def __len__(self):
+        return len(self._tail)
+
+    def journal_files(self):
+        """Existing journal files, oldest rotation first, live file
+        last — concatenation order for readers."""
+        if self.path is None:
+            return []
+        paths = [f"{self.path}.{i}"
+                 for i in range(self.max_files - 1, 0, -1)]
+        paths.append(self.path)
+        return [p for p in paths if os.path.exists(p)]
+
+
+def journal_files(path, max_files=16):
+    """Rotation set for ``path`` without a live :class:`EventLog` —
+    oldest first (``path.N`` .. ``path.1``, then ``path``)."""
+    paths = [f"{path}.{i}" for i in range(max_files, 0, -1)]
+    paths.append(path)
+    return [p for p in paths if os.path.exists(p)]
+
+
+def read_journal(path, max_files=16):
+    """Parse a journal (including rotated files) into event dicts,
+    oldest-first."""
+    out = []
+    for p in journal_files(path, max_files=max_files):
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+    return out
+
+
+def match(ev, rid=None, kind=None, since=None, until=None):
+    """One filter predicate shared by the CLI and tests.
+
+    ``kind`` matches exactly or as a dotted prefix (``"req"`` matches
+    ``"req.admit"``); ``since``/``until`` bound ``ts`` inclusively.
+    """
+    if rid is not None and ev.get("rid") != rid:
+        return False
+    if kind is not None:
+        k = ev.get("kind", "")
+        if k != kind and not k.startswith(kind + "."):
+            return False
+    if since is not None and ev.get("ts", 0.0) < since:
+        return False
+    if until is not None and ev.get("ts", 0.0) > until:
+        return False
+    return True
+
+
+def query(events, rid=None, kind=None, since=None, until=None):
+    """Filter an event iterable by rid / kind(-prefix) / time range."""
+    return [ev for ev in events
+            if match(ev, rid=rid, kind=kind, since=since, until=until)]

@@ -20,7 +20,7 @@ from collections import deque
 
 
 class FlightRecorder:
-    def __init__(self, clock, capacity=512):
+    def __init__(self, clock, capacity=512, sink=None):
         self._clock = clock
         self.capacity = int(capacity)
         if self.capacity < 1:
@@ -29,6 +29,10 @@ class FlightRecorder:
         self.seq = 0              # total events ever recorded
         self.dumps = 0
         self.last_dump = None     # text of the most recent dump
+        # sink: tee every ring event into the structured event log —
+        # the ring stays the bounded crash black box, the sink keeps
+        # the durable journal (obs wires this to EventLog.from_flight)
+        self._sink = sink
 
     def record(self, kind, **fields):
         self.seq += 1
@@ -36,6 +40,8 @@ class FlightRecorder:
               "kind": kind}
         ev.update(fields)
         self._events.append(ev)
+        if self._sink is not None:
+            self._sink(ev)
         return ev
 
     def events(self):

@@ -237,6 +237,12 @@ def isfinite(x, name=None):
 def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
     import numpy as np
 
+    from . import infermeta
+
+    infermeta.validate("allclose",
+                       (x._data if isinstance(x, Tensor) else x,
+                        y._data if isinstance(y, Tensor) else y),
+                       {"rtol": rtol, "atol": atol})
     return Tensor(np.allclose(x.numpy(), y.numpy(), rtol=rtol, atol=atol,
                               equal_nan=equal_nan))
 
@@ -244,8 +250,11 @@ def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
 def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
     import jax.numpy as jnp
 
+    from . import infermeta
+
     xd = x._data if isinstance(x, Tensor) else x
     yd = y._data if isinstance(y, Tensor) else y
+    infermeta.validate("isclose", (xd, yd), {"rtol": rtol, "atol": atol})
     return Tensor(jnp.isclose(xd, yd, rtol=rtol, atol=atol,
                               equal_nan=equal_nan))
 

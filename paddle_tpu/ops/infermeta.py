@@ -1050,3 +1050,85 @@ def _logcumsumexp(datas, attrs):
     if axis is None:
         return
     _axis_in("logcumsumexp", int(axis), max(_ndim(datas[0]), 1))
+
+
+# -- batch 9: lerp / dist / allclose / isclose / frexp / copysign ------------
+
+def _is_float_dtype(dt):
+    if dt is None:
+        return True
+    s = str(dt)
+    if "float" in s:  # covers float16/32/64 AND bfloat16 (which numpy's
+        return True   # issubdtype does not place under np.floating)
+    try:
+        return np.issubdtype(np.dtype(s), np.floating)
+    except TypeError:
+        return False
+
+
+def _broadcast_pair(op, x, y, xname="X", yname="Y"):
+    xs, ys = _shape(x), _shape(y)
+    try:
+        return np.broadcast_shapes(xs, ys)
+    except ValueError:
+        _fail(op,
+              f"The shape of {xname} {list(xs)} and the shape of "
+              f"{yname} {list(ys)} are not broadcast-compatible")
+
+
+@register_validator("lerp")
+def _lerp(datas, attrs):
+    # binary.cc LerpInferMeta: x/y broadcast first, then the weight
+    # against the pair (weight may be a python float — shape ())
+    xy = _broadcast_pair("lerp", datas[0], datas[1])
+    ws = _shape(datas[2])
+    try:
+        np.broadcast_shapes(xy, ws)
+    except ValueError:
+        _fail("lerp",
+              f"The shape of Weight {list(ws)} is not broadcast-"
+              f"compatible with the X/Y result shape {list(xy)}")
+
+
+@register_validator("copysign")
+def _copysign(datas, attrs):
+    _broadcast_pair("copysign", datas[0], datas[1])
+
+
+@register_validator("frexp")
+def _frexp(datas, attrs):
+    # unary.cc FrexpInferMeta: decomposition is only defined for
+    # floating inputs
+    dt = getattr(datas[0], "dtype", None)
+    if not _is_float_dtype(dt):
+        _fail("frexp",
+              f"The input's data type must be floating point, but "
+              f"received {dt}")
+
+
+@register_validator("dist")
+def _dist(datas, attrs):
+    # binary.cc DistInferMeta — composite wrapper, validated manually
+    # in linalg.dist (never passes registry.apply)
+    _broadcast_pair("dist", datas[0], datas[1])
+
+
+def _close_check(op, datas, attrs):
+    # binary.cc ValueCompareInferMeta + the rtol/atol contract; host
+    # path, wrapper-invoked
+    _broadcast_pair(op, datas[0], datas[1],
+                    xname="input X", yname="input Y")
+    for key in ("rtol", "atol"):
+        v = attrs.get(key)
+        if v is not None and float(v) < 0:
+            _fail(op, f"{key} must be non-negative, but received {v}")
+
+
+@register_validator("allclose")
+def _allclose(datas, attrs):
+    _close_check("allclose", datas, attrs)
+
+
+@register_validator("isclose")
+def _isclose(datas, attrs):
+    _close_check("isclose", datas, attrs)

@@ -169,8 +169,11 @@ def norm(x, p=None, axis=None, keepdim=False, name=None):
 
 
 def dist(x, y, p=2, name=None):
+    from . import infermeta
     from . import math as m
 
+    infermeta.validate("dist", (getattr(x, "_data", x),
+                                getattr(y, "_data", y)), {"p": p})
     return norm(m.subtract(x, y), p=p)
 
 

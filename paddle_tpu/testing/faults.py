@@ -90,6 +90,11 @@ REGISTERED = {
                 "serialized; after=dump text retained/written)",
     "obs.export": "one Chrome-trace export (before=no file, after=file "
                   "on disk)",
+    "obs.event": "one structured-event-log journal write (before=no "
+                 "line appended, after=line on disk/in tail)",
+    "obs.http": "one health-plane HTTP request (before=nothing "
+                "written to the socket; a raise here becomes a 500 "
+                "response, after=response sent)",
 }
 
 _PHASES = ("before", "after")

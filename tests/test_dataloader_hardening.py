@@ -92,9 +92,16 @@ def test_persistent_workers_reuse_pool_across_epochs():
     epoch1 = {int(b.numpy().ravel()[0]) for b in loader}
     pool1 = loader._pool
     assert pool1 is not None
+    pool_pids = {p.pid for p in pool1._pool}
     epoch2 = {int(b.numpy().ravel()[0]) for b in loader}
     assert loader._pool is pool1  # same pool object
-    assert epoch1 == epoch2  # literally the same worker processes
+    assert {p.pid for p in pool1._pool} == pool_pids  # no respawn
+    # Every batch must have come out of the persistent pool's workers.
+    # Deliberately NOT epoch1 == epoch2: which worker serves how many
+    # batches is OS-scheduler noise (under full-suite load one worker
+    # can take every batch), and asserting the per-epoch pid SETS match
+    # was exactly the load-sensitive flake this replaces.
+    assert epoch1 <= pool_pids and epoch2 <= pool_pids
     del loader
 
 

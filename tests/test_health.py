@@ -1,0 +1,455 @@
+"""Serving health plane: SLO burn-rate math, the alert state machine,
+the structured event log, and the /metrics-/healthz-/statusz endpoint
+contract.
+
+Everything runs on :class:`obs.LogicalClock` — burn rates, fire and
+resolve steps, and journal timestamps are exact, never wall-flaky.
+Objective snapshots are driven with explicit ``now=`` stamps, so the
+window arithmetic in each test is plain fractions you can check by
+hand.
+"""
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import obs
+from paddle_tpu.inference.server import ServingEngine
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.obs import events as ev_mod
+from paddle_tpu.obs import health, httpd
+from paddle_tpu.obs.events import EventLog
+from paddle_tpu.obs.trace import LogicalClock
+from paddle_tpu.testing import faults
+from paddle_tpu.testing.faults import InjectedFault
+from paddle_tpu.testing.load import LoadSpec, generate_load, run_load
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(11)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.reset()
+    obs.reset()
+    yield
+    faults.reset()
+    obs.reset()
+
+
+def _on(**kw):
+    kw.setdefault("clock", LogicalClock())
+    return obs.configure(mode="on", **kw)
+
+
+ENGINE_KW = dict(max_seqs=2, page_size=4, max_len=64)
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# -- burn-rate math (exact, by hand) -----------------------------------------
+
+def test_latency_objective_burn_is_exact():
+    h = _on()
+    fam = h.registry.histogram("ttft_s", "test",
+                               buckets=(0.001, 0.01, 0.1))
+    eng = health.SLOEngine(
+        [health.LatencyObjective("t", "ttft_s",
+                                 threshold_s=0.01, target=0.9)],
+        rules=[(10.0, 40.0, 2.0, "page")], now=0.0)
+    for _ in range(8):
+        fam.observe(0.005)          # good
+    for _ in range(2):
+        fam.observe(0.5)            # bad
+    eng.evaluate(now=5.0)
+    # bad fraction 2/10 = 0.2, budget 0.1 -> burn exactly 2.0 on both
+    # windows (whole history inside them), which meets the threshold.
+    row = eng.table()[0]
+    assert row["burn"] == {"10s": 2.0, "40s": 2.0}
+    assert row["budget_remaining"] == -1.0
+    assert eng.state("t") == "page"
+    text = h.registry.prometheus_text()
+    assert 'slo_burn_rate{slo="t",window="10s"} 2' in text
+    assert 'slo_alert_state{slo="t"} 2' in text
+    # 20 clean observations later the bad pair slides out of both
+    # windows: burn 0, alert resolves.
+    for _ in range(20):
+        fam.observe(0.005)
+    eng.evaluate(now=50.0)
+    row = eng.table()[0]
+    assert row["burn"] == {"10s": 0.0, "40s": 0.0}
+    assert row["budget_remaining"] == 1.0
+    assert eng.state("t") == "ok"
+
+
+def test_short_window_blip_does_not_page():
+    """The multi-window AND: a burst that saturates the short window
+    but not the long one must not fire (the SRE recipe's whole point)."""
+    h = _on()
+    fam = h.registry.histogram("ttft_s", "test", buckets=(0.01, 0.1))
+    eng = health.SLOEngine(
+        [health.LatencyObjective("t", "ttft_s",
+                                 threshold_s=0.01, target=0.9)],
+        rules=[(10.0, 100.0, 2.0, "page")], now=0.0)
+    for _ in range(100):
+        fam.observe(0.005)
+    eng.evaluate(now=90.0)
+    assert eng.state("t") == "ok"
+    for _ in range(10):
+        fam.observe(0.5)
+    eng.evaluate(now=100.0)
+    row = eng.table()[0]
+    # short window: 10 bad / 10 total = 1.0 / 0.1 budget = 10x
+    assert row["burn"]["10s"] == 10.0
+    # long window: 10 bad / 110 total ~ 0.909x — under threshold
+    assert row["burn"]["100s"] == round(10 / 110 / 0.1, 4)
+    assert eng.state("t") == "ok"
+
+
+def test_ratio_objective_with_label_filter():
+    h = _on()
+    fam = h.registry.counter("reqs_total", "by state",
+                             labels=("state",))
+    sub = h.registry.counter("submitted_total")
+    eng = health.SLOEngine(
+        [health.RatioObjective(
+            "errs", bad=("reqs_total", {"state": "failed"}),
+            total=("submitted_total", None), target=0.9)],
+        rules=[(10.0, 10.0, 1.0, "warn")], now=0.0)
+    sub.inc(20)
+    fam.labels(state="finished").inc(18)
+    fam.labels(state="failed").inc(2)
+    eng.evaluate(now=5.0)
+    # 2 failed / 20 submitted = 0.1 bad = exactly the budget: burn 1.0
+    row = eng.table()[0]
+    assert row["burn"]["10s"] == 1.0
+    assert eng.state("errs") == "warn"
+
+
+def test_alert_events_carry_step_and_transition():
+    h = _on()
+    fam = h.registry.histogram("ttft_s", "test", buckets=(0.01, 0.1))
+    eng = health.SLOEngine(
+        [health.LatencyObjective("t", "ttft_s",
+                                 threshold_s=0.01, target=0.9)],
+        rules=[(5.0, 5.0, 2.0, "page")], now=0.0)
+    fam.observe(0.5)
+    eng.evaluate(step=7, now=1.0)
+    fam.observe(0.005)
+    eng.evaluate(step=8, now=2.0)    # still paging (1 bad in window)
+    for _ in range(50):
+        fam.observe(0.005)
+    eng.evaluate(step=9, now=10.0)   # bad sample slid out
+    alerts = [e for e in h.events.events()
+              if e["kind"].startswith("alert.")]
+    assert [(e["kind"], e["step"], e["from"], e["to"])
+            for e in alerts] == [
+        ("alert.fire", 7, "ok", "page"),
+        ("alert.resolve", 9, "page", "ok"),
+    ]
+    assert all(e["slo"] == "t" for e in alerts)
+
+
+def test_objective_validation():
+    _on()
+    with pytest.raises(ValueError, match="target"):
+        health.LatencyObjective("t", "f", threshold_s=0.1, target=1.0)
+    with pytest.raises(ValueError, match="duplicate"):
+        health.SLOEngine(
+            [health.RatioObjective("x", ("a", None), ("b", None), 0.9),
+             health.RatioObjective("x", ("a", None), ("b", None), 0.9)])
+    with pytest.raises(ValueError, match="short<=long"):
+        health.SLOEngine(
+            [health.RatioObjective("x", ("a", None), ("b", None), 0.9)],
+            rules=[(100.0, 10.0, 1.0, "page")])
+    with pytest.raises(RuntimeError, match="telemetry"):
+        obs.configure(mode="off")
+        health.SLOEngine([])
+
+
+def test_latency_threshold_must_be_a_bucket_bound():
+    h = _on()
+    h.registry.histogram("ttft_s", "test", buckets=(0.01, 0.1))
+    with pytest.raises(ValueError, match="bucket"):
+        health.SLOEngine(
+            [health.LatencyObjective("t", "ttft_s",
+                                     threshold_s=0.05, target=0.9)])
+
+
+def test_rebuild_replaces_engine_per_source():
+    h = _on()
+    health.SLOEngine([health.RatioObjective(
+        "a", ("x", None), ("y", None), 0.9)], source="serving")
+    health.SLOEngine([health.RatioObjective(
+        "b", ("x", None), ("y", None), 0.9)], source="serving")
+    health.SLOEngine([health.RatioObjective(
+        "c", ("x", None), ("y", None), 0.9)], source="train")
+    names = [r["slo"] for e in h.slo_engines for r in e.table()]
+    assert names == ["b", "c"]
+
+
+# -- the acceptance scenario: seeded load fires and resolves -----------------
+
+def _violated_load(model):
+    """Seeded load against an impossible TTFT objective (every logical
+    clock read is 1 ms, so every TTFT lands above 1 ms).  Returns the
+    fire step and the live handle."""
+    h = obs.handle()
+    eng = ServingEngine(
+        model,
+        slos=[health.LatencyObjective(
+            "ttft_tight", "serve_ttft_seconds",
+            threshold_s=0.001, target=0.99)],
+        slo_rules=[(0.05, 0.2, 14.4, "page")], **ENGINE_KW)
+    rng = np.random.RandomState(1)
+    for n in (7, 13):
+        eng.submit(rng.randint(1, 256, (n,)).astype(np.int32),
+                   max_new_tokens=6)
+    eng.run()
+    return eng, h
+
+
+def test_violated_slo_fires_page_then_resolves(model):
+    _on()
+    eng, h = _violated_load(model)
+    assert eng._health.state("ttft_tight") == "page"
+    fires = [e for e in h.events.events() if e["kind"] == "alert.fire"]
+    assert len(fires) == 1
+    fire_step = fires[0]["step"]
+    assert fires[0]["slo"] == "ttft_tight"
+    assert fires[0]["severity"] == "page"
+    assert fire_step >= 1
+    # the alert surfaces in the live /statusz table while firing...
+    # (scraped further below; here via the payload builder)
+    rows = {r["slo"]: r for r in health.statusz_payload(h)["slos"]}
+    assert rows["ttft_tight"]["state"] == "page"
+    assert rows["ttft_tight"]["source"] == "serving"
+    # ...and resolves once idle steps slide the bad window out
+    # (each idle step advances the logical clock 1 ms; the windows
+    # are 50 ms / 200 ms).
+    for _ in range(400):
+        eng.step()
+    assert eng._health.state("ttft_tight") == "ok"
+    resolves = [e for e in h.events.events()
+                if e["kind"] == "alert.resolve"]
+    assert len(resolves) == 1 and resolves[0]["slo"] == "ttft_tight"
+    assert resolves[0]["step"] > fire_step
+    # the fire step is a deterministic function of the seeded load:
+    # an identical run on a fresh clock fires at the same step
+    obs.reset()
+    _on()
+    eng2, h2 = _violated_load(model)
+    fires2 = [e for e in h2.events.events()
+              if e["kind"] == "alert.fire"]
+    assert [e["step"] for e in fires2] == [fire_step]
+
+
+# -- PT_OBS=off parity with the health plane wired ---------------------------
+
+LOAD_SPEC = dict(n_requests=6, mean_interarrival=2.0,
+                 prompt_len=(4, 20), max_new=(3, 8), vocab=256, seed=7)
+LOGICAL_STATS = ("steps", "requests", "preemptions", "decode_tokens",
+                 "prefill_tokens", "batch_occupancy", "page_utilization",
+                 "queue_wait_steps_p50", "ttft_steps_p50")
+
+
+def _seeded_load(model):
+    # tight SLO + fast windows: with obs on this load fires alerts,
+    # which is exactly the path that must not perturb computation
+    eng = ServingEngine(
+        model, prefill_chunk=8,
+        slos=[health.LatencyObjective(
+            "ttft_tight", "serve_ttft_seconds",
+            threshold_s=0.001, target=0.99)],
+        slo_rules=[(0.05, 0.2, 14.4, "page")], **ENGINE_KW)
+    work = generate_load(LoadSpec(**LOAD_SPEC))
+    res = run_load(eng, work)
+    return ({w["rid"]: res["handles"][w["rid"]].tokens for w in work},
+            {k: res["stats"][k] for k in LOGICAL_STATS})
+
+
+def test_off_path_bit_identical_with_health_wired(model):
+    obs.configure(mode="off")
+    toks_off, stats_off = _seeded_load(model)
+    h = _on()
+    toks_on, stats_on = _seeded_load(model)
+    assert any(e["kind"] == "alert.fire" for e in h.events.events())
+    assert toks_on == toks_off
+    assert stats_on == stats_off
+
+
+# -- endpoints ----------------------------------------------------------------
+
+def test_endpoint_contract(model):
+    h = _on()
+    eng, _ = _violated_load(model)
+    srv = httpd.start(port=0)
+    assert httpd.start(port=0) is srv    # idempotent per bundle
+    code, prom = _get(srv.url + "/metrics")
+    assert code == 200
+    for fam in ("slo_burn_rate", "slo_budget_remaining",
+                "slo_alert_state", "serve_requests_submitted_total"):
+        assert fam in prom
+    code, body = _get(srv.url + "/healthz")
+    hz = json.loads(body)
+    assert code == 200 and hz["status"] == "ok"
+    assert "serving" in hz["components"]
+    code, body = _get(srv.url + "/statusz")
+    sz = json.loads(body)
+    assert code == 200
+    assert sz["build"]["project"] == "paddle_tpu"
+    rows = {r["slo"]: r for r in sz["slos"]}
+    assert rows["ttft_tight"]["state"] == "page"
+    pool = sz["providers"]["serving"]["pool"]
+    assert pool["num_pages"] == pool["free_pages"] + pool["used_pages"]
+    assert sz["event_log"]["seq"] == h.events.seq
+    code, body = _get(srv.url + "/nope")
+    assert code == 404 and "/statusz" in body
+
+
+def test_healthz_staleness(model, monkeypatch):
+    h = _on()
+    obs.beat("serving", now=h.clock())
+    ok, payload = health.healthz_payload(h, stale_after_s=1000.0)
+    assert ok and payload["status"] == "ok"
+    ok, payload = health.healthz_payload(h, stale_after_s=0.0)
+    assert not ok and payload["components"]["serving"]["stale"]
+    # the HTTP route reads PT_OBS_STALE_S
+    monkeypatch.setenv("PT_OBS_STALE_S", "0.0")
+    srv = httpd.start(port=0)
+    code, body = _get(srv.url + "/healthz")
+    assert code == 503 and json.loads(body)["status"] == "stale"
+
+
+def test_scrape_with_telemetry_off_is_503():
+    obs.configure(mode="off")
+    srv = httpd.ObsHTTPServer(port=0)
+    try:
+        code, body = _get(srv.url + "/metrics")
+        assert code == 503
+        assert "PT_OBS" in json.loads(body)["error"]
+    finally:
+        srv.stop()
+
+
+def test_env_gate_autostarts_httpd(monkeypatch):
+    monkeypatch.setenv("PT_OBS_HTTP", "0")
+    h = _on()
+    assert h.httpd is not None
+    code, prom = _get(h.httpd.url + "/metrics")
+    assert code == 200          # registry is empty but the route lives
+    obs.reset()                      # must stop the server
+    with pytest.raises(Exception):
+        _get(f"http://127.0.0.1:{h.httpd.port}/metrics")
+
+
+def test_statusz_provider_error_is_isolated():
+    h = _on()
+    h.statusz["good"] = lambda: {"x": 1}
+    h.statusz["dead"] = lambda: 1 / 0
+    sz = health.statusz_payload(h)
+    assert sz["providers"]["good"] == {"x": 1}
+    assert "ZeroDivisionError" in sz["providers"]["dead"]["error"]
+
+
+# -- event log: journal, rotation, query -------------------------------------
+
+def test_event_log_rotation(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(LogicalClock(), path=path, max_bytes=256,
+                   max_files=3)
+    for i in range(40):
+        log.log("tick", i=i, pad="x" * 32)
+    log.close()
+    files = ev_mod.journal_files(path)
+    assert len(files) == 3 and files[-1] == path
+    evs = ev_mod.read_journal(path)
+    # oldest rotations dropped, survivors contiguous and in order
+    seqs = [e["seq"] for e in evs]
+    assert seqs == list(range(seqs[0], 41))
+    assert seqs[0] > 1
+    assert all(all(k in e for k in ev_mod.SCHEMA_KEYS) for e in evs)
+
+
+def test_event_log_tail_bounded():
+    log = EventLog(LogicalClock(), capacity=8)
+    for i in range(20):
+        log.log("tick", i=i)
+    assert len(log) == 8
+    assert [e["i"] for e in log.events()] == list(range(12, 20))
+    assert log.seq == 20
+
+
+def test_flight_events_tee_into_journal():
+    h = _on()
+    h.recorder.record("serve.preempt", rid="r1", tick=3)
+    h.events.log("req.admit", rid="r2")
+    kinds = {e["kind"] for e in h.events.events()}
+    assert {"serve.preempt", "req.admit"} <= kinds
+    teed = next(e for e in h.events.events()
+                if e["kind"] == "serve.preempt")
+    assert teed["flight_seq"] >= 1 and teed["rid"] == "r1"
+
+
+def test_query_filters(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(LogicalClock(), path=path)
+    log.log("req.admit", rid="a")
+    log.log("req.finish", rid="a")
+    log.log("req.admit", rid="b")
+    log.log("alert.fire", slo="x")
+    log.close()
+    from tools import obs_query
+    evs = obs_query.run(path)
+    assert len(evs) == 4
+    assert len(obs_query.run(path, kind="req")) == 3      # prefix
+    assert len(obs_query.run(path, kind="req.admit")) == 2
+    assert {e["kind"] for e in obs_query.run(path, rid="a")} == \
+        {"req.admit", "req.finish"}
+    ts = [e["ts"] for e in evs]
+    assert obs_query.run(path, since=ts[2]) == evs[2:]
+    assert obs_query.run(path, until=ts[1]) == evs[:2]
+
+
+# -- fault serviceability -----------------------------------------------------
+
+def test_event_log_fault_point():
+    h = _on()
+    faults.reset("obs.event:before:1=raise")
+    with pytest.raises(InjectedFault):
+        h.events.log("req.admit", rid="x")
+    # next journal write succeeds — monitoring hiccups are survivable
+    ev = h.events.log("req.admit", rid="y")
+    assert ev["rid"] == "y"
+
+
+def test_httpd_fault_point_is_a_500_not_a_crash():
+    _on()
+    srv = httpd.start(port=0)
+    faults.reset("obs.http:before:1=raise")
+    code, body = _get(srv.url + "/metrics")
+    assert code == 500
+    assert "InjectedFault" in json.loads(body)["error"]
+    code, _ = _get(srv.url + "/metrics")
+    assert code == 200
+
+
+def test_fault_points_registered():
+    from paddle_tpu.testing.faults import REGISTERED
+    assert "obs.event" in REGISTERED and "obs.http" in REGISTERED

@@ -933,3 +933,88 @@ def test_logcumsumexp_rejects_wrapping_axis():
     # wrapped axis=2 on a rank-2 input to axis 0.
     with pytest.raises(InvalidArgumentError, match=r"range of \[-2, 2\)"):
         paddle.logcumsumexp(_f32(2, 3), axis=2)
+
+
+# -- batch 9 (r16): lerp / dist / allclose / isclose / frexp / copysign -----
+
+
+def test_lerp_accepts_broadcast_and_scalar_weight():
+    out = paddle.lerp(_f32(2, 3), _f32(1, 3), 0.5)
+    assert list(out.shape) == [2, 3]
+    out = paddle.lerp(_f32(2, 3), _f32(2, 3), _f32(3))
+    assert list(out.shape) == [2, 3]
+
+
+def test_lerp_rejects_incompatible_xy():
+    with pytest.raises(InvalidArgumentError, match="broadcast"):
+        paddle.lerp(_f32(2, 3), _f32(4, 5), 0.5)
+
+
+def test_lerp_rejects_incompatible_weight():
+    with pytest.raises(InvalidArgumentError, match="Weight"):
+        paddle.lerp(_f32(2, 3), _f32(2, 3), _f32(7))
+
+
+def test_copysign_accepts_broadcast():
+    out = paddle.copysign(_f32(2, 3), _f32(1, 3))
+    assert list(out.shape) == [2, 3]
+
+
+def test_copysign_rejects_incompatible_shapes():
+    with pytest.raises(InvalidArgumentError, match="broadcast"):
+        paddle.copysign(_f32(2, 3), _f32(4, 5))
+
+
+def test_frexp_accepts_float_and_bfloat16():
+    m, e = paddle.frexp(_f32(2, 3))
+    assert list(m.shape) == [2, 3] and list(e.shape) == [2, 3]
+    xb = _f32(2).astype("bfloat16")
+    assert list(paddle.frexp(xb)[0].shape) == [2]
+
+
+def test_frexp_rejects_integer_input():
+    with pytest.raises(InvalidArgumentError, match="floating point"):
+        paddle.frexp(paddle.to_tensor(np.ones((2,), np.int32)))
+
+
+def test_dist_accepts_broadcast():
+    x = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    y = paddle.to_tensor(np.ones((1, 3), np.float32))
+    np.testing.assert_allclose(float(paddle.dist(x, y, p=1)), 6.0)
+
+
+def test_dist_rejects_incompatible_shapes():
+    with pytest.raises(InvalidArgumentError, match="broadcast"):
+        paddle.dist(_f32(2, 3), _f32(4, 5))
+
+
+def test_allclose_accepts_broadcast():
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    y = paddle.to_tensor(np.ones((1, 3), np.float32))
+    assert bool(paddle.allclose(x, y))
+
+
+def test_allclose_rejects_incompatible_shapes():
+    with pytest.raises(InvalidArgumentError, match="broadcast"):
+        paddle.allclose(_f32(2, 3), _f32(4, 5))
+
+
+def test_allclose_rejects_negative_rtol():
+    with pytest.raises(InvalidArgumentError, match="rtol"):
+        paddle.allclose(_f32(2), _f32(2), rtol=-1.0)
+
+
+def test_isclose_accepts_broadcast():
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    y = paddle.to_tensor(np.ones((1, 3), np.float32))
+    assert bool(paddle.isclose(x, y).numpy().all())
+
+
+def test_isclose_rejects_incompatible_shapes():
+    with pytest.raises(InvalidArgumentError, match="broadcast"):
+        paddle.isclose(_f32(2, 3), _f32(4, 5))
+
+
+def test_isclose_rejects_negative_atol():
+    with pytest.raises(InvalidArgumentError, match="atol"):
+        paddle.isclose(_f32(2), _f32(2), atol=-0.5)

@@ -387,6 +387,22 @@ def test_check_perf_passes_with_nothing_to_compare(tmp_path):
     assert cp.main(["--dir", str(tmp_path)]) == 0
 
 
+def test_check_perf_compares_same_platform_only(tmp_path):
+    cp = _check_perf()
+    _round(tmp_path, 1, {**GOOD, "platform": "tpu"})
+    # a CPU round 10x slower than the TPU one is NOT a regression...
+    _round(tmp_path, 2, {**GOOD, "platform": "cpu", "value": 10.0})
+    assert cp.main(["--dir", str(tmp_path)]) == 0
+    # ...but a slower round on the SAME platform is
+    _round(tmp_path, 3, {**GOOD, "platform": "cpu", "value": 5.0})
+    assert cp.main(["--dir", str(tmp_path)]) == 1
+    # pre-stamp artifacts (no platform key) pair with each other
+    _round(tmp_path, 4, GOOD)
+    assert cp.main(["--dir", str(tmp_path)]) == 0   # no unnamed prior
+    _round(tmp_path, 5, {**GOOD, "value": 30.0})
+    assert cp.main(["--dir", str(tmp_path)]) == 1
+
+
 def test_check_perf_explicit_pair(tmp_path):
     cp = _check_perf()
     old = _round(tmp_path, 1, GOOD)

@@ -13,6 +13,12 @@ trajectory exists.
 Metric paths are dotted into the payload; missing/non-numeric values
 and legs recorded as ``{"skipped": ...}`` / ``{"error": ...}`` are
 skipped (an added or dropped bench leg is not a regression).
+
+Rounds are only auto-compared against a prior round recorded on the
+SAME ``platform`` (``jax.default_backend()``, stamped by bench.py
+since r06): a CPU dev round must not "regress" against a TPU round.
+Artifacts predating the stamp count as one unnamed platform.  An
+explicit ``--old``/``--new`` pair is compared unconditionally.
 """
 import argparse
 import glob
@@ -125,7 +131,15 @@ def main(argv=None):
             print(f"perf-check: {len(rounds)} usable round(s) under "
                   f"{args.dir} — nothing to compare, pass")
             return 0
-        (_, old, old_path), (_, new, new_path) = rounds[-2], rounds[-1]
+        _, new, new_path = rounds[-1]
+        plat = new.get("platform")
+        prior = [r for r in rounds[:-1]
+                 if r[1].get("platform") == plat]
+        if not prior:
+            print(f"perf-check: no prior usable round on platform "
+                  f"{plat or 'unnamed'!r} — nothing to compare, pass")
+            return 0
+        _, old, old_path = prior[-1]
 
     print(f"perf-check: {os.path.basename(new_path)} vs "
           f"{os.path.basename(old_path)}")

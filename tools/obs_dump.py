@@ -139,6 +139,43 @@ def main():
     seqs = [e["seq"] for e in events]
     check(seqs == sorted(seqs), "events in seq order")
 
+    # -- 4. health plane: /statusz JSON + event-log schema --------------
+    print("== health plane ==")
+    from paddle_tpu.obs import events as ev_mod
+    from paddle_tpu.obs import health
+    sz = health.statusz_payload(h)
+    check(json.loads(json.dumps(sz, default=str)) is not None,
+          "/statusz payload is JSON-serializable")
+    for key in ("build", "now", "heartbeats", "slos", "providers",
+                "event_log"):
+        check(key in sz, f"/statusz key {key}")
+    check(sz["build"].get("project") == "paddle_tpu",
+          "/statusz build info names the project")
+    rows = {r["slo"]: r for r in sz["slos"]}
+    check({"serve_ttft", "serve_errors"} <= set(rows),
+          "/statusz carries the stock serving SLOs")
+    for r in sz["slos"]:
+        check({"slo", "source", "target", "state", "burn",
+               "budget_remaining"} <= set(r),
+              f"SLO row schema for {r.get('slo')}")
+    check(rows.get("serve_errors", {}).get("state") == "ok",
+          "no failed requests: error SLO ok")
+    check(sz["providers"].get("serving", {}).get("pool", {})
+          .get("num_pages", 0) > 0,
+          "/statusz serving provider exposes the page pool")
+    check("serving" in sz["heartbeats"], "serving heartbeat recorded")
+    tail = h.events.events()
+    check(bool(tail), "event log has a tail")
+    check(all(all(k in e for k in ev_mod.SCHEMA_KEYS) for e in tail),
+          "event-log schema (seq/ts/kind on every event)")
+    echo = [e["seq"] for e in tail]
+    check(echo == sorted(echo), "event log in seq order")
+    ev_kinds = {e["kind"] for e in tail}
+    check({"req.admit", "req.finish", "serve.preempt"} <= ev_kinds,
+          "lifecycle events journaled (admit/finish/preempt)")
+    check(len(ev_mod.query(tail, kind="req.finish")) == 3,
+          "query by kind finds the three finishes")
+
     if FAILURES:
         print(f"\nobs-check: {len(FAILURES)} check(s) FAILED")
         for f in FAILURES:

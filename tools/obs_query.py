@@ -1,0 +1,58 @@
+"""Query the structured event log: filter a JSON-lines journal
+(including its rotated files) by request id, event kind, and time
+range.
+
+    python tools/obs_query.py events.jsonl --rid req-3
+    python tools/obs_query.py events.jsonl --kind req --since 0.5 --until 2.0
+    python tools/obs_query.py events.jsonl --kind alert.fire --count
+
+``--kind`` matches exactly or as a dotted prefix (``req`` matches
+``req.admit`` and ``req.finish``).  Rotated files (``path.N`` ..
+``path.1``) are read oldest-first, then the live file, so output is in
+journal order.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run(path, rid=None, kind=None, since=None, until=None,
+        max_files=16):
+    """Importable entry point: filtered events, oldest-first."""
+    from paddle_tpu.obs import events as ev
+
+    return ev.query(ev.read_journal(path, max_files=max_files),
+                    rid=rid, kind=kind, since=since, until=until)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="filter a paddle_tpu structured event log")
+    ap.add_argument("path", help="journal file (rotations found "
+                                 "automatically at path.1, path.2, ...)")
+    ap.add_argument("--rid", help="exact request id")
+    ap.add_argument("--kind", help="event kind, exact or dotted prefix")
+    ap.add_argument("--since", type=float, help="minimum ts (inclusive)")
+    ap.add_argument("--until", type=float, help="maximum ts (inclusive)")
+    ap.add_argument("--count", action="store_true",
+                    help="print only the number of matching events")
+    args = ap.parse_args(argv)
+    if not os.path.exists(args.path):
+        print(f"obs_query: no journal at {args.path}", file=sys.stderr)
+        return 2
+    out = run(args.path, rid=args.rid, kind=args.kind,
+              since=args.since, until=args.until)
+    if args.count:
+        print(len(out))
+    else:
+        for e in out:
+            print(json.dumps(e, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
