@@ -42,6 +42,7 @@ smoke:
 		tests/test_load_harness.py \
 		tests/test_prefix_cache.py \
 		tests/test_spec_decode.py \
+		tests/test_async_exec.py \
 		tests/test_obs.py \
 		tests/test_perf.py \
 		tests/test_health.py

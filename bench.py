@@ -767,6 +767,11 @@ def _bench_serving(jax):
             out["spec"] = _measure_spec(model, cfg, max_seqs)
         except Exception as e:  # same guard as the A/B leg
             out["spec"] = {"error": str(e)[:120]}
+    if os.environ.get("PT_BENCH_SERVE_ASYNC", "1") == "1":
+        try:
+            out["async_exec"] = _measure_async(model, cfg, max_seqs)
+        except Exception as e:  # same guard as the A/B leg
+            out["async_exec"] = {"error": str(e)[:120]}
     return out
 
 
@@ -929,6 +934,71 @@ def _measure_spec(model, cfg, max_seqs):
             (off["steps"] / ng["steps"]) if ng["steps"] else 0.0, 2),
         "tok_s_speedup": round(
             (ng["serving_tok_s"] / off["serving_tok_s"])
+            if off["serving_tok_s"] else 0.0, 2),
+    }
+
+
+def _measure_async(model, cfg, max_seqs):
+    """Async double-buffered executor A/B (r17): the SAME seeded
+    workload through `PT_ASYNC_EXEC=on` (plan N+1 on the host while
+    step N runs on the device, commit at the fence) and the sync
+    engine.  Exactness is a test contract (streams bit-identical,
+    tests/test_async_exec.py); this leg records the perf contract:
+    serving tok/s async-vs-sync, TTFT/TPOT percentiles per leg, and
+    host_overlap_ratio — overlapped host seconds over device compute
+    seconds, the quantity PERF.md's hiding math starts from (target
+    >0.8 at batch occupancy)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.inference.server import ServingEngine
+    from paddle_tpu.testing.load import LoadSpec, generate_load, run_load
+
+    n_req = int(os.environ.get("PT_BENCH_SERVE_REQS", "16"))
+    work = generate_load(LoadSpec(
+        n_requests=n_req, mean_interarrival=1.0, prompt_len=(64, 128),
+        max_new=(32, 64), vocab=cfg.vocab_size, seed=0))
+
+    def leg(async_exec):
+        eng = ServingEngine(model, max_seqs=max_seqs, page_size=16,
+                            max_len=512, dtype=jnp.bfloat16,
+                            prefill_chunk=128, async_exec=async_exec)
+        label = "on" if async_exec else "off"
+        print(f"serving[async {label}]: {n_req} seeded requests, "
+              f"batch {max_seqs}...", file=sys.stderr)
+        st = run_load(eng, work)["stats"]
+        done = st["requests"]["finished"] + st["requests"]["truncated"]
+        if done != n_req:
+            raise RuntimeError(f"async load did not finish cleanly: "
+                               f"{st['requests']}")
+        row = {
+            "serving_tok_s": st["throughput_tok_s"],
+            "ttft_ms_p50": st["ttft_ms_p50"],
+            "ttft_ms_p99": st["ttft_ms_p99"],
+            "tpot_ms_p50": st["tpot_ms_p50"],
+            "tpot_ms_p99": st["tpot_ms_p99"],
+            "batch_occupancy": st["batch_occupancy"],
+            "steps": st["steps"],
+        }
+        if async_exec:
+            s = eng.scheduler
+            row["host_overlap_ratio"] = round(s.host_overlap_ratio, 4)
+            row["replans"] = s.replans
+            row["phase_seconds_total"] = {
+                k: round(v, 4) for k, v in s.phase_totals.items()}
+        print(f"serving[async {label}]: "
+              f"{st['throughput_tok_s']:.0f} tok/s, tpot p50 "
+              f"{st['tpot_ms_p50']} ms"
+              + (f", overlap {row['host_overlap_ratio']}"
+                 if async_exec else ""), file=sys.stderr)
+        return row
+
+    on, off = leg(True), leg(False)
+    return {
+        "requests": n_req,
+        "on": on,
+        "off": off,
+        "tok_s_speedup": round(
+            (on["serving_tok_s"] / off["serving_tok_s"])
             if off["serving_tok_s"] else 0.0, 2),
     }
 

@@ -34,13 +34,21 @@ def _prefix_cache_enabled() -> bool:
     return mode == "on"
 
 
+def _async_exec_enabled() -> bool:
+    mode = os.environ.get("PT_ASYNC_EXEC", "off").lower()
+    if mode not in ("off", "on"):
+        raise ValueError(
+            f"PT_ASYNC_EXEC={mode!r}: expected off|on")
+    return mode == "on"
+
+
 class ServingEngine:
     def __init__(self, model, max_seqs=4, page_size=16, max_len=256,
                  dtype=jnp.float32, num_pages=None, policy="fifo",
                  prefill_chunk=None, eos_token_id=None,
                  max_preemptions=4, prefix_cache=None,
                  spec_decode=None, clock=None, slos=None,
-                 slo_rules=None):
+                 slo_rules=None, async_exec=None):
         self.executor = PagedExecutor(
             model, max_seqs=max_seqs, page_size=page_size,
             max_len=max_len, dtype=dtype, num_pages=num_pages)
@@ -75,11 +83,17 @@ class ServingEngine:
                     f"spec_decode={spec_decode!r}: expected off|ngram")
             spec_decode = spec_decode == "ngram"
         self.spec = SpecDecode() if spec_decode else None
+        # async_exec: None = follow PT_ASYNC_EXEC (default off,
+        # bit-exact legacy path); True/False force it (bench A/B).
+        # On = double-buffered steps: unrealized dispatch, next-step
+        # planning overlapped behind the device, commit at the fence.
+        if async_exec is None:
+            async_exec = _async_exec_enabled()
         self.scheduler = Scheduler(
             self.executor, self.metrics, policy=policy,
             prefill_chunk=prefill_chunk, eos_token_id=eos_token_id,
             max_preemptions=max_preemptions, prefix_cache=self.prefix,
-            spec=self.spec)
+            spec=self.spec, async_exec=async_exec)
         self._next_rid = 0
         # health plane: when telemetry is on, the engine owns an SLO
         # engine evaluated once per step, beats the "serving"
@@ -200,6 +214,13 @@ class ServingEngine:
                 "num_pages": cache.num_pages,
                 "free_pages": cache.free_pages,
                 "used_pages": cache.num_pages - cache.free_pages,
+            },
+            "async": {
+                "mode": "on" if s.async_mode else "off",
+                "replans": s.replans,
+                "host_overlap_ratio": s.host_overlap_ratio,
+                "step_phase_seconds": dict(s.last_phase_seconds),
+                "phase_seconds_total": dict(s.phase_totals),
             },
             "stats": self.stats(),
         }

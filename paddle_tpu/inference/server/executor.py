@@ -31,6 +31,70 @@ from ...ops.nn_ops import _rms_norm_plain, _rope_plain
 from ..paged import PagedKVCache, paged_decode_attention
 
 
+class _PendingDecode:
+    """Unrealized device output of one async decode dispatch.
+
+    ``wait()`` is the commit fence: ONE host transfer (the in-graph
+    argmax already reduced logits to an int32 [B] row), then the
+    last-token bookkeeping the sync path does inline.  Idempotent, so
+    a fault-interrupted commit can be re-driven safely."""
+
+    __slots__ = ("_ex", "sids", "_dev", "_out")
+
+    def __init__(self, ex, sids, dev):
+        self._ex = ex
+        self.sids = sids
+        self._dev = dev
+        self._out = None
+
+    def wait(self) -> dict:
+        if self._out is None:
+            toks = np.asarray(self._dev)      # the single device_get
+            out = {}
+            for i, s in enumerate(self.sids):
+                tok = int(toks[i])
+                self._ex.last_token[s] = tok
+                out[s] = tok
+            self._out = out
+            self._dev = None
+        return self._out
+
+
+class _PendingVerify:
+    """Unrealized device outputs of one async speculative-verify
+    dispatch: the sort-packed token block and per-seq counts stay on
+    device until ``wait()``, which also applies the length/last-token
+    bookkeeping the sync :meth:`PagedExecutor.verify` does inline."""
+
+    __slots__ = ("_ex", "sids", "_packed", "_emit_n", "_out")
+
+    def __init__(self, ex, sids, packed, emit_n):
+        self._ex = ex
+        self.sids = sids
+        self._packed = packed
+        self._emit_n = emit_n
+        self._out = None
+
+    def wait(self):
+        if self._out is None:
+            cache = self._ex.cache
+            packed = np.asarray(self._packed)
+            counts = np.asarray(self._emit_n)
+            out, accepted = {}, {}
+            off = 0
+            for i, s in enumerate(self.sids):
+                n = int(counts[i])
+                toks = [int(t) for t in packed[off:off + n]]
+                off += n
+                cache.lengths[s] += n
+                self._ex.last_token[s] = toks[-1]
+                out[s] = toks
+                accepted[s] = n - 1
+            self._out = (out, accepted)
+            self._packed = self._emit_n = None
+        return self._out
+
+
 class PagedExecutor:
     """Execution backend over the paged KV cache.
 
@@ -99,6 +163,13 @@ class PagedExecutor:
         self._jit_decode = CountedJit(self._decode_fwd,
                                       name="serve.decode",
                                       donate_argnums=(4, 5))
+        # async twin of serve.decode with the greedy argmax folded
+        # in-graph: the only transferable output is an int32 [B] token
+        # row, so the double-buffered scheduler's commit fence moves
+        # one small vector instead of [B, V] logits
+        self._jit_decode_async = CountedJit(self._decode_tok_fwd,
+                                            name="serve.decode_async",
+                                            donate_argnums=(4, 5))
         self._jit_decode_n = CountedJit(self._decode_n_fwd,
                                         name="serve.decode_n",
                                         static_argnames=("n",),
@@ -111,10 +182,11 @@ class PagedExecutor:
 
     @property
     def programs(self) -> dict:
-        """The five jitted programs, by contract name suffix."""
+        """The six jitted programs, by contract name suffix."""
         return {"prefill": self._jit_prefill,
                 "prefill_chunk": self._jit_chunk,
                 "decode": self._jit_decode,
+                "decode_async": self._jit_decode_async,
                 "decode_n": self._jit_decode_n,
                 "verify": self._jit_verify}
 
@@ -132,7 +204,7 @@ class PagedExecutor:
         return self._jit_verify.dispatches
 
     def _register_contracts(self):
-        """Register the five serving programs' graph contracts at
+        """Register the serving programs' graph contracts at
         representative shapes (lint traces ShapeDtypeStructs only — no
         device work).  Chunk shapes pick past cover == chunk length so
         the donation aliasing opportunity is visible to the checker."""
@@ -175,6 +247,12 @@ class PagedExecutor:
             args=(layers, tops, i32(B), i32(B), kp, kp, i32(B),
                   i32(B, pps)),
             donate_argnums=self._jit_decode.donate_argnums, **common))
+        register_program(ProgramContract(
+            name="serve.decode_async", fn=self._decode_tok_fwd,
+            args=(layers, tops, i32(B), i32(B), kp, kp, i32(B),
+                  i32(B, pps)),
+            donate_argnums=self._jit_decode_async.donate_argnums,
+            **common))
         register_program(ProgramContract(
             name="serve.decode_n", fn=self._decode_n_fwd,
             args=(layers, tops, i32(B), i32(B), kp, kp, i32(B),
@@ -353,6 +431,18 @@ class PagedExecutor:
             block, x, (layers, k_pages, v_pages))
         x = _rms_norm_plain(x, tops["norm_w"], epsilon=cfg.rms_norm_eps)
         return self._head(x[:, 0], tops), kps, vps
+
+    def _decode_tok_fwd(self, layers, tops, ids, positions, k_pages,
+                        v_pages, lengths, page_tables):
+        """:meth:`_decode_fwd` with the greedy argmax folded in-graph
+        (the spec-verify program already does this): the async executor
+        keeps the step's entire host sync down to one int32 [B]
+        transfer at the commit fence.  Returns (tokens [B], k_pages',
+        v_pages')."""
+        logits, kps, vps = self._decode_fwd(
+            layers, tops, ids, positions, k_pages, v_pages, lengths,
+            page_tables)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), kps, vps
 
     def _verify_fwd(self, layers, tops, ids, k_pages, v_pages, lengths,
                     page_tables, limits):
@@ -580,6 +670,35 @@ class PagedExecutor:
             out[s] = tok
         return out
 
+    def decode_async(self, sids) -> _PendingDecode:
+        """Dispatch one greedy decode step WITHOUT realizing the
+        result.  All page work and the length bookkeeping happen now —
+        so the scheduler can plan the NEXT step against post-step
+        lengths while the device runs — and the returned pending
+        object's :meth:`~_PendingDecode.wait` is the step's only host
+        sync point (one transfer, last-token updates)."""
+        sids = list(sids)
+        if not sids:
+            return _PendingDecode(self, [], np.zeros((0,), np.int32))
+        cache = self.cache
+        cache.reserve(sids, extra_tokens=1)
+        for s in sids:
+            pos = int(cache.lengths[s])
+            cache.make_writable(s, pos, pos + 1)
+        ids = jnp.asarray([self.last_token[s] for s in sids], jnp.int32)
+        positions = jnp.asarray([int(cache.lengths[s]) for s in sids],
+                                jnp.int32)
+        tables = jnp.asarray(np.maximum(cache.page_table[sids], 0))
+        lengths = jnp.asarray(cache.lengths[sids])
+        toks, kps, vps = self._jit_decode_async(
+            self.layers, self.tops, ids, positions, cache.k_pages,
+            cache.v_pages, lengths, tables)
+        cache.k_pages = kps
+        cache.v_pages = vps
+        for s in sids:
+            cache.lengths[s] += 1
+        return _PendingDecode(self, sids, toks)
+
     def verify(self, sids, drafts, limits, k):
         """Speculative decode step: run each listed slot's draft window
         through one jitted verify forward and commit the longest
@@ -632,6 +751,38 @@ class PagedExecutor:
             out[s] = toks
             accepted[s] = n - 1
         return out, accepted
+
+    def verify_async(self, sids, drafts, limits, k) -> _PendingVerify:
+        """:meth:`verify` split at its one natural sync point: the
+        jitted window verification is dispatched here (pages reserved,
+        windows COW'd, KV written in-graph), and the packed-token /
+        count transfers plus all length bookkeeping move into the
+        returned pending object's :meth:`~_PendingVerify.wait`."""
+        sids = list(sids)
+        if not sids:
+            return _PendingVerify(self, [], np.zeros((0,), np.int32),
+                                  np.zeros((0,), np.int32))
+        cache = self.cache
+        W = int(k) + 1
+        limits = [int(x) for x in limits]
+        cache.reserve(sids, extra_tokens=limits)
+        for s, lim in zip(sids, limits):
+            pos = int(cache.lengths[s])
+            cache.make_writable(s, pos, pos + lim)
+        ids = np.zeros((len(sids), W), np.int32)
+        for i, (s, dr) in enumerate(zip(sids, drafts)):
+            ids[i, 0] = self.last_token[s]
+            dr = np.asarray(dr, np.int32).reshape(-1)[:k]
+            ids[i, 1:1 + len(dr)] = dr
+        tables = jnp.asarray(np.maximum(cache.page_table[sids], 0))
+        lengths = jnp.asarray(cache.lengths[sids])
+        packed, emit_n, kps, vps = self._jit_verify(
+            self.layers, self.tops, jnp.asarray(ids), cache.k_pages,
+            cache.v_pages, lengths, tables,
+            jnp.asarray(limits, jnp.int32))
+        cache.k_pages = kps
+        cache.v_pages = vps
+        return _PendingVerify(self, sids, packed, emit_n)
 
     def rollback(self, sids) -> int:
         """Release pages reserved for rejected draft positions: trim

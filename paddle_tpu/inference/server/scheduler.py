@@ -26,6 +26,21 @@ poisoned-request isolation the tests prove.  Under speculative decode
 with the same discipline.  Every ``before`` site fires with engine
 state either untouched or already committed, so an injected raise
 never leaves a half-mutated scheduler.
+
+Double-buffered execution (``PT_ASYNC_EXEC=on``): the iteration is
+split into a pure-host ``plan`` (sweeps, preemption decisions, page
+reservations — a :class:`StepPlan`) and a ``commit`` that applies the
+device results, with the dispatch left UNREALIZED in between.  While
+step N runs on device the scheduler optimistically plans step N+1
+against the predicted post-N state; if commit invalidates the
+prediction (a request finished/failed/was cancelled under the
+planner's feet) the plan is discarded and rebuilt — ``replans`` is
+the audit counter.  ``async.plan`` / ``async.commit`` /
+``async.replan`` bracket the new phases: a commit interrupted by an
+injected raise parks the pending device output on ``_inflight`` and
+the next step completes it first, so no device work (and no token)
+is ever lost.  The interleaving stays deterministic on the logical
+clock — the async stream is bit-identical to the sync one.
 """
 from __future__ import annotations
 
@@ -39,10 +54,34 @@ from .request import Request, RequestState
 _POOL_EXHAUSTED = "KV page pool exhausted"
 
 
+class StepPlan:
+    """The host half of one scheduler iteration, split out so the
+    double-buffered path can build step N+1's plan while step N is in
+    flight.  ``fingerprint`` is the predicted sorted
+    ``(rid, sid, generated)`` tuple the running set must match when
+    the plan is adopted; any divergence (finish, failure, cancel,
+    deadline) re-plans from live state and bumps the audit counter —
+    prediction quality affects only the overlap ratio, never the
+    stream."""
+
+    __slots__ = ("tick", "sids", "by_sid", "fingerprint", "kind",
+                 "drafts")
+
+    def __init__(self, tick, sids, by_sid, fingerprint=None,
+                 kind="decode", drafts=None):
+        self.tick = tick
+        self.sids = sids
+        self.by_sid = by_sid
+        self.fingerprint = fingerprint
+        self.kind = kind
+        self.drafts = drafts
+
+
 class Scheduler:
     def __init__(self, executor, metrics, policy="fifo",
                  prefill_chunk=None, eos_token_id=None,
-                 max_preemptions=4, prefix_cache=None, spec=None):
+                 max_preemptions=4, prefix_cache=None, spec=None,
+                 async_exec=False):
         if policy not in ("fifo", "priority"):
             raise ValueError(
                 f"policy must be 'fifo' or 'priority', got {policy!r}")
@@ -65,6 +104,25 @@ class Scheduler:
         # None check per site, and tests reconfigure obs BEFORE
         # building the engine under test
         self._obs = obs.handle()
+        # double-buffered execution state (PT_ASYNC_EXEC=on): the plan
+        # built while the previous step was in flight, a commit a
+        # fault interrupted mid-step, the replan audit counter, and
+        # the host-overlap accounting the statusz/bench surfaces read
+        self.async_mode = bool(async_exec)
+        self._pending = None     # StepPlan parked for the next step
+        self._inflight = None    # (StepPlan, pending) awaiting commit
+        self.replans = 0
+        self.overlapped_s = 0.0  # host seconds hidden behind device
+        self.device_s = 0.0      # dispatch-to-fence wall seconds
+        self.last_phase_seconds = {}
+        self.phase_totals = {}
+        self._timer = None
+        if self.async_mode:
+            from ...obs.perf import StepTimer
+
+            self._timer = StepTimer("serve.step_async")
+            self._timer.PHASES = ("plan", "dispatch", "overlap",
+                                  "fence", "commit")
 
     # -- submission boundary (called by the engine) ---------------------
 
@@ -100,11 +158,14 @@ class Scheduler:
         sp = (h.tracer.span("serve.step", cat="serve", tick=self.tick)
               if h is not None else obs.NULL_SPAN)
         with sp, RecordEvent("serve.step"):
-            self._sweep_cancelled()
-            self._sweep_deadlines()
-            self._decode(emitted)
-            self._admit()
-            self._prefill(emitted)
+            if self.async_mode:
+                self._step_async(emitted)
+            else:
+                self._sweep_cancelled()
+                self._sweep_deadlines()
+                self._decode(emitted)
+                self._admit()
+                self._prefill(emitted)
         self.metrics.on_step(
             decode_batch=self._last_decode_batch,
             pages_used=(self.executor.cache.num_pages
@@ -129,20 +190,23 @@ class Scheduler:
 
     # -- decode with preemption under page pressure ---------------------
 
-    def _decode(self, emitted):
-        if self.spec is not None:
-            self._decode_spec(emitted)
-            return
+    def _reserve_decode_batch(self, extra_fn):
+        """Preemption-under-pressure reservation loop shared by the
+        sync and async paths: reserve each RUNNING sequence's lookahead
+        (``extra_fn(sids, by_sid)`` -> extra_tokens for reserve()),
+        preempting the victim policy's pick while the pool cannot cover
+        the batch.  Returns the surviving run list ([] when every
+        holder failed/preempted away).  The reservation is idempotent,
+        so the executor's own reserve() inside decode()/verify()
+        re-verifies without re-allocating."""
         run = [r for r in self.running]
-        self._last_decode_batch = 0
         while run:
             sids = sorted(r.sid for r in run)
+            by_sid = {r.sid: r for r in run}
             try:
-                # batch-atomic page reservation; idempotent, so the
-                # executor's own reserve() inside decode() re-verifies
-                # without re-allocating
-                self.executor.cache.reserve(sids, extra_tokens=1)
-                break
+                self.executor.cache.reserve(
+                    sids, extra_tokens=extra_fn(sids, by_sid))
+                return run
             except RuntimeError as e:
                 if _POOL_EXHAUSTED not in str(e):
                     raise
@@ -161,6 +225,14 @@ class Scheduler:
                     continue
                 self._preempt(victim)
                 run = [r for r in self.running]
+        return run
+
+    def _decode(self, emitted):
+        if self.spec is not None:
+            self._decode_spec(emitted)
+            return
+        self._last_decode_batch = 0
+        run = self._reserve_decode_batch(lambda sids, by_sid: 1)
         if not run:
             return
         sids = sorted(r.sid for r in run)
@@ -218,30 +290,10 @@ class Scheduler:
         faults.fire("spec.draft", "before")
         drafts = {r.rid: self.spec.propose(r) for r in run}
         faults.fire("spec.draft", "after")
-        while run:
-            sids = sorted(r.sid for r in run)
-            by_sid = {r.sid: r for r in run}
-            lims = [self._spec_limit(by_sid[s],
-                                     len(drafts[by_sid[s].rid]))
-                    for s in sids]
-            try:
-                ex.cache.reserve(sids, extra_tokens=lims)
-                break
-            except RuntimeError as e:
-                if _POOL_EXHAUSTED not in str(e):
-                    raise
-                victim = self._pick_victim()
-                if victim is None or (len(run) == 1 and victim is run[0]
-                                      and not self.prefilling):
-                    self._finish(
-                        run[0], RequestState.FAILED, "pool_exhausted",
-                        error=RuntimeError(
-                            f"{_POOL_EXHAUSTED} for a single sequence "
-                            f"(pool {ex.cache.num_pages} pages)"))
-                    run = [r for r in self.running]
-                    continue
-                self._preempt(victim)
-                run = [r for r in self.running]
+        run = self._reserve_decode_batch(
+            lambda sids, by_sid: [
+                self._spec_limit(by_sid[s], len(drafts[by_sid[s].rid]))
+                for s in sids])
         if not run:
             return
         sids = sorted(r.sid for r in run)
@@ -287,6 +339,272 @@ class Scheduler:
                                      trace_id=by_sid[sid].rid,
                                      rejected=rejected)
         faults.fire("spec.rollback", "after")
+
+    # -- double-buffered execution (PT_ASYNC_EXEC=on) -------------------
+
+    @property
+    def host_overlap_ratio(self) -> float:
+        """Overlapped-host-seconds / device-compute-seconds over the
+        scheduler's lifetime (0.0 before the first async decode)."""
+        return (self.overlapped_s / self.device_s
+                if self.device_s > 0 else 0.0)
+
+    def _step_async(self, emitted):
+        """One double-buffered iteration: adopt (or rebuild) the plan
+        parked while the previous step was in flight, dispatch without
+        realizing the result, plan the NEXT step against the predicted
+        post-step state while the device runs, then fence + commit."""
+        clk = self.metrics.clock
+        ph = {}
+        t0 = clk()
+        faults.fire("async.plan", "before")
+        if self._inflight is not None:
+            # a fault escaped between dispatch and commit last step:
+            # complete the parked commit first so no device work (and
+            # no token) is lost — they land in THIS step's emitted map
+            # but every per-request stream stays exact
+            plan0, pending0 = self._inflight
+            pending0.wait()
+            self._inflight = None
+            if plan0.kind == "verify":
+                self._commit_verify(plan0, pending0, emitted)
+            else:
+                self._commit_decode(plan0, pending0, emitted)
+        self._sweep_cancelled()
+        self._sweep_deadlines()
+        if self.spec is not None:
+            t1 = self._step_async_spec(emitted, clk, ph, t0)
+        else:
+            t1 = self._step_async_plain(emitted, clk, ph, t0)
+        self._admit()
+        self._prefill(emitted)
+        ph["commit"] = ph.get("commit", 0.0) + (clk() - t1)
+        self._publish_phases(ph)
+
+    def _step_async_plain(self, emitted, clk, ph, t0):
+        self._last_decode_batch = 0
+        plan = self._obtain_plan()
+        faults.fire("async.plan", "after")
+        t1 = clk()
+        ph["plan"] = t1 - t0
+        if plan is None:
+            return t1
+        h = self._obs
+        sp = (h.tracer.span("serve.decode_async", cat="serve",
+                            batch=len(plan.sids), tick=self.tick)
+              if h is not None else obs.NULL_SPAN)
+        with sp, RecordEvent("serve.decode"):
+            pending = self.executor.decode_async(plan.sids)
+            t2 = clk()
+            ph["dispatch"] = t2 - t1
+            self._plan_ahead(plan)
+            t3 = clk()
+            ph["overlap"] = t3 - t2
+            self._inflight = (plan, pending)
+            faults.fire("async.commit", "before")
+            pending.wait()
+            self._inflight = None
+            t4 = clk()
+            ph["fence"] = t4 - t3
+        self._commit_decode(plan, pending, emitted)
+        faults.fire("async.commit", "after")
+        self.overlapped_s += ph["overlap"]
+        self.device_s += ph["dispatch"] + ph["overlap"] + ph["fence"]
+        return t4
+
+    def _step_async_spec(self, emitted, clk, ph, t0):
+        ex = self.executor
+        self._last_decode_batch = 0
+        run = [r for r in self.running]
+        if not run:
+            faults.fire("async.plan", "after")
+            t1 = clk()
+            ph["plan"] = t1 - t0
+            return t1
+        faults.fire("spec.draft", "before")
+        drafts = {r.rid: self.spec.propose(r) for r in run}
+        faults.fire("spec.draft", "after")
+        run = self._reserve_decode_batch(
+            lambda sids, by_sid: [
+                self._spec_limit(by_sid[s], len(drafts[by_sid[s].rid]))
+                for s in sids])
+        faults.fire("async.plan", "after")
+        t1 = clk()
+        ph["plan"] = t1 - t0
+        if not run:
+            return t1
+        sids = sorted(r.sid for r in run)
+        by_sid = {r.sid: r for r in run}
+        lims = [self._spec_limit(by_sid[s], len(drafts[by_sid[s].rid]))
+                for s in sids]
+        dr = [drafts[by_sid[s].rid][:lim - 1]
+              for s, lim in zip(sids, lims)]
+        plan = StepPlan(self.tick, sids, by_sid, kind="verify",
+                        drafts=dr)
+        faults.fire("spec.verify", "before")
+        h = self._obs
+        sp = (h.tracer.span("serve.verify", cat="serve",
+                            batch=len(sids), tick=self.tick,
+                            drafted=sum(len(v) for v in dr))
+              if h is not None else obs.NULL_SPAN)
+        with sp, RecordEvent("serve.decode"):
+            pending = ex.verify_async(sids, dr, lims, self.spec.k)
+            t2 = clk()
+            ph["dispatch"] = t2 - t1
+            self._inflight = (plan, pending)
+            faults.fire("async.commit", "before")
+            pending.wait()
+            self._inflight = None
+            t3 = clk()
+            ph["fence"] = t3 - t2
+        self._commit_verify(plan, pending, emitted)
+        faults.fire("async.commit", "after")
+        self.device_s += ph["dispatch"] + ph["fence"]
+        return t3
+
+    def _obtain_plan(self):
+        """The parked plan if its prediction survived commit, else a
+        fresh one from live state (the replan path — audited)."""
+        plan, self._pending = self._pending, None
+        if plan is not None and not self._plan_valid(plan):
+            faults.fire("async.replan", "before")
+            self.replans += 1
+            if self._obs is not None:
+                self._obs.recorder.record("async.replan",
+                                          tick=self.tick)
+                self._obs.tracer.instant("async.replan", cat="serve",
+                                         tick=self.tick)
+            faults.fire("async.replan", "after")
+            plan = None
+        if plan is None:
+            plan = self._build_plan()
+        return plan
+
+    def _build_plan(self):
+        run = self._reserve_decode_batch(lambda sids, by_sid: 1)
+        if not run:
+            return None
+        return StepPlan(self.tick, sorted(r.sid for r in run),
+                        {r.sid: r for r in run})
+
+    def _plan_valid(self, plan) -> bool:
+        if plan.tick != self.tick or self.prefilling:
+            return False
+        actual = tuple(sorted((r.rid, r.sid, len(r.generated))
+                              for r in self.running))
+        return actual == plan.fingerprint
+
+    def _plan_ahead(self, plan):
+        """The overlapped host work: while the dispatched step runs on
+        device, reserve the NEXT step's decode pages against the
+        predicted post-step state (the executor already advanced
+        lengths at dispatch) and fingerprint the prediction.
+
+        Strictly speculative: nothing observable may move — no
+        preemption, no failure, and no prefix eviction (the reclaimer
+        is disabled so the reserve draws from free pages only; a
+        shortfall just abandons the speculation and the next step
+        plans live, where the sync-equivalent eviction/preemption
+        logic runs).  Page identity never affects numerics (attention
+        gathers through the page table), so early reservation cannot
+        perturb the stream."""
+        self._pending = None
+        if self.queue or self.prefilling:
+            return  # admissions/prefills this step would shift state
+        ex = self.executor
+        survivors = []
+        for sid in plan.sids:
+            r = plan.by_sid[sid]
+            cap = min(r.max_new_tokens,
+                      ex.max_len - len(r.prompt_ids))
+            if len(r.generated) + 1 >= cap:
+                continue  # finishes this step on the length cap
+            survivors.append(r)
+        if not survivors:
+            return
+        sids = sorted(r.sid for r in survivors)
+        cache = ex.cache
+        saved, cache.reclaimer = cache.reclaimer, None
+        try:
+            cache.reserve(sids, extra_tokens=1)
+        except RuntimeError as e:
+            if _POOL_EXHAUSTED not in str(e):
+                raise
+            return  # pool too tight to speculate
+        finally:
+            cache.reclaimer = saved
+        fp = tuple(sorted((r.rid, r.sid, len(r.generated) + 1)
+                          for r in survivors))
+        self._pending = StepPlan(self.tick + 1, sids,
+                                 {r.sid: r for r in survivors},
+                                 fingerprint=fp)
+
+    def _commit_decode(self, plan, pending, emitted):
+        """Apply one async decode's device results — the sync tail of
+        :meth:`_decode`, fed from the pending object's fence."""
+        toks = pending.wait()
+        self._last_decode_batch = len(plan.sids)
+        self.metrics.on_decode_tokens(len(plan.sids))
+        for sid in plan.sids:
+            self._on_token(plan.by_sid[sid], toks[sid], emitted)
+
+    def _commit_verify(self, plan, pending, emitted):
+        """Apply one async verify's device results — the sync tail of
+        :meth:`_decode_spec` (emission, spec metrics, rollback)."""
+        toks, accepted = pending.wait()
+        sids, by_sid, dr = plan.sids, plan.by_sid, plan.drafts
+        self._last_decode_batch = len(sids)
+        self.metrics.on_decode_step(
+            slots=len(sids), tokens=sum(len(v) for v in toks.values()))
+        self.metrics.on_spec(proposed=sum(len(d) for d in dr),
+                             accepted=sum(accepted.values()))
+        for i, sid in enumerate(sids):
+            req = by_sid[sid]
+            req.draft_proposed += len(dr[i])
+            req.draft_accepted += accepted[sid]
+            for tok in toks[sid]:
+                if req.terminal:
+                    break   # tokens past eos/cap are dropped
+                self._on_token(req, tok, emitted)
+        faults.fire("spec.verify", "after")
+        faults.fire("spec.rollback", "before")
+        self.executor.rollback(
+            [r.sid for r in by_sid.values() if r.sid is not None])
+        h = self._obs
+        if h is not None:
+            for i, sid in enumerate(sids):
+                rejected = len(dr[i]) - accepted[sid]
+                if rejected > 0:
+                    h.recorder.record("spec.rollback",
+                                      rid=by_sid[sid].rid,
+                                      rejected=rejected, tick=self.tick)
+                    h.tracer.instant("req.spec_rollback", cat="serve",
+                                     trace_id=by_sid[sid].rid,
+                                     rejected=rejected)
+        faults.fire("spec.rollback", "after")
+
+    def _publish_phases(self, ph):
+        """Fold one async step's phase seconds into the totals and,
+        when telemetry is on, publish the ``step_phase_seconds`` gauges
+        + Perfetto counter track (via StepTimer) and the
+        ``serving_host_overlap_ratio`` gauge + counter track."""
+        if not ph:
+            return
+        self.last_phase_seconds = dict(ph)
+        for k, v in ph.items():
+            self.phase_totals[k] = self.phase_totals.get(k, 0.0) + v
+        h = self._obs
+        if h is None:
+            return
+        self._timer._acc = dict(ph)
+        self._timer.end_step()
+        h.registry.gauge(
+            "serving_host_overlap_ratio",
+            "Overlapped host seconds / device compute seconds "
+            "(async double-buffered executor)"
+        ).set(self.host_overlap_ratio)
+        h.tracer.counter("perf.host_overlap", cat="perf",
+                         ratio=round(self.host_overlap_ratio, 6))
 
     # -- page-aware admission -------------------------------------------
 

@@ -1132,3 +1132,101 @@ def _allclose(datas, attrs):
 @register_validator("isclose")
 def _isclose(datas, attrs):
     _close_check("isclose", datas, attrs)
+
+
+# -- batch 10: linalg tail (kron / outer / householder_product / --------------
+# -- matrix_power / slogdet / pinv) -------------------------------------------
+
+def _square_matrix(op, x, name="X"):
+    xs = _shape(x)
+    if len(xs) < 2:
+        _fail(op,
+              f"The Input({name}) should have at least 2 dimensions, "
+              f"but received a tensor of shape {list(xs)}")
+    if xs[-1] != xs[-2]:
+        _fail(op,
+              f"The inner-most 2 dimensions of Input({name}) should "
+              f"be equal (a square matrix or batches of square "
+              f"matrices), but received shape {list(xs)}")
+    return xs
+
+
+@register_validator("kron")
+def _kron(datas, attrs):
+    # binary.cc KronInferMeta: both operands need rank >= 1 (the
+    # output dim is the elementwise product of the right-aligned dims)
+    for name, d in (("X", datas[0]), ("Y", datas[1])):
+        if _ndim(d) < 1:
+            _fail("kron",
+                  f"the rank of Input({name}) should be no less than "
+                  f"1, but received a 0-D tensor")
+
+
+@register_validator("outer")
+def _outer(datas, attrs):
+    # linalg outer flattens both sides; only 0-D operands are rejected
+    for name, d in (("X", datas[0]), ("Y", datas[1])):
+        if _ndim(d) < 1:
+            _fail("outer",
+                  f"Input({name}) of outer should be a tensor with "
+                  f"rank >= 1, but received a 0-D tensor")
+
+
+@register_validator("householder_product")
+def _householder_product(datas, attrs):
+    # unary.cc HouseholderProductInferMeta: x is [*, m, n] reflectors,
+    # tau is [*, k] with k <= n <= m and matching batch dims
+    x, tau = datas[0], datas[1]
+    xs, ts = _shape(x), _shape(tau)
+    if len(xs) < 2:
+        _fail("householder_product",
+              f"The input matrix x must be at least 2-D, but received "
+              f"shape {list(xs)}")
+    if len(ts) != len(xs) - 1:
+        _fail("householder_product",
+              f"The input vector tau should have one dimension less "
+              f"than x, but received x {list(xs)} and tau {list(ts)}")
+    m, n = xs[-2], xs[-1]
+    if m < n:
+        _fail("householder_product",
+              f"The rows of input matrix x must be greater than or "
+              f"equal to its columns, but received shape {list(xs)}")
+    if ts[-1] > n:
+        _fail("householder_product",
+              f"The last dim of tau ({ts[-1]}) must not exceed the "
+              f"columns of x ({n}), received x {list(xs)} and tau "
+              f"{list(ts)}")
+    if xs[:-2] != ts[:-1]:
+        _fail("householder_product",
+              f"The batch dimensions of x and tau should match, but "
+              f"received x {list(xs)} and tau {list(ts)}")
+
+
+@register_validator("matrix_power")
+def _matrix_power(datas, attrs):
+    # unary.cc MatrixPowerInferMeta: square matrices only (a negative
+    # exponent inverts, so squareness is the whole contract)
+    _square_matrix("matrix_power", datas[0])
+
+
+@register_validator("slogdet")
+def _slogdet(datas, attrs):
+    # unary.cc SlogDeterminantInferMeta
+    _square_matrix("slogdet", datas[0], name="Input")
+
+
+@register_validator("pinv")
+def _pinv(datas, attrs):
+    # unary.cc PInverseInferMeta — host-path wrapper, validated
+    # manually in linalg.pinv (never passes registry.apply).  The
+    # hermitian fast path additionally requires squareness.
+    x = datas[0]
+    xs = _shape(x)
+    if len(xs) < 2:
+        _fail("pinv",
+              f"The input tensor x's dimension of PinvOp should be "
+              f"no less than 2, but received shape {list(xs)}")
+    if attrs.get("hermitian") and xs[-1] != xs[-2]:
+        _fail("pinv",
+              f"hermitian=True requires square matrices, but "
+              f"received shape {list(xs)}")

@@ -264,10 +264,12 @@ _matrix_power_op = register_op(
 
 
 def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    from . import infermeta
     from ..core.tensor import Tensor
 
-    return Tensor(jnp.linalg.pinv(x._data if isinstance(x, Tensor) else x,
-                                  rtol=rcond, hermitian=hermitian))
+    xd = x._data if isinstance(x, Tensor) else x
+    infermeta.validate("pinv", (xd,), {"hermitian": bool(hermitian)})
+    return Tensor(jnp.linalg.pinv(xd, rtol=rcond, hermitian=hermitian))
 
 
 def matrix_rank(x, tol=None, hermitian=False, name=None):

@@ -86,6 +86,16 @@ REGISTERED = {
     "spec.rollback": "the post-verify page trim (before=rejected-"
                      "draft pages still assigned, after=pages back on "
                      "the free list)",
+    "async.plan": "the double-buffered step's host planning phase "
+                  "(before=nothing this step has mutated, after=plan "
+                  "built and pages reserved, nothing dispatched)",
+    "async.commit": "the double-buffered step's commit fence (before="
+                    "dispatched results parked un-applied — the next "
+                    "step completes the commit first; after=tokens "
+                    "applied, admission/prefill not yet run)",
+    "async.replan": "a parked plan invalidated by commit (before="
+                    "stale plan discarded, nothing else mutated; "
+                    "after=audit counter bumped, replanning live)",
     "obs.dump": "one flight-recorder dump (before=ring intact, nothing "
                 "serialized; after=dump text retained/written)",
     "obs.export": "one Chrome-trace export (before=no file, after=file "

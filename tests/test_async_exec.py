@@ -1,0 +1,320 @@
+"""Async double-buffered serving executor (PT_ASYNC_EXEC=on).
+
+The load-bearing property is EXACTNESS: splitting the step into
+plan/dispatch/overlap/fence/commit must not move a single token.
+Asserted here at the engine level, under a seeded load with
+preemption, prefix-cache hits/evictions and speculative drafts all
+firing (per-step emission maps AND per-request streams bit-identical
+to the sync path, pool audit green after every step), across injected
+raises at every async.* fault point x phase, and through the replan
+path (a cancellation invalidating a parked plan).  The perf plumbing
+is asserted structurally: one jitted call + one transfer per async
+decode step (dispatch audit on serve.decode_async), a positive
+host_overlap_ratio at steady-state occupancy, and the phase/overlap
+telemetry visible in the registry, the trace and /statusz.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import obs
+from paddle_tpu.inference.server import (
+    RequestState, ServingEngine, check_pool_invariants,
+)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.testing import faults
+from paddle_tpu.testing.load import LoadSpec, generate_load
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(11)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+ENGINE_KW = dict(max_seqs=2, page_size=4, max_len=128)
+
+PROMPT = np.random.RandomState(2).randint(1, 256, (8,)).astype(np.int32)
+
+LOAD_SPEC = LoadSpec(n_requests=8, mean_interarrival=2.0,
+                     prompt_len=(4, 12), max_new=(6, 10), vocab=256,
+                     seed=21, prefix_share=0.6, prefix_len=10,
+                     prefix_pool=2, repeat_share=0.5, repeat_period=3)
+# undersized pool: decode growth forces preemption AND cached pages
+# must be LRU-evicted under the prefix-cache variants
+TIGHT_KW = dict(max_seqs=2, page_size=4, max_len=64, num_pages=11,
+                prefill_chunk=8)
+
+
+def _drive_load(model, spec, engine_kw, check_invariants=False,
+                on_error="raise"):
+    """Replay the seeded load step by step, recording the PER-STEP
+    emission maps (stricter than per-request streams: the async path
+    must match the sync interleaving tick for tick)."""
+    eng = ServingEngine(model, **engine_kw)
+    pending = sorted(generate_load(spec),
+                     key=lambda w: (w["arrival_tick"], w["rid"]))
+    handles, errors, per_step = {}, [], []
+    while pending or eng.in_flight:
+        assert eng.tick < 3000, "load did not drain"
+        while pending and pending[0]["arrival_tick"] <= eng.tick:
+            w = pending.pop(0)
+            handles[w["rid"]] = eng.submit(
+                w["prompt_ids"], max_new_tokens=w["max_new_tokens"],
+                rid=w["rid"])
+        try:
+            per_step.append(eng.step())
+        except faults.InjectedFault as e:
+            if on_error != "continue":
+                raise
+            errors.append(e)
+        if check_invariants:
+            check_pool_invariants(eng.executor.cache, eng.prefix)
+    return eng, handles, errors, per_step
+
+
+# -- mode knob ----------------------------------------------------------
+
+
+def test_env_gate(model, monkeypatch):
+    monkeypatch.setenv("PT_ASYNC_EXEC", "on")
+    assert ServingEngine(model, **ENGINE_KW).scheduler.async_mode
+    monkeypatch.setenv("PT_ASYNC_EXEC", "off")
+    assert not ServingEngine(model, **ENGINE_KW).scheduler.async_mode
+    monkeypatch.delenv("PT_ASYNC_EXEC")
+    assert not ServingEngine(model, **ENGINE_KW).scheduler.async_mode
+    # param forces over env
+    monkeypatch.setenv("PT_ASYNC_EXEC", "on")
+    assert not ServingEngine(model, async_exec=False,
+                             **ENGINE_KW).scheduler.async_mode
+    monkeypatch.setenv("PT_ASYNC_EXEC", "eager")
+    with pytest.raises(ValueError, match="PT_ASYNC_EXEC"):
+        ServingEngine(model, **ENGINE_KW)
+
+
+def test_off_mode_is_legacy_path(model):
+    """async_exec=False (and the default) never touches the async
+    program: the sync serve.decode path runs untouched."""
+    eng = ServingEngine(model, async_exec=False, **ENGINE_KW)
+    want = eng.submit(PROMPT, max_new_tokens=12).result()
+    assert eng.executor.programs["decode_async"].dispatches == 0
+    assert eng.executor.programs["decode"].dispatches > 0
+    assert eng.scheduler.replans == 0
+    assert eng.scheduler.host_overlap_ratio == 0.0
+    on = ServingEngine(model, async_exec=True, **ENGINE_KW)
+    assert on.submit(PROMPT, max_new_tokens=12).result() == want
+
+
+# -- one jitted call + one transfer per step ----------------------------
+
+
+def test_async_decode_is_one_dispatch_per_step(model):
+    """Every async decode step is ONE serve.decode_async dispatch (the
+    argmax rides in-graph, so the commit fence transfers one int32 [B]
+    row) and the sync serve.decode program never runs."""
+    from paddle_tpu.analysis import DispatchAuditor
+
+    eng = ServingEngine(model, async_exec=True, **ENGINE_KW)
+    eng.submit(PROMPT, max_new_tokens=24)
+    eng.submit(np.tile(PROMPT, 2), max_new_tokens=24)
+    with DispatchAuditor(eng.executor.programs["decode_async"],
+                         max_traces=ENGINE_KW["max_seqs"]) as audit:
+        prev = 0
+        while eng.scheduler.has_work():
+            assert eng.tick < 500
+            eng.step()
+            assert audit.dispatches - prev <= 1, "one dispatch per step"
+            prev = audit.dispatches
+        assert audit.dispatches > 0
+    assert eng.executor.programs["decode"].dispatches == 0
+
+
+# -- bit-parity under load ----------------------------------------------
+
+
+@pytest.mark.parametrize("variant", ["plain", "prefix", "spec",
+                                     "prefix_spec"])
+def test_async_load_parity(model, variant):
+    """The acceptance-criteria run: the seeded load on an undersized
+    pool — preemption, prefix hits/evictions and spec drafts firing
+    per variant — emits bit-identical PER-STEP maps in async and sync
+    mode, with the refcount audit green after every async step."""
+    kw = dict(TIGHT_KW)
+    if "prefix" in variant:
+        kw["prefix_cache"] = True
+    if "spec" in variant:
+        kw["spec_decode"] = "ngram"
+    e_off, h_off, _, steps_off = _drive_load(model, LOAD_SPEC,
+                                             dict(kw, async_exec=False))
+    e_on, h_on, _, steps_on = _drive_load(model, LOAD_SPEC,
+                                          dict(kw, async_exec=True),
+                                          check_invariants=True)
+    assert steps_on == steps_off, variant
+    for rid in h_off:
+        assert h_on[rid].tokens == h_off[rid].tokens, (variant, rid)
+        assert h_on[rid].state == h_off[rid].state, (variant, rid)
+    if variant == "plain":
+        # steady decode stretches actually overlapped host work
+        assert e_on.scheduler.overlapped_s > 0
+    assert e_on.scheduler.device_s > 0
+    s = e_off.stats()
+    if "prefix" in variant:
+        assert s["preemptions"] > 0 and s["evicted_pages"] > 0 \
+            and s["cached_tokens"] > 0
+    if "spec" in variant:
+        assert e_on.metrics.draft_proposed > 0
+    if "prefix" not in variant:
+        # no prefix tree holding cached pages: the pool drains whole
+        assert e_on.executor.free_pages == e_on.executor.cache.num_pages
+
+
+# -- replan: a parked plan invalidated under the planner's feet ---------
+
+
+def _run_with_cancel(model, async_exec, arm=None):
+    """Two concurrent requests; cancel the first once it has streamed
+    a few tokens AND (async mode) a next-step plan is parked — the
+    commit-side finish then invalidates the parked plan."""
+    eng = ServingEngine(model, async_exec=async_exec, **ENGINE_KW)
+    eng.submit(PROMPT, max_new_tokens=30, rid="a")
+    hb = eng.submit(PROMPT[:5], max_new_tokens=30, rid="b")
+    got, cancelled, errors = {"a": [], "b": []}, False, 0
+    while eng.scheduler.has_work():
+        assert eng.tick < 500
+        try:
+            out = eng.step()
+        except faults.InjectedFault:
+            errors += 1
+            continue
+        for rid, toks in out.items():
+            got[rid].extend(toks)
+        if not cancelled and len(got["a"]) >= 3 and (
+                not async_exec
+                or eng.scheduler._pending is not None):
+            eng.cancel("a")
+            cancelled = True
+        check_pool_invariants(eng.executor.cache)
+    return eng, hb, got, errors
+
+
+def test_replan_on_cancel_keeps_streams_exact(model):
+    e_off, hb_off, got_off, _ = _run_with_cancel(model, False)
+    e_on, hb_on, got_on, _ = _run_with_cancel(model, True)
+    assert e_on.scheduler.replans >= 1      # the audit counter moved
+    assert e_off.scheduler.replans == 0
+    assert got_on == got_off
+    assert hb_on.state is RequestState.FINISHED
+    assert e_on.request("a").state is RequestState.CANCELLED
+    assert e_on.executor.free_pages == e_on.executor.cache.num_pages
+
+
+# -- fault points -------------------------------------------------------
+
+
+@pytest.mark.parametrize("point", ["async.plan", "async.commit"])
+@pytest.mark.parametrize("phase", ["before", "after"])
+def test_async_fault_leaves_engine_serviceable(model, point, phase):
+    """An injected raise at every async point x phase escapes step()
+    with the pool consistent; the remaining steps finish every request
+    with the EXACT sync streams (a commit interrupted before the fence
+    parks the device output and the next step completes it — no token
+    is lost), and the engine accepts new work after."""
+    _, want, _, _ = _drive_load(model, LOAD_SPEC,
+                                dict(TIGHT_KW, async_exec=False))
+    faults.reset()
+    faults.arm(point, phase, 2, "raise")
+    eng, handles, errors, _ = _drive_load(
+        model, LOAD_SPEC, dict(TIGHT_KW, async_exec=True),
+        check_invariants=True, on_error="continue")
+    assert len(errors) == 1, (point, phase)
+    for rid in want:
+        assert handles[rid].tokens == want[rid].tokens, (point, phase)
+    faults.reset()
+    h = eng.submit(PROMPT, max_new_tokens=8)
+    base = ServingEngine(model, **dict(TIGHT_KW, async_exec=False))
+    assert h.result() == base.submit(PROMPT, max_new_tokens=8).result()
+    assert eng.executor.free_pages == eng.executor.cache.num_pages
+
+
+@pytest.mark.parametrize("phase", ["before", "after"])
+def test_async_replan_fault(model, phase):
+    """async.replan only fires when a parked plan is invalidated, so
+    drive the cancel scenario: the raise escapes step() with the stale
+    plan already discarded, and the surviving request still streams
+    the exact greedy tokens."""
+    _, hb_sync, _, _ = _run_with_cancel(model, False)
+    faults.reset()
+    faults.arm("async.replan", phase, 1, "raise")
+    eng, hb, _, errors = _run_with_cancel(model, True, arm=True)
+    assert errors == 1, phase
+    assert hb.state is RequestState.FINISHED
+    assert hb.tokens == hb_sync.tokens, phase
+    assert eng.executor.free_pages == eng.executor.cache.num_pages
+
+
+@pytest.mark.parametrize("phase", ["before", "after"])
+def test_async_fault_under_spec(model, phase):
+    """async.commit x spec decode: the parked verify commit survives
+    an injected raise with the speculative stream still exact."""
+    base = ServingEngine(model, spec_decode="ngram", async_exec=False,
+                         **ENGINE_KW)
+    want = base.submit(PROMPT, max_new_tokens=16).result()
+    faults.reset()
+    faults.arm("async.commit", phase, 2, "raise")
+    eng = ServingEngine(model, spec_decode="ngram", async_exec=True,
+                        **ENGINE_KW)
+    h = eng.submit(PROMPT, max_new_tokens=16)
+    errors = 0
+    while h.state is not RequestState.FINISHED:
+        assert eng.tick < 500
+        try:
+            eng.step()
+        except faults.InjectedFault:
+            errors += 1
+            check_pool_invariants(eng.executor.cache)
+    assert errors == 1, phase
+    assert h.tokens == want, phase
+    assert eng.executor.free_pages == eng.executor.cache.num_pages
+
+
+# -- telemetry: overlap ratio, phase seconds, /statusz ------------------
+
+
+def test_overlap_telemetry_published(model):
+    obs.reset()
+    obs.configure(mode="on", clock=obs.LogicalClock())
+    try:
+        eng = ServingEngine(model, async_exec=True, **ENGINE_KW)
+        eng.submit(PROMPT, max_new_tokens=24)
+        eng.run()
+        sched = eng.scheduler
+        assert sched.host_overlap_ratio > 0.0
+        assert sched.overlapped_s > 0.0
+        for ph in ("plan", "dispatch", "overlap", "fence", "commit"):
+            assert ph in sched.phase_totals, ph
+        h = obs.handle()
+        fam = h.registry.get("serving_host_overlap_ratio")
+        assert fam is not None and fam.type == "gauge"
+        fam = h.registry.get("step_phase_seconds")
+        assert fam is not None
+        tracks = [s for s in h.tracer.spans
+                  if s.name == "perf.host_overlap"]
+        assert tracks, "Perfetto counter track missing"
+        sz = eng._statusz()
+        assert sz["async"]["mode"] == "on"
+        assert sz["async"]["host_overlap_ratio"] > 0.0
+        assert set(sz["async"]["step_phase_seconds"]) <= {
+            "plan", "dispatch", "overlap", "fence", "commit"}
+    finally:
+        obs.reset()

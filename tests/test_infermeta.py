@@ -1018,3 +1018,95 @@ def test_isclose_rejects_incompatible_shapes():
 def test_isclose_rejects_negative_atol():
     with pytest.raises(InvalidArgumentError, match="atol"):
         paddle.isclose(_f32(2), _f32(2), atol=-0.5)
+
+
+# -- batch 10 (r17): kron / outer / householder_product / matrix_power /
+# -- slogdet / pinv ---------------------------------------------------------
+
+
+def test_kron_accepts_mixed_ranks():
+    out = paddle.kron(_f32(2, 3), _f32(4))
+    assert list(out.shape) == [2, 12]
+
+
+def test_kron_rejects_scalar_operand():
+    with pytest.raises(InvalidArgumentError, match="no less than 1"):
+        paddle.kron(_f32(2, 3), paddle.to_tensor(np.float32(2.0)))
+
+
+def test_outer_accepts_and_flattens():
+    out = paddle.outer(_f32(2, 3), _f32(4))
+    assert list(out.shape) == [6, 4]
+
+
+def test_outer_rejects_scalar_operand():
+    with pytest.raises(InvalidArgumentError, match="rank >= 1"):
+        paddle.outer(paddle.to_tensor(np.float32(1.0)), _f32(3))
+
+
+def test_householder_product_accepts_tall_reflectors():
+    x, tau = _f32(4, 3), _f32(3)
+    out = paddle.linalg.householder_product(x, tau)
+    assert list(out.shape) == [4, 3]
+
+
+def test_householder_product_rejects_wide_matrix():
+    with pytest.raises(InvalidArgumentError,
+                       match="greater than or equal to its columns"):
+        paddle.linalg.householder_product(_f32(3, 4), _f32(3))
+
+
+def test_householder_product_rejects_tau_rank():
+    with pytest.raises(InvalidArgumentError,
+                       match="one dimension less"):
+        paddle.linalg.householder_product(_f32(4, 3), _f32(2, 3))
+
+
+def test_householder_product_rejects_excess_tau():
+    with pytest.raises(InvalidArgumentError, match="must not exceed"):
+        paddle.linalg.householder_product(_f32(4, 3), _f32(4))
+
+
+def test_householder_product_rejects_batch_mismatch():
+    with pytest.raises(InvalidArgumentError, match="batch dimensions"):
+        paddle.linalg.householder_product(_f32(2, 4, 3), _f32(3, 3))
+
+
+def test_matrix_power_accepts_square_batch():
+    out = paddle.linalg.matrix_power(_f32(2, 3, 3), 3)
+    assert list(out.shape) == [2, 3, 3]
+
+
+def test_matrix_power_rejects_non_square():
+    with pytest.raises(InvalidArgumentError, match="square"):
+        paddle.linalg.matrix_power(_f32(3, 4), 2)
+
+
+def test_matrix_power_rejects_vector():
+    with pytest.raises(InvalidArgumentError, match="at least 2"):
+        paddle.linalg.matrix_power(_f32(4), 2)
+
+
+def test_slogdet_accepts_square():
+    sign, logdet = paddle.linalg.slogdet(_f32(3, 3))
+    assert list(sign.shape) == [] and list(logdet.shape) == []
+
+
+def test_slogdet_rejects_non_square():
+    with pytest.raises(InvalidArgumentError, match="square"):
+        paddle.linalg.slogdet(_f32(2, 3))
+
+
+def test_pinv_accepts_rectangular():
+    out = paddle.linalg.pinv(_f32(3, 5))
+    assert list(out.shape) == [5, 3]
+
+
+def test_pinv_rejects_vector():
+    with pytest.raises(InvalidArgumentError, match="no less than 2"):
+        paddle.linalg.pinv(_f32(5))
+
+
+def test_pinv_rejects_non_square_hermitian():
+    with pytest.raises(InvalidArgumentError, match="hermitian"):
+        paddle.linalg.pinv(_f32(3, 5), hermitian=True)

@@ -44,6 +44,12 @@ METRICS = (
     ("large.value", "higher", 0.10),
     ("sd_unet.value", "higher", 0.10),
     ("obs_overhead.on_off_ratio", "lower", 0.05),
+    # async double-buffered executor (r17): the on-leg must not lose
+    # throughput vs its own round's sync leg by more than the
+    # tolerance, and the measured host-hiding must not collapse
+    ("serving.async_exec.on.serving_tok_s", "higher", 0.10),
+    ("serving.async_exec.tok_s_speedup", "higher", 0.10),
+    ("serving.async_exec.on.host_overlap_ratio", "higher", 0.20),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

@@ -176,6 +176,37 @@ def main():
     check(len(ev_mod.query(tail, kind="req.finish")) == 3,
           "query by kind finds the three finishes")
 
+    # -- 5. async executor: overlap ratio + phase telemetry -------------
+    # a second engine (PT_ASYNC_EXEC on) takes over the /statusz
+    # serving provider, so this section runs after the sync checks
+    print("== async executor ==")
+    eng2 = ServingEngine(model, max_seqs=2, page_size=4, max_len=64,
+                         async_exec=True, slos=[])
+    h2 = [eng2.submit(rng.randint(1, 256, (n,)).astype(np.int32),
+                      max_new_tokens=12) for n in (6, 9)]
+    eng2.run()
+    check(all(hd.state is RequestState.FINISHED for hd in h2),
+          "async engine drained")
+    prom = h.registry.prometheus_text()
+    check("serving_host_overlap_ratio" in prom,
+          "host_overlap_ratio gauge exported")
+    check('step_phase_seconds{phase="overlap",program='
+          '"serve.step_async"}' in prom,
+          "serve.step_async phase gauges exported")
+    check(any(s.name == "perf.host_overlap"
+              for s in h.tracer.spans), "host-overlap counter track")
+    sz = health.statusz_payload(h)
+    az = sz["providers"].get("serving", {}).get("async", {})
+    check(az.get("mode") == "on", "/statusz async mode on")
+    check(isinstance(az.get("replans"), int), "/statusz replan counter")
+    check(az.get("host_overlap_ratio", -1) > 0,
+          "/statusz host_overlap_ratio > 0")
+    check(set(az.get("step_phase_seconds", {})) <= {
+        "plan", "dispatch", "overlap", "fence", "commit"}
+        and az.get("step_phase_seconds"),
+        "/statusz per-step phase seconds")
+    check("phase_seconds_total" in az, "/statusz cumulative phases")
+
     if FAILURES:
         print(f"\nobs-check: {len(FAILURES)} check(s) FAILED")
         for f in FAILURES:
