@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: smoke test test-fast verify-fast lint-graph obs-check \
-	health-check perf-report perf-check bench
+	health-check aot-check perf-report perf-check bench
 
 # <3 min sanity gate: import + one eager op, one jitted llama forward
 # step (the driver's entry()), and a 2-virtual-device multichip train
@@ -45,9 +45,11 @@ smoke:
 		tests/test_async_exec.py \
 		tests/test_obs.py \
 		tests/test_perf.py \
-		tests/test_health.py
+		tests/test_health.py \
+		tests/test_aot.py
 	$(MAKE) obs-check
 	$(MAKE) health-check
+	$(MAKE) aot-check
 
 # Fast lane — must be green before any snapshot commit (see README).
 test-fast:
@@ -78,6 +80,12 @@ obs-check:
 # the endpoint contract and event-journal schema/query checks.
 health-check:
 	JAX_PLATFORMS=cpu $(PY) tools/health_check.py
+
+# AOT-plane end-to-end smoke: warm every (program x shape-rung) pair
+# into a fresh compile cache, then prove a second engine re-warms
+# entirely from disk with zero compiles and zero traces.
+aot-check:
+	JAX_PLATFORMS=cpu $(PY) tools/aot_warmup.py
 
 # Per-program roofline table: analytical cost (FLOPs / HBM bytes /
 # intensity from the jaxpr cost model) vs achieved wall time for every

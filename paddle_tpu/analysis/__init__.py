@@ -37,7 +37,7 @@ from .cost import (  # noqa: F401
     CostReport, estimate_cost, estimate_fn_cost, transformer_flops_per_token,
 )
 from .registry import (  # noqa: F401
-    lint_all, lint_contract, lint_mode, lint_program, register_program,
-    registered, unregister_program,
+    aot_warmup, lint_all, lint_contract, lint_mode, lint_program,
+    register_program, registered, unregister_program,
 )
 from . import walker  # noqa: F401

@@ -7,10 +7,22 @@ and how many times it was DISPATCHED — replacing the hand-rolled
 carried.  ``DispatchAuditor`` is the context manager that asserts the
 counts over a block: an extra dispatch (a hidden host loop) or an extra
 trace (a shape leak) raises :class:`GraphContractError`.
+
+``CountedJit.aot_compile`` is the AOT plane's entry point: it compiles
+the program at an abstract signature (``jax.ShapeDtypeStruct`` leaves —
+no real buffers) via ``lower().compile()``, consults the persistent
+:class:`~paddle_tpu.core.aot.CompileCache` first, and installs the
+executable in a per-program table that ``__call__`` checks before
+falling back to the normal jit path.  A table hit NEVER traces; a
+``seal()``-ed program (PT_AOT=strict) raises
+:class:`~paddle_tpu.core.aot.AotMissError` on a miss instead of
+silently compiling mid-traffic.
 """
 from __future__ import annotations
 
 import functools
+import time
+import warnings
 
 import jax
 
@@ -37,6 +49,11 @@ class CountedJit:
         self._fn = fn
         self.donate_argnums = tuple(donate_argnums)
         self._obs = obs.handle()
+        # AOT executable table: abstract signature -> jax.stages.Compiled
+        self._exe = {}
+        self._sealed = False
+        self.aot_hits = 0
+        self.aot_misses = 0
 
         @functools.wraps(fn)
         def counted(*args, **kwargs):
@@ -70,10 +87,89 @@ class CountedJit:
                 "jit_dispatches_total",
                 "Jitted program dispatches per program",
                 labels=("program",)).labels(program=self.name).inc()
+        if self._exe:
+            from ..core import aot
+
+            exe = self._exe.get(aot.signature(args, kwargs))
+            if exe is not None:
+                self.aot_hits += 1
+                return exe(*args, **kwargs)
+            self.aot_misses += 1
+            if self._sealed:
+                raise aot.AotMissError(
+                    f"[{self.name}] PT_AOT=strict: dispatch at an "
+                    f"un-warmed signature after seal() — the shape "
+                    f"ladder must cover every runtime shape "
+                    f"({aot.signature(args, kwargs)})")
         return self._jit(*args, **kwargs)
 
     def lower(self, *args, **kwargs):
         return self._jit.lower(*args, **kwargs)
+
+    def aot_compile(self, args, kwargs=None, cache=None):
+        """AOT-compile at an abstract signature and install the
+        executable; returns how it was satisfied.
+
+        ``args``/``kwargs`` follow the call convention of ``__call__``
+        with arrays replaced by ``jax.ShapeDtypeStruct`` leaves (static
+        kwargs stay concrete python values).  Resolution order:
+
+        * ``'warm'``    — already in this process's table
+        * ``'disk'``    — deserialized from the persistent ``cache``
+          (zero traces: the compile happened in an earlier process)
+        * ``'compile'`` — lowered and compiled now (this traces the
+          body ONCE, bumping ``traces`` — warmup cost, paid off-path)
+        """
+        from ..core import aot
+        from ..testing import faults
+
+        kwargs = dict(kwargs or {})
+        sig = aot.signature(args, kwargs)
+        if sig in self._exe:
+            return "warm"
+        key = cache.key(self.name, sig) if cache is not None else None
+        if cache is not None:
+            exe = cache.load(key, program=self.name)
+            if exe is not None:
+                self._exe[sig] = exe
+                return "disk"
+        t0 = time.perf_counter()
+        faults.fire("aot.lower", "before")
+        with warnings.catch_warnings():
+            # AOT lowering of a donating program at SDS avals warns
+            # that donated buffers are unused — expected: there are no
+            # real buffers to donate at lowering time
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            lowered = self._jit.lower(*args, **kwargs)
+            faults.fire("aot.lower", "after")
+            faults.fire("aot.compile", "before")
+            exe = lowered.compile()
+        faults.fire("aot.compile", "after")
+        secs = time.perf_counter() - t0
+        self._exe[sig] = exe
+        h = self._obs
+        if h is not None:
+            h.registry.histogram(
+                "aot_compile_seconds",
+                "AOT lower+compile wall seconds per program",
+                labels=("program",)).labels(program=self.name).observe(
+                secs)
+            h.recorder.record("aot.compile", program=self.name,
+                              seconds=round(secs, 4))
+        if cache is not None:
+            cache.store(key, exe, program=self.name, sig=sig)
+        return "compile"
+
+    def seal(self):
+        """Forbid post-warmup misses (PT_AOT=strict): once sealed, a
+        dispatch whose signature is not in the table raises AotMissError
+        instead of tracing."""
+        if not self._exe:
+            raise ValueError(
+                f"[{self.name}] seal() before any aot_compile(): a "
+                f"sealed empty table would reject every dispatch")
+        self._sealed = True
 
     def __repr__(self):
         return (f"CountedJit({self.name}, traces={self.traces}, "

@@ -106,6 +106,11 @@ class ProgramContract:
     * ``allow_host_sync``: permit callback/infeed primitives.
     * ``expected_collectives``: exact {collective: count} inventory
       ({} asserts a collective-free program).
+
+    ``aot_hook`` is an optional zero-arg callable (held weakly, like
+    ``fn``) that re-runs the owner's AOT warmup — checkpoint restore
+    sweeps every registered hook via ``registry.aot_warmup()`` so a
+    rolled-back replica resumes with warmed executables.
     """
 
     name: str
@@ -119,15 +124,22 @@ class ProgramContract:
     f32_floor_bytes: int = 1 << 20
     allow_host_sync: bool = False
     expected_collectives: Optional[dict] = None
+    aot_hook: Any = None
 
     def __post_init__(self):
         self.donate_argnums = tuple(int(i) for i in self.donate_argnums)
         self._fn_ref = _weak(self.fn)
         self.fn = None  # weak only: the contract must not pin the owner
+        self._aot_ref = (_weak(self.aot_hook)
+                         if self.aot_hook is not None else None)
+        self.aot_hook = None
         self._cost = None
 
     def resolve_fn(self):
         return self._fn_ref()
+
+    def resolve_aot_hook(self):
+        return self._aot_ref() if self._aot_ref is not None else None
 
     def example_args(self):
         """Concrete args -> ShapeDtypeStruct pytrees, or None when the

@@ -96,6 +96,27 @@ def lint_program(name: str, *, hlo=False) -> LintReport:
     return lint_contract(_REGISTRY[name], hlo=hlo)
 
 
+def aot_warmup() -> dict:
+    """Sweep every registered contract's ``aot_hook`` — checkpoint
+    restore calls this so a rolled-back replica resumes with warmed
+    executables.  Hooks are deduplicated by resolved callable (the six
+    serving programs all point at one ``PagedExecutor.aot_warmup``
+    bound method) and a dead owner's entry is skipped, not failed.
+    Returns {contract name: hook result} for the hooks that ran."""
+    out, ran = {}, set()
+    for name, contract in list(_REGISTRY.items()):
+        hook = contract.resolve_aot_hook()
+        if hook is None:
+            continue
+        ident = (id(getattr(hook, "__self__", hook)),
+                 id(getattr(hook, "__func__", hook)))
+        if ident in ran:
+            continue
+        ran.add(ident)
+        out[name] = hook()
+    return out
+
+
 def lint_all(*, hlo=False) -> LintReport:
     """Lint every registered program; entries whose program has been
     garbage-collected are dropped, not failed."""

@@ -138,7 +138,7 @@ class CheckpointManager:
 
     def __init__(self, root, keep_last_k=3, world_size=None, rank=None,
                  coordinator_rank=0, barrier_timeout=300.0,
-                 watchdog=None):
+                 watchdog=None, aot_warmup=None):
         self.root = root
         self.keep_last_k = keep_last_k
         self.world_size = (world_size if world_size is not None
@@ -149,6 +149,11 @@ class CheckpointManager:
         self._watchdog = watchdog
         self._inflight = None
         self._prev_sigterm = None
+        # aot_warmup: zero-arg callable run after every load() so a
+        # restored replica re-warms its AOT executables before serving
+        # (guardian rollback resumes in seconds).  None = sweep the
+        # registered program contracts' hooks when PT_AOT != off.
+        self._aot_warmup = aot_warmup
         os.makedirs(root, exist_ok=True)
 
     # -- paths ---------------------------------------------------------------
@@ -316,6 +321,23 @@ class CheckpointManager:
             raise FileNotFoundError(
                 f"step {step} under {self.root} is not committed")
         load_state_dict(state_dict, d)
+        # re-warm AOT executables after a rollback: the programs are
+        # intact (params changed, shapes did not) but a FRESH process
+        # restoring here would otherwise pay the full compile wall
+        try:
+            if self._aot_warmup is not None:
+                self._aot_warmup()
+            else:
+                from ..core.aot import mode as _aot_mode
+
+                if _aot_mode() != "off":
+                    from ..analysis import aot_warmup as _sweep
+
+                    _sweep()
+        except Exception:
+            # warmup is an optimization: a failing hook must never turn
+            # a good restore into a failed one
+            pass
         return step
 
     # -- preemption ----------------------------------------------------------

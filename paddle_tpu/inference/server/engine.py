@@ -48,7 +48,8 @@ class ServingEngine:
                  prefill_chunk=None, eos_token_id=None,
                  max_preemptions=4, prefix_cache=None,
                  spec_decode=None, clock=None, slos=None,
-                 slo_rules=None, async_exec=None):
+                 slo_rules=None, async_exec=None, aot=None,
+                 compile_cache=None, decode_n_steps=()):
         self.executor = PagedExecutor(
             model, max_seqs=max_seqs, page_size=page_size,
             max_len=max_len, dtype=dtype, num_pages=num_pages)
@@ -95,6 +96,40 @@ class ServingEngine:
             max_preemptions=max_preemptions, prefix_cache=self.prefix,
             spec=self.spec, async_exec=async_exec)
         self._next_rid = 0
+        # aot: None = follow PT_AOT (default off, bit-exact legacy
+        # path); "off"/"warm"/"strict" force it (bench A/B).  warm =
+        # AOT-compile every (program x shape-rung) pair at build via
+        # the persistent compile cache; strict additionally seals the
+        # programs so a post-warmup miss raises instead of compiling
+        # mid-traffic.  compile_cache: a core.aot.CompileCache, a cache
+        # dir path, or None for the PT_COMPILE_CACHE default.
+        from paddle_tpu.core import aot as aot_mod
+
+        if aot is None:
+            aot = aot_mod.mode()
+        if aot not in aot_mod.MODES:
+            raise ValueError(f"aot={aot!r}: expected off|warm|strict")
+        self.compile_cache = None
+        self._aot_report = None
+        self.aot_mode = aot
+        if aot != "off":
+            if not isinstance(compile_cache, aot_mod.CompileCache):
+                self.compile_cache = aot_mod.CompileCache(
+                    path=compile_cache)
+            else:
+                self.compile_cache = compile_cache
+            self._aot_report = self.executor.aot_warmup(
+                prefill_chunk=prefill_chunk,
+                compile_cache=self.compile_cache,
+                spec_window=(self.spec.k + 1 if self.spec else None),
+                decode_n_steps=decode_n_steps)
+            if aot == "strict":
+                self.executor.seal()
+            from paddle_tpu import obs as _obs
+
+            if _obs.handle() is not None:
+                _obs.handle().statusz["compile_cache"] = \
+                    self.compile_cache.statusz
         # health plane: when telemetry is on, the engine owns an SLO
         # engine evaluated once per step, beats the "serving"
         # heartbeat, and feeds the /statusz pool/occupancy provider.

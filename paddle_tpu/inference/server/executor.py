@@ -26,6 +26,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ... import obs
 from ...analysis import CountedJit, ProgramContract, register_program
 from ...ops.nn_ops import _rms_norm_plain, _rope_plain
 from ..paged import PagedKVCache, paged_decode_attention
@@ -178,6 +179,14 @@ class PagedExecutor:
                                       name="serve.verify",
                                       donate_argnums=(3, 4))
         self.rollback_pages = 0
+        # AOT plane state (core/aot.py): a non-None ladder switches the
+        # executor into bucketed-shape mode — the scheduler quantizes
+        # prefill chunks onto the rungs and prefill_chunk pads the past
+        # cover onto page buckets.  None (PT_AOT=off) is bit-exact r17.
+        self.aot_ladder = None
+        self._aot_page_buckets = None
+        self._aot_sealed = False
+        self._aot_config = None
         self._register_contracts()
 
     @property
@@ -234,6 +243,10 @@ class PagedExecutor:
             compute_dtype=str(pool_dt) if pool_dt.itemsize < 4 else None,
             # single-device programs must stay collective-free
             expected_collectives={},
+            # checkpoint restore sweeps this hook (registry.aot_warmup)
+            # so a rolled-back replica re-warms its executables; a no-op
+            # until the engine has run aot_warmup once
+            aot_hook=self._aot_rewarm,
         )
         register_program(ProgramContract(
             name="serve.prefill", fn=self._prefill_fwd,
@@ -264,6 +277,136 @@ class PagedExecutor:
             args=(layers, tops, i32(B, 2), kp, kp, i32(B), i32(B, pps),
                   i32(B)),
             donate_argnums=self._jit_verify.donate_argnums, **common))
+
+    # -- AOT warmup (core/aot.py) ---------------------------------------
+
+    def aot_warmup(self, prefill_chunk=None, compile_cache=None,
+                   spec_window=None, decode_n_steps=(), ladder=None):
+        """Pre-compile every (program x shape-rung) pair the bucketed
+        executor can dispatch, so a warmed engine serves with ZERO
+        post-warmup traces.
+
+        The shape universe is finite by construction:
+
+        * ``serve.prefill_chunk`` — chunk length runs over the pow2
+          ``ladder`` rungs (the scheduler floor-quantizes onto them and
+          any prompt decomposes into descending rungs), past-KV cover
+          over the feasible page buckets (a chunk of C at rung r can
+          only ever see ``<= ceil((max_len - C) / page_size)`` past
+          pages).  Whole-prompt prefill is routed through this program
+          (``serve.prefill`` has an unbounded [1, S] shape — the reason
+          chunking exists).
+        * ``serve.decode`` / ``serve.decode_async`` / ``serve.verify``
+          — batch runs over exactly 1..max_seqs (``verify`` only when
+          ``spec_window`` gives the draft window W = k + 1).
+        * ``serve.decode_n`` — per requested static ``n``.
+
+        Each entry resolves warm (already in-process) / disk (the
+        persistent ``compile_cache``) / compile; a failing entry is
+        recorded and skipped — warmup must never take the engine down.
+        Returns the warmup report and arms ``self.aot_ladder``.
+        """
+        import time as _time
+
+        from ...core import aot
+
+        kvc = self.cache
+        cfg = self.config
+        L = cfg.num_hidden_layers
+        KV, D = cfg.num_key_value_heads, cfg.head_dim
+        ps, pps = kvc.page_size, kvc.max_pages_per_seq
+        pool_dt = kvc.k_pages.dtype
+
+        def sds(tree):
+            return jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(jnp.shape(a), a.dtype),
+                tree)
+
+        def i32(*shape):
+            return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+        layers, tops = sds(self.layers), sds(self.tops)
+        kp = jax.ShapeDtypeStruct(jnp.shape(kvc.k_pages), pool_dt)
+
+        cap = (min(int(prefill_chunk), self.max_len)
+               if prefill_chunk else self.max_len)
+        if ladder is None:
+            ladder = aot.BucketLadder.pow2(cap)
+        buckets = aot.page_buckets(pps)
+
+        plan = []  # (CountedJit, args, kwargs)
+        for C in ladder.rungs:
+            # feasible past covers for a chunk of C: the chunk's last
+            # token still fits in max_len, so past <= max_len - C
+            pmax = aot.bucket_pages(-(-(self.max_len - C) // ps),
+                                    buckets)
+            for b in (x for x in buckets if x <= pmax):
+                past = jax.ShapeDtypeStruct((L, KV, b * ps, D), pool_dt)
+                plan.append((self._jit_chunk,
+                             (layers, tops, i32(1, C), i32(), past,
+                              past, i32()), {}))
+        for B in range(1, kvc.max_seqs + 1):
+            dec = (layers, tops, i32(B), i32(B), kp, kp, i32(B),
+                   i32(B, pps))
+            plan.append((self._jit_decode, dec, {}))
+            plan.append((self._jit_decode_async, dec, {}))
+            for n in decode_n_steps:
+                plan.append((self._jit_decode_n, dec, {"n": int(n)}))
+            if spec_window:
+                plan.append((self._jit_verify,
+                             (layers, tops, i32(B, int(spec_window)),
+                              kp, kp, i32(B), i32(B, pps), i32(B)), {}))
+
+        t0 = _time.perf_counter()
+        report = {"compile": 0, "disk": 0, "warm": 0, "failed": [],
+                  "programs": {}, "ladder": ladder.rungs,
+                  "page_buckets": buckets}
+        for prog, args, kwargs in plan:
+            try:
+                how = prog.aot_compile(args, kwargs,
+                                       cache=compile_cache)
+            except Exception as e:  # a failed entry must not kill warmup
+                report["failed"].append((prog.name, str(e)))
+                continue
+            report[how] += 1
+            report["programs"][prog.name] = \
+                report["programs"].get(prog.name, 0) + 1
+        report["entries"] = len(plan)
+        report["seconds"] = round(_time.perf_counter() - t0, 3)
+
+        self.aot_ladder = ladder
+        self._aot_page_buckets = buckets
+        self._aot_config = dict(prefill_chunk=prefill_chunk,
+                                compile_cache=compile_cache,
+                                spec_window=spec_window,
+                                decode_n_steps=tuple(decode_n_steps),
+                                ladder=ladder)
+        h = obs.handle()
+        if h is not None:
+            h.recorder.record("aot.warmup", **{
+                k: report[k] for k in
+                ("compile", "disk", "warm", "entries", "seconds")})
+        return report
+
+    def _aot_rewarm(self):
+        """Contract ``aot_hook``: re-run the last warmup configuration
+        (checkpoint restore / guardian rollback path); no-op until the
+        engine has warmed once."""
+        if self._aot_config is None:
+            return None
+        return self.aot_warmup(**self._aot_config)
+
+    def seal(self):
+        """PT_AOT=strict: forbid post-warmup compilation.  Every warmed
+        program's table is sealed (a miss raises AotMissError) and
+        whole-prompt ``prefill`` — un-bucketable, routed through chunks
+        by the scheduler — starts refusing direct calls too."""
+        if self.aot_ladder is None:
+            raise ValueError("seal() before aot_warmup()")
+        for prog in self.programs.values():
+            if prog._exe:
+                prog.seal()
+        self._aot_sealed = True
 
     def _head(self, x, tops):
         w = tops["head_w"]
@@ -600,6 +743,14 @@ class PagedExecutor:
     def prefill(self, sid: int, prompt_ids) -> int:
         """Whole-prompt prefill into an allocated slot; returns the
         first greedy token."""
+        if self._aot_sealed:
+            from ...core.aot import AotMissError
+
+            raise AotMissError(
+                "[serve.prefill] PT_AOT=strict: whole-prompt prefill "
+                "has an unbounded [1, S] shape and cannot be warmed — "
+                "the scheduler routes prompts through prefill_chunk's "
+                "bucket ladder instead")
         ids = jnp.asarray(np.asarray(prompt_ids)[None], jnp.int32)
         self.prefill_events.append((sid, int(ids.shape[1])))
         logits, k, v = self._jit_prefill(self.layers, self.tops, ids)
@@ -614,6 +765,20 @@ class PagedExecutor:
         already-written pages.  When ``final``, records and returns the
         prompt's first greedy token; else returns None."""
         past_k, past_v = self.cache.gather_dense(sid, start)
+        if self.aot_ladder is not None:
+            # bucket the past cover so its shape comes from the finite
+            # warmup set: pad to the next page bucket with zeros — the
+            # in-graph `arange(P) < past_len` mask drops the padding's
+            # contribution entirely, so numerics are exact
+            from ...core.aot import bucket_pages
+
+            ps = self.cache.page_size
+            pages = past_k.shape[2] // ps
+            b = bucket_pages(pages, self._aot_page_buckets)
+            if b > pages:
+                pad = ((0, 0), (0, 0), (0, (b - pages) * ps), (0, 0))
+                past_k = jnp.pad(past_k, pad)
+                past_v = jnp.pad(past_v, pad)
         ids = jnp.asarray(np.asarray(chunk_ids)[None], jnp.int32)
         self.prefill_events.append((sid, int(ids.shape[1])))
         # past_k/past_v are donated: gather_dense returns fresh dense

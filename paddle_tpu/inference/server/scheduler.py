@@ -701,12 +701,20 @@ class Scheduler:
     # -- chunked prefill -------------------------------------------------
 
     def _prefill(self, emitted):
+        # a warmed executor publishes its AOT bucket ladder: chunks are
+        # floor-quantized onto the rungs (any prompt decomposes into
+        # descending rungs, so every chunk shape is pre-compiled) and
+        # whole prompts route through prefill_chunk — serve.prefill's
+        # [1, S] shape is unbounded and cannot be warmed
+        ladder = getattr(self.executor, "aot_ladder", None)
         for req in list(self.prefilling):
             ids = req.resume_ids
             total = len(ids)
             start = req.prefill_done
             chunk = (total - start if self.prefill_chunk is None
                      else min(self.prefill_chunk, total - start))
+            if ladder is not None:
+                chunk = ladder.floor(chunk)
             final = start + chunk == total
             try:
                 # page work FIRST, outside the per-request bracket: a
@@ -728,7 +736,7 @@ class Scheduler:
                                     tick=self.tick)
                       if h is not None else obs.NULL_SPAN)
                 with sp, RecordEvent("serve.prefill"):
-                    if start == 0 and final:
+                    if start == 0 and final and ladder is None:
                         tok = self.executor.prefill(req.sid, ids)
                     else:
                         tok = self.executor.prefill_chunk(

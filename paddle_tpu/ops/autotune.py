@@ -61,10 +61,13 @@ def device_kind() -> str:
 
 
 def cache_path() -> str:
+    # shares the PT_CACHE_DIR root with the AOT compile cache — one
+    # directory to ship/mount to pre-warm a fresh replica
+    from ..core.aot import cache_root
+
     return os.environ.get(
         "PT_AUTOTUNE_CACHE",
-        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
-                     "autotune.json"))
+        os.path.join(cache_root(), "autotune.json"))
 
 
 def _key(kernel, shape_key) -> str:

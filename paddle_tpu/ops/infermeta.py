@@ -1230,3 +1230,118 @@ def _pinv(datas, attrs):
         _fail("pinv",
               f"hermitian=True requires square matrices, but "
               f"received shape {list(xs)}")
+
+
+# -- batch 11: linalg solves + factorizations (lu / lu_unpack / ---------------
+# -- cholesky_solve / triangular_solve / matrix_rank / eigvalsh) --------------
+
+def _batch_broadcast(op, xs, ys, xname="X", yname="Y"):
+    """Batch dims (everything left of the matrix dims) must broadcast."""
+    try:
+        np.broadcast_shapes(xs[:-2], ys[:-2])
+    except ValueError:
+        _fail(op,
+              f"The batch dimensions of Input({xname}) {list(xs)} and "
+              f"Input({yname}) {list(ys)} are not broadcast-compatible")
+
+
+@register_validator("lu")
+def _lu(datas, attrs):
+    # unary.cc LUInferMeta — host-path wrapper, validated manually in
+    # linalg.lu (never passes registry.apply)
+    xs = _shape(datas[0])
+    if len(xs) < 2:
+        _fail("lu",
+              f"The rank of input must greater than 2, but received "
+              f"input shape {list(xs)}")
+
+
+@register_validator("lu_unpack")
+def _lu_unpack(datas, attrs):
+    # unary.cc LUUnpackInferMeta — host-path wrapper, validated
+    # manually in linalg.lu_unpack.  Pivots carry one fewer dim than
+    # the packed factor and their last dim is min(m, n).
+    x, piv = datas[0], datas[1]
+    xs, ps = _shape(x), _shape(piv)
+    if len(xs) < 2:
+        _fail("lu_unpack",
+              f"The rank of input must greater than 2, but received "
+              f"input shape {list(xs)}")
+    if len(ps) != len(xs) - 1:
+        _fail("lu_unpack",
+              f"The rank of Pivots should be one less than the rank "
+              f"of X, but received X {list(xs)} and Pivots {list(ps)}")
+    k = min(xs[-2], xs[-1])
+    if ps[-1] != k:
+        _fail("lu_unpack",
+              f"The last dim of Pivots should be min(rows, cols) = "
+              f"{k} of X {list(xs)}, but received Pivots {list(ps)}")
+    if xs[:-2] != ps[:-1]:
+        _fail("lu_unpack",
+              f"The batch dimensions of X and Pivots should match, "
+              f"but received X {list(xs)} and Pivots {list(ps)}")
+
+
+@register_validator("cholesky_solve")
+def _cholesky_solve(datas, attrs):
+    # binary.cc CholeskySolveInferMeta — host-path wrapper, validated
+    # manually in linalg.cholesky_solve.  x is the RHS [*, M, K], y
+    # the square Cholesky factor [*, M, M].
+    x, y = datas[0], datas[1]
+    xs = _shape(x)
+    if len(xs) < 2:
+        _fail("cholesky_solve",
+              f"The rank of Input(X) should be no less than 2, but "
+              f"received shape {list(xs)}")
+    ys = _square_matrix("cholesky_solve", y, name="Y")
+    if ys[-1] != xs[-2]:
+        _fail("cholesky_solve",
+              f"The rows of RHS X should match the order of the "
+              f"factor Y, but received X {list(xs)} and Y {list(ys)}")
+    _batch_broadcast("cholesky_solve", xs, ys)
+
+
+@register_validator("triangular_solve")
+def _triangular_solve(datas, attrs):
+    # binary.cc TriangularSolveInferMeta: x is the square triangular
+    # coefficient [*, M, M], y the RHS [*, M, K]
+    x, y = datas[0], datas[1]
+    xs = _square_matrix("triangular_solve", x)
+    ys = _shape(y)
+    if len(ys) < 2:
+        _fail("triangular_solve",
+              f"The rank of Input(Y) should be no less than 2, but "
+              f"received shape {list(ys)}")
+    if xs[-1] != ys[-2]:
+        _fail("triangular_solve",
+              f"The last dimension of X should be equal to the "
+              f"second-to-last dimension of Y, but received X "
+              f"{list(xs)} and Y {list(ys)}")
+    _batch_broadcast("triangular_solve", xs, ys)
+
+
+@register_validator("matrix_rank")
+def _matrix_rank(datas, attrs):
+    # unary.cc MatrixRankInferMeta — host-path wrapper, validated
+    # manually in linalg.matrix_rank.  The hermitian fast path (eigh
+    # under the hood) additionally requires squareness.
+    xs = _shape(datas[0])
+    if len(xs) < 2:
+        _fail("matrix_rank",
+              f"The dims of input must be greater than 2, but "
+              f"received shape {list(xs)}")
+    if attrs.get("hermitian") and xs[-1] != xs[-2]:
+        _fail("matrix_rank",
+              f"if hermitian == true, matrix should be n*n, but "
+              f"received shape {list(xs)}")
+
+
+@register_validator("eigvalsh")
+def _eigvalsh(datas, attrs):
+    # unary.cc EigvalshInferMeta — host-path wrapper, validated
+    # manually in linalg.eigvalsh
+    _square_matrix("eigvalsh", datas[0], name="Input")
+    uplo = attrs.get("UPLO", "L")
+    if uplo not in ("L", "U"):
+        _fail("eigvalsh",
+              f"UPLO must be 'L' or 'U', but received {uplo!r}")

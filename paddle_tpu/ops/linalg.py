@@ -273,10 +273,13 @@ def pinv(x, rcond=1e-15, hermitian=False, name=None):
 
 
 def matrix_rank(x, tol=None, hermitian=False, name=None):
+    from . import infermeta
     from ..core.tensor import Tensor
 
-    return Tensor(jnp.linalg.matrix_rank(
-        x._data if isinstance(x, Tensor) else x))
+    xd = x._data if isinstance(x, Tensor) else x
+    # host path, so it never passes registry.apply's validator hook
+    infermeta.validate("matrix_rank", (xd,), {"hermitian": bool(hermitian)})
+    return Tensor(jnp.linalg.matrix_rank(xd))
 
 
 def cross(x, y, axis=9, name=None):
@@ -334,11 +337,14 @@ def _raw(x):
 
 def lu(x, pivot=True, get_infos=False, name=None):
     """Packed LU + 1-based pivots (reference linalg.lu)."""
+    from . import infermeta
     from ..core.tensor import Tensor
 
     import jax
 
-    res = jax.lax.linalg.lu(_raw(x))
+    xd = _raw(x)
+    infermeta.validate("lu", (xd,), {"pivot": bool(pivot)})
+    res = jax.lax.linalg.lu(xd)
     packed, piv = res[0], res[1]
     out = (Tensor(packed), Tensor(piv.astype(jnp.int64) + 1))
     if get_infos:
@@ -350,11 +356,13 @@ def lu(x, pivot=True, get_infos=False, name=None):
 def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
               name=None):
     """(P, L, U) from packed LU (reference linalg.lu_unpack)."""
+    from . import infermeta
     from ..core.tensor import Tensor
 
     import jax
 
     a = _raw(lu_data)
+    infermeta.validate("lu_unpack", (a, _raw(lu_pivots)), {})
     piv = _raw(lu_pivots).astype(jnp.int32) - 1  # back to 0-based
     m, n = a.shape[-2], a.shape[-1]
     k = min(m, n)
@@ -390,11 +398,14 @@ def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
 def cholesky_solve(x, y, upper=False, name=None):
     """Solve A X = B given the Cholesky factor (reference
     linalg.cholesky_solve)."""
+    from . import infermeta
     from ..core.tensor import Tensor
 
     import jax.scipy.linalg as jsl
 
-    return Tensor(jsl.cho_solve((_raw(y), not upper), _raw(x)))
+    xd, yd = _raw(x), _raw(y)
+    infermeta.validate("cholesky_solve", (xd, yd), {"upper": bool(upper)})
+    return Tensor(jsl.cho_solve((yd, not upper), xd))
 
 
 def eig(x, name=None):
@@ -418,9 +429,12 @@ def eigvals(x, name=None):
 
 
 def eigvalsh(x, UPLO="L", name=None):
+    from . import infermeta
     from ..core.tensor import Tensor
 
-    return Tensor(jnp.linalg.eigvalsh(_raw(x), UPLO=UPLO))
+    xd = _raw(x)
+    infermeta.validate("eigvalsh", (xd,), {"UPLO": UPLO})
+    return Tensor(jnp.linalg.eigvalsh(xd, UPLO=UPLO))
 
 
 def svdvals(x, name=None):

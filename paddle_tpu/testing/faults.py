@@ -105,6 +105,16 @@ REGISTERED = {
     "obs.http": "one health-plane HTTP request (before=nothing "
                 "written to the socket; a raise here becomes a 500 "
                 "response, after=response sent)",
+    "aot.lower": "one AOT lowering in CountedJit.aot_compile (before="
+                 "nothing traced; after=lowered, not yet compiled — a "
+                 "raise in either phase fails only that warmup entry)",
+    "aot.compile": "one AOT lowered.compile() (before=lowered, no "
+                   "executable; after=executable built, not yet in "
+                   "the table or on disk)",
+    "aot.cache": "one persistent compile-cache entry load (before=file "
+                 "untouched — corrupt/truncate target the entry file; "
+                 "after=executable deserialized; ANY failure degrades "
+                 "to a miss + recompile, never a crash)",
 }
 
 _PHASES = ("before", "after")

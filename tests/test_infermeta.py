@@ -1110,3 +1110,121 @@ def test_pinv_rejects_vector():
 def test_pinv_rejects_non_square_hermitian():
     with pytest.raises(InvalidArgumentError, match="hermitian"):
         paddle.linalg.pinv(_f32(3, 5), hermitian=True)
+
+# -- batch 11 (r18): lu / lu_unpack / cholesky_solve / triangular_solve /
+# -- matrix_rank / eigvalsh -------------------------------------------------
+
+
+def test_lu_accepts_batch():
+    packed, piv = paddle.linalg.lu(_f32(2, 4, 4))
+    assert list(packed.shape) == [2, 4, 4]
+    assert list(piv.shape) == [2, 4]
+
+
+def test_lu_rejects_vector():
+    with pytest.raises(InvalidArgumentError, match="rank of input"):
+        paddle.linalg.lu(_f32(4))
+
+
+def test_lu_unpack_accepts_roundtrip():
+    x = _f32(4, 4)
+    packed, piv = paddle.linalg.lu(x)
+    P, L, U = paddle.linalg.lu_unpack(packed, piv)
+    rebuilt = paddle.matmul(P, paddle.matmul(L, U)).numpy()
+    np.testing.assert_allclose(rebuilt, x.numpy(), atol=1e-4)
+
+
+def test_lu_unpack_rejects_pivot_rank():
+    with pytest.raises(InvalidArgumentError, match="one less"):
+        paddle.linalg.lu_unpack(_f32(4, 4), paddle.to_tensor(
+            np.ones((2, 4), np.int64)))
+
+
+def test_lu_unpack_rejects_pivot_length():
+    with pytest.raises(InvalidArgumentError, match="min"):
+        paddle.linalg.lu_unpack(_f32(4, 4), paddle.to_tensor(
+            np.ones((3,), np.int64)))
+
+
+def test_lu_unpack_rejects_batch_mismatch():
+    with pytest.raises(InvalidArgumentError, match="batch dimensions"):
+        paddle.linalg.lu_unpack(_f32(2, 4, 4), paddle.to_tensor(
+            np.ones((3, 4), np.int64)))
+
+
+def test_cholesky_solve_accepts_factor_solve():
+    a = np.eye(3, dtype=np.float32) * 4.0
+    factor = paddle.linalg.cholesky(paddle.to_tensor(a))
+    b = _f32(3, 2)
+    out = paddle.linalg.cholesky_solve(b, factor)
+    np.testing.assert_allclose(out.numpy(), b.numpy() / 4.0, atol=1e-5)
+
+
+def test_cholesky_solve_rejects_non_square_factor():
+    with pytest.raises(InvalidArgumentError, match="square"):
+        paddle.linalg.cholesky_solve(_f32(3, 2), _f32(3, 4))
+
+
+def test_cholesky_solve_rejects_order_mismatch():
+    with pytest.raises(InvalidArgumentError, match="rows of RHS"):
+        paddle.linalg.cholesky_solve(_f32(4, 2), _f32(3, 3))
+
+
+def test_cholesky_solve_rejects_rhs_vector():
+    with pytest.raises(InvalidArgumentError, match="no less than 2"):
+        paddle.linalg.cholesky_solve(_f32(3), _f32(3, 3))
+
+
+def test_triangular_solve_accepts_wide_rhs():
+    coef = paddle.to_tensor(np.eye(3, dtype=np.float32) * 2.0)
+    rhs = _f32(3, 4)
+    out = paddle.linalg.triangular_solve(coef, rhs)
+    np.testing.assert_allclose(out.numpy(), rhs.numpy() / 2.0, atol=1e-5)
+
+
+def test_triangular_solve_rejects_non_square_coef():
+    with pytest.raises(InvalidArgumentError, match="square"):
+        paddle.linalg.triangular_solve(_f32(3, 4), _f32(4, 2))
+
+
+def test_triangular_solve_rejects_dim_mismatch():
+    with pytest.raises(InvalidArgumentError, match="second-to-last"):
+        paddle.linalg.triangular_solve(_f32(3, 3), _f32(4, 2))
+
+
+def test_triangular_solve_rejects_batch_mismatch():
+    with pytest.raises(InvalidArgumentError,
+                       match="not broadcast-compatible"):
+        paddle.linalg.triangular_solve(_f32(2, 3, 3), _f32(5, 3, 2))
+
+
+def test_matrix_rank_accepts_batch():
+    out = paddle.linalg.matrix_rank(_f32(2, 3, 4))
+    assert list(out.shape) == [2]
+
+
+def test_matrix_rank_rejects_vector():
+    with pytest.raises(InvalidArgumentError, match="greater than 2"):
+        paddle.linalg.matrix_rank(_f32(4))
+
+
+def test_matrix_rank_rejects_non_square_hermitian():
+    with pytest.raises(InvalidArgumentError, match="hermitian"):
+        paddle.linalg.matrix_rank(_f32(3, 4), hermitian=True)
+
+
+def test_eigvalsh_accepts_square():
+    a = _f32(3, 3)
+    sym = paddle.to_tensor(a.numpy() + a.numpy().T)
+    out = paddle.linalg.eigvalsh(sym)
+    assert list(out.shape) == [3]
+
+
+def test_eigvalsh_rejects_non_square():
+    with pytest.raises(InvalidArgumentError, match="square"):
+        paddle.linalg.eigvalsh(_f32(2, 3))
+
+
+def test_eigvalsh_rejects_bad_uplo():
+    with pytest.raises(InvalidArgumentError, match="UPLO"):
+        paddle.linalg.eigvalsh(_f32(3, 3), UPLO="X")

@@ -1,0 +1,109 @@
+"""make aot-check — warm the serving executor's AOT plane on CPU.
+
+Builds a small engine with ``PT_AOT=warm`` against a compile-cache dir
+(``--cache``, default a temp dir so CI stays hermetic), warms every
+(program x shape-rung) pair, then proves the persistence contract by
+re-warming a SECOND engine against the same cache: every entry must
+resolve from disk with zero fresh compiles and zero traces.  Prints the
+bucket-ladder table and the cache manifest, exits non-zero on any
+violated check — wired into ``make smoke``.
+
+Also the operator tool for pre-warming a real cache dir before rollout:
+
+    python tools/aot_warmup.py --cache /var/cache/paddle_tpu/compile
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+FAILURES = []
+
+
+def check(ok, what):
+    print(f"  {'ok' if ok else 'FAIL'}: {what}")
+    if not ok:
+        FAILURES.append(what)
+
+
+def build_engine(cache_dir, **kw):
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.server import ServingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(11)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    return ServingEngine(model, max_seqs=2, page_size=4, max_len=64,
+                         prefill_chunk=8, aot="warm",
+                         compile_cache=cache_dir, **kw)
+
+
+def run(cache_dir):
+    print("== warm (first engine) ==")
+    eng = build_engine(cache_dir)
+    rep = eng._aot_report
+    print(f"  ladder rungs:   {list(rep['ladder'])}")
+    print(f"  page buckets:   {list(rep['page_buckets'])}")
+    for name, n in sorted(rep["programs"].items()):
+        print(f"  {name:<22} {n} shape(s)")
+    print(f"  resolved: compile={rep['compile']} disk={rep['disk']} "
+          f"warm={rep['warm']} in {rep['seconds']}s")
+    check(rep["entries"] > 0, "warmup plan is non-empty")
+    check(not rep["failed"],
+          f"no failed warmup entries ({rep['failed'] or 'none'})")
+    check(rep["compile"] + rep["disk"] == rep["entries"],
+          "every entry resolved")
+
+    print("== re-warm (second engine, same cache) ==")
+    eng2 = build_engine(cache_dir)
+    rep2 = eng2._aot_report
+    traces = sum(p.traces for p in eng2.executor.programs.values())
+    print(f"  resolved: compile={rep2['compile']} disk={rep2['disk']} "
+          f"in {rep2['seconds']}s; traces={traces}")
+    check(rep2["compile"] == 0, "re-warm compiled nothing")
+    check(rep2["disk"] == rep2["entries"],
+          "re-warm resolved every entry from the persistent cache")
+    check(traces == 0, "re-warm traced nothing")
+
+    print("== manifest ==")
+    st = eng2.compile_cache.statusz()
+    print(json.dumps(st, indent=1, sort_keys=True))
+    check(st["entries"] == rep["entries"],
+          "manifest entry count matches the warmup plan")
+    check(st["hits"] >= rep2["disk"], "manifest hit accounting")
+    return 0 if not FAILURES else 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cache", default=None,
+                    help="compile-cache dir to warm (default: temp dir "
+                         "— hermetic check mode)")
+    args = ap.parse_args(argv)
+    if args.cache:
+        rc = run(args.cache)
+    else:
+        with tempfile.TemporaryDirectory() as d:
+            rc = run(d)
+    if FAILURES:
+        print(f"\naot-check: {len(FAILURES)} check(s) FAILED")
+        for f in FAILURES:
+            print(f"  - {f}")
+    else:
+        print("\naot-check: all checks passed")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,6 +50,11 @@ METRICS = (
     ("serving.async_exec.on.serving_tok_s", "higher", 0.10),
     ("serving.async_exec.tok_s_speedup", "higher", 0.10),
     ("serving.async_exec.on.host_overlap_ratio", "higher", 0.20),
+    # AOT cold-start leg (r18): warmed-cache cold-process TTFT, the
+    # cold-vs-warm speedup and the persistent-cache hit rate must hold
+    ("coldstart.coldstart_ttft_s", "lower", 0.25),
+    ("coldstart.speedup", "higher", 0.15),
+    ("coldstart.compile_cache_hit_rate", "higher", 0.10),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

@@ -207,6 +207,39 @@ def main():
         "/statusz per-step phase seconds")
     check("phase_seconds_total" in az, "/statusz cumulative phases")
 
+    # -- 6. AOT compile cache: gauges + /statusz provider ----------------
+    print("== aot compile cache ==")
+    with tempfile.TemporaryDirectory() as d:
+        eng3 = ServingEngine(model, max_seqs=2, page_size=4, max_len=64,
+                             prefill_chunk=8, aot="warm",
+                             compile_cache=d, slos=[])
+        rep = eng3._aot_report
+        check(rep is not None and rep["entries"] > 0,
+              "warmup report covers entries")
+        check(rep["compile"] == rep["entries"] and not rep["failed"],
+              "cold warmup compiled every (program x rung) pair")
+        # a second engine against the same cache dir must come off disk
+        eng4 = ServingEngine(model, max_seqs=2, page_size=4,
+                             max_len=64, prefill_chunk=8, aot="warm",
+                             compile_cache=d, slos=[])
+        rep2 = eng4._aot_report
+        check(rep2["disk"] == rep2["entries"] and rep2["compile"] == 0,
+              "re-warm resolves every entry from the persistent cache")
+        prom = h.registry.prometheus_text()
+        for fam in ("aot_compile_seconds", "aot_cache_hits_total",
+                    "aot_cache_misses_total", "aot_cache_entries",
+                    "aot_cache_bytes"):
+            check(fam in prom, f"family {fam}")
+        sz = health.statusz_payload(h)
+        cc = sz["providers"].get("compile_cache", {})
+        for key in ("dir", "entries", "bytes", "hits", "misses",
+                    "hit_rate", "programs"):
+            check(key in cc, f"/statusz compile_cache key {key}")
+        check(cc.get("entries", 0) == rep["entries"],
+              "/statusz entry count matches the warmup plan")
+        check(cc.get("hits", 0) >= rep2["disk"] > 0,
+              "/statusz hit accounting reflects the disk re-warm")
+
     if FAILURES:
         print(f"\nobs-check: {len(FAILURES)} check(s) FAILED")
         for f in FAILURES:
