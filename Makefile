@@ -46,7 +46,8 @@ smoke:
 		tests/test_obs.py \
 		tests/test_perf.py \
 		tests/test_health.py \
-		tests/test_aot.py
+		tests/test_aot.py \
+		tests/test_quant.py
 	$(MAKE) obs-check
 	$(MAKE) health-check
 	$(MAKE) aot-check

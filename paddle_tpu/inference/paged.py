@@ -96,6 +96,36 @@ def _dense_paged_attention(q, k_pages, v_pages, lengths, page_indices):
     return out.reshape(B, H, D).astype(q.dtype)
 
 
+def _dense_paged_attention_q(q, k_pages, v_pages, lengths, page_indices,
+                             k_scales, v_scales):
+    """Int8-page analog of ``_dense_paged_attention`` — dequantize the
+    GATHERED window (never the whole pool) with the per-page scales,
+    then the same f32 einsum/softmax/einsum.  The off-TPU fallback and
+    the parity oracle for the quant kernel."""
+    B, H, D = q.shape
+    KV, _, ps, _ = k_pages.shape
+    pages_per_seq = page_indices.shape[1]
+    T = pages_per_seq * ps
+    kc = jnp.swapaxes(k_pages[:, page_indices], 0, 1)  # [B, KV, pps, ps, D]
+    vc = jnp.swapaxes(v_pages[:, page_indices], 0, 1)
+    ksc = jnp.swapaxes(k_scales[:, page_indices], 0, 1)  # [B, KV, pps]
+    vsc = jnp.swapaxes(v_scales[:, page_indices], 0, 1)
+    kc = kc.astype(jnp.float32) * ksc[..., None, None]
+    vc = vc.astype(jnp.float32) * vsc[..., None, None]
+    kc = kc.reshape(B, KV, T, D)
+    vc = vc.reshape(B, KV, T, D)
+    g = H // KV
+    qg = q.reshape(B, KV, g, D)
+    logits = jnp.einsum("bkgd,bktd->bkgt", qg.astype(jnp.float32),
+                        kc) / np.sqrt(D)
+    mask = jnp.arange(T)[None, None, None, :] < \
+        lengths[:, None, None, None]
+    logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgt,bktd->bkgd", p, vc)
+    return out.reshape(B, H, D).astype(q.dtype)
+
+
 def _select_impl(head_dim, page_size):
     """Resolve the decode-attention implementation.
 
@@ -130,18 +160,50 @@ def _select_impl(head_dim, page_size):
 
 
 def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
-                           pages_per_compute_block=4):
+                           pages_per_compute_block=4,
+                           k_scales=None, v_scales=None):
     """Decode attention over the page pool.  On TPU this is the
     self-authored fused kernel (``ops/pallas_kernels/paged_decode.py``:
     per-sequence DMA page gather + whole decode attention in VMEM) or
     the stock flash-style ``paged_attention`` kernel; elsewhere the
     dense-gather fallback jit-cached through the op registry.  Routing
     is overridable via ``PT_PAGED_IMPL`` (see ``_select_impl``).
-    Returns a Tensor iff ``q`` is a Tensor."""
+    Returns a Tensor iff ``q`` is a Tensor.
+
+    ``k_scales``/``v_scales`` [KV, P] select the int8-page path
+    (``PT_QUANT=int8``): the fused quant kernel when its (stricter)
+    shape gate passes, else the dense dequantize-the-gather fallback —
+    the stock kernel has no scale inlet, so quant never routes there.
+    """
     wrap = isinstance(q, Tensor)
     q = q._data if wrap else jnp.asarray(q)
     lengths = jnp.asarray(lengths, jnp.int32)
     page_indices = jnp.asarray(page_indices, jnp.int32)
+
+    if k_scales is not None:
+        from ..ops.pallas_kernels import paged_decode as _fused
+
+        impl = _select_impl(q.shape[-1], k_pages.shape[2])
+        if impl == "pallas" and (
+                _fused.supported_quant(q.shape[-1], k_pages.shape[2],
+                                       _on_tpu())
+                or not _on_tpu()):
+            out = _fused.handle_quant()(
+                Tensor(q), Tensor(jnp.asarray(k_pages)),
+                Tensor(jnp.asarray(v_pages)), Tensor(lengths),
+                Tensor(page_indices),
+                Tensor(jnp.asarray(k_scales, jnp.float32)),
+                Tensor(jnp.asarray(v_scales, jnp.float32)))
+        else:
+            out = _op("paged_decode_attention_q",
+                      _dense_paged_attention_q,
+                      Tensor(q), Tensor(jnp.asarray(k_pages)),
+                      Tensor(jnp.asarray(v_pages)), Tensor(lengths),
+                      Tensor(page_indices),
+                      Tensor(jnp.asarray(k_scales, jnp.float32)),
+                      Tensor(jnp.asarray(v_scales, jnp.float32)))
+        return out if wrap else out._data
+
     impl = _select_impl(q.shape[-1], k_pages.shape[2])
 
     if impl == "pallas":
@@ -204,7 +266,9 @@ class PagedKVCache:
 
     def __init__(self, n_layers, n_kv_heads, head_dim, num_pages,
                  page_size=16, max_seqs=8, dtype=jnp.bfloat16,
-                 max_pages_per_seq=None):
+                 max_pages_per_seq=None, quant=None):
+        from ..ops import quant as _quant
+
         self.n_layers = n_layers
         self.page_size = page_size
         self.num_pages = num_pages
@@ -215,9 +279,26 @@ class PagedKVCache:
                                   if max_pages_per_seq is None
                                   else int(max_pages_per_seq))
         self.max_seqs = max_seqs
+        #: what consumers compute in — the pool storage dtype in the
+        #: plain mode, the requested float dtype when the pool is int8.
+        self.compute_dtype = dtype
+        self.quant = _quant.quant_mode(quant)
         shape = (n_layers, n_kv_heads, num_pages, page_size, head_dim)
-        self.k_pages = jnp.zeros(shape, dtype)
-        self.v_pages = jnp.zeros(shape, dtype)
+        if self.quant == "int8":
+            # int8 pages + one f32 scale per (layer, kv-head, page),
+            # kept alongside the page table: a page's scale moves,
+            # copies, and frees with the page.
+            self.k_pages = jnp.zeros(shape, jnp.int8)
+            self.v_pages = jnp.zeros(shape, jnp.int8)
+            self.k_scales = jnp.zeros((n_layers, n_kv_heads, num_pages),
+                                      jnp.float32)
+            self.v_scales = jnp.zeros((n_layers, n_kv_heads, num_pages),
+                                      jnp.float32)
+        else:
+            self.k_pages = jnp.zeros(shape, dtype)
+            self.v_pages = jnp.zeros(shape, dtype)
+            self.k_scales = None
+            self.v_scales = None
         self._free = list(range(num_pages - 1, -1, -1))
         # page table: [max_seqs, max_pages_per_seq] int32; -1 = unset
         # (page id 0 is valid, so 0 cannot double as the sentinel)
@@ -335,6 +416,13 @@ class PagedKVCache:
             self.k_pages[:, :, old])
         self.v_pages = self.v_pages.at[:, :, new].set(
             self.v_pages[:, :, old])
+        if self.k_scales is not None:
+            # a quantized page is meaningless without its scale — the
+            # copy must carry both or the COW'd page dequantizes wrong
+            self.k_scales = self.k_scales.at[:, :, new].set(
+                self.k_scales[:, :, old])
+            self.v_scales = self.v_scales.at[:, :, new].set(
+                self.v_scales[:, :, old])
         self.page_table[seq, slot] = new
         self.page_refs[old] -= 1
         self.cow_count += 1
@@ -423,15 +511,36 @@ class PagedKVCache:
         """Write a token span's KV at position ``start`` (chunked
         prefill): k/v [L, KV, T, D] covering positions
         ``start..start+T-1``.  Pages are allocated as needed; the
-        sequence length becomes ``start + T``."""
-        k = jnp.asarray(k, self.k_pages.dtype)
-        v = jnp.asarray(v, self.v_pages.dtype)
-        T = k.shape[2]
+        sequence length becomes ``start + T``.  On an int8 pool the
+        span is quantized on write (``ops.quant.kv_write``:
+        scatter-max the touched pages' scales, requantize residents,
+        write the new cells)."""
+        T = int(np.shape(k)[2])
         self._ensure_capacity(seq, start + T)
         # shared pages in the write window are read-only: COW them
         # first (no-op when nothing is shared, i.e. no prefix cache)
         self.make_writable(seq, start, start + T)
         ps = self.page_size
+        if self.quant == "int8":
+            from ..ops import quant as _quant
+
+            row = self.page_table[seq]
+            pids = jnp.asarray([int(row[(start + t) // ps])
+                                for t in range(T)], jnp.int32)
+            offs = jnp.asarray([(start + t) % ps for t in range(T)],
+                               jnp.int32)
+            _faults.fire("quant.kv_write", "before")
+            self.k_pages, self.k_scales = _quant.kv_write(
+                self.k_pages, self.k_scales, pids, offs,
+                jnp.asarray(k))
+            self.v_pages, self.v_scales = _quant.kv_write(
+                self.v_pages, self.v_scales, pids, offs,
+                jnp.asarray(v))
+            _faults.fire("quant.kv_write", "after")
+            self.lengths[seq] = start + T
+            return
+        k = jnp.asarray(k, self.k_pages.dtype)
+        v = jnp.asarray(v, self.v_pages.dtype)
         t = 0
         while t < T:
             pos = start + t
@@ -465,6 +574,15 @@ class PagedKVCache:
         pids = jnp.asarray(row)
         k = self.k_pages[:, :, pids]          # [L, KV, n, ps, D]
         v = self.v_pages[:, :, pids]
+        if self.quant == "int8":
+            from ..ops import quant as _quant
+
+            _faults.fire("quant.dequant", "before")
+            k = _quant.kv_dequant(k, self.k_scales[:, :, pids],
+                                  self.compute_dtype)
+            v = _quant.kv_dequant(v, self.v_scales[:, :, pids],
+                                  self.compute_dtype)
+            _faults.fire("quant.dequant", "after")
         sh = (k.shape[0], k.shape[1], n * self.page_size, k.shape[4])
         return k.reshape(sh), v.reshape(sh)
 
@@ -484,8 +602,6 @@ class PagedKVCache:
         sequence's allocation first, commit only if the whole batch
         fits (otherwise an earlier seq would record a length whose
         page slot never got written)."""
-        k = jnp.asarray(k, self.k_pages.dtype)
-        v = jnp.asarray(v, self.v_pages.dtype)
         ps = self.page_size
         self.reserve(seqs, extra_tokens=1)  # batch-atomic
         for s in seqs:
@@ -499,6 +615,18 @@ class PagedKVCache:
             self.lengths[s] = pos + 1
         pids = jnp.asarray(pids)
         offs = jnp.asarray(offs)
+        if self.quant == "int8":
+            from ..ops import quant as _quant
+
+            _faults.fire("quant.kv_write", "before")
+            self.k_pages, self.k_scales = _quant.kv_write(
+                self.k_pages, self.k_scales, pids, offs, jnp.asarray(k))
+            self.v_pages, self.v_scales = _quant.kv_write(
+                self.v_pages, self.v_scales, pids, offs, jnp.asarray(v))
+            _faults.fire("quant.kv_write", "after")
+            return
+        k = jnp.asarray(k, self.k_pages.dtype)
+        v = jnp.asarray(v, self.v_pages.dtype)
         # advanced indexing: [L, KV, B, D] written at (page, offset)[B]
         self.k_pages = self.k_pages.at[:, :, pids, offs].set(k)
         self.v_pages = self.v_pages.at[:, :, pids, offs].set(v)
@@ -514,4 +642,8 @@ class PagedKVCache:
         lens = jnp.asarray(self.lengths[seqs])
         return paged_decode_attention(
             q, self.k_pages[layer], self.v_pages[layer], lens, table,
-            pages_per_compute_block=pages_per_compute_block)
+            pages_per_compute_block=pages_per_compute_block,
+            k_scales=(None if self.k_scales is None
+                      else self.k_scales[layer]),
+            v_scales=(None if self.v_scales is None
+                      else self.v_scales[layer]))

@@ -49,10 +49,14 @@ class ServingEngine:
                  max_preemptions=4, prefix_cache=None,
                  spec_decode=None, clock=None, slos=None,
                  slo_rules=None, async_exec=None, aot=None,
-                 compile_cache=None, decode_n_steps=()):
+                 compile_cache=None, decode_n_steps=(), quant=None):
+        # quant: None = follow PT_QUANT (default none, bit-exact legacy
+        # path); "none"/"int8" force it (bench A/B).  int8 = per-channel
+        # int8 projection weights + per-page int8 KV pools.
         self.executor = PagedExecutor(
             model, max_seqs=max_seqs, page_size=page_size,
-            max_len=max_len, dtype=dtype, num_pages=num_pages)
+            max_len=max_len, dtype=dtype, num_pages=num_pages,
+            quant=quant)
         # clock: injectable wall-clock source for the SLO metrics and
         # per-request timestamps (default time.perf_counter; seeded
         # tests pass obs.LogicalClock() for exact ms percentiles)
@@ -249,6 +253,16 @@ class ServingEngine:
                 "num_pages": cache.num_pages,
                 "free_pages": cache.free_pages,
                 "used_pages": cache.num_pages - cache.free_pages,
+            },
+            "quant": {
+                "mode": self.executor.quant,
+                "kv_pool_dtype": str(cache.k_pages.dtype),
+                "weight_format": ("int8+per-channel-scale"
+                                  if self.executor.quant == "int8"
+                                  else "checkpoint"),
+                "kv_scale_bytes": (0 if cache.k_scales is None else
+                                   cache.k_scales.nbytes
+                                   + cache.v_scales.nbytes),
             },
             "async": {
                 "mode": "on" if s.async_mode else "off",

@@ -28,8 +28,31 @@ import jax.numpy as jnp
 
 from ... import obs
 from ...analysis import CountedJit, ProgramContract, register_program
+from ...ops import quant as _quant
 from ...ops.nn_ops import _rms_norm_plain, _rope_plain
 from ..paged import PagedKVCache, paged_decode_attention
+
+
+def _mm(x, w):
+    """Weight matmul that dispatches on the weight's pytree form at
+    TRACE time: a plain array keeps the exact pre-quant jaxpr
+    (PT_QUANT=none stays bit-exact by construction), a QuantizedLinear
+    dict routes through the fused-dequant path."""
+    if _quant.is_quantized(w):
+        return _quant.qmatmul(x, w)
+    return x @ w
+
+
+#: the stacked decoder weights quantized under PT_QUANT=int8 — the
+#: seven per-layer projection matmuls.  Embedding, norms, RoPE tables
+#: and the LM head stay in the checkpoint dtype (small, and the head
+#: dominates logit drift).
+_QUANT_LAYER_WEIGHTS = (
+    "self_attn.q_proj.weight", "self_attn.k_proj.weight",
+    "self_attn.v_proj.weight", "self_attn.o_proj.weight",
+    "mlp.gate_proj.weight", "mlp.up_proj.weight",
+    "mlp.down_proj.weight",
+)
 
 
 class _PendingDecode:
@@ -107,15 +130,26 @@ class PagedExecutor:
     """
 
     def __init__(self, model, max_seqs=4, page_size=16, max_len=256,
-                 dtype=jnp.float32, num_pages=None):
+                 dtype=jnp.float32, num_pages=None, quant=None):
         from ...models.generation import _stack_layer_params
         from ...models.llama import _rope_tables
 
         cfg = model.config
         self.config = cfg
         self.max_len = int(max_len)
+        # PT_QUANT gate (ops/quant.py): validated here so a bogus value
+        # fails the engine build, not the first decode step
+        self.quant = _quant.quant_mode(quant)
         state = {k: v._data for k, v in model.state_dict().items()}
         self.layers = _stack_layer_params(state, cfg.num_hidden_layers)
+        if self.quant == "int8":
+            # stacked [L, in, out] projections -> QuantizedLinear dicts
+            # ({qweight int8 [L, in, out], scale f32 [L, 1, out]});
+            # lax.scan slices the dict leaves per layer like any other
+            # stacked param, so the forwards only change at _mm()
+            for name in _QUANT_LAYER_WEIGHTS:
+                self.layers[name] = _quant.quantize_linear(
+                    self.layers[name])
         embed = jnp.asarray(state["llama.embed_tokens.weight"])
         cos, sin = _rope_tables(cfg)
         # non-layer weights travel as jit ARGUMENTS: closed-over arrays
@@ -142,7 +176,19 @@ class PagedExecutor:
             num_pages=(max_seqs * pages_per_seq if num_pages is None
                        else int(num_pages)),
             page_size=page_size, max_seqs=max_seqs, dtype=dtype,
-            max_pages_per_seq=pages_per_seq)
+            max_pages_per_seq=pages_per_seq, quant=self.quant)
+        h = obs.handle()
+        if h is not None:
+            h.registry.gauge(
+                "kv_pool_dtype",
+                "KV page pool storage dtype (value 1 marks the active "
+                "dtype)", labels=("dtype",)).labels(
+                dtype=str(np.dtype(self.cache.k_pages.dtype))).set(1)
+            h.registry.gauge(
+                "quant_mode",
+                "Serving quantization mode (PT_QUANT; value 1 marks "
+                "the active mode)", labels=("mode",)).labels(
+                mode=self.quant).set(1)
         self.last_token = {}
         # (sid, n_tokens) per prefill dispatch — the audit trail the
         # prefix-cache tests use to assert prefill FLOPs covered only
@@ -212,17 +258,57 @@ class PagedExecutor:
     def verify_dispatches(self) -> int:
         return self._jit_verify.dispatches
 
+    def _pools(self):
+        """The jit-argument form of the KV pools: the bare page arrays
+        in the plain mode (byte-identical signatures to r18), or
+        ``(pages, scales)`` tuples on an int8 pool — jit flattens the
+        tuple, donation covers every leaf, and the forwards branch on
+        the pytree form at trace time."""
+        c = self.cache
+        if self.quant == "int8":
+            return (c.k_pages, c.k_scales), (c.v_pages, c.v_scales)
+        return c.k_pages, c.v_pages
+
+    def _set_pools(self, kps, vps):
+        """Store a program's updated pool outputs back on the cache."""
+        c = self.cache
+        if self.quant == "int8":
+            (c.k_pages, c.k_scales), (c.v_pages, c.v_scales) = kps, vps
+        else:
+            c.k_pages, c.v_pages = kps, vps
+
+    def _pool_sds(self):
+        """ShapeDtypeStruct mirror of :meth:`_pools` for contracts and
+        AOT warmup."""
+        c = self.cache
+        kp = jax.ShapeDtypeStruct(jnp.shape(c.k_pages),
+                                  c.k_pages.dtype)
+        if self.quant == "int8":
+            sc = jax.ShapeDtypeStruct(jnp.shape(c.k_scales),
+                                      c.k_scales.dtype)
+            return (kp, sc)
+        return kp
+
     def _register_contracts(self):
         """Register the serving programs' graph contracts at
         representative shapes (lint traces ShapeDtypeStructs only — no
         device work).  Chunk shapes pick past cover == chunk length so
-        the donation aliasing opportunity is visible to the checker."""
+        the donation aliasing opportunity is visible to the checker.
+
+        Quantized builds register under ``.int8``-suffixed names: the
+        registry is replace-by-name and lint_graph builds BOTH engine
+        flavors, so the suffix keeps the quantized decode/verify
+        programs linted alongside (not instead of) the plain ones.  The
+        contract ``compute_dtype`` comes from the cache's COMPUTE dtype,
+        never the pool storage dtype — the int8→f32 dequant inside the
+        programs is the point, not an upcast violation."""
         cache = self.cache
         cfg = self.config
         L = cfg.num_hidden_layers
         KV, D = cfg.num_key_value_heads, cfg.head_dim
         ps, B, pps = cache.page_size, cache.max_seqs, \
             cache.max_pages_per_seq
+        sfx = ".int8" if self.quant == "int8" else ""
 
         def sds(tree):
             return jax.tree.map(
@@ -233,14 +319,14 @@ class PagedExecutor:
             return jax.ShapeDtypeStruct(shape, jnp.int32)
 
         layers, tops = sds(self.layers), sds(self.tops)
-        kp = jax.ShapeDtypeStruct(jnp.shape(cache.k_pages),
-                                  cache.k_pages.dtype)
-        past = jax.ShapeDtypeStruct((L, KV, ps, D), cache.k_pages.dtype)
-        # reduced-precision pool => bf16 serving build: flag big f32
-        # intermediates as upcasts (f32 pools skip the check)
-        pool_dt = np.dtype(cache.k_pages.dtype)
+        kp = self._pool_sds()
+        past = jax.ShapeDtypeStruct((L, KV, ps, D),
+                                    cache.compute_dtype)
+        # reduced-precision compute => bf16 serving build: flag big f32
+        # intermediates as upcasts (f32 builds skip the check)
+        cd = np.dtype(cache.compute_dtype)
         common = dict(
-            compute_dtype=str(pool_dt) if pool_dt.itemsize < 4 else None,
+            compute_dtype=str(cd) if cd.itemsize < 4 else None,
             # single-device programs must stay collective-free
             expected_collectives={},
             # checkpoint restore sweeps this hook (registry.aot_warmup)
@@ -249,31 +335,31 @@ class PagedExecutor:
             aot_hook=self._aot_rewarm,
         )
         register_program(ProgramContract(
-            name="serve.prefill", fn=self._prefill_fwd,
+            name="serve.prefill" + sfx, fn=self._prefill_fwd,
             args=(layers, tops, i32(1, 2 * ps)), **common))
         register_program(ProgramContract(
-            name="serve.prefill_chunk", fn=self._chunk_fwd,
+            name="serve.prefill_chunk" + sfx, fn=self._chunk_fwd,
             args=(layers, tops, i32(1, ps), i32(), past, past, i32()),
             donate_argnums=self._jit_chunk.donate_argnums, **common))
         register_program(ProgramContract(
-            name="serve.decode", fn=self._decode_fwd,
+            name="serve.decode" + sfx, fn=self._decode_fwd,
             args=(layers, tops, i32(B), i32(B), kp, kp, i32(B),
                   i32(B, pps)),
             donate_argnums=self._jit_decode.donate_argnums, **common))
         register_program(ProgramContract(
-            name="serve.decode_async", fn=self._decode_tok_fwd,
+            name="serve.decode_async" + sfx, fn=self._decode_tok_fwd,
             args=(layers, tops, i32(B), i32(B), kp, kp, i32(B),
                   i32(B, pps)),
             donate_argnums=self._jit_decode_async.donate_argnums,
             **common))
         register_program(ProgramContract(
-            name="serve.decode_n", fn=self._decode_n_fwd,
+            name="serve.decode_n" + sfx, fn=self._decode_n_fwd,
             args=(layers, tops, i32(B), i32(B), kp, kp, i32(B),
                   i32(B, pps)),
             kwargs={"n": 2},
             donate_argnums=self._jit_decode_n.donate_argnums, **common))
         register_program(ProgramContract(
-            name="serve.verify", fn=self._verify_fwd,
+            name="serve.verify" + sfx, fn=self._verify_fwd,
             args=(layers, tops, i32(B, 2), kp, kp, i32(B), i32(B, pps),
                   i32(B)),
             donate_argnums=self._jit_verify.donate_argnums, **common))
@@ -315,7 +401,10 @@ class PagedExecutor:
         L = cfg.num_hidden_layers
         KV, D = cfg.num_key_value_heads, cfg.head_dim
         ps, pps = kvc.page_size, kvc.max_pages_per_seq
-        pool_dt = kvc.k_pages.dtype
+        # past-KV gathers come back dense in the COMPUTE dtype (int8
+        # pools dequantize inside gather_dense), so the chunk program's
+        # past SDS must not mirror the pool storage dtype
+        past_dt = kvc.compute_dtype
 
         def sds(tree):
             return jax.tree.map(
@@ -326,7 +415,7 @@ class PagedExecutor:
             return jax.ShapeDtypeStruct(shape, jnp.int32)
 
         layers, tops = sds(self.layers), sds(self.tops)
-        kp = jax.ShapeDtypeStruct(jnp.shape(kvc.k_pages), pool_dt)
+        kp = self._pool_sds()
 
         cap = (min(int(prefill_chunk), self.max_len)
                if prefill_chunk else self.max_len)
@@ -341,7 +430,7 @@ class PagedExecutor:
             pmax = aot.bucket_pages(-(-(self.max_len - C) // ps),
                                     buckets)
             for b in (x for x in buckets if x <= pmax):
-                past = jax.ShapeDtypeStruct((L, KV, b * ps, D), pool_dt)
+                past = jax.ShapeDtypeStruct((L, KV, b * ps, D), past_dt)
                 plan.append((self._jit_chunk,
                              (layers, tops, i32(1, C), i32(), past,
                               past, i32()), {}))
@@ -429,9 +518,12 @@ class PagedExecutor:
         def block(x, lp):
             h = _rms_norm_plain(x, lp["input_layernorm.weight"],
                                 epsilon=cfg.rms_norm_eps)
-            q = (h @ lp["self_attn.q_proj.weight"]).reshape(B, S, nh, d)
-            k = (h @ lp["self_attn.k_proj.weight"]).reshape(B, S, nkv, d)
-            v = (h @ lp["self_attn.v_proj.weight"]).reshape(B, S, nkv, d)
+            q = _mm(h, lp["self_attn.q_proj.weight"]) \
+                .reshape(B, S, nh, d)
+            k = _mm(h, lp["self_attn.k_proj.weight"]) \
+                .reshape(B, S, nkv, d)
+            v = _mm(h, lp["self_attn.v_proj.weight"]) \
+                .reshape(B, S, nkv, d)
             q, k = _rope_plain(q, k, tops["cos"], tops["sin"],
                                position_ids=pos)
             g = nh // nkv
@@ -452,12 +544,13 @@ class PagedExecutor:
                 .astype(x.dtype)
             o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
             o = jnp.swapaxes(o, 1, 2).reshape(B, S, nh * d)
-            x = x + o @ lp["self_attn.o_proj.weight"]
+            x = x + _mm(o, lp["self_attn.o_proj.weight"])
             h2 = _rms_norm_plain(x, lp["post_attention_layernorm.weight"],
                                  epsilon=cfg.rms_norm_eps)
-            gate = h2 @ lp["mlp.gate_proj.weight"]
-            up = h2 @ lp["mlp.up_proj.weight"]
-            x = x + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
+            gate = _mm(h2, lp["mlp.gate_proj.weight"])
+            up = _mm(h2, lp["mlp.up_proj.weight"])
+            x = x + _mm(jax.nn.silu(gate) * up,
+                        lp["mlp.down_proj.weight"])
             return x, (jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2))
 
         x, (ks, vs) = jax.lax.scan(block, x, layers)
@@ -493,9 +586,12 @@ class PagedExecutor:
             lp, pk, pv = lp_kv
             h = _rms_norm_plain(x, lp["input_layernorm.weight"],
                                 epsilon=cfg.rms_norm_eps)
-            q = (h @ lp["self_attn.q_proj.weight"]).reshape(B, C, nh, d)
-            k = (h @ lp["self_attn.k_proj.weight"]).reshape(B, C, nkv, d)
-            v = (h @ lp["self_attn.v_proj.weight"]).reshape(B, C, nkv, d)
+            q = _mm(h, lp["self_attn.q_proj.weight"]) \
+                .reshape(B, C, nh, d)
+            k = _mm(h, lp["self_attn.k_proj.weight"]) \
+                .reshape(B, C, nkv, d)
+            v = _mm(h, lp["self_attn.v_proj.weight"]) \
+                .reshape(B, C, nkv, d)
             q, k = _rope_plain(q, k, tops["cos"], tops["sin"],
                                position_ids=pos)
             g = nh // nkv
@@ -514,12 +610,13 @@ class PagedExecutor:
                 .astype(x.dtype)
             o = jnp.einsum("bhqk,bhkd->bhqd", p, vf)
             o = jnp.swapaxes(o, 1, 2).reshape(B, C, nh * d)
-            x = x + o @ lp["self_attn.o_proj.weight"]
+            x = x + _mm(o, lp["self_attn.o_proj.weight"])
             h2 = _rms_norm_plain(x, lp["post_attention_layernorm.weight"],
                                  epsilon=cfg.rms_norm_eps)
-            gate = h2 @ lp["mlp.gate_proj.weight"]
-            up = h2 @ lp["mlp.up_proj.weight"]
-            x = x + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
+            gate = _mm(h2, lp["mlp.gate_proj.weight"])
+            up = _mm(h2, lp["mlp.up_proj.weight"])
+            x = x + _mm(jax.nn.silu(gate) * up,
+                        lp["mlp.down_proj.weight"])
             return x, (jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2))
 
         x, (ks, vs) = jax.lax.scan(block, x, (layers, past_k, past_v))
@@ -547,27 +644,44 @@ class PagedExecutor:
             lp, kp, vp = lp_kv
             h = _rms_norm_plain(x, lp["input_layernorm.weight"],
                                 epsilon=cfg.rms_norm_eps)
-            q = (h @ lp["self_attn.q_proj.weight"]).reshape(B, 1, nh, d)
-            k = (h @ lp["self_attn.k_proj.weight"]).reshape(B, 1, nkv, d)
-            v = (h @ lp["self_attn.v_proj.weight"]).reshape(B, 1, nkv, d)
+            q = _mm(h, lp["self_attn.q_proj.weight"]) \
+                .reshape(B, 1, nh, d)
+            k = _mm(h, lp["self_attn.k_proj.weight"]) \
+                .reshape(B, 1, nkv, d)
+            v = _mm(h, lp["self_attn.v_proj.weight"]) \
+                .reshape(B, 1, nkv, d)
             q, k = _rope_plain(q, k, tops["cos"], tops["sin"],
                                position_ids=pos)
             kh = jnp.swapaxes(k, 1, 2)[:, :, 0]   # [B, nkv, d]
             vh = jnp.swapaxes(v, 1, 2)[:, :, 0]
-            kp = kp.at[:, pids, offs].set(
-                jnp.swapaxes(kh, 0, 1).astype(kp.dtype))
-            vp = vp.at[:, pids, offs].set(
-                jnp.swapaxes(vh, 0, 1).astype(vp.dtype))
-            o = paged_decode_attention(
-                jnp.swapaxes(q, 1, 2)[:, :, 0], kp, vp, lengths + 1,
-                page_tables)                      # [B, nh, d]
+            if isinstance(kp, tuple):
+                # int8 pool slice (pages, per-page scales): quantize
+                # the new token on write (scale grow + resident
+                # requant), attend with the scales threaded through
+                kp = _quant.kv_write(kp[0], kp[1], pids, offs,
+                                     jnp.swapaxes(kh, 0, 1))
+                vp = _quant.kv_write(vp[0], vp[1], pids, offs,
+                                     jnp.swapaxes(vh, 0, 1))
+                o = paged_decode_attention(
+                    jnp.swapaxes(q, 1, 2)[:, :, 0], kp[0], vp[0],
+                    lengths + 1, page_tables,
+                    k_scales=kp[1], v_scales=vp[1])
+            else:
+                kp = kp.at[:, pids, offs].set(
+                    jnp.swapaxes(kh, 0, 1).astype(kp.dtype))
+                vp = vp.at[:, pids, offs].set(
+                    jnp.swapaxes(vh, 0, 1).astype(vp.dtype))
+                o = paged_decode_attention(
+                    jnp.swapaxes(q, 1, 2)[:, :, 0], kp, vp,
+                    lengths + 1, page_tables)     # [B, nh, d]
             o = o.reshape(B, 1, nh * d).astype(x.dtype)
-            x = x + o @ lp["self_attn.o_proj.weight"]
+            x = x + _mm(o, lp["self_attn.o_proj.weight"])
             h2 = _rms_norm_plain(x, lp["post_attention_layernorm.weight"],
                                  epsilon=cfg.rms_norm_eps)
-            gate = h2 @ lp["mlp.gate_proj.weight"]
-            up = h2 @ lp["mlp.up_proj.weight"]
-            x = x + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
+            gate = _mm(h2, lp["mlp.gate_proj.weight"])
+            up = _mm(h2, lp["mlp.up_proj.weight"])
+            x = x + _mm(jax.nn.silu(gate) * up,
+                        lp["mlp.down_proj.weight"])
             return x, (kp, vp)
 
         x, (kps, vps) = jax.lax.scan(
@@ -622,7 +736,8 @@ class PagedExecutor:
         ps = self.cache.page_size
         B, W = ids.shape
         pps = page_tables.shape[1]
-        num_pages = k_pages.shape[2]
+        num_pages = (k_pages[0] if isinstance(k_pages, tuple)
+                     else k_pages).shape[2]
         x = tops["embed"][ids]                         # [B, W, h]
         pos = lengths[:, None] + jnp.arange(W)[None]   # [B, W]
         slot = pos // ps
@@ -643,26 +758,40 @@ class PagedExecutor:
             lp, kp, vp = lp_kv
             h = _rms_norm_plain(x, lp["input_layernorm.weight"],
                                 epsilon=cfg.rms_norm_eps)
-            q = (h @ lp["self_attn.q_proj.weight"]).reshape(B, W, nh, d)
-            k = (h @ lp["self_attn.k_proj.weight"]).reshape(B, W, nkv, d)
-            v = (h @ lp["self_attn.v_proj.weight"]).reshape(B, W, nkv, d)
+            q = _mm(h, lp["self_attn.q_proj.weight"]) \
+                .reshape(B, W, nh, d)
+            k = _mm(h, lp["self_attn.k_proj.weight"]) \
+                .reshape(B, W, nkv, d)
+            v = _mm(h, lp["self_attn.v_proj.weight"]) \
+                .reshape(B, W, nkv, d)
             q, k = _rope_plain(q, k, tops["cos"], tops["sin"],
                                position_ids=pos)
             kf = jnp.swapaxes(k.reshape(B * W, nkv, d), 0, 1)
             vf = jnp.swapaxes(v.reshape(B * W, nkv, d), 0, 1)
-            kp = kp.at[:, pids, offs].set(kf.astype(kp.dtype),
-                                          mode="drop")
-            vp = vp.at[:, pids, offs].set(vf.astype(vp.dtype),
-                                          mode="drop")
-            o = paged_decode_attention(
-                q.reshape(B * W, nh, d), kp, vp, lens_f, tables_f)
+            if isinstance(kp, tuple):
+                # kv_write scatters with mode='drop' throughout, so the
+                # num_pages sentinel pid of invalid window cells is
+                # dropped exactly like the plain path's scatter
+                kp = _quant.kv_write(kp[0], kp[1], pids, offs, kf)
+                vp = _quant.kv_write(vp[0], vp[1], pids, offs, vf)
+                o = paged_decode_attention(
+                    q.reshape(B * W, nh, d), kp[0], vp[0], lens_f,
+                    tables_f, k_scales=kp[1], v_scales=vp[1])
+            else:
+                kp = kp.at[:, pids, offs].set(kf.astype(kp.dtype),
+                                              mode="drop")
+                vp = vp.at[:, pids, offs].set(vf.astype(vp.dtype),
+                                              mode="drop")
+                o = paged_decode_attention(
+                    q.reshape(B * W, nh, d), kp, vp, lens_f, tables_f)
             o = o.reshape(B, W, nh * d).astype(x.dtype)
-            x = x + o @ lp["self_attn.o_proj.weight"]
+            x = x + _mm(o, lp["self_attn.o_proj.weight"])
             h2 = _rms_norm_plain(x, lp["post_attention_layernorm.weight"],
                                  epsilon=cfg.rms_norm_eps)
-            gate = h2 @ lp["mlp.gate_proj.weight"]
-            up = h2 @ lp["mlp.up_proj.weight"]
-            x = x + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
+            gate = _mm(h2, lp["mlp.gate_proj.weight"])
+            up = _mm(h2, lp["mlp.up_proj.weight"])
+            x = x + _mm(jax.nn.silu(gate) * up,
+                        lp["mlp.down_proj.weight"])
             return x, (kp, vp)
 
         x, (kps, vps) = jax.lax.scan(
@@ -819,11 +948,11 @@ class PagedExecutor:
                                 jnp.int32)
         tables = jnp.asarray(np.maximum(cache.page_table[sids], 0))
         lengths = jnp.asarray(cache.lengths[sids])
+        kp, vp = self._pools()
         logits, kps, vps = self._jit_decode(
-            self.layers, self.tops, ids, positions, cache.k_pages,
-            cache.v_pages, lengths, tables)
-        cache.k_pages = kps
-        cache.v_pages = vps
+            self.layers, self.tops, ids, positions, kp, vp, lengths,
+            tables)
+        self._set_pools(kps, vps)
         for s in sids:
             cache.lengths[s] += 1
         # single batched argmax + ONE host transfer for the whole step
@@ -855,11 +984,11 @@ class PagedExecutor:
                                 jnp.int32)
         tables = jnp.asarray(np.maximum(cache.page_table[sids], 0))
         lengths = jnp.asarray(cache.lengths[sids])
+        kp, vp = self._pools()
         toks, kps, vps = self._jit_decode_async(
-            self.layers, self.tops, ids, positions, cache.k_pages,
-            cache.v_pages, lengths, tables)
-        cache.k_pages = kps
-        cache.v_pages = vps
+            self.layers, self.tops, ids, positions, kp, vp, lengths,
+            tables)
+        self._set_pools(kps, vps)
         for s in sids:
             cache.lengths[s] += 1
         return _PendingDecode(self, sids, toks)
@@ -895,12 +1024,11 @@ class PagedExecutor:
             ids[i, 1:1 + len(dr)] = dr
         tables = jnp.asarray(np.maximum(cache.page_table[sids], 0))
         lengths = jnp.asarray(cache.lengths[sids])
+        kp, vp = self._pools()
         packed, emit_n, kps, vps = self._jit_verify(
-            self.layers, self.tops, jnp.asarray(ids), cache.k_pages,
-            cache.v_pages, lengths, tables,
-            jnp.asarray(limits, jnp.int32))
-        cache.k_pages = kps
-        cache.v_pages = vps
+            self.layers, self.tops, jnp.asarray(ids), kp, vp, lengths,
+            tables, jnp.asarray(limits, jnp.int32))
+        self._set_pools(kps, vps)
         # ONE host transfer: the sort-packed token block + counts;
         # splitting it is per-SEQUENCE host work, never per-token-cell
         packed = np.asarray(packed)
@@ -941,12 +1069,11 @@ class PagedExecutor:
             ids[i, 1:1 + len(dr)] = dr
         tables = jnp.asarray(np.maximum(cache.page_table[sids], 0))
         lengths = jnp.asarray(cache.lengths[sids])
+        kp, vp = self._pools()
         packed, emit_n, kps, vps = self._jit_verify(
-            self.layers, self.tops, jnp.asarray(ids), cache.k_pages,
-            cache.v_pages, lengths, tables,
-            jnp.asarray(limits, jnp.int32))
-        cache.k_pages = kps
-        cache.v_pages = vps
+            self.layers, self.tops, jnp.asarray(ids), kp, vp, lengths,
+            tables, jnp.asarray(limits, jnp.int32))
+        self._set_pools(kps, vps)
         return _PendingVerify(self, sids, packed, emit_n)
 
     def rollback(self, sids) -> int:
@@ -975,11 +1102,11 @@ class PagedExecutor:
                                 jnp.int32)
         tables = jnp.asarray(np.maximum(cache.page_table[sids], 0))
         lengths = jnp.asarray(cache.lengths[sids])
+        kp, vp = self._pools()
         toks, kps, vps = self._jit_decode_n(
-            self.layers, self.tops, ids, positions, cache.k_pages,
-            cache.v_pages, lengths, tables, n=int(n))
-        cache.k_pages = kps
-        cache.v_pages = vps
+            self.layers, self.tops, ids, positions, kp, vp, lengths,
+            tables, n=int(n))
+        self._set_pools(kps, vps)
         toks = np.asarray(toks)                     # [n, B]
         out = {}
         for i, s in enumerate(sids):

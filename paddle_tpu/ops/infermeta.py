@@ -1345,3 +1345,77 @@ def _eigvalsh(datas, attrs):
     if uplo not in ("L", "U"):
         _fail("eigvalsh",
               f"UPLO must be 'L' or 'U', but received {uplo!r}")
+
+
+@register_validator("cholesky")
+def _cholesky(datas, attrs):
+    # unary.cc CholeskyInferMeta — auto-wired through registry.apply
+    _square_matrix("cholesky", datas[0], name="Input")
+
+
+@register_validator("svd")
+def _svd(datas, attrs):
+    # unary.cc SvdInferMeta — host-path wrapper, validated manually in
+    # linalg.svd
+    xs = _shape(datas[0])
+    if len(xs) < 2:
+        _fail("svd",
+              f"The rank of Input(X) should be greater equal than 2, "
+              f"but received shape {list(xs)}")
+
+
+@register_validator("qr")
+def _qr(datas, attrs):
+    # unary.cc QrInferMeta: rank >= 2 plus the mode grammar ('reduced'
+    # and 'complete' return (Q, R); paddle's 'r' keeps R only)
+    xs = _shape(datas[0])
+    if len(xs) < 2:
+        _fail("qr",
+              f"The rank of Input(X) should be greater or equal to 2, "
+              f"but received shape {list(xs)}")
+    mode = attrs.get("mode", "reduced")
+    if mode not in ("reduced", "complete", "r"):
+        _fail("qr",
+              f"QR received unrecognized mode {mode!r}; expected one "
+              f"of 'reduced', 'complete', 'r'")
+
+
+@register_validator("eig")
+def _eig(datas, attrs):
+    # unary.cc EigInferMeta — the general eigendecomposition needs a
+    # square (batch of) matrix
+    _square_matrix("eig", datas[0], name="Input")
+
+
+@register_validator("eigh")
+def _eigh(datas, attrs):
+    # unary.cc EighInferMeta — square plus the UPLO grammar, the same
+    # contract as eigvalsh
+    _square_matrix("eigh", datas[0], name="Input")
+    uplo = attrs.get("UPLO", "L")
+    if uplo not in ("L", "U"):
+        _fail("eigh",
+              f"UPLO must be 'L' or 'U', but received {uplo!r}")
+
+
+@register_validator("cond")
+def _cond(datas, attrs):
+    # unary.cc CondInferMeta: rank >= 2 always; the singular-value
+    # norms (p None/2/-2) accept rectangles, every other order inverts
+    # the matrix and needs squareness
+    xs = _shape(datas[0])
+    if len(xs) < 2:
+        _fail("cond",
+              f"The input of condition number must be a matrix or "
+              f"batches of matrices, but received shape {list(xs)}")
+    p = attrs.get("p")
+    if p not in (None, 1, -1, 2, -2, float("inf"), float("-inf"),
+                 "fro", "nuc"):
+        _fail("cond",
+              f"The p of condition number must be one of None, 1, "
+              f"-1, 2, -2, inf, -inf, 'fro', 'nuc', but received "
+              f"{p!r}")
+    if p not in (None, 2, -2) and xs[-1] != xs[-2]:
+        _fail("cond",
+              f"The input matrix must be square when p is {p!r}, but "
+              f"received shape {list(xs)}")

@@ -233,24 +233,35 @@ def solve(x, y, name=None):
 
 
 def svd(x, full_matrices=False, name=None):
+    from . import infermeta
     from ..core.tensor import Tensor
 
-    u, s, vh = jnp.linalg.svd(x._data if isinstance(x, Tensor) else x,
-                              full_matrices=full_matrices)
+    xd = x._data if isinstance(x, Tensor) else x
+    infermeta.validate("svd", (xd,),
+                       {"full_matrices": bool(full_matrices)})
+    u, s, vh = jnp.linalg.svd(xd, full_matrices=full_matrices)
     return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2))
 
 
 def qr(x, mode="reduced", name=None):
+    from . import infermeta
     from ..core.tensor import Tensor
 
-    q, r = jnp.linalg.qr(x._data if isinstance(x, Tensor) else x, mode=mode)
+    xd = x._data if isinstance(x, Tensor) else x
+    infermeta.validate("qr", (xd,), {"mode": mode})
+    if mode == "r":
+        return Tensor(jnp.linalg.qr(xd, mode="r"))
+    q, r = jnp.linalg.qr(xd, mode=mode)
     return Tensor(q), Tensor(r)
 
 
 def eigh(x, UPLO="L", name=None):
+    from . import infermeta
     from ..core.tensor import Tensor
 
-    w, v = jnp.linalg.eigh(x._data if isinstance(x, Tensor) else x)
+    xd = x._data if isinstance(x, Tensor) else x
+    infermeta.validate("eigh", (xd,), {"UPLO": UPLO})
+    w, v = jnp.linalg.eigh(xd)
     return Tensor(w), Tensor(v)
 
 
@@ -412,11 +423,14 @@ def eig(x, name=None):
     """General (non-symmetric) eigendecomposition.  XLA has no TPU
     kernel for general eig (CPU only in the reference's GPU build too —
     phi eig kernel is CPU); computed host-side via LAPACK."""
+    from . import infermeta
     from ..core.tensor import Tensor
 
     import numpy as _np
 
-    w, v = _np.linalg.eig(_np.asarray(_raw(x)))
+    xd = _raw(x)
+    infermeta.validate("eig", (xd,), {})
+    w, v = _np.linalg.eig(_np.asarray(xd))
     return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
 
 
@@ -444,9 +458,12 @@ def svdvals(x, name=None):
 
 
 def cond(x, p=None, name=None):
+    from . import infermeta
     from ..core.tensor import Tensor
 
-    return Tensor(jnp.asarray(jnp.linalg.cond(_raw(x), p=p)))
+    xd = _raw(x)
+    infermeta.validate("cond", (xd,), {"p": p})
+    return Tensor(jnp.asarray(jnp.linalg.cond(xd, p=p)))
 
 
 def corrcoef(x, rowvar=True, name=None):

@@ -147,6 +147,74 @@ def einsum_ffn(x, w1, b1, w2, b2, activation):
     return jnp.einsum("ecf,efh->ech", h, w2) + b2
 
 
+# -- int8 weights (PT_QUANT=int8, r19) --------------------------------------
+
+def _qkernel(x_ref, w1_ref, s1_ref, b1_ref, w2_ref, s2_ref, b2_ref,
+             o_ref, acc, *, activation, n_fblocks):
+    """Same tiling as ``_kernel`` with int8 expert weights: the weight
+    panels stream HBM→VMEM at half/quarter the bytes and the per-output-
+    channel f32 scales are applied to the f32 products right next to
+    the MXU dots (scales commute with the contractions; s2 is constant
+    across F blocks, so scaling each contribution before accumulation
+    is exact)."""
+    j = pl.program_id(2)
+    x = x_ref[0].astype(jnp.float32)                 # [bc, H]
+    w1 = w1_ref[0].astype(jnp.float32)               # [H, bf] (int8 in)
+    h = _act_fn(activation)(
+        jax.lax.dot(x, w1, preferred_element_type=jnp.float32)
+        * s1_ref[0] + b1_ref[0].astype(jnp.float32))  # [bc, bf]
+    contrib = jax.lax.dot(h, w2_ref[0].astype(jnp.float32),
+                          preferred_element_type=jnp.float32) \
+        * s2_ref[0]                                   # [bc, H]
+
+    @pl.when(j == 0)
+    def _init():
+        acc[...] = contrib + b2_ref[0].astype(jnp.float32)
+
+    @pl.when(j > 0)
+    def _accum():
+        acc[...] += contrib
+
+    @pl.when(j == n_fblocks - 1)
+    def _flush():
+        o_ref[0] = acc[...].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("activation",))
+def _pallas_ffn_q(x, qw1, s1, b1, qw2, s2, b2, activation):
+    E, C, H = x.shape
+    F = qw1.shape[-1]
+    bc, bf = blocks(H, F)
+    bc = min(bc, max(8, -(-C // 8) * 8))  # tiny C: one padded row block
+    pad = -C % bc
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    rows = x.shape[1]
+    kernel = functools.partial(_qkernel, activation=activation,
+                               n_fblocks=F // bf)
+    s1 = s1.astype(jnp.float32)
+    s2 = s2.astype(jnp.float32)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            grid=(E, rows // bc, F // bf),
+            in_specs=[
+                pl.BlockSpec((1, bc, H), lambda e, i, j: (e, i, 0)),
+                pl.BlockSpec((1, H, bf), lambda e, i, j: (e, 0, j)),
+                pl.BlockSpec((1, 1, bf), lambda e, i, j: (e, 0, j)),
+                pl.BlockSpec((1, 1, bf), lambda e, i, j: (e, 0, j)),
+                pl.BlockSpec((1, bf, H), lambda e, i, j: (e, j, 0)),
+                pl.BlockSpec((1, 1, H), lambda e, i, j: (e, 0, 0)),
+                pl.BlockSpec((1, 1, H), lambda e, i, j: (e, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, bc, H), lambda e, i, j: (e, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((E, rows, H), x.dtype),
+            scratch_shapes=[pltpu.VMEM((bc, H), jnp.float32)],
+            interpret=_interpret(),
+        )(x, qw1, s1, b1, qw2, s2, b2)
+    return out[:, :C]
+
+
 # -- custom VJP over the kernel ------------------------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
@@ -212,7 +280,30 @@ def grouped_ffn(x, w1, b1, w2, b2, activation="gelu", impl=None):
     x [E, C, H]; w1 [E, H, F]; b1 [E, 1, F]; w2 [E, F, H]; b2 [E, 1, H]
     -> [E, C, H].  Differentiable on both routes (custom VJP over the
     kernel, native AD over the einsum fallback).
+
+    ``w1``/``w2`` may instead be ``QuantizedLinear`` dicts
+    (``ops.quant``): int8 ``qweight`` + per-output-channel f32
+    ``scale``.  That path is inference-only (no VJP — training
+    differentiates the dense weights) and fuses the dequant into the
+    kernel; the einsum route dequantizes first.
     """
+    from .. import quant as _quant
+
+    if _quant.is_quantized(w1) or _quant.is_quantized(w2):
+        if not (_quant.is_quantized(w1) and _quant.is_quantized(w2)):
+            raise ValueError(
+                "grouped_ffn: w1 and w2 must both be quantized")
+        F = w1["qweight"].shape[-1]
+        if resolve_impl(x.shape[-1], F, impl) == "pallas":
+            return _pallas_ffn_q(x, w1["qweight"], w1["scale"], b1,
+                                 w2["qweight"], w2["scale"], b2,
+                                 activation)
+        return einsum_ffn(x, _quant.dequantize(w1["qweight"],
+                                               w1["scale"], x.dtype),
+                          b1,
+                          _quant.dequantize(w2["qweight"], w2["scale"],
+                                            x.dtype),
+                          b2, activation)
     if resolve_impl(x.shape[-1], w1.shape[-1], impl) == "pallas":
         return _fused(x, w1, b1, w2, b2, activation)
     return einsum_ffn(x, w1, b1, w2, b2, activation)

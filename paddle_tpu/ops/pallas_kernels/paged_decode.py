@@ -169,6 +169,131 @@ def paged_decode(q, k_pages, v_pages, lengths, page_indices, scale=None):
     return out.reshape(B, H, D)
 
 
+def _kernel_quant(len_ref, tbl_ref, ks_ref, vs_ref, q_ref, k_hbm, v_hbm,
+                  o_ref, k_buf, v_buf, sem, *, page_size, pages_per_seq,
+                  scale):
+    """Int8-page variant (PT_QUANT=int8): the pools ride HBM→VMEM as
+    int8 (half the bytes of bf16 — the decode step IS this stream) and
+    the per-page f32 scales arrive via scalar prefetch; dequant is a
+    per-page broadcast multiply on the f32 window right next to the MXU
+    dots.  Math past the dequant is identical to ``_kernel``."""
+    b = pl.program_id(0)
+    kv = pl.program_id(1)
+    length = len_ref[b]
+    npages = pl.cdiv(length, jnp.int32(page_size))
+
+    def page_dma(i, pool, buf):
+        return pltpu.make_async_copy(
+            pool.at[kv, tbl_ref[b, i]],
+            buf.at[pl.ds(i * page_size, page_size)],
+            sem)
+
+    for i in range(pages_per_seq):
+        @pl.when(i < npages)
+        def _start():
+            page_dma(i, k_hbm, k_buf).start()
+            page_dma(i, v_hbm, v_buf).start()
+
+        @pl.when(i >= npages)
+        def _zero():
+            k_buf[pl.ds(i * page_size, page_size)] = jnp.zeros(
+                (page_size, k_buf.shape[-1]), k_buf.dtype)
+            v_buf[pl.ds(i * page_size, page_size)] = jnp.zeros(
+                (page_size, v_buf.shape[-1]), v_buf.dtype)
+
+    for i in range(pages_per_seq):
+        @pl.when(i < npages)
+        def _wait():
+            page_dma(i, k_hbm, k_buf).wait()
+            page_dma(i, v_hbm, v_buf).wait()
+
+    # Per-row dequant scale for the window: row r belongs to window page
+    # r // page_size, whose pool page id is tbl[b, i] — a static unroll
+    # over the (small) page window turns the SMEM scale gathers into a
+    # [S_window, 1] VMEM vector.
+    S = k_buf.shape[0]
+    row_page = jax.lax.broadcasted_iota(jnp.int32, (S, 1), 0) \
+        // jnp.int32(page_size)
+    k_scale = jnp.zeros((S, 1), jnp.float32)
+    v_scale = jnp.zeros((S, 1), jnp.float32)
+    for i in range(pages_per_seq):
+        pid = tbl_ref[b, i]
+        k_scale = jnp.where(row_page == i, ks_ref[kv, pid], k_scale)
+        v_scale = jnp.where(row_page == i, vs_ref[kv, pid], v_scale)
+
+    q = q_ref[0, 0].astype(jnp.float32) * jnp.float32(scale)  # [G, D]
+    k = k_buf[...].astype(jnp.float32) * k_scale     # [S_window, D]
+    v = v_buf[...].astype(jnp.float32) * v_scale
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    col = jax.lax.broadcasted_iota(jnp.int32, (q.shape[0], S), 1)
+    s = jnp.where(col < length, s, jnp.float32(-1e30))
+    m = jnp.max(s, axis=1, keepdims=True)
+    e = jnp.exp(s - m)
+    p = e / jnp.sum(e, axis=1, keepdims=True)
+    o = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    o_ref[0, 0] = o.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale",))
+def _call_quant(q, k_pages, v_pages, lengths, page_indices, k_scales,
+                v_scales, scale):
+    B, KV, G, D = q.shape
+    ps = k_pages.shape[2]
+    pps = page_indices.shape[1]
+    kernel = functools.partial(_kernel_quant, page_size=ps,
+                               pages_per_seq=pps, scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,  # lengths + page table + k/v page scales
+        grid=(B, KV),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, D),
+                         lambda b, kv, lens, tbl, ks, vs: (b, kv, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # K pool stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),   # V pool stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, D),
+                               lambda b, kv, lens, tbl, ks, vs:
+                               (b, kv, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((pps * ps, D), k_pages.dtype),
+            pltpu.VMEM((pps * ps, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((B, KV, G, D), q.dtype),
+            interpret=_interpret(),
+        )(jnp.asarray(lengths, jnp.int32),
+          jnp.asarray(page_indices, jnp.int32),
+          jnp.asarray(k_scales, jnp.float32),
+          jnp.asarray(v_scales, jnp.float32), q, k_pages, v_pages)
+
+
+def paged_decode_quant(q, k_pages, v_pages, lengths, page_indices,
+                       k_scales, v_scales, scale=None):
+    """Fused paged-decode attention over an int8 page pool.
+
+    Same layout contract as :func:`paged_decode` with int8 pools plus
+    per-page f32 scales ``[KV, P]`` (one per (kv-head, page), kept with
+    the page table by PagedKVCache).
+    """
+    B, H, D = q.shape
+    KV = k_pages.shape[0]
+    if H % KV:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {KV}")
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    qg = q.reshape(B, KV, H // KV, D)
+    out = _call_quant(qg, k_pages, v_pages, lengths, page_indices,
+                      k_scales, v_scales, float(scale))
+    return out.reshape(B, H, D)
+
+
 def supported(head_dim, page_size, on_tpu):
     """Shape gate for the compiled (non-interpret) kernel: D must tile
     to 128 lanes and a page must tile to 8 f32 sublanes.  Off-TPU the
@@ -177,6 +302,15 @@ def supported(head_dim, page_size, on_tpu):
     if not on_tpu:
         return False
     return head_dim % 128 == 0 and page_size % 8 == 0
+
+
+def supported_quant(head_dim, page_size, on_tpu):
+    """Gate for the int8-page kernel: int8 sublane tiling is 32, so the
+    per-page DMA slices need page_size % 32 == 0 (vs 8 for the f32/bf16
+    pools)."""
+    if not on_tpu:
+        return False
+    return head_dim % 128 == 0 and page_size % 32 == 0
 
 
 def paged_decode_spmd_rule(mesh, q_spec, k_spec, v_spec, len_spec,
@@ -188,7 +322,16 @@ def paged_decode_spmd_rule(mesh, q_spec, k_spec, v_spec, len_spec,
     return tuple(q_spec)[:2] + (None,)
 
 
+def paged_decode_quant_spmd_rule(mesh, q_spec, k_spec, v_spec, len_spec,
+                                 tbl_spec, ks_spec, vs_spec):
+    """Same sharding story as :func:`paged_decode_spmd_rule`; the scale
+    tables must carry the pools' KV sharding and are otherwise
+    kernel-internal."""
+    return tuple(q_spec)[:2] + (None,)
+
+
 _HANDLE = None
+_HANDLE_QUANT = None
 
 
 def handle():
@@ -205,3 +348,17 @@ def handle():
             static_argnames=("scale",),
             spmd_rule=paged_decode_spmd_rule)
     return _HANDLE
+
+
+def handle_quant():
+    """Custom-op handle for the int8-page kernel, registered as
+    ``fused_paged_decode_quant`` (same lazy-global pattern)."""
+    global _HANDLE_QUANT
+    if _HANDLE_QUANT is None:
+        from ...utils.cpp_extension import register_custom_op
+
+        _HANDLE_QUANT = register_custom_op(
+            "fused_paged_decode_quant", paged_decode_quant,
+            static_argnames=("scale",),
+            spmd_rule=paged_decode_quant_spmd_rule)
+    return _HANDLE_QUANT

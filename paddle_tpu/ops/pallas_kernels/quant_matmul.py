@@ -1,0 +1,166 @@
+"""Int8-weight matmul Pallas kernel with fused dequant (#6, r19).
+
+The serving-side weight GEMM for ``PT_QUANT=int8``: activations stay in
+the compute dtype, the weight rides HBM→VMEM as int8 (half the bytes of
+bf16 — decode is bandwidth-bound, so the weight stream IS the decode
+step cost), and the per-output-channel f32 scale is applied to the f32
+accumulator right next to the MXU op:
+
+    acc[bm, bn] += x_blk @ qw_blk.astype(f32)        (K-block innermost)
+    out = (acc * scale[bn]) * 1                      (flushed once)
+
+Per-OUTPUT-channel scales commute with the K contraction, which is what
+makes the late multiply exact w.r.t. dequant-then-dot.  Grid is
+``(M/bm, N/bn, K/bk)`` with K innermost so each ``[bm, bn]`` output
+tile accumulates across K blocks in VMEM f32 scratch (same
+accumulate-then-flush shape as ``grouped_gemm``).
+
+Routing mirrors the package convention: ``PT_QUANT_MATMUL`` ∈
+{auto, pallas, einsum}; auto takes the kernel on TPU when K and N tile
+to 128 lanes, else the caller's dequant-then-dot fallback
+(``ops/quant.qmatmul``).  Tiles come from the autotune cache under
+``quant_matmul_blocks``.  Inference-only: no VJP (quantized weights are
+a serving artifact; training differentiates the dense weights).
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: (row, col, contraction) tile.  int8 min tile is (32, 128); 512 on
+#: the K axis keeps the MXU fed while one [bk, bn] int8 panel is 64 KB.
+_DEFAULT_BLOCKS = (128, 256, 512)
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def blocks(m, k, n):
+    """(bm, bn, bk) for an [m, k] x [k, n] GEMM — the autotune winner
+    when on record, clamped so bn divides n and bk divides k (callers
+    gate k % 128 == n % 128 == 0; m is padded)."""
+    from .. import autotune as _autotune
+
+    bm, bn, bk = _autotune.lookup("quant_matmul_blocks", (k, n),
+                                  default=_DEFAULT_BLOCKS)
+    bn = min(int(bn), n)
+    while n % bn != 0 and bn > 1:
+        bn //= 2
+    if n % bn != 0:
+        bn = n
+    bk = min(int(bk), k)
+    while k % bk != 0 and bk > 1:
+        bk //= 2
+    if k % bk != 0:
+        bk = k
+    return int(bm), bn, bk
+
+
+def _kernel(x_ref, w_ref, s_ref, o_ref, acc, *, n_kblocks):
+    kb = pl.program_id(2)
+    part = jax.lax.dot(x_ref[...].astype(jnp.float32),
+                       w_ref[...].astype(jnp.float32),
+                       preferred_element_type=jnp.float32)  # [bm, bn]
+
+    @pl.when(kb == 0)
+    def _init():
+        acc[...] = part
+
+    @pl.when(kb > 0)
+    def _accum():
+        acc[...] += part
+
+    @pl.when(kb == n_kblocks - 1)
+    def _flush():
+        o_ref[...] = (acc[...] * s_ref[...]).astype(o_ref.dtype)
+
+
+@jax.jit
+def _pallas_qmm(x, qweight, scale):
+    M, K = x.shape
+    N = qweight.shape[-1]
+    bm, bn, bk = blocks(M, K, N)
+    bm = min(bm, max(8, -(-M // 8) * 8))  # tiny M: one padded row block
+    pad = -M % bm
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    rows = x.shape[0]
+    kernel = functools.partial(_kernel, n_kblocks=K // bk)
+    # Mosaic rejects i64 grid/index constants from the repo's global
+    # x64 mode — trace x64-off like every other kernel in this package.
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            grid=(rows // bm, N // bn, K // bk),
+            in_specs=[
+                pl.BlockSpec((bm, bk), lambda i, j, kb: (i, kb)),
+                pl.BlockSpec((bk, bn), lambda i, j, kb: (kb, j)),
+                pl.BlockSpec((1, bn), lambda i, j, kb: (0, j)),
+            ],
+            out_specs=pl.BlockSpec((bm, bn), lambda i, j, kb: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((rows, N), x.dtype),
+            scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+            interpret=_interpret(),
+        )(x, qweight, scale)
+    return out[:M]
+
+
+def supported(k, n, on_tpu):
+    """Shape gate for the compiled kernel: both the contraction and the
+    output minor dim must tile to 128 lanes.  Off-TPU auto routing
+    takes the dequant-then-dot fallback (interpreter mode is test
+    machinery, not a fast path)."""
+    if not on_tpu:
+        return False
+    return k % 128 == 0 and n % 128 == 0
+
+
+def use_pallas(x_shape, w_shape, impl=None):
+    """Route [M, K] x [K, N].  ``impl``/PT_QUANT_MATMUL ∈
+    {auto, pallas, einsum}."""
+    impl = (impl or os.environ.get("PT_QUANT_MATMUL", "auto")).lower()
+    if impl not in ("auto", "pallas", "einsum"):
+        raise ValueError(
+            f"PT_QUANT_MATMUL={impl!r}: expected auto|pallas|einsum")
+    if impl == "auto":
+        return supported(w_shape[-2], w_shape[-1],
+                         jax.default_backend() == "tpu")
+    return impl == "pallas"
+
+
+def quant_matmul(x, qweight, scale):
+    """``x [M, K] @ int8 qweight [K, N] * scale [1, N] -> [M, N]`` in
+    ``x.dtype``, dequant fused into the kernel flush."""
+    return _pallas_qmm(x, qweight, scale.astype(jnp.float32))
+
+
+def quant_matmul_spmd_rule(mesh, x_spec, w_spec, s_spec):
+    """SPMD rule: the row (batch·token) dim may shard — output tiles
+    are independent per row block; K/N are kernel-internal (the scale
+    must ride with its N shard, so both stay replicated).  Output
+    follows x's leading dim."""
+    return (tuple(x_spec)[:1] or (None,)) + (None,)
+
+
+_HANDLE = None
+
+
+def handle():
+    """Custom-op handle (lazy — registration is global).  Registered as
+    ``quant_matmul`` for out-of-tree callers; the serving executor calls
+    ``ops.quant.qmatmul`` directly (it already runs inside a registered
+    program's trace)."""
+    global _HANDLE
+    if _HANDLE is None:
+        from ...utils.cpp_extension import register_custom_op
+
+        _HANDLE = register_custom_op(
+            "quant_matmul", quant_matmul,
+            spmd_rule=quant_matmul_spmd_rule)
+    return _HANDLE

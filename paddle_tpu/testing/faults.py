@@ -115,6 +115,17 @@ REGISTERED = {
                  "untouched — corrupt/truncate target the entry file; "
                  "after=executable deserialized; ANY failure degrades "
                  "to a miss + recompile, never a crash)",
+    "quant.pack": "one per-channel int8 weight quantization in "
+                  "quantize_linear (before=weight untouched, after="
+                  "QuantizedLinear dict built — a raise fails the "
+                  "engine BUILD, never a serving step)",
+    "quant.kv_write": "one host-side quantized KV page write "
+                      "(write_at/append; before=pool untouched, after="
+                      "pages+scales updated, length not yet bumped)",
+    "quant.dequant": "one dense dequantizing gather of a sequence's "
+                     "int8 pages (gather_dense; before=nothing read, "
+                     "after=dense f32/bf16 copy built — the pool is "
+                     "never mutated by a read)",
 }
 
 _PHASES = ("before", "after")

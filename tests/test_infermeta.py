@@ -1228,3 +1228,96 @@ def test_eigvalsh_rejects_non_square():
 def test_eigvalsh_rejects_bad_uplo():
     with pytest.raises(InvalidArgumentError, match="UPLO"):
         paddle.linalg.eigvalsh(_f32(3, 3), UPLO="X")
+
+
+# -- batch 12 (r19): svd / qr / eig / eigh / cholesky / cond ----------------
+
+
+def test_svd_accepts_rectangle():
+    u, s, v = paddle.linalg.svd(_f32(2, 4, 3))
+    assert list(u.shape) == [2, 4, 3]
+    assert list(s.shape) == [2, 3]
+    assert list(v.shape) == [2, 3, 3]
+
+
+def test_svd_rejects_vector():
+    with pytest.raises(InvalidArgumentError, match="rank of Input"):
+        paddle.linalg.svd(_f32(4))
+
+
+def test_qr_accepts_modes():
+    q, r = paddle.linalg.qr(_f32(4, 3))
+    assert list(q.shape) == [4, 3] and list(r.shape) == [3, 3]
+    r_only = paddle.linalg.qr(_f32(4, 3), mode="r")
+    assert list(r_only.shape) == [3, 3]
+
+
+def test_qr_rejects_vector():
+    with pytest.raises(InvalidArgumentError, match="rank of Input"):
+        paddle.linalg.qr(_f32(4))
+
+
+def test_qr_rejects_bad_mode():
+    with pytest.raises(InvalidArgumentError, match="mode"):
+        paddle.linalg.qr(_f32(3, 3), mode="thin")
+
+
+def test_eig_accepts_square():
+    w, v = paddle.linalg.eig(_f32(3, 3))
+    assert list(w.shape) == [3]
+    assert list(v.shape) == [3, 3]
+
+
+def test_eig_rejects_non_square():
+    with pytest.raises(InvalidArgumentError, match="square"):
+        paddle.linalg.eig(_f32(2, 3))
+
+
+def test_eigh_accepts_square():
+    a = _f32(3, 3)
+    sym = paddle.to_tensor(a.numpy() + a.numpy().T)
+    w, v = paddle.linalg.eigh(sym)
+    assert list(w.shape) == [3]
+    assert list(v.shape) == [3, 3]
+
+
+def test_eigh_rejects_non_square():
+    with pytest.raises(InvalidArgumentError, match="square"):
+        paddle.linalg.eigh(_f32(2, 3))
+
+
+def test_eigh_rejects_bad_uplo():
+    with pytest.raises(InvalidArgumentError, match="UPLO"):
+        paddle.linalg.eigh(_f32(3, 3), UPLO="X")
+
+
+def test_cholesky_accepts_spd():
+    a = np.eye(3, dtype=np.float32) * 2.0
+    out = paddle.linalg.cholesky(paddle.to_tensor(a))
+    np.testing.assert_allclose(out.numpy(),
+                               np.linalg.cholesky(a), atol=1e-6)
+
+
+def test_cholesky_rejects_non_square():
+    with pytest.raises(InvalidArgumentError, match="square"):
+        paddle.linalg.cholesky(_f32(3, 4))
+
+
+def test_cond_accepts_rectangle_2norm():
+    out = paddle.linalg.cond(_f32(4, 3))
+    assert out.numpy().shape == ()
+
+
+def test_cond_rejects_vector():
+    with pytest.raises(InvalidArgumentError, match="matrix"):
+        paddle.linalg.cond(_f32(4))
+
+
+def test_cond_rejects_non_square_fro():
+    with pytest.raises(InvalidArgumentError, match="square"):
+        paddle.linalg.cond(_f32(4, 3), p="fro")
+
+
+def test_cond_rejects_bad_p():
+    with pytest.raises(InvalidArgumentError, match="p of condition"):
+        paddle.linalg.cond(_f32(3, 3), p=3)

@@ -55,6 +55,12 @@ METRICS = (
     ("coldstart.coldstart_ttft_s", "lower", 0.25),
     ("coldstart.speedup", "higher", 0.15),
     ("coldstart.compile_cache_hit_rate", "higher", 0.10),
+    # quantized serving (r19): the KV capacity multiplier at fixed pool
+    # bytes is analytic (layout-derived) and must not drift; the int8
+    # leg must keep serving throughput and its logit-accuracy bound
+    ("serving.quant.occupancy_ratio", "higher", 0.05),
+    ("serving.quant.int8.serving_tok_s", "higher", 0.10),
+    ("serving.quant.logit_drift_rel_rms", "lower", 0.50),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

@@ -46,6 +46,11 @@ def build_programs():
     # contracts register inside the executor's constructor.
     engine = ServingEngine(model, max_seqs=2, page_size=4, max_len=128)
 
+    # serve.*.int8 — the quantized build registers its programs under
+    # suffixed names, so both flavors stay in the linted registry.
+    engine_q = ServingEngine(model, max_seqs=2, page_size=4,
+                             max_len=128, quant="int8")
+
     # moe.ep_alltoall — the fused shard_map body over the ep=8 mesh.
     mesh = ProcessMesh(list(range(8)), dim_names=["ep"])
     moe = MoELayer(d_model=16, d_hidden=32, num_experts=8,
@@ -53,7 +58,8 @@ def build_programs():
                    mesh=mesh, ep_axis="ep", dispatch_mode="alltoall",
                    moe_impl="fused")
     moe._ep_opdef()
-    return step, engine, moe  # keep owners alive through the lint
+    # keep owners alive through the lint
+    return step, engine, engine_q, moe
 
 
 def main():

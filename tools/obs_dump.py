@@ -240,6 +240,30 @@ def main():
         check(cc.get("hits", 0) >= rep2["disk"] > 0,
               "/statusz hit accounting reflects the disk re-warm")
 
+    # -- 7. quant plane: pool-dtype/mode gauges + /statusz section -------
+    print("== quant plane ==")
+    eng5 = ServingEngine(model, max_seqs=2, page_size=4, max_len=64,
+                         quant="int8", slos=[])
+    h5 = [eng5.submit(rng.randint(1, 256, (n,)).astype(np.int32),
+                      max_new_tokens=8) for n in (5, 11)]
+    eng5.run()
+    check(all(hd.state is RequestState.FINISHED for hd in h5),
+          "int8 engine drained")
+    prom = h.registry.prometheus_text()
+    check('kv_pool_dtype{dtype="int8"} 1' in prom,
+          "kv_pool_dtype gauge marks int8")
+    check('quant_mode{mode="int8"} 1' in prom,
+          "quant_mode gauge marks int8")
+    sz = health.statusz_payload(h)
+    qz = sz["providers"].get("serving", {}).get("quant", {})
+    for key in ("mode", "kv_pool_dtype", "weight_format",
+                "kv_scale_bytes"):
+        check(key in qz, f"/statusz quant key {key}")
+    check(qz.get("mode") == "int8" and qz.get("kv_pool_dtype") == "int8",
+          "/statusz quant section reflects the int8 build")
+    check(qz.get("kv_scale_bytes", 0) > 0,
+          "/statusz reports per-page scale bytes")
+
     if FAILURES:
         print(f"\nobs-check: {len(FAILURES)} check(s) FAILED")
         for f in FAILURES:
