@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: smoke test test-fast verify-fast lint-graph obs-check \
-	health-check aot-check perf-report perf-check bench
+	health-check aot-check cluster-check perf-report perf-check bench
 
 # <3 min sanity gate: import + one eager op, one jitted llama forward
 # step (the driver's entry()), and a 2-virtual-device multichip train
@@ -47,10 +47,12 @@ smoke:
 		tests/test_perf.py \
 		tests/test_health.py \
 		tests/test_aot.py \
-		tests/test_quant.py
+		tests/test_quant.py \
+		tests/test_cluster.py
 	$(MAKE) obs-check
 	$(MAKE) health-check
 	$(MAKE) aot-check
+	$(MAKE) cluster-check
 
 # Fast lane — must be green before any snapshot commit (see README).
 test-fast:
@@ -87,6 +89,13 @@ health-check:
 # entirely from disk with zero compiles and zero traces.
 aot-check:
 	JAX_PLATFORMS=cpu $(PY) tools/aot_warmup.py
+
+# Fleet end-to-end smoke: 2-replica cluster under PT_OBS, seeded burst
+# through the affinity router, drain one replica mid-load + join a
+# fresh one — asserts zero request loss, journaled route/drain events,
+# replica-labelled gauges and the /statusz cluster provider.
+cluster-check:
+	JAX_PLATFORMS=cpu $(PY) tools/cluster_check.py
 
 # Per-program roofline table: analytical cost (FLOPs / HBM bytes /
 # intensity from the jaxpr cost model) vs achieved wall time for every

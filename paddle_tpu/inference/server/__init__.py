@@ -2,6 +2,9 @@
 
 Layering (host control plane / device data plane):
 
+  ServingCluster (cluster.py) N-replica fleet: prefix-affinity
+                              Router, elastic drain/join, optional
+                              prefill/decode disaggregation
   ServingEngine (engine.py)  user API: submit / cancel / step / stats
     Scheduler   (scheduler.py) iteration-level admission, chunked
                                prefill, preemption-with-recompute
@@ -9,6 +12,7 @@ Layering (host control plane / device data plane):
     PagedExecutor (executor.py) jit'd prefill/chunk/decode forwards
                                 over paged.PagedKVCache slots
 """
+from .cluster import Replica, Router, ServingCluster
 from .engine import ServingEngine
 from .executor import PagedExecutor
 from .metrics import EngineMetrics
@@ -22,4 +26,5 @@ __all__ = [
     "RequestHandle", "RequestState", "TERMINAL", "Scheduler",
     "PrefixCache", "check_pool_invariants",
     "NGramProposer", "SpecDecode", "spec_mode",
+    "ServingCluster", "Router", "Replica",
 ]

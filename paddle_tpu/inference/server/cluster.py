@@ -1,0 +1,605 @@
+"""Multi-replica serving fleet over the shared logical clock.
+
+One :class:`ServingEngine` is a single box; the fleet wraps N of them
+(each with its own page pool and executor) behind a :class:`Router`
+that places every request by **prefix affinity** — probe each
+replica's radix tree with the read-only
+:meth:`PrefixCache.match_len` — falling back to page-pool headroom
+and queue depth, so shared-prefix traffic lands where its KV pages
+already live (SGLang-style radix-affinity scheduling).  Elastic
+scale: :meth:`ServingCluster.drain` closes one replica's admission
+and re-steers its queued requests while in-flight work finishes in
+place; :meth:`ServingCluster.join` builds a fresh replica whose AOT
+warmup resolves from the fleet's shared persistent compile cache, so
+a new box serves in seconds.  Opt-in disaggregation
+(``disaggregated=True``) splits roles DistServe-style: prefill
+replicas compute prompt KV, then ship each finished sequence's pages
+to a decode replica as ONE bulk copy through the pool's
+``gather_dense``/``write_at`` seams — pages land refcounted, and the
+COW/prefix invariants hold on both pools.
+
+Determinism: replicas step in lockstep — one cluster ``step()`` steps
+every live replica once — and greedy streams depend only on weights +
+prompt (page identity never enters the numerics), so per-request token
+streams are bit-identical to a single engine whatever the routing,
+and across drain/join re-steers and KV handoffs, in all four serving
+variants (plain / prefix / spec / async).
+
+Gate: ``PT_CLUSTER`` (off|on; anything else raises).  Off, the
+cluster degenerates to ONE replica with a pass-through router — the
+bit-exact single-engine path.
+
+Fault points: ``route.pick`` brackets one placement decision,
+``replica.drain`` / ``replica.join`` bracket the elastic transitions,
+``kv.handoff`` brackets one page shipment.  All four DEGRADE on an
+injected raise — fallback placement, aborted transition, or the
+request keeps decoding where it is — never request loss (the
+aot.cache discipline: a dead replica is a miss, not a crash).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ... import obs
+from ...testing import faults
+from .engine import ServingEngine
+from .request import RequestHandle
+
+
+def _cluster_enabled() -> bool:
+    mode = os.environ.get("PT_CLUSTER", "off").lower()
+    if mode not in ("off", "on"):
+        raise ValueError(f"PT_CLUSTER={mode!r}: expected off|on")
+    return mode == "on"
+
+
+#: replica lifecycle states (statusz/gauge encoding in this order).
+REPLICA_STATES = ("active", "draining", "drained")
+
+
+class Replica:
+    """One engine plus its fleet-side control state."""
+
+    __slots__ = ("name", "engine", "role", "state")
+
+    def __init__(self, name, engine, role="mixed"):
+        self.name = name
+        self.engine = engine
+        self.role = role            # mixed | prefill | decode
+        self.state = "active"
+
+    @property
+    def depth(self) -> int:
+        """Queue depth the router balances on: everything holding or
+        waiting for a slot."""
+        s = self.engine.scheduler
+        return len(s.queue) + len(s.prefilling) + len(s.running)
+
+    @property
+    def admitting(self) -> bool:
+        return self.state == "active" and self.role in ("mixed",
+                                                        "prefill")
+
+    def __repr__(self):
+        return (f"Replica({self.name}, role={self.role}, "
+                f"state={self.state}, depth={self.depth})")
+
+
+class Router:
+    """Placement policy over the admitting replicas.
+
+    ``affinity`` (default): maximize the prefix-affinity probe
+    (tokens of the prompt already resident in the replica's radix
+    tree), tie-broken by lowest queue depth, then most free pages,
+    then lowest replica index — fully deterministic.  ``random``:
+    seeded uniform pick, the bench A/B control arm.
+    """
+
+    POLICIES = ("affinity", "random")
+
+    def __init__(self, policy="affinity", seed=0):
+        if policy not in self.POLICIES:
+            raise ValueError(
+                f"router policy must be one of {self.POLICIES}, "
+                f"got {policy!r}")
+        self.policy = policy
+        self._rng = np.random.RandomState(seed)
+        self.decisions = 0
+        self.affinity_hits = 0     # picks that landed on cached pages
+        self.degraded = 0          # injected-fault fallback placements
+
+    def pick(self, candidates, prompt_ids):
+        """(replica, affinity_tokens) for one request."""
+        if self.policy == "random":
+            return candidates[int(self._rng.randint(
+                len(candidates)))], 0
+        best, best_key = None, None
+        for i, rep in enumerate(candidates):
+            prefix = rep.engine.prefix
+            aff = (prefix.match_len(prompt_ids)
+                   if prefix is not None else 0)
+            key = (aff, -rep.depth, rep.engine.executor.free_pages, -i)
+            if best is None or key > best_key:
+                best, best_key = rep, key
+        if best_key[0] > 0:
+            self.affinity_hits += 1
+        return best, best_key[0]
+
+
+class ServingCluster:
+    """N engine replicas behind a :class:`Router`, stepped in lockstep
+    on one logical clock.  Exposes the single-engine driving surface
+    (``submit`` / ``step`` / ``run`` / ``tick`` / ``in_flight`` /
+    ``stats``), so :func:`paddle_tpu.testing.load.run_load` drives a
+    fleet exactly like one engine.
+
+    ``cluster``: None = follow ``PT_CLUSTER`` (default off — the
+    cluster collapses to one replica, bit-exact single-engine);
+    True/False force it (tests / bench A/B).  Engine keyword arguments
+    (``max_seqs``, ``page_size``, ``prefix_cache``, ``aot``, ...)
+    apply to every replica.
+    """
+
+    def __init__(self, model, n_replicas=2, cluster=None,
+                 router_policy="affinity", router_seed=0,
+                 disaggregated=False, n_prefill=None, clock=None,
+                 compile_cache=None, **engine_kwargs):
+        if cluster is None:
+            cluster = _cluster_enabled()
+        self.enabled = bool(cluster)
+        if not self.enabled:
+            n_replicas, disaggregated = 1, False
+        n_replicas = int(n_replicas)
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        if disaggregated and n_replicas < 2:
+            raise ValueError(
+                "disaggregated mode needs >= 2 replicas "
+                "(at least one prefill and one decode role)")
+        self.model = model
+        self.disaggregated = bool(disaggregated)
+        self._engine_kwargs = dict(engine_kwargs)
+        self._clock = clock
+        # one persistent compile cache shared by the whole fleet when
+        # AOT is in play: join() re-warms a fresh replica from disk
+        from paddle_tpu.core import aot as aot_mod
+
+        aot = engine_kwargs.get("aot")
+        if aot is None:
+            aot = aot_mod.mode()
+        self._compile_cache = None
+        if aot != "off":
+            if isinstance(compile_cache, aot_mod.CompileCache):
+                self._compile_cache = compile_cache
+            else:
+                self._compile_cache = aot_mod.CompileCache(
+                    path=compile_cache)
+        self.router = Router(policy=router_policy, seed=router_seed)
+        self.replicas: list = []
+        self._n_built = 0
+        self._tick = 0
+        self._next_rid = 0
+        self._owner: dict = {}      # rid -> Replica (current home)
+        self.handoffs = 0
+        self.handoff_tokens = 0
+        self.handoffs_skipped = 0
+        self.drains = 0
+        self.drains_aborted = 0
+        self.joins = 0
+        self.joins_aborted = 0
+        self.resteered = 0
+        self._obs = obs.handle()
+        n_pre = 0
+        if self.disaggregated:
+            n_pre = (max(1, n_replicas // 2) if n_prefill is None
+                     else int(n_prefill))
+            if not 1 <= n_pre < n_replicas:
+                raise ValueError(
+                    f"n_prefill must be in [1, {n_replicas - 1}], "
+                    f"got {n_pre}")
+        for i in range(n_replicas):
+            role = "mixed"
+            if self.disaggregated:
+                role = "prefill" if i < n_pre else "decode"
+            self._build_replica(role)
+        if self._obs is not None:
+            self._obs.statusz["cluster"] = self._statusz
+
+    def _build_replica(self, role="mixed") -> Replica:
+        name = f"r{self._n_built}"
+        self._n_built += 1
+        eng = ServingEngine(self.model, clock=self._clock,
+                            compile_cache=self._compile_cache,
+                            **self._engine_kwargs)
+        rep = Replica(name, eng, role=role)
+        self.replicas.append(rep)
+        return rep
+
+    def replica(self, name) -> Replica:
+        for rep in self.replicas:
+            if rep.name == name:
+                return rep
+        raise KeyError(f"no replica named {name!r} "
+                       f"(have {[r.name for r in self.replicas]})")
+
+    # -- routing + submission -------------------------------------------
+
+    def _admitting(self):
+        return [r for r in self.replicas if r.admitting]
+
+    def _route(self, rid, prompt_ids, resteer=False):
+        cands = self._admitting()
+        if not cands:
+            raise RuntimeError(
+                "ServingCluster: no admitting replica "
+                "(all draining/drained)")
+        self.router.decisions += 1
+        degraded = False
+        try:
+            faults.fire("route.pick", "before")
+            rep, aff = self.router.pick(cands, prompt_ids)
+        except faults.InjectedFault:
+            # degraded placement: deterministic fallback to the first
+            # admitting replica — the request is never dropped
+            self.router.degraded += 1
+            rep, aff, degraded = cands[0], 0, True
+        if not degraded:
+            try:
+                faults.fire("route.pick", "after")
+            except faults.InjectedFault:
+                self.router.degraded += 1
+                degraded = True     # decision stands; nothing was lost
+        if self._obs is not None:
+            self._obs.events.log(
+                "route.decide", rid=rid, replica=rep.name,
+                policy=self.router.policy, aff_tokens=int(aff),
+                depth=rep.depth,
+                free_pages=rep.engine.executor.free_pages,
+                degraded=int(degraded), resteer=int(resteer),
+                tick=self._tick)
+        return rep, aff
+
+    def submit(self, prompt_ids, max_new_tokens=16, priority=0,
+               deadline=None, on_token=None, rid=None) -> RequestHandle:
+        """Route one request to a replica; the returned handle drives
+        the whole CLUSTER (handle.result()/stream() step every
+        replica), so it stays live across re-steers and handoffs."""
+        if rid is None:
+            rid = f"req-{self._next_rid}"
+        if rid in self._owner:
+            raise ValueError(f"duplicate request id {rid!r}")
+        self._next_rid += 1
+        rep, _ = self._route(rid, np.asarray(
+            prompt_ids, np.int32).reshape(-1))
+        handle = rep.engine.submit(
+            prompt_ids, max_new_tokens=max_new_tokens,
+            priority=priority, deadline=deadline, on_token=on_token,
+            rid=rid)
+        self._owner[rid] = rep
+        return RequestHandle(self, handle._req)
+
+    def cancel(self, rid) -> None:
+        rep = self._owner.get(rid)
+        if rep is not None:
+            rep.engine.cancel(rid)
+
+    def request(self, rid):
+        rep = self._owner.get(rid)
+        return None if rep is None else rep.engine.request(rid)
+
+    # -- driving ---------------------------------------------------------
+
+    def step(self) -> dict:
+        """One cluster iteration: every live replica steps once (the
+        shared logical clock), then disaggregated migrations run and
+        finished drains are retired.  Returns the merged
+        {rid: [tokens]} map."""
+        self._tick += 1
+        emitted: dict = {}
+        for rep in list(self.replicas):
+            if rep.state == "drained":
+                continue
+            for rid, toks in rep.engine.step().items():
+                emitted.setdefault(rid, []).extend(toks)
+        if self.disaggregated:
+            self._migrate()
+        for rep in self.replicas:
+            if rep.state == "draining" and rep.engine.in_flight == 0:
+                rep.state = "drained"
+                if self._obs is not None:
+                    self._obs.events.log("replica.drained",
+                                         replica=rep.name,
+                                         tick=self._tick)
+        self._publish_gauges()
+        return emitted
+
+    def run(self, max_steps=100000) -> dict:
+        while self.in_flight:
+            if self._tick >= max_steps:
+                raise RuntimeError(
+                    f"cluster did not drain in {max_steps} steps")
+            self.step()
+        return self.stats()
+
+    @property
+    def tick(self) -> int:
+        return self._tick
+
+    @property
+    def in_flight(self) -> int:
+        return sum(rep.engine.in_flight for rep in self.replicas)
+
+    # -- elastic scale ---------------------------------------------------
+
+    def drain(self, name) -> Replica:
+        """Close one replica's admission and re-steer its queued
+        requests; prefilling/running work finishes in place and the
+        replica retires (state ``drained``) once idle.  Refuses to
+        drain the last admitting replica — the fleet must keep
+        accepting traffic."""
+        rep = self.replica(name) if not isinstance(name, Replica) \
+            else name
+        if rep.state != "active":
+            return rep
+        targets = [r for r in self.replicas
+                   if r is not rep and r.admitting]
+        if rep.admitting and not targets:
+            raise RuntimeError(
+                f"cannot drain {rep.name}: it is the last admitting "
+                f"replica")
+        try:
+            faults.fire("replica.drain", "before")
+        except faults.InjectedFault:
+            # drain aborted before anything moved: replica stays active
+            self.drains_aborted += 1
+            if self._obs is not None:
+                self._obs.events.log("replica.drain", replica=rep.name,
+                                     aborted=1, tick=self._tick)
+            return rep
+        rep.state = "draining"
+        sch = rep.engine.scheduler
+        moved = list(sch.queue)
+        for req in moved:
+            sch.queue.remove(req)
+            sch.requests.pop(req.rid, None)
+            self._owner.pop(req.rid, None)
+        for req in moved:
+            dst, aff = self.router.pick(targets, req.resume_ids)
+            dst.engine.scheduler.add(req)
+            self._owner[req.rid] = dst
+            self.resteered += 1
+            if self._obs is not None:
+                self._obs.events.log(
+                    "route.decide", rid=req.rid, replica=dst.name,
+                    policy=self.router.policy, aff_tokens=int(aff),
+                    depth=dst.depth,
+                    free_pages=dst.engine.executor.free_pages,
+                    degraded=0, resteer=1, tick=self._tick)
+        try:
+            faults.fire("replica.drain", "after")
+        except faults.InjectedFault:
+            pass                    # the drain is already committed
+        self.drains += 1
+        if self._obs is not None:
+            self._obs.events.log(
+                "replica.drain", replica=rep.name, aborted=0,
+                resteered=len(moved), in_flight=rep.engine.in_flight,
+                tick=self._tick)
+        return rep
+
+    def join(self, role=None):
+        """Add a fresh replica to the fleet.  Under AOT the new
+        engine's warmup resolves from the shared persistent compile
+        cache (disk hits, zero compiles) — elastic join in seconds.
+        Returns the new :class:`Replica`, or None when an injected
+        ``replica.join`` fault aborts the build (fleet unchanged)."""
+        if role is None:
+            role = "decode" if self.disaggregated else "mixed"
+        try:
+            faults.fire("replica.join", "before")
+        except faults.InjectedFault:
+            self.joins_aborted += 1
+            if self._obs is not None:
+                self._obs.events.log("replica.join", aborted=1,
+                                     tick=self._tick)
+            return None
+        rep = self._build_replica(role=role)
+        try:
+            faults.fire("replica.join", "after")
+        except faults.InjectedFault:
+            pass            # engine built and warmed: join committed
+        self.joins += 1
+        if self._obs is not None:
+            report = rep.engine._aot_report or {}
+            self._obs.events.log(
+                "replica.join", replica=rep.name, role=role, aborted=0,
+                aot_compiled=int(report.get("compile", 0)),
+                aot_disk=int(report.get("disk", 0)), tick=self._tick)
+        return rep
+
+    # -- disaggregated prefill -> decode handoff ------------------------
+
+    def _migrate(self):
+        decode_reps = [r for r in self.replicas
+                       if r.role == "decode" and r.state == "active"]
+        if not decode_reps:
+            return
+        for rep in self.replicas:
+            if rep.role != "prefill" or rep.state == "drained":
+                continue
+            for req in list(rep.engine.scheduler.running):
+                self._handoff(rep, req, decode_reps)
+
+    def _handoff(self, src, req, decode_reps) -> bool:
+        """Ship one RUNNING sequence's KV pages from a prefill replica
+        to a decode replica as one bulk copy, then move the request.
+        Skips (request keeps decoding on the source — degradation,
+        never loss) when no decode replica has room or an injected
+        ``kv.handoff`` before-fault fires."""
+        src_ex = src.engine.executor
+        length = int(src_ex.cache.lengths[req.sid])
+        dst = None
+        for cand in sorted(
+                decode_reps,
+                key=lambda r: (r.depth, -r.engine.executor.free_pages)):
+            ex = cand.engine.executor
+            if ex.free_slots >= 1 \
+                    and ex.free_pages >= ex.pages_for(length + 1):
+                dst = cand
+                break
+        if dst is None:
+            self.handoffs_skipped += 1
+            return False
+        try:
+            faults.fire("kv.handoff", "before")
+        except faults.InjectedFault:
+            self.handoffs_skipped += 1
+            if self._obs is not None:
+                self._obs.events.log("kv.handoff", rid=req.rid,
+                                     src=src.name, dst=dst.name,
+                                     skipped=1, tick=self._tick)
+            return False
+        dst_ex = dst.engine.executor
+        k, v = src_ex.cache.gather_dense(req.sid, length)
+        dst_sid = dst_ex.alloc_slot()
+        dst_ex.cache.write_at(dst_sid, k[:, :, :length],
+                              v[:, :, :length], 0)
+        dst_ex.last_token[dst_sid] = src_ex.last_token[req.sid]
+        try:
+            faults.fire("kv.handoff", "after")
+        except faults.InjectedFault:
+            pass    # pages landed refcounted: the handoff commits
+        src_sch = src.engine.scheduler
+        if src_sch.spec is not None:
+            src_sch.spec.on_release(req)
+        src_sch.running.remove(req)
+        src_sch.requests.pop(req.rid, None)
+        src_ex.free_slot(req.sid)
+        src_sch._pending = None   # any parked plan names the old sid
+        dst_sch = dst.engine.scheduler
+        req.sid = dst_sid
+        dst_sch.requests[req.rid] = req
+        dst_sch.running.append(req)
+        dst_sch._pending = None   # predicted running set just changed
+        if dst_sch.spec is not None:
+            dst_sch.spec.on_running(req)
+        self._owner[req.rid] = dst
+        self.handoffs += 1
+        self.handoff_tokens += length
+        pages = int((dst_ex.cache.page_table[dst_sid] >= 0).sum())
+        if self._obs is not None:
+            self._obs.events.log(
+                "kv.handoff", rid=req.rid, src=src.name, dst=dst.name,
+                skipped=0, tokens=length, pages=pages, tick=self._tick)
+            self._obs.tracer.instant(
+                "kv.handoff", cat="serve", trace_id=req.rid,
+                src=src.name, dst=dst.name, tokens=length)
+        return True
+
+    # -- observability ---------------------------------------------------
+
+    def _publish_gauges(self):
+        h = self._obs
+        if h is None:
+            return
+        reg = h.registry
+        g_pages = reg.gauge("cluster_replica_free_pages",
+                            "Free KV pages on one fleet replica",
+                            labels=("replica",))
+        g_depth = reg.gauge(
+            "cluster_replica_in_flight",
+            "Queued+prefilling+running requests on one fleet replica",
+            labels=("replica",))
+        g_state = reg.gauge(
+            "cluster_replica_state",
+            "Replica lifecycle (0=active, 1=draining, 2=drained)",
+            labels=("replica",))
+        for rep in self.replicas:
+            g_pages.labels(replica=rep.name).set(
+                rep.engine.executor.free_pages)
+            g_depth.labels(replica=rep.name).set(rep.depth)
+            g_state.labels(replica=rep.name).set(
+                REPLICA_STATES.index(rep.state))
+        reg.gauge("cluster_replicas_active",
+                  "Fleet replicas currently accepting work").set(
+            sum(1 for r in self.replicas if r.state == "active"))
+
+    def _statusz(self) -> dict:
+        return {
+            "tick": self._tick,
+            "enabled": self.enabled,
+            "disaggregated": self.disaggregated,
+            "router": {
+                "policy": self.router.policy,
+                "decisions": self.router.decisions,
+                "affinity_hits": self.router.affinity_hits,
+                "degraded": self.router.degraded,
+                "resteered": self.resteered,
+            },
+            "handoffs": {
+                "done": self.handoffs,
+                "tokens": self.handoff_tokens,
+                "skipped": self.handoffs_skipped,
+            },
+            "drains": {"done": self.drains,
+                       "aborted": self.drains_aborted},
+            "joins": {"done": self.joins,
+                      "aborted": self.joins_aborted},
+            "replicas": [
+                {
+                    "name": rep.name,
+                    "role": rep.role,
+                    "state": rep.state,
+                    "tick": rep.engine.tick,
+                    "in_flight": rep.engine.in_flight,
+                    "queued": len(rep.engine.scheduler.queue),
+                    "running": len(rep.engine.scheduler.running),
+                    "pool": {
+                        "num_pages":
+                            rep.engine.executor.cache.num_pages,
+                        "free_pages": rep.engine.executor.free_pages,
+                    },
+                    "prefix": (None if rep.engine.prefix is None
+                               else rep.engine.prefix.stats()),
+                }
+                for rep in self.replicas
+            ],
+        }
+
+    def stats(self) -> dict:
+        """Aggregate fleet stats plus each replica's full engine
+        stats.  ``agg_tok_per_step`` is the fleet-level throughput on
+        the LOGICAL clock — decode tokens per cluster tick — the
+        scaling metric the bench gates (wall time cannot scale when N
+        simulated replicas share one CPU)."""
+        per = {rep.name: rep.engine.stats() for rep in self.replicas}
+        reqs: dict = {}
+        for p in per.values():
+            for k, n in p["requests"].items():
+                reqs[k] = reqs.get(k, 0) + n
+        decode = sum(p["decode_tokens"] for p in per.values())
+        prefill = sum(p["prefill_tokens"] for p in per.values())
+        cached = sum(p["cached_tokens"] for p in per.values())
+        return {
+            "steps": self._tick,
+            "replicas": len(self.replicas),
+            "requests": reqs,
+            "decode_tokens": decode,
+            "prefill_tokens": prefill,
+            "cached_tokens": cached,
+            "agg_tok_per_step": round(decode / max(self._tick, 1), 4),
+            "prefix_hit_rate": round(
+                cached / max(cached + prefill, 1), 4),
+            "router": {
+                "policy": self.router.policy,
+                "decisions": self.router.decisions,
+                "affinity_hits": self.router.affinity_hits,
+                "degraded": self.router.degraded,
+                "resteered": self.resteered,
+            },
+            "handoffs": self.handoffs,
+            "handoffs_skipped": self.handoffs_skipped,
+            "per_replica": per,
+        }

@@ -154,6 +154,49 @@ class PrefixCache:
             self.hit_tokens += pos
         return pos, pages
 
+    def match_len(self, token_ids) -> int:
+        """Read-only affinity probe: how many leading tokens of
+        ``token_ids`` this tree already holds.  Same walk as
+        :meth:`match` but touches NOTHING — no LRU clock bump, no
+        ``last_access``, no hit counters — so the cluster router can
+        probe every replica per request without perturbing eviction
+        order or hit-rate stats on the replicas that lose the vote."""
+        ids = np.asarray(token_ids, np.int32).reshape(-1)
+        limit = len(ids) - 1
+        node = self.root
+        pos = 0
+        while pos < limit:
+            child = None
+            if pos + self.ps <= len(ids):
+                child = node.children.get(
+                    tuple(int(t) for t in ids[pos:pos + self.ps]))
+            if child is not None:
+                done = False
+                for j in range(len(child.pages)):
+                    span = child.tokens[j * self.ps:(j + 1) * self.ps]
+                    rest = ids[pos:]
+                    if len(rest) - 1 >= self.ps \
+                            and np.array_equal(span, rest[:self.ps]):
+                        pos += self.ps
+                        continue
+                    t = min(_common_prefix(span, rest), limit - pos)
+                    pos += t
+                    done = True
+                    break
+                if done:
+                    break
+                node = child
+                continue
+            best_t = 0
+            for c in node.children.values():
+                t = min(_common_prefix(c.tokens[:self.ps], ids[pos:]),
+                        limit - pos)
+                if t > best_t:
+                    best_t = t
+            pos += best_t
+            break
+        return pos
+
     # -- insertion -------------------------------------------------------
 
     def insert(self, token_ids, page_row) -> int:

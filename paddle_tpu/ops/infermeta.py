@@ -67,6 +67,15 @@ def _matmul(datas, attrs):
               f"received X'shape: {list(xs)}, Y'shape: {list(ys)} "
               f"(contracted dims {kx} vs {ky}, transpose_x={tx}, "
               f"transpose_y={ty})")
+    # batch 13: MatmulInferMeta also broadcasts the batch dims (every
+    # dim left of the matrix dims) — a mismatch otherwise surfaces as
+    # a jnp dot_general error deep inside dispatch
+    try:
+        np.broadcast_shapes(xs[:-2], ys[:-2])
+    except ValueError:
+        _fail("matmul",
+              f"The batch dimensions of Input(X) {list(xs)} and "
+              f"Input(Y) {list(ys)} are not broadcast-compatible")
 
 
 @register_validator("concat")
@@ -1419,3 +1428,132 @@ def _cond(datas, attrs):
         _fail("cond",
               f"The input matrix must be square when p is {p!r}, but "
               f"received shape {list(xs)}")
+
+
+# -- batch 13: linalg systems + products (solve / lstsq / tensordot / ---------
+# -- multi_dot) + matmul batch broadcasting (extends _matmul above) -----------
+
+@register_validator("solve")
+def _solve(datas, attrs):
+    # binary.cc SolveInferMeta — auto-wired through registry.apply: x
+    # is the square coefficient [*, M, M], y the RHS ([*, M, K] or an
+    # [M] vector), batch dims broadcast
+    x, y = datas[0], datas[1]
+    xs = _square_matrix("solve", x)
+    ys = _shape(y)
+    if not ys:
+        _fail("solve",
+              f"The rank of Input(Y) should be no less than 1, but "
+              f"received a 0-D tensor")
+    rows = ys[-2] if len(ys) >= 2 else ys[0]
+    if rows != xs[-1]:
+        _fail("solve",
+              f"The rows of the RHS Y should match the order of the "
+              f"coefficient matrix X, but received X {list(xs)} and "
+              f"Y {list(ys)}")
+    if len(ys) >= 2:
+        _batch_broadcast("solve", xs, ys)
+
+
+@register_validator("lstsq")
+def _lstsq(datas, attrs):
+    # binary.cc LstsqInferMeta — host-path wrapper, validated manually
+    # in linalg.lstsq: x [*, M, N] and y [*, M, K] share their rows
+    # and batch dims; the driver grammar is the reference's
+    x, y = datas[0], datas[1]
+    xs, ys = _shape(x), _shape(y)
+    for name, s in (("X", xs), ("Y", ys)):
+        if len(s) < 2:
+            _fail("lstsq",
+                  f"The rank of Input({name}) should be no less than "
+                  f"2, but received shape {list(s)}")
+    if xs[-2] != ys[-2]:
+        _fail("lstsq",
+              f"The rows (second-to-last dimension) of X and Y should "
+              f"be equal, but received X {list(xs)} and Y {list(ys)}")
+    _batch_broadcast("lstsq", xs, ys)
+    driver = attrs.get("driver")
+    if driver not in (None, "gels", "gelsy", "gelsd", "gelss"):
+        _fail("lstsq",
+              f"The driver should be one of None, 'gels', 'gelsy', "
+              f"'gelsd', 'gelss', but received {driver!r}")
+
+
+@register_validator("tensordot")
+def _tensordot(datas, attrs):
+    # tensordot (math.py TensordotInferMeta shape grammar) — auto-wired
+    # through registry.apply after the wrapper normalizes axes to an
+    # int or a hashable pair
+    x, y = datas[0], datas[1]
+    xs, ys = _shape(x), _shape(y)
+    axes = attrs.get("axes", 2)
+    if isinstance(axes, int):
+        if axes < 0:
+            _fail("tensordot",
+                  f"The number of contracted axes must be "
+                  f"non-negative, but received {axes}")
+        if axes > min(len(xs), len(ys)):
+            _fail("tensordot",
+                  f"The number of contracted axes ({axes}) must not "
+                  f"exceed the rank of either operand, but received "
+                  f"x {list(xs)} and y {list(ys)}")
+        if axes and xs[len(xs) - axes:] != ys[:axes]:
+            _fail("tensordot",
+                  f"The contracted dimensions should be equal: the "
+                  f"last {axes} dims of x {list(xs)} vs the first "
+                  f"{axes} dims of y {list(ys)}")
+        return
+    if not (isinstance(axes, tuple) and len(axes) == 2):
+        return  # unrecognized spelling: jnp's own checks apply
+    ax, ay = axes
+    ax = (ax,) if isinstance(ax, int) else tuple(ax)
+    ay = (ay,) if isinstance(ay, int) else tuple(ay)
+    if len(ax) != len(ay):
+        _fail("tensordot",
+              f"The axes lists for x and y should have the same "
+              f"length, but received {list(ax)} and {list(ay)}")
+    for a, b in zip(ax, ay):
+        if not -len(xs) <= a < len(xs):
+            _fail("tensordot",
+                  f"The axis {a} is out of range for x of rank "
+                  f"{len(xs)}")
+        if not -len(ys) <= b < len(ys):
+            _fail("tensordot",
+                  f"The axis {b} is out of range for y of rank "
+                  f"{len(ys)}")
+        if xs[a] != ys[b]:
+            _fail("tensordot",
+                  f"The contracted dimensions should be equal, but "
+                  f"x axis {a} has size {xs[a]} and y axis {b} has "
+                  f"size {ys[b]}")
+
+
+@register_validator("multi_dot")
+def _multi_dot(datas, attrs):
+    # multiary.cc MultiDotInferMeta — host-path wrapper, validated
+    # manually in linalg.multi_dot: >= 2 operands, the ends may be
+    # vectors, every middle operand must be a matrix, and the chain's
+    # adjacent inner dimensions must agree
+    shapes = [_shape(d) for d in datas]
+    if len(shapes) < 2:
+        _fail("multi_dot",
+              f"The number of input tensors should be no less than 2, "
+              f"but received {len(shapes)}")
+    for name, s in (("first", shapes[0]), ("last", shapes[-1])):
+        if len(s) not in (1, 2):
+            _fail("multi_dot",
+                  f"The {name} input tensor can be 1-D or 2-D, but "
+                  f"received shape {list(s)}")
+    for i, s in enumerate(shapes[1:-1], 1):
+        if len(s) != 2:
+            _fail("multi_dot",
+                  f"The middle input tensors must be 2-D, but "
+                  f"input[{i}] has shape {list(s)}")
+    k = shapes[0][-1]
+    for i, s in enumerate(shapes[1:], 1):
+        if s[0] != k:
+            _fail("multi_dot",
+                  f"The inner dimensions of adjacent operands should "
+                  f"be equal, but input[{i - 1}] ends with {k} and "
+                  f"input[{i}] {list(s)} starts with {s[0]}")
+        k = s[-1]

@@ -486,8 +486,10 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None,
 def lstsq(x, y, rcond=None, driver=None, name=None):
     """Least squares (reference linalg.lstsq): returns (solution,
     residuals, rank, singular_values)."""
+    from . import infermeta
     from ..core.tensor import Tensor
 
+    infermeta.validate("lstsq", (_raw(x), _raw(y)), {"driver": driver})
     sol, res, rank, sv = jnp.linalg.lstsq(_raw(x), _raw(y), rcond=rcond)
     return (Tensor(sol), Tensor(res), Tensor(jnp.asarray(rank)),
             Tensor(sv))
@@ -504,9 +506,11 @@ def matrix_exp(x, name=None):
 def multi_dot(tensors, name=None):
     """Chain matmul with optimal-order association (jnp's dynamic
     program picks the association)."""
+    from . import infermeta
     from ..core.tensor import Tensor
 
     datas = [_raw(t) for t in tensors]
+    infermeta.validate("multi_dot", tuple(datas), {})
     return Tensor(jnp.linalg.multi_dot(datas))
 
 

@@ -126,6 +126,24 @@ REGISTERED = {
                      "int8 pages (gather_dense; before=nothing read, "
                      "after=dense f32/bf16 copy built — the pool is "
                      "never mutated by a read)",
+    "route.pick": "one cluster router placement decision (before=no "
+                  "replica chosen, nothing submitted; after=decision "
+                  "made, request not yet handed to the engine — a "
+                  "raise at either phase re-steers, never loses the "
+                  "request)",
+    "replica.drain": "one replica drain (before=replica still "
+                     "admitting, nothing re-steered; after=admission "
+                     "closed and queued requests re-steered, in-flight "
+                     "work still finishing in place)",
+    "replica.join": "one elastic replica join (before=no engine "
+                    "built; after=engine AOT-rewarmed from the shared "
+                    "compile cache and routable — a raise leaves the "
+                    "fleet exactly as it was)",
+    "kv.handoff": "one disaggregated prefill→decode KV-page handoff "
+                  "(before=pages still on the prefill replica, "
+                  "nothing copied — the request keeps decoding where "
+                  "it is; after=pages landed refcounted on the decode "
+                  "replica, source slot not yet freed)",
 }
 
 _PHASES = ("before", "after")

@@ -29,7 +29,7 @@ class LoadSpec:
                  prompt_len=(4, 24), max_new=(4, 12),
                  priorities=(0,), vocab=256, seed=0,
                  prefix_share=0.0, prefix_len=16, prefix_pool=2,
-                 repeat_share=0.0, repeat_period=4):
+                 repeat_share=0.0, repeat_period=4, zipf_s=None):
         self.n_requests = int(n_requests)
         self.mean_interarrival = float(mean_interarrival)
         self.prompt_len = tuple(prompt_len)
@@ -50,6 +50,12 @@ class LoadSpec:
         # /templated workloads where prompt-lookup drafting pays off
         self.repeat_share = float(repeat_share)
         self.repeat_period = int(repeat_period)
+        # skewed prefix popularity (exercises affinity routing): when
+        # set, the prefix index is drawn Zipf(s) over the pool instead
+        # of uniform — a few "hot" system prompts dominate, the shape
+        # affinity routing wins on.  None (the default) keeps the
+        # uniform randint draw, so legacy seeds replay byte-identically.
+        self.zipf_s = None if zipf_s is None else float(zipf_s)
 
 
 def generate_load(spec: LoadSpec) -> list:
@@ -78,8 +84,18 @@ def generate_load(spec: LoadSpec) -> list:
             prompt = np.tile(prompt[:period],
                              -(-plen // period))[:plen].astype(np.int32)
         if prefixes is not None and rng.rand() < spec.prefix_share:
-            prompt = np.concatenate(
-                [prefixes[rng.randint(len(prefixes))], prompt])
+            if spec.zipf_s is not None:
+                # Zipf-weighted index (one rand draw + searchsorted);
+                # only reached when zipf_s is set, so the uniform
+                # branch's draw sequence is untouched
+                w = 1.0 / np.arange(1, len(prefixes) + 1,
+                                    dtype=np.float64) ** spec.zipf_s
+                idx = min(int(np.searchsorted(np.cumsum(w / w.sum()),
+                                              rng.rand())),
+                          len(prefixes) - 1)
+            else:
+                idx = int(rng.randint(len(prefixes)))
+            prompt = np.concatenate([prefixes[idx], prompt])
         work.append({
             "rid": f"load-{i}",
             "arrival_tick": tick,

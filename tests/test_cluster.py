@@ -1,0 +1,314 @@
+"""Multi-replica serving fleet: routing, elastic scale, handoff.
+
+Everything asserts on the logical clock against seeded workloads.  The
+fleet-wide invariant under test: per-request token streams are
+BIT-IDENTICAL to the same requests on a single engine — whatever the
+routing, across mid-load drain/join re-steers and disaggregated
+prefill→decode KV handoffs, in all four serving variants — and the
+page pools on every replica stay refcount/COW-consistent.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.server import (
+    RequestState, Router, ServingCluster, ServingEngine,
+)
+from paddle_tpu.inference.server.prefix_cache import (
+    check_pool_invariants,
+)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.testing import faults
+from paddle_tpu.testing.load import LoadSpec, generate_load, run_load
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(11)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+KW = dict(max_seqs=2, page_size=4, max_len=64, prefill_chunk=8)
+SPEC = dict(n_requests=8, mean_interarrival=2.0, prompt_len=(4, 20),
+            max_new=(3, 8), vocab=256, seed=7, prefix_share=0.5,
+            prefix_len=8, prefix_pool=3, zipf_s=1.2)
+
+#: the four serving variants whose streams must survive clustering.
+VARIANTS = {
+    "plain": {},
+    "prefix": {"prefix_cache": True},
+    "spec": {"spec_decode": "ngram"},
+    "async": {"async_exec": True},
+}
+
+
+def _workload(**over):
+    return generate_load(LoadSpec(**dict(SPEC, **over)))
+
+
+def _audit(cl):
+    for rep in cl.replicas:
+        check_pool_invariants(rep.engine.executor.cache,
+                              rep.engine.prefix)
+
+
+@pytest.fixture(scope="module")
+def plain_baseline(model):
+    work = _workload()
+    return work, run_load(ServingEngine(model, **KW), work)
+
+
+# -- streams across the fleet == single engine, all four variants -------
+# (fast lane keeps the plain variant; the other three are compile-heavy
+# engine rebuilds and ride the slow lane / make smoke)
+
+@pytest.mark.parametrize(
+    "variant",
+    [pytest.param(v, marks=() if v == "plain" else pytest.mark.slow)
+     for v in sorted(VARIANTS)])
+def test_cluster_streams_match_single_engine(model, variant):
+    kw = VARIANTS[variant]
+    work = _workload(repeat_share=0.5 if variant == "spec" else 0.0)
+    base = run_load(ServingEngine(model, **KW, **kw), work)
+    cl = ServingCluster(model, n_replicas=3, cluster=True, **KW, **kw)
+    res = run_load(cl, work)
+    assert res["errors"] == []
+    for w in work:
+        h = res["handles"][w["rid"]]
+        assert h.state is RequestState.FINISHED, (variant, w["rid"])
+        assert h.tokens == base["handles"][w["rid"]].tokens, \
+            (variant, w["rid"])
+    # the fleet really spread the load (router balanced, not pinned)
+    busy = [r for r in cl.replicas
+            if r.engine.metrics.submitted > 0]
+    assert len(busy) >= 2, [r.engine.metrics.submitted
+                            for r in cl.replicas]
+    if variant == "prefix":
+        # shared-prefix traffic found its pages: the affinity probe
+        # steered at least one request onto a warm radix tree
+        assert cl.router.affinity_hits >= 1
+        assert cl.stats()["cached_tokens"] > 0
+    _audit(cl)
+
+
+# -- elastic drain / join -----------------------------------------------
+
+def test_drain_resteers_queue_and_join_serves(model, plain_baseline):
+    work, base = plain_baseline
+    cl = ServingCluster(model, n_replicas=2, cluster=True, **KW)
+    # burst-submit so the drained replica has a queue to re-steer
+    handles = {w["rid"]: cl.submit(w["prompt_ids"],
+                                   max_new_tokens=w["max_new_tokens"],
+                                   rid=w["rid"])
+               for w in work}
+    for _ in range(3):
+        cl.step()
+    rep = cl.drain("r0")
+    assert rep.state in ("draining", "drained")
+    assert cl.resteered > 0              # queued work moved, not lost
+    assert cl.join() is not None
+    assert len(cl.replicas) == 3
+    cl.run()
+    assert cl.replica("r0").state == "drained"
+    assert cl.replica("r0").engine.in_flight == 0
+    for w in work:                       # zero lost requests, exact
+        h = handles[w["rid"]]
+        assert h.state is RequestState.FINISHED, w["rid"]
+        assert h.tokens == base["handles"][w["rid"]].tokens, w["rid"]
+    with pytest.raises(RuntimeError, match="last admitting"):
+        for r in cl.replicas:            # draining every admitting
+            cl.drain(r.name)             # replica must refuse the last
+    _audit(cl)
+
+
+def test_drain_unknown_replica_raises(model):
+    cl = ServingCluster(model, n_replicas=2, cluster=True, **KW)
+    with pytest.raises(KeyError, match="no replica named"):
+        cl.drain("r9")
+
+
+# -- disaggregated prefill -> decode handoff ----------------------------
+
+def test_disaggregated_handoff_parity_and_invariants(
+        model, plain_baseline):
+    work, base = plain_baseline
+    cl = ServingCluster(model, n_replicas=2, cluster=True,
+                        disaggregated=True, **KW)
+    assert [r.role for r in cl.replicas] == ["prefill", "decode"]
+    res = run_load(cl, work)
+    assert cl.handoffs > 0
+    for w in work:
+        h = res["handles"][w["rid"]]
+        assert h.state is RequestState.FINISHED, w["rid"]
+        assert h.tokens == base["handles"][w["rid"]].tokens, w["rid"]
+    # roles were respected: the decode replica admitted nothing but
+    # decoded the handed-off sequences
+    decode = cl.replica("r1").engine
+    assert decode.metrics.submitted == 0
+    assert decode.metrics.decode_tokens > 0
+    _audit(cl)
+
+
+def test_disaggregated_needs_two_replicas(model):
+    with pytest.raises(ValueError, match="disaggregated"):
+        ServingCluster(model, n_replicas=1, cluster=True,
+                       disaggregated=True, **KW)
+
+
+# -- fault matrix: degrade, never lose ----------------------------------
+
+#: fast lane keeps one abort-style and one skip-style before-phase
+#: cell; the remaining six fleet rebuilds ride the slow lane
+_FAST_FAULTS = {("route.pick", "before"), ("kv.handoff", "before")}
+
+
+@pytest.mark.parametrize(
+    "point,phase",
+    [pytest.param(pt, ph,
+                  marks=() if (pt, ph) in _FAST_FAULTS
+                  else pytest.mark.slow)
+     for pt in ("route.pick", "replica.drain", "replica.join",
+                "kv.handoff")
+     for ph in ("before", "after")])
+def test_fault_matrix_degrades_without_loss(model, plain_baseline,
+                                            point, phase):
+    work, base = plain_baseline
+    faults.arm(point, phase, 1, "raise")
+    disagg = point == "kv.handoff"
+    cl = ServingCluster(model, n_replicas=2, cluster=True,
+                        disaggregated=disagg, **KW)
+    handles = {w["rid"]: cl.submit(w["prompt_ids"],
+                                   max_new_tokens=w["max_new_tokens"],
+                                   rid=w["rid"])
+               for w in work}
+    for _ in range(3):
+        cl.step()
+    if point == "replica.drain":
+        rep = cl.drain("r0")
+        if phase == "before":        # aborted before anything moved
+            assert rep.state == "active" and cl.drains_aborted == 1
+        else:                        # the drain is already committed
+            assert rep.state in ("draining", "drained")
+            assert cl.drains == 1
+    if point == "replica.join":
+        rep = cl.join()
+        if phase == "before":        # fleet exactly as it was
+            assert rep is None and len(cl.replicas) == 2
+            assert cl.joins_aborted == 1
+        else:                        # engine built: join committed
+            assert rep is not None and len(cl.replicas) == 3
+            assert cl.joins == 1
+    cl.run()
+    for w in work:                   # the invariant: zero loss, exact
+        h = handles[w["rid"]]
+        assert h.state is RequestState.FINISHED, (point, phase,
+                                                  w["rid"])
+        assert h.tokens == base["handles"][w["rid"]].tokens, \
+            (point, phase, w["rid"])
+    if point == "route.pick":
+        assert cl.router.degraded >= 1
+    if point == "kv.handoff":
+        if phase == "before":        # first shipment skipped in place
+            assert cl.handoffs_skipped >= 1
+        assert cl.handoffs >= 1      # later shipments still commit
+    _audit(cl)
+
+
+def test_new_fault_points_are_registered():
+    for point in ("route.pick", "replica.drain", "replica.join",
+                  "kv.handoff"):
+        assert point in faults.REGISTERED
+
+
+# -- PT_CLUSTER gate ----------------------------------------------------
+
+def test_gate_off_is_single_engine_parity(model, plain_baseline,
+                                          monkeypatch):
+    work, base = plain_baseline
+    monkeypatch.delenv("PT_CLUSTER", raising=False)
+    cl = ServingCluster(model, n_replicas=4, **KW)   # follows env: off
+    assert not cl.enabled and len(cl.replicas) == 1
+    res = run_load(cl, work)
+    for w in work:
+        assert res["handles"][w["rid"]].tokens \
+            == base["handles"][w["rid"]].tokens, w["rid"]
+    monkeypatch.setenv("PT_CLUSTER", "on")
+    cl2 = ServingCluster(model, n_replicas=2, **KW)
+    assert cl2.enabled and len(cl2.replicas) == 2
+
+
+def test_gate_bogus_value_raises(model, monkeypatch):
+    monkeypatch.setenv("PT_CLUSTER", "bogus")
+    with pytest.raises(ValueError, match="PT_CLUSTER"):
+        ServingCluster(model, n_replicas=2, **KW)
+
+
+def test_router_policy_validated():
+    with pytest.raises(ValueError, match="policy"):
+        Router(policy="round-robin")
+
+
+def test_duplicate_rid_across_replicas_rejected(model):
+    cl = ServingCluster(model, n_replicas=2, cluster=True, **KW)
+    cl.submit(np.asarray([1, 2, 3], np.int32), rid="dup")
+    with pytest.raises(ValueError, match="duplicate"):
+        cl.submit(np.asarray([4, 5, 6], np.int32), rid="dup")
+    cl.run()
+
+
+# -- match_len probe ----------------------------------------------------
+
+def test_match_len_probe_is_read_only(model):
+    eng = ServingEngine(model, prefix_cache=True, **KW)
+    prompt = (np.arange(1, 25, dtype=np.int32) % 250) + 1
+    eng.submit(prompt, max_new_tokens=4).result()
+    prefix = eng.prefix
+    before = (prefix.lookups, prefix.hits, prefix.hit_tokens,
+              prefix._clock)
+    probed = prefix.match_len(prompt)
+    assert probed > 0
+    # the probe touched NOTHING: counters and LRU clock unchanged
+    assert (prefix.lookups, prefix.hits, prefix.hit_tokens,
+            prefix._clock) == before
+    # ...and it agrees with the real (mutating) walk
+    got, _ = prefix.match(prompt)
+    assert probed == got
+    miss = np.full((6,), 7, np.int32)
+    assert prefix.match_len(miss) == prefix.match(miss)[0] == 0
+
+
+# -- LoadSpec zipf skew -------------------------------------------------
+
+def test_zipf_draws_only_when_set():
+    """zipf_s=None keeps the legacy uniform draw sequence; setting it
+    is deterministic and actually skews prefix popularity."""
+    kw = dict(n_requests=64, prefix_share=1.0, prefix_len=8,
+              prefix_pool=8, seed=3, vocab=256)
+    legacy1 = generate_load(LoadSpec(**kw))
+    legacy2 = generate_load(LoadSpec(**kw, zipf_s=None))
+    for a, b in zip(legacy1, legacy2):
+        assert np.array_equal(a["prompt_ids"], b["prompt_ids"])
+    skew1 = generate_load(LoadSpec(**kw, zipf_s=4.0))
+    skew2 = generate_load(LoadSpec(**kw, zipf_s=4.0))
+    for a, b in zip(skew1, skew2):
+        assert np.array_equal(a["prompt_ids"], b["prompt_ids"])
+
+    def top_share(work):
+        heads = [tuple(w["prompt_ids"][:8]) for w in work]
+        return max(heads.count(h) for h in set(heads))
+
+    # Zipf(4) concentrates ~92% of draws on the hottest prefix;
+    # uniform spreads them ~1/8 each
+    assert top_share(skew1) > top_share(legacy1)
+    assert top_share(skew1) > len(skew1) // 2

@@ -1321,3 +1321,99 @@ def test_cond_rejects_non_square_fro():
 def test_cond_rejects_bad_p():
     with pytest.raises(InvalidArgumentError, match="p of condition"):
         paddle.linalg.cond(_f32(3, 3), p=3)
+
+
+# -- batch 13: linalg systems + products (solve / lstsq / tensordot /
+# -- multi_dot) + matmul batch broadcasting
+
+
+def test_matmul_broadcasts_batch_dims():
+    out = paddle.matmul(_f32(2, 1, 3, 4), _f32(5, 4, 2))
+    assert list(out.shape) == [2, 5, 3, 2]
+
+
+def test_matmul_rejects_bad_batch_dims():
+    with pytest.raises(InvalidArgumentError, match="broadcast"):
+        paddle.matmul(_f32(2, 3, 4), _f32(3, 4, 2))
+
+
+def test_solve_accepts_broadcast_batches():
+    a = np.tile(np.eye(3, dtype=np.float32) * 2.0, (1, 1, 1))
+    out = paddle.linalg.solve(paddle.to_tensor(a), _f32(4, 3, 2))
+    assert list(out.shape) == [4, 3, 2]
+
+
+def test_solve_rejects_non_square():
+    with pytest.raises(InvalidArgumentError, match="square"):
+        paddle.linalg.solve(_f32(3, 4), _f32(3, 2))
+
+
+def test_solve_rejects_row_mismatch():
+    with pytest.raises(InvalidArgumentError, match="rows"):
+        paddle.linalg.solve(_f32(3, 3), _f32(4, 2))
+
+
+def test_lstsq_accepts_overdetermined():
+    sol, res, rank, sv = paddle.linalg.lstsq(_f32(5, 3), _f32(5, 2))
+    assert list(sol.shape) == [3, 2]
+
+
+def test_lstsq_rejects_vector_rhs():
+    with pytest.raises(InvalidArgumentError, match="rank of Input"):
+        paddle.linalg.lstsq(_f32(5, 3), _f32(5))
+
+
+def test_lstsq_rejects_row_mismatch():
+    with pytest.raises(InvalidArgumentError, match="rows"):
+        paddle.linalg.lstsq(_f32(5, 3), _f32(4, 2))
+
+
+def test_lstsq_rejects_bad_driver():
+    with pytest.raises(InvalidArgumentError, match="driver"):
+        paddle.linalg.lstsq(_f32(5, 3), _f32(5, 2), driver="magic")
+
+
+def test_tensordot_accepts_int_axes():
+    out = paddle.tensordot(_f32(3, 4, 5), _f32(4, 5, 6), axes=2)
+    assert list(out.shape) == [3, 6]
+
+
+def test_tensordot_accepts_axis_pairs():
+    out = paddle.tensordot(_f32(3, 4), _f32(4, 5), axes=[[1], [0]])
+    assert list(out.shape) == [3, 5]
+
+
+def test_tensordot_rejects_excess_axes():
+    with pytest.raises(InvalidArgumentError, match="exceed"):
+        paddle.tensordot(_f32(3, 4), _f32(4, 5), axes=3)
+
+
+def test_tensordot_rejects_dim_mismatch():
+    with pytest.raises(InvalidArgumentError, match="contracted"):
+        paddle.tensordot(_f32(3, 4), _f32(5, 6), axes=[[1], [0]])
+
+
+def test_tensordot_rejects_out_of_range_axis():
+    with pytest.raises(InvalidArgumentError, match="out of range"):
+        paddle.tensordot(_f32(3, 4), _f32(4, 5), axes=[[2], [0]])
+
+
+def test_multi_dot_chains_matrices():
+    out = paddle.linalg.multi_dot([_f32(2, 3), _f32(3, 4), _f32(4, 5)])
+    assert list(out.shape) == [2, 5]
+
+
+def test_multi_dot_rejects_single_operand():
+    with pytest.raises(InvalidArgumentError, match="no less than 2"):
+        paddle.linalg.multi_dot([_f32(2, 3)])
+
+
+def test_multi_dot_rejects_nd_middle():
+    with pytest.raises(InvalidArgumentError, match="2-D"):
+        paddle.linalg.multi_dot([_f32(2, 3), _f32(3, 4, 5),
+                                 _f32(5, 6)])
+
+
+def test_multi_dot_rejects_chain_mismatch():
+    with pytest.raises(InvalidArgumentError, match="adjacent"):
+        paddle.linalg.multi_dot([_f32(2, 3), _f32(4, 5)])

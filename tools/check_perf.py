@@ -61,6 +61,15 @@ METRICS = (
     ("serving.quant.occupancy_ratio", "higher", 0.05),
     ("serving.quant.int8.serving_tok_s", "higher", 0.10),
     ("serving.quant.logit_drift_rel_rms", "lower", 0.50),
+    # multi-replica fleet (r20): logical-clock aggregate throughput
+    # must keep scaling with N, affinity routing must keep beating
+    # random placement on Zipf-skewed prefix traffic, and the N=4
+    # fleet's p99 TTFT (in steps) must not collapse
+    ("serving.cluster.value", "higher", 0.10),
+    ("serving.cluster.scaling_n4_vs_n1", "higher", 0.10),
+    ("serving.cluster.affinity_tok_ratio", "higher", 0.10),
+    ("serving.cluster.hit_rate_delta", "higher", 0.25),
+    ("serving.cluster.ttft_steps_p99_n4", "lower", 0.25),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

@@ -264,6 +264,41 @@ def main():
     check(qz.get("kv_scale_bytes", 0) > 0,
           "/statusz reports per-page scale bytes")
 
+    # -- 8. cluster plane: replica-labelled gauges + /statusz section ----
+    print("== cluster plane ==")
+    from paddle_tpu.inference.server import ServingCluster
+    cl = ServingCluster(model, n_replicas=2, cluster=True,
+                        disaggregated=True, max_seqs=2, page_size=4,
+                        max_len=64, slos=[])
+    h8 = [cl.submit(rng.randint(1, 256, (n,)).astype(np.int32),
+                    max_new_tokens=6) for n in (6, 10, 14)]
+    cl.run()
+    check(all(hd.state is RequestState.FINISHED for hd in h8),
+          "disaggregated fleet drained")
+    prom = h.registry.prometheus_text()
+    for fam in ("cluster_replica_free_pages", "cluster_replica_in_flight",
+                "cluster_replica_state", "cluster_replicas_active"):
+        check(fam in prom, f"family {fam}")
+    check('cluster_replica_state{replica="r0"}' in prom
+          and 'cluster_replica_state{replica="r1"}' in prom,
+          "gauges labelled per replica")
+    ev_kinds = {e["kind"] for e in h.events.events()}
+    check("route.decide" in ev_kinds, "route.decide journaled")
+    check("kv.handoff" in ev_kinds, "kv.handoff journaled")
+    sz = health.statusz_payload(h)
+    cz = sz["providers"].get("cluster", {})
+    for key in ("tick", "enabled", "disaggregated", "router",
+                "handoffs", "drains", "joins", "replicas"):
+        check(key in cz, f"/statusz cluster key {key}")
+    check(cz.get("disaggregated") is True
+          and cz.get("handoffs", {}).get("done", 0) > 0,
+          "/statusz records the prefill->decode handoffs")
+    for row in cz.get("replicas", []):
+        check({"name", "role", "state", "in_flight", "pool"}
+              <= set(row), f"replica row schema for {row.get('name')}")
+    check([r["role"] for r in cz.get("replicas", [])]
+          == ["prefill", "decode"], "/statusz replica roles")
+
     if FAILURES:
         print(f"\nobs-check: {len(FAILURES)} check(s) FAILED")
         for f in FAILURES:
