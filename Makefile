@@ -4,7 +4,8 @@
 PY ?= python
 
 .PHONY: smoke test test-fast verify-fast lint-graph obs-check \
-	health-check aot-check cluster-check perf-report perf-check bench
+	health-check aot-check cluster-check chaos-check perf-report \
+	perf-check bench
 
 # <3 min sanity gate: import + one eager op, one jitted llama forward
 # step (the driver's entry()), and a 2-virtual-device multichip train
@@ -48,11 +49,13 @@ smoke:
 		tests/test_health.py \
 		tests/test_aot.py \
 		tests/test_quant.py \
-		tests/test_cluster.py
+		tests/test_cluster.py \
+		tests/test_chaos.py
 	$(MAKE) obs-check
 	$(MAKE) health-check
 	$(MAKE) aot-check
 	$(MAKE) cluster-check
+	$(MAKE) chaos-check
 
 # Fast lane — must be green before any snapshot commit (see README).
 test-fast:
@@ -96,6 +99,14 @@ aot-check:
 # replica-labelled gauges and the /statusz cluster provider.
 cluster-check:
 	JAX_PLATFORMS=cpu $(PY) tools/cluster_check.py
+
+# Survivability end-to-end smoke: 3-replica fleet takes an injected
+# crash mid-load (failover + auto-restart), a seeded PT_CHAOS schedule
+# over every fault point, and saturating submits against a bounded
+# queue — asserts zero loss with bit-identical streams, REJECTED-with-
+# retry-after shedding, and the fail/restart/shed telemetry contract.
+chaos-check:
+	JAX_PLATFORMS=cpu $(PY) tools/chaos_check.py
 
 # Per-program roofline table: analytical cost (FLOPs / HBM bytes /
 # intensity from the jaxpr cost model) vs achieved wall time for every

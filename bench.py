@@ -290,6 +290,32 @@ def main():
                 "error": str(e)[:200]}
         print(json.dumps(result), flush=True)
 
+    # Fleet survivability leg (r21): kill 1 of 4 replicas mid-load and
+    # measure what the failure actually costs — recovery steps until
+    # the auto-restarted replica rejoins, the TTFT tax paid by the
+    # failed-over requests (both legs timed on the shared cluster
+    # clock against workload arrival ticks), and the fraction of
+    # healthy-fleet throughput retained through the incident.
+    if on_cpu and os.environ.get("PT_BENCH_CLUSTER_FAILOVER",
+                                 "1") == "1":
+        try:
+            ccfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                               intermediate_size=128,
+                               num_hidden_layers=2,
+                               num_attention_heads=4,
+                               num_key_value_heads=2,
+                               max_position_embeddings=256)
+            cmodel = LlamaForCausalLM(ccfg)
+            cmodel.eval()
+            result.setdefault("serving", {})["cluster_failover"] = \
+                _measure_cluster_failover(cmodel)
+            del cmodel
+        except Exception as e:  # never lose earlier measurements
+            print(f"cluster_failover: FAILED: {e}", file=sys.stderr)
+            result.setdefault("serving", {})["cluster_failover"] = {
+                "error": str(e)[:200]}
+        print(json.dumps(result), flush=True)
+
     if not on_cpu:
         # Free the small config's HBM state before the extended runs.
         import gc
@@ -1323,6 +1349,111 @@ def _measure_cluster(model):
         "hit_rate_delta": hit_delta,
         "ttft_steps_p99_n4": n4["ttft_steps_p99"],
     }
+
+
+def _measure_cluster_failover(model):
+    """Fleet survivability A/B (r21): the same Zipf-skewed workload
+    through an N=4 affinity fleet twice — once healthy, once with one
+    replica operator-killed at the median arrival tick.  The kill leg
+    exercises the whole survivability plane: in-flight requests fail
+    over (recompute) to healthy replicas, the supervisor schedules the
+    restart, the rebuilt replica rejoins and takes traffic again.
+
+    TTFT on BOTH legs is measured on the shared cluster clock against
+    workload arrival ticks (never per-engine submit steps: failover
+    re-adds reset those, and a restarted replica's engine clock starts
+    over), so the per-request tax is an honest apples-to-apples delta.
+    """
+    from paddle_tpu.inference.server import ServingCluster
+    from paddle_tpu.testing.load import LoadSpec, generate_load
+
+    n_req = int(os.environ.get("PT_BENCH_FAILOVER_REQS", "32"))
+    spec = LoadSpec(n_requests=n_req, mean_interarrival=1.0,
+                    prompt_len=(4, 8), max_new=(8, 16), vocab=256,
+                    seed=5, prefix_share=0.75, prefix_len=32,
+                    prefix_pool=8, zipf_s=1.3)
+    work = generate_load(spec)
+    arrival = {w["rid"]: w["arrival_tick"] for w in work}
+    kill_tick = int(np.median([w["arrival_tick"] for w in work]))
+    kw = dict(max_seqs=2, page_size=4, max_len=64, prefill_chunk=8,
+              prefix_cache=True)
+
+    def drive(kill):
+        cl = ServingCluster(model, n_replicas=4, cluster=True,
+                            router_policy="affinity", **kw)
+        pending = sorted(work, key=lambda w: (w["arrival_tick"],
+                                              w["rid"]))
+        handles, ttft = {}, {}
+        victim, failed_over, recovered_tick = None, [], None
+        while pending or cl.in_flight:
+            if cl.tick > 10000:
+                raise RuntimeError("failover load did not drain")
+            while pending and pending[0]["arrival_tick"] <= cl.tick:
+                w = pending.pop(0)
+                handles[w["rid"]] = cl.submit(
+                    w["prompt_ids"],
+                    max_new_tokens=w["max_new_tokens"],
+                    priority=w["priority"], rid=w["rid"])
+            if kill and victim is None and cl.tick >= kill_tick:
+                victim = cl.replicas[1]
+                failed_over = [
+                    rid for rid, req in
+                    victim.engine.scheduler.requests.items()
+                    if not req.terminal]
+                cl.fail(victim.name, reason="bench_kill")
+            cl.step()
+            for rid, h in handles.items():
+                if rid not in ttft and h.tokens:
+                    ttft[rid] = cl.tick - arrival[rid]
+            if victim is not None and recovered_tick is None \
+                    and victim.state == "active" and victim.restarts:
+                recovered_tick = cl.tick
+        st = cl.stats()
+        # zero-loss check on the HANDLES, not engine counters: the
+        # restart rebuilds the victim's engine, dropping its pre-kill
+        # finished counts from the aggregate
+        bad = [rid for rid, h in handles.items()
+               if h.state.value not in ("finished", "truncated")]
+        if len(handles) != n_req or bad:
+            raise RuntimeError(f"failover load lost requests: {bad}")
+        return dict(stats=st, ttft=ttft, failed_over=failed_over,
+                    recovered_tick=recovered_tick)
+
+    print(f"serving[failover]: healthy N=4 leg, {n_req} requests...",
+          file=sys.stderr)
+    healthy = drive(kill=False)
+    print(f"serving[failover]: kill r1 at tick {kill_tick}...",
+          file=sys.stderr)
+    killed = drive(kill=True)
+
+    h_tok = healthy["stats"]["agg_tok_per_step"]
+    k_tok = killed["stats"]["agg_tok_per_step"]
+    retention = round(k_tok / max(h_tok, 1e-9), 4)
+    recovery = killed["recovered_tick"] - kill_tick
+    taxes = [killed["ttft"][r] - healthy["ttft"][r]
+             for r in killed["failed_over"]]
+    tax_mean = round(float(np.mean(taxes)), 2) if taxes else 0.0
+    tax_max = int(max(taxes)) if taxes else 0
+    out = {
+        "requests": n_req,
+        "kill_tick": kill_tick,
+        "failed_over": len(killed["failed_over"]),
+        "failovers": killed["stats"]["failovers"],
+        "recovery_steps": int(recovery),
+        "failover_ttft_tax_mean": tax_mean,
+        "failover_ttft_tax_max": tax_max,
+        "healthy_tok_per_step": round(h_tok, 4),
+        "killed_tok_per_step": round(k_tok, 4),
+        # headline: throughput retained through the incident
+        "value": retention,
+        "unit": "ratio",
+        "tok_per_step_retention": retention,
+    }
+    print(f"serving[failover]: {len(killed['failed_over'])} failed "
+          f"over, recovery {recovery} steps, TTFT tax mean "
+          f"{tax_mean} steps, retention x{retention}",
+          file=sys.stderr)
+    return out
 
 
 def _bench_moe(jax):

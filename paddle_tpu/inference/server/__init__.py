@@ -4,7 +4,11 @@ Layering (host control plane / device data plane):
 
   ServingCluster (cluster.py) N-replica fleet: prefix-affinity
                               Router, elastic drain/join, optional
-                              prefill/decode disaggregation
+                              prefill/decode disaggregation, and the
+                              ReplicaSupervisor survivability plane
+                              (crash/hang detection, request
+                              failover, auto-restart + breaker,
+                              overload shedding)
   ServingEngine (engine.py)  user API: submit / cancel / step / stats
     Scheduler   (scheduler.py) iteration-level admission, chunked
                                prefill, preemption-with-recompute
@@ -12,12 +16,14 @@ Layering (host control plane / device data plane):
     PagedExecutor (executor.py) jit'd prefill/chunk/decode forwards
                                 over paged.PagedKVCache slots
 """
-from .cluster import Replica, Router, ServingCluster
+from .cluster import (Replica, ReplicaSupervisor, Router,
+                      ServingCluster)
 from .engine import ServingEngine
 from .executor import PagedExecutor
 from .metrics import EngineMetrics
 from .prefix_cache import PrefixCache, check_pool_invariants
-from .request import Request, RequestHandle, RequestState, TERMINAL
+from .request import (Request, RequestHandle, RequestRejected,
+                      RequestState, TERMINAL)
 from .scheduler import Scheduler
 from .spec_decode import NGramProposer, SpecDecode, spec_mode
 
@@ -26,5 +32,6 @@ __all__ = [
     "RequestHandle", "RequestState", "TERMINAL", "Scheduler",
     "PrefixCache", "check_pool_invariants",
     "NGramProposer", "SpecDecode", "spec_mode",
-    "ServingCluster", "Router", "Replica",
+    "ServingCluster", "Router", "Replica", "ReplicaSupervisor",
+    "RequestRejected",
 ]

@@ -35,17 +35,33 @@ Fault points: ``route.pick`` brackets one placement decision,
 injected raise — fallback placement, aborted transition, or the
 request keeps decoding where it is — never request loss (the
 aot.cache discipline: a dead replica is a miss, not a crash).
+
+Survivability (the :class:`ReplicaSupervisor`): replicas heartbeat on
+the logical clock; a crash, hang, or escaping exception marks the
+replica FAILED and every request it held fails over — re-queued
+through the router for a bit-identical prompt+generated re-prefill
+(the preemption-recompute idiom) on a healthy replica, handles
+untouched.  Failed replicas auto-restart after exponential backoff
+(engine rebuilt, AOT re-warmed from the shared persistent compile
+cache) under a consecutive-failure circuit breaker that permanently
+retires flappers.  Admission control (``max_queue`` +
+deadline-aware early rejection) sheds saturating load as terminal
+REJECTED-with-retry-after — never silent loss.  Chaos points:
+``replica.fail`` (crash/hang/raise consumed in-process),
+``replica.restart``, ``req.failover``, ``req.shed``.
 """
 from __future__ import annotations
 
 import os
+import time
 
 import numpy as np
 
 from ... import obs
 from ...testing import faults
 from .engine import ServingEngine
-from .request import RequestHandle
+from .request import (Request, RequestHandle, RequestRejected,
+                      RequestState)
 
 
 def _cluster_enabled() -> bool:
@@ -55,20 +71,35 @@ def _cluster_enabled() -> bool:
     return mode == "on"
 
 
-#: replica lifecycle states (statusz/gauge encoding in this order).
-REPLICA_STATES = ("active", "draining", "drained")
+#: replica lifecycle states (statusz/gauge encoding in this order;
+#: the survivability states append so r20 gauge values are unchanged).
+REPLICA_STATES = ("active", "draining", "drained",
+                  "failed", "restarting", "retired")
+
+#: states in which a replica no longer steps or holds live requests.
+DEAD_STATES = ("drained", "failed", "restarting", "retired")
 
 
 class Replica:
     """One engine plus its fleet-side control state."""
 
-    __slots__ = ("name", "engine", "role", "state")
+    __slots__ = ("name", "engine", "role", "state",
+                 "last_beat", "hung", "fail_streak", "fails",
+                 "restarts", "restart_at", "probation_until")
 
     def __init__(self, name, engine, role="mixed"):
         self.name = name
         self.engine = engine
         self.role = role            # mixed | prefill | decode
         self.state = "active"
+        # survivability bookkeeping (the ReplicaSupervisor's state):
+        self.last_beat = 0          # cluster tick of the last full step
+        self.hung = False           # injected silent stall in progress
+        self.fail_streak = 0        # consecutive failures (breaker)
+        self.fails = 0              # lifetime failures
+        self.restarts = 0           # lifetime successful restarts
+        self.restart_at = None      # tick of the next restart attempt
+        self.probation_until = None  # healthy-until tick resets streak
 
     @property
     def depth(self) -> int:
@@ -79,8 +110,8 @@ class Replica:
 
     @property
     def admitting(self) -> bool:
-        return self.state == "active" and self.role in ("mixed",
-                                                        "prefill")
+        return (self.state == "active" and not self.hung
+                and self.role in ("mixed", "prefill"))
 
     def __repr__(self):
         return (f"Replica({self.name}, role={self.role}, "
@@ -111,7 +142,18 @@ class Router:
         self.degraded = 0          # injected-fault fallback placements
 
     def pick(self, candidates, prompt_ids):
-        """(replica, affinity_tokens) for one request."""
+        """(replica, affinity_tokens) for one request.
+
+        The admitting flag is re-checked HERE, at decision time, not
+        just when the candidate list was snapshotted: a replica whose
+        ``drain()`` (or failure) landed between the snapshot and the
+        pick must never win the placement.  When every candidate went
+        stale the original list is kept — the caller owns the
+        no-admitting-replica error path.
+        """
+        live = [r for r in candidates if r.admitting]
+        if live:
+            candidates = live
         if self.policy == "random":
             return candidates[int(self._rng.randint(
                 len(candidates)))], 0
@@ -126,6 +168,310 @@ class Router:
         if best_key[0] > 0:
             self.affinity_hits += 1
         return best, best_key[0]
+
+
+class ReplicaSupervisor:
+    """Crash/hang detection and closed-loop recovery for one fleet.
+
+    Detection is two-pronged, both deterministic on the logical
+    clock's side: a replica that completes a step beats
+    (``last_beat = cluster tick``, mirrored into the obs heartbeat
+    plane as ``replica.<name>``); one that misses ``beat_timeout``
+    consecutive beats — a hang, silent or injected — is marked FAILED.
+    ``watchdog_s`` (off by default: wall time is nondeterministic)
+    additionally bounds one step's wall-clock; a step that finishes
+    but blows the deadline fails the replica AFTER its tokens are
+    kept (they are valid — greedy streams depend only on weights +
+    prompt).
+
+    Failure handling is the tentpole loop: every non-terminal request
+    on the failed replica is failed over — re-queued through the
+    :class:`Router` for a bit-identical prompt+generated re-prefill
+    (the preemption-recompute path; prefix-cache hits make it cheap)
+    on a healthy replica, its :class:`RequestHandle` untouched.  With
+    no healthy target the request parks on the cluster's orphan list
+    (never lost) and re-homes as soon as a replica restarts or joins.
+
+    Restart is automatic (``auto_restart``): after an exponential
+    backoff (``backoff_base * 2**(streak-1)`` ticks) the replica
+    rebuilds its engine — AOT re-warmed from the fleet's shared
+    persistent compile cache, zero new compiles — and rejoins
+    admission.  A circuit breaker retires it permanently once
+    ``fail_streak`` exceeds ``restart_budget``; the streak resets
+    only after the replica survives a probation window
+    (``2 * beat_timeout`` ticks), so a flapping replica keeps
+    accumulating strikes.
+    """
+
+    def __init__(self, cluster, beat_timeout=3, watchdog_s=None,
+                 auto_restart=True, restart_budget=3, backoff_base=2):
+        if int(beat_timeout) < 1:
+            raise ValueError(
+                f"beat_timeout must be >= 1, got {beat_timeout}")
+        self.cluster = cluster
+        self.beat_timeout = int(beat_timeout)
+        self.watchdog_s = (None if watchdog_s is None
+                           else float(watchdog_s))
+        self.auto_restart = bool(auto_restart)
+        self.restart_budget = int(restart_budget)
+        self.backoff_base = max(1, int(backoff_base))
+        self.probation = 2 * self.beat_timeout
+
+    # -- supervised stepping --------------------------------------------
+
+    def step_replica(self, rep) -> dict:
+        """One replica step under supervision; returns its emitted
+        map ({} when the replica stalled, crashed, or failed)."""
+        cl = self.cluster
+        hit = faults.consume("replica.fail", "before")
+        if hit is not None:
+            action = hit[0]
+            if action == "hang":
+                rep.hung = True     # silent: no step, no beat
+            elif action == "crash":
+                self.fail(rep, "crash")
+                return {}
+            else:                   # raise & friends: exception path
+                self.fail(rep, f"injected:{action}")
+                return {}
+        if rep.hung:
+            return {}
+        t0 = (time.monotonic() if self.watchdog_s is not None
+              else None)
+        try:
+            out = rep.engine.step()
+        except Exception as e:
+            # one replica's step blowing up must not take the fleet
+            # down: confine it, fail the replica, fail over its work.
+            self.fail(rep, f"{type(e).__name__}: {e}", error=e)
+            return {}
+        rep.last_beat = cl._tick
+        if cl._obs is not None:
+            obs.beat(f"replica.{rep.name}",
+                     now=rep.engine.metrics._t_last)
+        if t0 is not None and time.monotonic() - t0 > self.watchdog_s:
+            # the step finished but blew its wall-clock deadline: the
+            # tokens it emitted are valid and are returned — the
+            # replica is failed afterwards.
+            self.fail(rep, "watchdog")
+        return out
+
+    # -- detection + recovery loop --------------------------------------
+
+    def poll(self) -> None:
+        """Once per cluster step: missed-beat detection, probation
+        expiry, due restarts, orphan re-homing."""
+        cl = self.cluster
+        tick = cl._tick
+        for rep in list(cl.replicas):
+            if rep.state in ("active", "draining"):
+                if tick - rep.last_beat >= self.beat_timeout:
+                    self.fail(rep, "missed_beats")
+                elif (rep.fail_streak
+                      and rep.probation_until is not None
+                      and tick >= rep.probation_until):
+                    rep.fail_streak = 0     # survived probation
+                    rep.probation_until = None
+            elif (rep.state == "failed" and self.auto_restart
+                  and rep.restart_at is not None
+                  and tick >= rep.restart_at):
+                self.restart(rep)
+        if cl._orphans:
+            self._rehome()
+
+    def fail(self, rep, reason, error=None) -> None:
+        """Mark one replica FAILED and fail over every non-terminal
+        request it holds.  Idempotent on already-dead replicas."""
+        cl = self.cluster
+        if rep.state in ("failed", "restarting", "retired", "drained"):
+            return
+        in_flight = rep.engine.in_flight
+        rep.state = "failed"
+        rep.hung = False
+        rep.fails += 1
+        rep.fail_streak += 1
+        rep.probation_until = None
+        if cl._obs is not None:
+            cl._obs.events.log(
+                "replica.fail", replica=rep.name, reason=reason,
+                in_flight=in_flight, fail_streak=rep.fail_streak,
+                tick=cl._tick)
+            cl._obs.recorder.record(
+                "replica.fail", replica=rep.name, reason=reason,
+                tick=cl._tick)
+        try:
+            faults.fire("replica.fail", "after")
+        except faults.InjectedFault:
+            pass            # the failure is already being handled
+        # strip every live request off the dead scheduler (its engine
+        # is garbage — the restart path rebuilds it from scratch, so
+        # no slot/page bookkeeping is owed here) and fail each over.
+        sch = rep.engine.scheduler
+        live = [r for r in sch.requests.values() if not r.terminal]
+        for req in live:
+            for pool in (sch.queue, sch.prefilling, sch.running):
+                if req in pool:
+                    pool.remove(req)
+            sch.requests.pop(req.rid, None)
+            if sch.spec is not None:
+                try:
+                    sch.spec.on_release(req)
+                except Exception:
+                    pass    # dead engine's draft state is garbage too
+            cl._owner.pop(req.rid, None)
+            self._failover(req, rep)
+        # schedule the restart — or trip the breaker.
+        if rep.fail_streak > self.restart_budget:
+            self.retire(rep)
+        elif self.auto_restart:
+            backoff = self.backoff_base * (
+                2 ** (rep.fail_streak - 1))
+            rep.restart_at = cl._tick + backoff
+        if error is not None and cl._obs is not None:
+            obs.auto_dump(f"replica-failed-{rep.name}",
+                          extra={"replica": rep.name,
+                                 "reason": reason})
+
+    def _failover(self, req, src) -> None:
+        """Fail one request over to a healthy replica (or the orphan
+        list).  The recompute resume is the preemption idiom: prefill
+        prompt+generated from scratch, decode resumes bit-identically
+        after the already-streamed tokens."""
+        cl = self.cluster
+        cl.failovers += 1
+        if cl._obs is not None:
+            cl._obs.registry.counter(
+                "cluster_failovers_total",
+                "Requests failed over off a dead replica").inc()
+        if not req.terminal:
+            req.resume_ids = np.concatenate(
+                [req.prompt_ids,
+                 np.asarray(req.generated, np.int32)]).astype(np.int32)
+            req.prefill_done = 0
+            req.sid = None
+            req.state = RequestState.QUEUED
+        placed = self._place(req, src=src)
+        if not placed:
+            cl._orphans.append(req)
+            if cl._obs is not None:
+                cl._obs.events.log(
+                    "req.failover", rid=req.rid, src=src.name,
+                    dst=None, orphaned=1,
+                    tokens_done=len(req.generated), tick=cl._tick)
+
+    def _place(self, req, src=None) -> bool:
+        """Route one failed-over request onto an admitting replica;
+        False when none exists.  An injected ``req.failover`` raise
+        degrades to the first admitting replica — never loss."""
+        cl = self.cluster
+        targets = cl._admitting()
+        if not targets:
+            return False
+        degraded = False
+        try:
+            faults.fire("req.failover", "before")
+            dst, aff = cl.router.pick(targets, req.resume_ids)
+        except faults.InjectedFault:
+            cl.router.degraded += 1
+            dst, aff, degraded = targets[0], 0, True
+        dst.engine.scheduler.add(req)
+        cl._owner[req.rid] = dst
+        if cl._obs is not None:
+            cl._obs.events.log(
+                "req.failover", rid=req.rid,
+                src=None if src is None else src.name, dst=dst.name,
+                orphaned=0, aff_tokens=int(aff), degraded=int(degraded),
+                tokens_done=len(req.generated), tick=cl._tick)
+        try:
+            faults.fire("req.failover", "after")
+        except faults.InjectedFault:
+            pass            # the migration is already committed
+        return True
+
+    def _rehome(self) -> None:
+        """Drain the orphan list onto whatever is admitting now."""
+        cl = self.cluster
+        remaining = []
+        for req in cl._orphans:
+            if req.terminal:
+                continue
+            if not self._place(req):
+                remaining.append(req)
+        cl._orphans[:] = remaining
+
+    # -- restart + circuit breaker --------------------------------------
+
+    def restart(self, rep):
+        """One automatic restart attempt: rebuild the engine (AOT
+        re-warmed from the fleet's shared persistent compile cache)
+        and rejoin admission.  A failed attempt counts against the
+        breaker budget and doubles the backoff."""
+        cl = self.cluster
+        if rep.state != "failed":
+            raise ValueError(
+                f"cannot restart {rep.name}: state={rep.state!r}")
+        rep.state = "restarting"
+        rep.restart_at = None
+        try:
+            faults.fire("replica.restart", "before")
+            eng = cl._build_engine()
+            faults.fire("replica.restart", "after")
+        except Exception:
+            cl.restarts_failed += 1
+            rep.fail_streak += 1
+            if cl._obs is not None:
+                cl._obs.events.log(
+                    "replica.restart", replica=rep.name, ok=0,
+                    fail_streak=rep.fail_streak, tick=cl._tick)
+            if rep.fail_streak > self.restart_budget:
+                self.retire(rep)
+            else:
+                rep.state = "failed"
+                backoff = self.backoff_base * (
+                    2 ** (rep.fail_streak - 1))
+                rep.restart_at = cl._tick + backoff
+            return None
+        rep.engine = eng
+        rep.state = "active"
+        rep.hung = False
+        rep.last_beat = cl._tick
+        rep.restarts += 1
+        rep.probation_until = cl._tick + self.probation
+        cl.restarts += 1
+        if cl._obs is not None:
+            report = eng._aot_report or {}
+            cl._obs.events.log(
+                "replica.restart", replica=rep.name, ok=1,
+                restarts=rep.restarts,
+                aot_compiled=int(report.get("compile", 0)),
+                aot_disk=int(report.get("disk", 0)), tick=cl._tick)
+        self._rehome()
+        return rep
+
+    def retire(self, rep) -> None:
+        """Circuit breaker: permanently remove a flapping replica
+        from rotation (state ``retired`` — never restarted)."""
+        cl = self.cluster
+        if rep.state == "retired":
+            return
+        rep.state = "retired"
+        rep.restart_at = None
+        cl.retired += 1
+        if cl._obs is not None:
+            cl._obs.events.log(
+                "replica.retire", replica=rep.name,
+                fail_streak=rep.fail_streak,
+                budget=self.restart_budget, tick=cl._tick)
+
+    def statusz(self) -> dict:
+        return {
+            "beat_timeout": self.beat_timeout,
+            "watchdog_s": self.watchdog_s,
+            "auto_restart": self.auto_restart,
+            "restart_budget": self.restart_budget,
+            "backoff_base": self.backoff_base,
+            "probation": self.probation,
+        }
 
 
 class ServingCluster:
@@ -145,7 +491,10 @@ class ServingCluster:
     def __init__(self, model, n_replicas=2, cluster=None,
                  router_policy="affinity", router_seed=0,
                  disaggregated=False, n_prefill=None, clock=None,
-                 compile_cache=None, **engine_kwargs):
+                 compile_cache=None, beat_timeout=3, watchdog_s=None,
+                 auto_restart=True, restart_budget=3, backoff_base=2,
+                 max_queue=None, shed_deadlines=None,
+                 **engine_kwargs):
         if cluster is None:
             cluster = _cluster_enabled()
         self.enabled = bool(cluster)
@@ -190,6 +539,28 @@ class ServingCluster:
         self.joins = 0
         self.joins_aborted = 0
         self.resteered = 0
+        # survivability plane: supervisor policy + counters.  All of
+        # it is inert without failures — a fault-free run is
+        # bit-exact r20 whatever the knobs.
+        self.supervisor = ReplicaSupervisor(
+            self, beat_timeout=beat_timeout, watchdog_s=watchdog_s,
+            auto_restart=auto_restart, restart_budget=restart_budget,
+            backoff_base=backoff_base)
+        # admission control: max_queue bounds the fleet-wide queued
+        # backlog; shed_deadlines (default: on iff max_queue is set)
+        # early-rejects requests whose deadline the router can already
+        # prove unmeetable.  Both default OFF-equivalent so legacy
+        # submits are untouched.
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.shed_deadlines = (self.max_queue is not None
+                               if shed_deadlines is None
+                               else bool(shed_deadlines))
+        self.failovers = 0
+        self.sheds = 0
+        self.restarts = 0
+        self.restarts_failed = 0
+        self.retired = 0
+        self._orphans: list = []    # failed-over, awaiting a home
         self._obs = obs.handle()
         n_pre = 0
         if self.disaggregated:
@@ -206,14 +577,22 @@ class ServingCluster:
             self._build_replica(role)
         if self._obs is not None:
             self._obs.statusz["cluster"] = self._statusz
+            self._obs.statusz["survivability"] = \
+                self._survivability_statusz
+
+    def _build_engine(self) -> ServingEngine:
+        """One replica engine, AOT-warmed (when on) from the fleet's
+        shared persistent compile cache — the join() AND restart
+        rebuild path."""
+        return ServingEngine(self.model, clock=self._clock,
+                             compile_cache=self._compile_cache,
+                             **self._engine_kwargs)
 
     def _build_replica(self, role="mixed") -> Replica:
         name = f"r{self._n_built}"
         self._n_built += 1
-        eng = ServingEngine(self.model, clock=self._clock,
-                            compile_cache=self._compile_cache,
-                            **self._engine_kwargs)
-        rep = Replica(name, eng, role=role)
+        rep = Replica(name, self._build_engine(), role=role)
+        rep.last_beat = self._tick
         self.replicas.append(rep)
         return rep
 
@@ -228,6 +607,14 @@ class ServingCluster:
 
     def _admitting(self):
         return [r for r in self.replicas if r.admitting]
+
+    def _recovering(self) -> bool:
+        """True when capacity is expected back: some replica is mid
+        restart or failed with a restart already scheduled."""
+        return any(
+            r.state == "restarting"
+            or (r.state == "failed" and r.restart_at is not None)
+            for r in self.replicas)
 
     def _route(self, rid, prompt_ids, resteer=False):
         cands = self._admitting()
@@ -271,6 +658,30 @@ class ServingCluster:
         if rid in self._owner:
             raise ValueError(f"duplicate request id {rid!r}")
         self._next_rid += 1
+        verdict = self._shed_verdict(deadline)
+        if verdict is not None:
+            shed = self._shed(rid, prompt_ids, max_new_tokens,
+                              priority, deadline, on_token, verdict)
+            if shed is not None:
+                return shed     # REJECTED terminal, never silent loss
+        if not self._admitting() and self._recovering():
+            # the whole admitting set is down but a restart is already
+            # scheduled: park the request on the orphan list (never
+            # refused, never lost) — the supervisor re-homes it the
+            # moment a replica rejoins.
+            req = Request(rid, prompt_ids,
+                          max_new_tokens=max_new_tokens,
+                          priority=priority, deadline=deadline,
+                          on_token=on_token)
+            if len(req.prompt_ids) == 0:
+                raise ValueError("prompt_ids must be non-empty")
+            if req.max_new_tokens < 1:
+                raise ValueError("max_new_tokens must be >= 1")
+            self._orphans.append(req)
+            if self._obs is not None:
+                self._obs.events.log("req.parked", rid=rid,
+                                     tick=self._tick)
+            return RequestHandle(self, req)
         rep, _ = self._route(rid, np.asarray(
             prompt_ids, np.int32).reshape(-1))
         handle = rep.engine.submit(
@@ -280,10 +691,79 @@ class ServingCluster:
         self._owner[rid] = rep
         return RequestHandle(self, handle._req)
 
+    # -- admission control (overload shedding) --------------------------
+
+    def _queued_total(self) -> int:
+        return len(self._orphans) + sum(
+            len(r.engine.scheduler.queue) for r in self.replicas
+            if r.state not in DEAD_STATES)
+
+    def _shed_verdict(self, deadline):
+        """(reason, retry_after_steps) to reject NOW, else None.
+
+        Deterministic on the logical clock: the backlog bound counts
+        every queued-not-admitted request fleet-wide; the deadline
+        check uses a lower bound on TTFT (one prefill step plus the
+        queue overflow ahead of the best replica) — if even the bound
+        misses the deadline, admission would only discover the same
+        truncation later, holding pages the whole wait.
+        """
+        queued = self._queued_total()
+        if self.max_queue is not None and queued >= self.max_queue:
+            return ("overload", max(1, queued - self.max_queue + 1))
+        if deadline is not None and self.shed_deadlines:
+            best = None
+            for rep in self._admitting():
+                est = 1 + max(0, rep.depth
+                              - rep.engine.executor.cache.max_seqs)
+                if best is None or est < best:
+                    best = est
+            if best is not None and best > int(deadline):
+                return ("deadline_unmeetable",
+                        max(1, best - int(deadline)))
+        return None
+
+    def _shed(self, rid, prompt_ids, max_new_tokens, priority,
+              deadline, on_token, verdict):
+        """Reject one request at the boundary: terminal REJECTED with
+        a retry-after hint.  An injected ``req.shed`` before-raise
+        degrades to ADMITTING the request (returns None) — shedding
+        must never turn into loss."""
+        reason, retry_after = verdict
+        try:
+            faults.fire("req.shed", "before")
+        except faults.InjectedFault:
+            return None
+        req = Request(rid, prompt_ids, max_new_tokens=max_new_tokens,
+                      priority=priority, deadline=deadline,
+                      on_token=on_token)
+        req.state = RequestState.REJECTED
+        req.finish_reason = reason
+        req.retry_after = int(retry_after)
+        req.error = RequestRejected(rid, reason, retry_after)
+        self.sheds += 1
+        if self._obs is not None:
+            self._obs.registry.counter(
+                "cluster_shed_total",
+                "Requests rejected by cluster admission control").inc()
+            self._obs.events.log(
+                "req.shed", rid=rid, reason=reason,
+                retry_after=int(retry_after),
+                queued=self._queued_total(), tick=self._tick)
+        try:
+            faults.fire("req.shed", "after")
+        except faults.InjectedFault:
+            pass                # the rejection is already terminal
+        return RequestHandle(self, req)
+
     def cancel(self, rid) -> None:
         rep = self._owner.get(rid)
         if rep is not None:
             rep.engine.cancel(rid)
+            return
+        for req in self._orphans:   # cancelled while awaiting a home
+            if req.rid == rid and not req.terminal:
+                req.cancel_flag = True
 
     def request(self, rid):
         rep = self._owner.get(rid)
@@ -293,18 +773,20 @@ class ServingCluster:
 
     def step(self) -> dict:
         """One cluster iteration: every live replica steps once (the
-        shared logical clock), then disaggregated migrations run and
-        finished drains are retired.  Returns the merged
-        {rid: [tokens]} map."""
+        shared logical clock) under the supervisor's watch, then
+        disaggregated migrations run, the supervisor polls (missed-
+        beat detection, restarts, orphan re-homing) and finished
+        drains are retired.  Returns the merged {rid: [tokens]} map."""
         self._tick += 1
         emitted: dict = {}
         for rep in list(self.replicas):
-            if rep.state == "drained":
+            if rep.state in DEAD_STATES:
                 continue
-            for rid, toks in rep.engine.step().items():
+            for rid, toks in self.supervisor.step_replica(rep).items():
                 emitted.setdefault(rid, []).extend(toks)
         if self.disaggregated:
             self._migrate()
+        self.supervisor.poll()
         for rep in self.replicas:
             if rep.state == "draining" and rep.engine.in_flight == 0:
                 rep.state = "drained"
@@ -329,20 +811,45 @@ class ServingCluster:
 
     @property
     def in_flight(self) -> int:
-        return sum(rep.engine.in_flight for rep in self.replicas)
+        return len(self._orphans) + sum(
+            rep.engine.in_flight for rep in self.replicas
+            if rep.state not in DEAD_STATES)
 
     # -- elastic scale ---------------------------------------------------
+
+    def fail(self, name, reason="operator") -> Replica:
+        """Force one replica FAILED (ops hook and the bench's kill
+        switch): in-flight requests fail over immediately, the
+        supervisor owns the restart/breaker follow-up."""
+        rep = self.replica(name) if not isinstance(name, Replica) \
+            else name
+        self.supervisor.fail(rep, reason)
+        return rep
 
     def drain(self, name) -> Replica:
         """Close one replica's admission and re-steer its queued
         requests; prefilling/running work finishes in place and the
         replica retires (state ``drained``) once idle.  Refuses to
         drain the last admitting replica — the fleet must keep
-        accepting traffic."""
+        accepting traffic.
+
+        Idempotency is deterministic: draining an already
+        ``draining``/``drained`` replica is a pure no-op (same object
+        back, no counters, no re-steer, no fault firing); draining a
+        ``failed``/``restarting``/``retired`` replica raises — there
+        is nothing to hand off and pretending otherwise would hide a
+        dead box from the operator."""
         rep = self.replica(name) if not isinstance(name, Replica) \
             else name
-        if rep.state != "active":
+        if rep.state in ("draining", "drained"):
+            if self._obs is not None:
+                self._obs.events.log("replica.drain", replica=rep.name,
+                                     idempotent=1, tick=self._tick)
             return rep
+        if rep.state != "active":
+            raise ValueError(
+                f"cannot drain {rep.name}: state={rep.state!r} "
+                f"(only active replicas drain)")
         targets = [r for r in self.replicas
                    if r is not rep and r.admitting]
         if rep.admitting and not targets:
@@ -394,9 +901,18 @@ class ServingCluster:
         engine's warmup resolves from the shared persistent compile
         cache (disk hits, zero compiles) — elastic join in seconds.
         Returns the new :class:`Replica`, or None when an injected
-        ``replica.join`` fault aborts the build (fleet unchanged)."""
+        ``replica.join`` fault aborts the build (fleet unchanged).
+
+        Deterministic while a drain is in progress: the join commits
+        independently (fresh name, fresh engine), never resurrects or
+        touches the draining replica, and the draining replica's
+        re-steered queue may land on the newcomer on the NEXT routing
+        decision only — the in-progress transition is untouched."""
         if role is None:
             role = "decode" if self.disaggregated else "mixed"
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"join role must be mixed|prefill|decode, got {role!r}")
         try:
             faults.fire("replica.join", "before")
         except faults.InjectedFault:
@@ -514,7 +1030,8 @@ class ServingCluster:
             labels=("replica",))
         g_state = reg.gauge(
             "cluster_replica_state",
-            "Replica lifecycle (0=active, 1=draining, 2=drained)",
+            "Replica lifecycle (0=active, 1=draining, 2=drained, "
+            "3=failed, 4=restarting, 5=retired)",
             labels=("replica",))
         for rep in self.replicas:
             g_pages.labels(replica=rep.name).set(
@@ -525,6 +1042,9 @@ class ServingCluster:
         reg.gauge("cluster_replicas_active",
                   "Fleet replicas currently accepting work").set(
             sum(1 for r in self.replicas if r.state == "active"))
+        reg.gauge("cluster_orphan_requests",
+                  "Failed-over requests still awaiting a healthy "
+                  "replica").set(len(self._orphans))
 
     def _statusz(self) -> dict:
         return {
@@ -547,6 +1067,14 @@ class ServingCluster:
                        "aborted": self.drains_aborted},
             "joins": {"done": self.joins,
                       "aborted": self.joins_aborted},
+            "survivability": {
+                "failovers": self.failovers,
+                "shed": self.sheds,
+                "orphans": len(self._orphans),
+                "restarts": {"done": self.restarts,
+                             "failed": self.restarts_failed},
+                "retired": self.retired,
+            },
             "replicas": [
                 {
                     "name": rep.name,
@@ -563,6 +1091,40 @@ class ServingCluster:
                     },
                     "prefix": (None if rep.engine.prefix is None
                                else rep.engine.prefix.stats()),
+                }
+                for rep in self.replicas
+            ],
+        }
+
+    def _survivability_statusz(self) -> dict:
+        """/statusz provider: supervisor policy, recovery counters,
+        and the per-replica breaker table."""
+        return {
+            "tick": self._tick,
+            "policy": self.supervisor.statusz(),
+            "admission": {
+                "max_queue": self.max_queue,
+                "shed_deadlines": self.shed_deadlines,
+                "queued": self._queued_total(),
+            },
+            "failovers": self.failovers,
+            "shed": self.sheds,
+            "orphans": len(self._orphans),
+            "restarts": {"done": self.restarts,
+                         "failed": self.restarts_failed},
+            "retired": self.retired,
+            "replicas": [
+                {
+                    "name": rep.name,
+                    "state": rep.state,
+                    "hung": rep.hung,
+                    "last_beat": rep.last_beat,
+                    "missed_beats": max(0, self._tick - rep.last_beat),
+                    "fails": rep.fails,
+                    "fail_streak": rep.fail_streak,
+                    "restarts": rep.restarts,
+                    "restart_at": rep.restart_at,
+                    "probation_until": rep.probation_until,
                 }
                 for rep in self.replicas
             ],
@@ -601,5 +1163,11 @@ class ServingCluster:
             },
             "handoffs": self.handoffs,
             "handoffs_skipped": self.handoffs_skipped,
+            "failovers": self.failovers,
+            "shed": self.sheds,
+            "orphans": len(self._orphans),
+            "restarts": self.restarts,
+            "restarts_failed": self.restarts_failed,
+            "retired": self.retired,
             "per_replica": per,
         }

@@ -1557,3 +1557,125 @@ def _multi_dot(datas, attrs):
                   f"be equal, but input[{i - 1}] ends with {k} and "
                   f"input[{i}] {list(s)} starts with {s[0]}")
         k = s[-1]
+
+
+# -- batch 14: construction + statistics + in-place random fills --------------
+
+
+def _float_dtype(x):
+    dt = getattr(x, "dtype", None)
+    if dt is None:
+        return True
+    try:
+        return np.issubdtype(np.dtype(str(dt)), np.floating)
+    except TypeError:
+        return True     # extension dtypes (bfloat16): let jnp decide
+
+
+@register_validator("block_diag")
+def _block_diag(datas, attrs):
+    # multiary.cc BlockDiagInferMeta — auto-wired: every input must be
+    # at most 2-D (each block lands on the result diagonal)
+    if not datas:
+        _fail("block_diag", "block_diag expects at least one input")
+    for i, d in enumerate(datas):
+        if _ndim(d) > 2:
+            _fail("block_diag",
+                  f"Each input tensor can be 0-D, 1-D or 2-D, but "
+                  f"input[{i}] has shape {list(_shape(d))}")
+
+
+@register_validator("vander")
+def _vander(datas, attrs):
+    # unary.cc VanderInferMeta — auto-wired: 1-D input, non-negative
+    # column count
+    x = datas[0]
+    if _ndim(x) != 1:
+        _fail("vander",
+              f"The input tensor must be 1-D, but received shape "
+              f"{list(_shape(x))}")
+    n = attrs.get("n")
+    if n is not None and int(n) < 0:
+        _fail("vander",
+              f"The number of columns N should be non-negative, but "
+              f"received {n}")
+
+
+@register_validator("corrcoef")
+def _corrcoef(datas, attrs):
+    # unary.cc CorrcoefInferMeta — host-path wrapper, validated
+    # manually in linalg.corrcoef: observations as a vector or matrix
+    x = datas[0]
+    if _ndim(x) > 2:
+        _fail("corrcoef",
+              f"The input tensor must be 1-D or 2-D, but received "
+              f"shape {list(_shape(x))}")
+    if not _float_dtype(x):
+        _fail("corrcoef",
+              f"The input must be a floating dtype, got "
+              f"{getattr(x, 'dtype', None)}")
+
+
+@register_validator("cov")
+def _cov(datas, attrs):
+    # multiary.cc CovInferMeta — host-path wrapper, validated manually
+    # in linalg.cov: 1-D/2-D observations; each weights vector must be
+    # 1-D with one entry per observation
+    x = datas[0]
+    xs = _shape(x)
+    if len(xs) > 2:
+        _fail("cov",
+              f"The input tensor must be 1-D or 2-D, but received "
+              f"shape {list(xs)}")
+    rowvar = bool(attrs.get("rowvar", True))
+    if len(xs) <= 1:
+        nobs = xs[0] if xs else 1
+    else:
+        nobs = xs[1] if rowvar else xs[0]
+    for name in ("fweights", "aweights"):
+        w = attrs.get(name)
+        if w is None:
+            continue
+        ws = _shape(w)
+        if len(ws) != 1:
+            _fail("cov",
+                  f"The {name} tensor must be 1-D, but received shape "
+                  f"{list(ws)}")
+        if ws[0] != nobs:
+            _fail("cov",
+                  f"The length of {name} ({ws[0]}) should match the "
+                  f"number of observations ({nobs})")
+
+
+@register_validator("cauchy_")
+def _cauchy_(datas, attrs):
+    # unary.cc CauchyInferMeta — in-place fill, validated manually in
+    # random.cauchy_: floating destination, positive scale
+    x = datas[0]
+    if not _float_dtype(x):
+        _fail("cauchy_",
+              f"The tensor to fill must be a floating dtype, got "
+              f"{getattr(x, 'dtype', None)}")
+    scale = attrs.get("scale", 1)
+    if not float(scale) > 0:
+        _fail("cauchy_",
+              f"The scale parameter should be positive, but received "
+              f"{scale}")
+
+
+@register_validator("geometric_")
+def _geometric_(datas, attrs):
+    # unary.cc GeometricInferMeta — in-place fill, validated manually
+    # in random.geometric_: floating destination, success probability
+    # strictly inside (0, 1)
+    x = datas[0]
+    if not _float_dtype(x):
+        _fail("geometric_",
+              f"The tensor to fill must be a floating dtype, got "
+              f"{getattr(x, 'dtype', None)}")
+    probs = attrs.get("probs")
+    if probs is not None and np.ndim(probs) == 0 \
+            and not 0.0 < float(probs) < 1.0:
+        _fail("geometric_",
+              f"The probs parameter should be in the open interval "
+              f"(0, 1), but received {probs}")

@@ -467,18 +467,25 @@ def cond(x, p=None, name=None):
 
 
 def corrcoef(x, rowvar=True, name=None):
+    from . import infermeta
     from ..core.tensor import Tensor
 
-    return Tensor(jnp.corrcoef(_raw(x), rowvar=rowvar))
+    xd = _raw(x)
+    infermeta.validate("corrcoef", (xd,), {"rowvar": rowvar})
+    return Tensor(jnp.corrcoef(xd, rowvar=rowvar))
 
 
 def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None,
         name=None):
+    from . import infermeta
     from ..core.tensor import Tensor
 
+    xd = _raw(x)
     fw = None if fweights is None else _raw(fweights)
     aw = None if aweights is None else _raw(aweights)
-    return Tensor(jnp.cov(_raw(x), rowvar=rowvar,
+    infermeta.validate("cov", (xd,), {"rowvar": rowvar, "ddof": ddof,
+                                      "fweights": fw, "aweights": aw})
+    return Tensor(jnp.cov(xd, rowvar=rowvar,
                           ddof=1 if ddof else 0, fweights=fw,
                           aweights=aw))
 

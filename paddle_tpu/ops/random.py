@@ -271,6 +271,10 @@ def cauchy_(x, loc=0, scale=1, name=None):
     """reference tensor/random.cauchy_."""
     import math as _m
 
+    from . import infermeta
+
+    infermeta.validate("cauchy_", (x._data,),
+                       {"loc": loc, "scale": scale})
     u = jax.random.uniform(default_generator.next_key(),
                            jnp.shape(x._data), jnp.float32,
                            1e-7, 1.0 - 1e-7)
@@ -282,6 +286,9 @@ def cauchy_(x, loc=0, scale=1, name=None):
 def geometric_(x, probs, name=None):
     """reference tensor/random.geometric_ (counts trials, support
     1, 2, ...)."""
+    from . import infermeta
+
+    infermeta.validate("geometric_", (x._data,), {"probs": probs})
     u = jax.random.uniform(default_generator.next_key(),
                            jnp.shape(x._data), jnp.float32,
                            1e-7, 1.0 - 1e-7)

@@ -21,6 +21,12 @@ Grammar (comma-separated specs)::
             truncate       truncate the file at the site's ``path`` to
                            half its bytes, then os._exit — a torn write
             delay:SECS     sleep SECS (default 0.05) and continue
+            hang[:SECS]    at a generic site: a bounded wall-clock
+                           stall (like delay); at the supervised
+                           replica points the cluster consume()s it
+                           and the replica stalls SILENTLY — no steps,
+                           no heartbeats — until the missed-beat
+                           watchdog fails it
             corrupt        flip one bit in the middle of the file at the
                            site's ``path`` and CONTINUE — silent bit rot
                            (checksum verification must catch it at load)
@@ -144,6 +150,27 @@ REGISTERED = {
                   "nothing copied — the request keeps decoding where "
                   "it is; after=pages landed refcounted on the decode "
                   "replica, source slot not yet freed)",
+    "replica.fail": "one supervised replica step (before=the CHAOS "
+                    "injection site — the cluster CONSUMES crash/hang/"
+                    "raise here: crash kills the replica instantly, "
+                    "hang stalls it silently until the watchdog "
+                    "misses its beats, raise fails it with an "
+                    "exception; after=failure handled, every in-"
+                    "flight request already failed over)",
+    "replica.restart": "one automatic replica restart attempt "
+                       "(before=no engine rebuilt — a raise fails the "
+                       "attempt and counts against the circuit-"
+                       "breaker budget; after=engine rebuilt and AOT-"
+                       "rewarmed, replica not yet active)",
+    "req.failover": "one request migration off a failed replica "
+                    "(before=still owned by the dead replica — a "
+                    "raise degrades to the first healthy replica, "
+                    "never loses the request; after=re-queued on the "
+                    "target for bit-identical re-prefill)",
+    "req.shed": "one admission-control rejection at the cluster "
+                "boundary (before=verdict computed, nothing rejected "
+                "— a raise degrades to ADMITTING the request; after="
+                "terminal REJECTED with retry_after set)",
 }
 
 _PHASES = ("before", "after")
@@ -165,7 +192,7 @@ class _Spec:
             raise ValueError(f"fault phase must be one of {_PHASES}, "
                              f"got {phase!r}")
         if action not in ("crash", "raise", "truncate", "delay",
-                          "corrupt", "inject"):
+                          "corrupt", "inject", "hang"):
             raise ValueError(f"unknown fault action {action!r}")
         self.point = point
         self.phase = phase
@@ -272,6 +299,15 @@ def _trip(spec, path):
     if spec.action == "delay":
         time.sleep(float(spec.arg) if spec.arg is not None else 0.05)
         return
+    if spec.action == "hang":
+        # at a generic fire() site a hang is a bounded wall-clock stall
+        # (arg seconds, default 0.05) the per-step watchdog can see; at
+        # the supervised replica sites the cluster consume()s the spec
+        # instead and the stall is a SILENT logical one — the replica
+        # stops stepping and beating until the missed-beat threshold
+        # trips.
+        time.sleep(float(spec.arg) if spec.arg is not None else 0.05)
+        return
     if spec.action == "corrupt":
         if path and os.path.isfile(path):
             _flip_bit(path)
@@ -342,6 +378,112 @@ def poll(point, phase="before"):
     return hit
 
 
+def consume(point, phase="before"):
+    """Supervised-site probe: pop the matching armed spec's
+    ``(action, arg)`` WITHOUT executing its side effect.
+
+    The cluster's replica-scoped points (``replica.fail``,
+    ``replica.restart``) use this instead of :func:`fire` so that
+    ``crash`` and ``hang`` become *replica-level* faults the fleet
+    absorbs in-process — instant death and a silent stall — rather
+    than ``os._exit`` killing the whole test process.  ``inject``
+    specs are skipped exactly as in :func:`fire`.  Returns ``None``
+    when nothing fires at this hit.
+    """
+    specs = _specs if _specs is not None else _ensure_loaded()
+    if not specs:
+        return None
+    assert point in REGISTERED, f"unregistered fault point {point!r}"
+    hit = None
+    with _lock:
+        for spec in specs:
+            if spec.point != point or spec.phase != phase \
+                    or spec.action == "inject":
+                continue
+            spec.hits += 1
+            if spec.nth == "*" or spec.hits == spec.nth:
+                hit = (spec.action, spec.arg)
+                break
+    if hit is not None:
+        _journal(point, phase, hit[0])
+    return hit
+
+
 def registered_points():
     """Names usable in specs — the property test iterates these."""
     return sorted(REGISTERED)
+
+
+# -- seeded chaos schedules (PT_CHAOS) --------------------------------
+
+#: actions the chaos generator draws.  ``crash`` and ``hang`` are only
+#: drawn onto the supervised replica point (the in-process fleet
+#: absorbs them); ``raise`` is drawn across every registered point —
+#: the one generic action that degrades instead of killing the test
+#: process.
+CHAOS_ACTIONS = ("crash", "hang", "raise")
+
+
+def parse_chaos(text=None):
+    """Parse ``PT_CHAOS="<seed>:<steps>"`` (or ``text``) into
+    ``(seed, steps)``; returns ``None`` when unset/empty."""
+    if text is None:
+        text = os.environ.get("PT_CHAOS", "")
+    text = text.strip()
+    if not text:
+        return None
+    try:
+        seed_s, steps_s = text.split(":")
+        seed, steps = int(seed_s), int(steps_s)
+    except ValueError:
+        raise ValueError(
+            f"bad PT_CHAOS {text!r}; expected '<seed>:<steps>'") \
+            from None
+    if steps < 1:
+        raise ValueError(f"PT_CHAOS steps must be >= 1, got {steps}")
+    return seed, steps
+
+
+def chaos_schedule(seed, steps, n_faults=None):
+    """Draw one deterministic randomized fault schedule.
+
+    Returns a list of ``PT_FAULTS`` spec strings (pass
+    ``",".join(...)`` to :func:`reset`): ``n_faults`` firings (default
+    ``max(2, steps // 8)``) with seeded point/phase/hit-count draws
+    spread over a run of roughly ``steps`` cluster steps.  Value-only
+    ``guard.*`` sites are skipped (they consume ``inject``, never
+    trip), and crash/hang land exclusively on ``replica.fail`` so the
+    supervised fleet absorbs them in-process.  Same seed, same
+    schedule — the chaos tests replay it against a fault-free baseline
+    and assert bit-identical streams.
+    """
+    import random
+
+    rng = random.Random(int(seed))
+    steps = int(steps)
+    n = max(2, steps // 8) if n_faults is None else int(n_faults)
+    points = [p for p in registered_points()
+              if not p.startswith("guard.")]
+    specs = []
+    for _ in range(n):
+        action = CHAOS_ACTIONS[rng.randrange(len(CHAOS_ACTIONS))]
+        if action in ("crash", "hang"):
+            point, phase = "replica.fail", "before"
+        else:
+            point = points[rng.randrange(len(points))]
+            phase = _PHASES[rng.randrange(len(_PHASES))]
+        nth = rng.randrange(1, max(2, steps))
+        specs.append(f"{point}:{phase}:{nth}={action}")
+    return specs
+
+
+def chaos_from_env():
+    """Arm the schedule ``PT_CHAOS`` describes (replacing any armed
+    specs); returns the spec-string list, or ``None`` when unset."""
+    parsed = parse_chaos()
+    if parsed is None:
+        return None
+    seed, steps = parsed
+    specs = chaos_schedule(seed, steps)
+    reset(",".join(specs))
+    return specs

@@ -327,8 +327,12 @@ def test_engine_env_gate(model, cache_dir, warm_engine, monkeypatch):
 # -- zero post-warmup traces + bit-parity under load --------------------
 
 
-@pytest.mark.parametrize("variant", ["plain", "prefix", "spec",
-                                     "async"])
+@pytest.mark.parametrize("variant", [
+    "plain",
+    pytest.param("prefix", marks=pytest.mark.slow),
+    pytest.param("spec", marks=pytest.mark.slow),
+    pytest.param("async", marks=pytest.mark.slow),
+])
 def test_warmed_load_zero_traces_and_parity(model, cache_dir,
                                             warm_engine, plain_off,
                                             variant):

@@ -143,8 +143,12 @@ def test_async_decode_is_one_dispatch_per_step(model):
 # -- bit-parity under load ----------------------------------------------
 
 
-@pytest.mark.parametrize("variant", ["plain", "prefix", "spec",
-                                     "prefix_spec"])
+@pytest.mark.parametrize("variant", [
+    "plain",
+    pytest.param("prefix", marks=pytest.mark.slow),
+    pytest.param("spec", marks=pytest.mark.slow),
+    pytest.param("prefix_spec", marks=pytest.mark.slow),
+])
 def test_async_load_parity(model, variant):
     """The acceptance-criteria run: the seeded load on an undersized
     pool — preemption, prefix hits/evictions and spec drafts firing
@@ -222,8 +226,12 @@ def test_replan_on_cancel_keeps_streams_exact(model):
 # -- fault points -------------------------------------------------------
 
 
-@pytest.mark.parametrize("point", ["async.plan", "async.commit"])
-@pytest.mark.parametrize("phase", ["before", "after"])
+@pytest.mark.parametrize("phase,point", [
+    ("before", "async.plan"),
+    pytest.param("before", "async.commit", marks=pytest.mark.slow),
+    pytest.param("after", "async.plan", marks=pytest.mark.slow),
+    pytest.param("after", "async.commit", marks=pytest.mark.slow),
+])
 def test_async_fault_leaves_engine_serviceable(model, point, phase):
     """An injected raise at every async point x phase escapes step()
     with the pool consistent; the remaining steps finish every request

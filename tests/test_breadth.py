@@ -209,6 +209,7 @@ def test_transformer_trains():
 
 # -- vision models + datasets ------------------------------------------------
 
+@pytest.mark.slow
 def test_vision_model_zoo_forward():
     from paddle_tpu.vision.models import (
         LeNet, MobileNetV2, VGG, alexnet, vgg11,

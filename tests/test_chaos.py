@@ -1,0 +1,529 @@
+"""Fleet survivability under seeded chaos.
+
+The contract under test, for EVERY seed and fault action: a replica
+that crashes, hangs, or raises mid-load loses ZERO requests — its
+in-flight work fails over and completes with token streams
+BIT-IDENTICAL to a fault-free single engine, unaffected streams stay
+bit-exact, every live replica's page pool satisfies
+``check_pool_invariants`` after EVERY cluster step, and the failed
+replica restarts (AOT re-warmed) under the circuit breaker's budget.
+Overload shedding returns terminal REJECTED with retry-after — never
+silent loss.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.server import (
+    RequestRejected, RequestState, Router, ServingCluster,
+    ServingEngine,
+)
+from paddle_tpu.inference.server.cluster import DEAD_STATES
+from paddle_tpu.inference.server.prefix_cache import (
+    check_pool_invariants,
+)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.testing import faults
+from paddle_tpu.testing.load import LoadSpec, generate_load
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(11)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+KW = dict(max_seqs=2, page_size=4, max_len=64, prefill_chunk=8)
+SPEC = dict(n_requests=8, mean_interarrival=1.0, prompt_len=(4, 14),
+            max_new=(4, 8), vocab=256, seed=3)
+
+#: terminal states that count as "served" — anything else under chaos
+#: is a lost request.
+SERVED = (RequestState.FINISHED, RequestState.TRUNCATED)
+
+
+def _workload(**over):
+    return generate_load(LoadSpec(**dict(SPEC, **over)))
+
+
+def _audit(cl):
+    """Pool invariants on every replica that still owns a live pool."""
+    for rep in cl.replicas:
+        if rep.state in DEAD_STATES:
+            continue
+        check_pool_invariants(rep.engine.executor.cache,
+                              rep.engine.prefix)
+
+
+def _drive(cl, work, max_steps=400, audit=True):
+    """run_load with a per-step pool-invariant audit; returns
+    {rid: handle}."""
+    pending = sorted(work, key=lambda w: (w["arrival_tick"],
+                                          w["rid"]))
+    handles = {}
+    while pending or cl.in_flight:
+        assert cl.tick < max_steps, (
+            f"chaos run did not drain in {max_steps} steps")
+        while pending and pending[0]["arrival_tick"] <= cl.tick:
+            w = pending.pop(0)
+            handles[w["rid"]] = cl.submit(
+                w["prompt_ids"], max_new_tokens=w["max_new_tokens"],
+                priority=w["priority"], rid=w["rid"])
+        try:
+            cl.step()
+        except faults.InjectedFault:
+            pass    # cluster-boundary injection; the fleet keeps going
+        if audit:
+            _audit(cl)
+    return handles
+
+
+def _assert_zero_loss(handles, baseline):
+    for rid, h in handles.items():
+        assert h.state in SERVED, (rid, h.state)
+        assert h.tokens == baseline[rid], \
+            f"{rid}: stream diverged after failover"
+
+
+@pytest.fixture(scope="module")
+def baseline(model):
+    """Fault-free single-engine streams — the bit-exactness oracle for
+    every cluster/chaos variant (placement never enters numerics)."""
+    work = _workload()
+    eng = ServingEngine(model, **KW)
+    handles = _drive_engine(eng, work)
+    return work, {rid: h.tokens for rid, h in handles.items()}
+
+
+def _drive_engine(eng, work):
+    pending = sorted(work, key=lambda w: (w["arrival_tick"],
+                                          w["rid"]))
+    handles = {}
+    while pending or eng.in_flight:
+        while pending and pending[0]["arrival_tick"] <= eng.tick:
+            w = pending.pop(0)
+            handles[w["rid"]] = eng.submit(
+                w["prompt_ids"], max_new_tokens=w["max_new_tokens"],
+                priority=w["priority"], rid=w["rid"])
+        eng.step()
+    return handles
+
+
+# -- 3 seeds x (crash, hang, raise): zero loss, bit-exact streams ------
+
+# the full 3 nth x 3 action matrix rides `make test`/`make smoke`; the
+# fast lane keeps one cell per action to stay inside the tier-1 budget
+_slow = pytest.mark.slow
+
+@pytest.mark.parametrize("nth,action", [
+    (5, "crash"), (7, "hang"), (9, "raise"),
+    pytest.param(5, "hang", marks=_slow),
+    pytest.param(5, "raise", marks=_slow),
+    pytest.param(7, "crash", marks=_slow),
+    pytest.param(7, "raise", marks=_slow),
+    pytest.param(9, "crash", marks=_slow),
+    pytest.param(9, "hang", marks=_slow),
+])
+def test_replica_fault_zero_loss(model, baseline, action, nth):
+    """One injected replica fault mid-load: the replica fails (hang:
+    after the missed-beat threshold), every request completes
+    bit-identically, and the replica restarts."""
+    work, base = baseline
+    faults.reset(f"replica.fail:before:{nth}={action}")
+    cl = ServingCluster(model, n_replicas=3, cluster=True, **KW)
+    handles = _drive(cl, work)
+    _assert_zero_loss(handles, base)
+    assert cl.failovers > 0
+    assert cl.restarts > 0          # auto-restart closed the loop
+    assert all(r.state == "active" for r in cl.replicas)
+    assert cl.in_flight == 0 and not cl._orphans
+
+
+@pytest.mark.parametrize("seed", [
+    7,
+    pytest.param(21, marks=pytest.mark.slow),
+    pytest.param(1337, marks=pytest.mark.slow),
+])
+def test_chaos_schedule_zero_loss(model, baseline, seed):
+    """A full PT_CHAOS-style randomized schedule over ALL registered
+    points: whatever fires, no request is lost, streams stay
+    bit-exact, pools stay consistent every step."""
+    work, base = baseline
+    cl = ServingCluster(model, n_replicas=3, cluster=True, **KW)
+    specs = faults.chaos_schedule(seed, steps=48)
+    faults.reset(",".join(specs))
+    handles = _drive(cl, work)
+    faults.reset()
+    _assert_zero_loss(handles, base)
+    assert cl.in_flight == 0 and not cl._orphans
+
+
+def test_chaos_env_grammar(monkeypatch):
+    assert faults.parse_chaos("42:64") == (42, 64)
+    assert faults.parse_chaos("") is None
+    monkeypatch.delenv("PT_CHAOS", raising=False)
+    assert faults.parse_chaos() is None
+    with pytest.raises(ValueError, match="PT_CHAOS"):
+        faults.parse_chaos("42")
+    with pytest.raises(ValueError, match="steps"):
+        faults.parse_chaos("42:0")
+    # same seed, same schedule — different seed, different schedule
+    assert faults.chaos_schedule(5, 64) == faults.chaos_schedule(5, 64)
+    assert faults.chaos_schedule(5, 64) != faults.chaos_schedule(6, 64)
+    monkeypatch.setenv("PT_CHAOS", "9:32")
+    specs = faults.chaos_from_env()
+    assert specs == faults.chaos_schedule(9, 32)
+    faults.reset("")
+
+
+# -- detection mechanics ----------------------------------------------
+
+def test_hang_detected_at_missed_beat_threshold(model, baseline):
+    """A hung replica beats no more; the supervisor fails it exactly
+    ``beat_timeout`` ticks later, on the logical clock."""
+    work, base = baseline
+    faults.reset("replica.fail:before:2=hang")
+    cl = ServingCluster(model, n_replicas=2, cluster=True,
+                        beat_timeout=3, **KW)
+    pending = sorted(work, key=lambda w: (w["arrival_tick"],
+                                          w["rid"]))
+    handles, hung_at, failed_at = {}, None, None
+    while pending or cl.in_flight:
+        assert cl.tick < 400
+        while pending and pending[0]["arrival_tick"] <= cl.tick:
+            w = pending.pop(0)
+            handles[w["rid"]] = cl.submit(
+                w["prompt_ids"], max_new_tokens=w["max_new_tokens"],
+                rid=w["rid"])
+        cl.step()
+        _audit(cl)
+        for rep in cl.replicas:
+            if rep.hung and hung_at is None:
+                hung_at = cl.tick
+            if rep.state == "failed" and failed_at is None:
+                failed_at = cl.tick
+    assert hung_at is not None and failed_at is not None
+    # silent stall: detection exactly beat_timeout ticks after the
+    # last completed beat (the hang tick itself counts as missed)
+    assert failed_at - hung_at == 2     # beat_timeout=3, last beat t-1
+    assert cl.restarts == 1
+    _assert_zero_loss(handles, base)
+
+
+def test_crash_fails_over_same_tick(model, baseline):
+    """An instant crash is detected in the SAME cluster step: the
+    victim's requests are re-queued on healthy replicas before the
+    tick ends."""
+    work, base = baseline
+    faults.reset("replica.fail:before:4=crash")
+    cl = ServingCluster(model, n_replicas=2, cluster=True, **KW)
+    seen_failed = []
+    pending = sorted(work, key=lambda w: (w["arrival_tick"],
+                                          w["rid"]))
+    handles = {}
+    while pending or cl.in_flight:
+        assert cl.tick < 400
+        while pending and pending[0]["arrival_tick"] <= cl.tick:
+            w = pending.pop(0)
+            handles[w["rid"]] = cl.submit(
+                w["prompt_ids"], max_new_tokens=w["max_new_tokens"],
+                rid=w["rid"])
+        cl.step()
+        _audit(cl)
+        for rep in cl.replicas:
+            if rep.state == "failed" and rep.name not in seen_failed:
+                seen_failed.append(rep.name)
+                # failover already done: the dead scheduler is empty
+                assert rep.engine.in_flight == 0
+    assert seen_failed, "the armed crash never fired"
+    _assert_zero_loss(handles, base)
+
+
+def test_handles_survive_failover(model, baseline):
+    """A RequestHandle taken before the crash keeps working after its
+    request migrates — it drives the CLUSTER, not a replica."""
+    work, base = baseline
+    faults.reset("replica.fail:before:3=crash")
+    cl = ServingCluster(model, n_replicas=2, cluster=True, **KW)
+    rid0 = work[0]["rid"]
+    h = cl.submit(work[0]["prompt_ids"],
+                  max_new_tokens=work[0]["max_new_tokens"], rid=rid0)
+    toks = h.result()               # drives cl.step() through the crash
+    assert toks == base[rid0]
+    assert h.state is RequestState.FINISHED
+
+
+def test_orphans_park_then_rehome(model, baseline):
+    """With NO healthy target the failed-over requests park on the
+    orphan list (never lost) and re-home the moment the restarted
+    replica rejoins."""
+    work, base = baseline
+    # single replica: its failure leaves nowhere to fail over to
+    faults.reset("replica.fail:before:3=crash")
+    cl = ServingCluster(model, n_replicas=1, cluster=True,
+                        backoff_base=2, **KW)
+    handles = _drive(cl, work, max_steps=600)
+    assert cl.restarts == 1
+    assert not cl._orphans
+    _assert_zero_loss(handles, base)
+
+
+# -- restart + circuit breaker ----------------------------------------
+
+def test_breaker_retires_flapping_replica(model, baseline):
+    """Every restart attempt fails (armed replica.restart raise): the
+    streak exhausts the budget and the replica is permanently
+    retired; the fleet still serves everything."""
+    work, base = baseline
+    faults.reset("replica.fail:before:3=crash,"
+                 "replica.restart:before:*=raise")
+    cl = ServingCluster(model, n_replicas=2, cluster=True,
+                        restart_budget=2, backoff_base=1, **KW)
+    handles = _drive(cl, work, max_steps=600)
+    victim = [r for r in cl.replicas if r.state == "retired"]
+    assert len(victim) == 1
+    assert victim[0].fail_streak == 3       # budget 2 + the last straw
+    assert cl.restarts_failed == 2
+    assert cl.restarts == 0
+    _assert_zero_loss(handles, base)
+
+
+@pytest.mark.slow
+def test_probation_resets_streak(model, baseline):
+    """A replica that survives its probation window after a restart
+    gets its consecutive-failure streak zeroed."""
+    work, base = baseline
+    faults.reset("replica.fail:before:3=crash")
+    cl = ServingCluster(model, n_replicas=2, cluster=True,
+                        beat_timeout=2, backoff_base=1, **KW)
+    handles = _drive(cl, work, max_steps=600)
+    victim = [r for r in cl.replicas if r.restarts][0]
+    # ran well past probation while draining the load
+    assert victim.fail_streak == 0
+    assert victim.state == "active"
+    _assert_zero_loss(handles, base)
+
+
+@pytest.mark.slow
+def test_restart_rewarms_from_shared_compile_cache(model, baseline,
+                                                   tmp_path):
+    """The rebuilt engine's AOT warmup must resolve every entry from
+    the fleet's persistent compile cache: zero fresh compiles."""
+    work, base = baseline
+    faults.reset("replica.fail:before:3=crash")
+    cl = ServingCluster(model, n_replicas=2, cluster=True, aot="warm",
+                        compile_cache=str(tmp_path), **KW)
+    handles = _drive(cl, work, max_steps=600)
+    victim = [r for r in cl.replicas if r.restarts][0]
+    report = victim.engine._aot_report
+    assert report["compile"] == 0, report
+    assert report["disk"] > 0, report
+    _assert_zero_loss(handles, base)
+
+
+# -- new fault points degrade, never lose -----------------------------
+
+@pytest.mark.slow
+def test_req_failover_fault_degrades_to_first_healthy(model, baseline):
+    work, base = baseline
+    faults.reset("replica.fail:before:7=crash,"
+                 "req.failover:before:1=raise")
+    cl = ServingCluster(model, n_replicas=3, cluster=True, **KW)
+    handles = _drive(cl, work)
+    assert cl.router.degraded >= 1      # fallback placement taken
+    _assert_zero_loss(handles, base)
+
+
+def test_req_shed_fault_degrades_to_admission(model):
+    """An injected raise at req.shed ADMITS the request instead —
+    shedding may never turn into loss."""
+    faults.reset("req.shed:before:*=raise")
+    cl = ServingCluster(model, n_replicas=2, cluster=True,
+                        max_queue=1, **KW)
+    hs = [cl.submit(np.arange(1, 9), max_new_tokens=3, rid=f"s{i}")
+          for i in range(4)]
+    assert all(h.state is not RequestState.REJECTED for h in hs)
+    assert cl.sheds == 0
+    for h in hs:
+        assert len(h.result()) == 3
+
+
+# -- overload shedding ------------------------------------------------
+
+def test_shed_overload_terminal_rejected(model):
+    """Saturating submits over the backlog bound: the overflow gets a
+    terminal REJECTED with retry_after; admitted requests finish."""
+    cl = ServingCluster(model, n_replicas=2, cluster=True,
+                        max_queue=3, **KW)
+    hs = {f"s{i}": cl.submit(np.arange(1, 7), max_new_tokens=3,
+                             rid=f"s{i}") for i in range(8)}
+    rejected = {r: h for r, h in hs.items()
+                if h.state is RequestState.REJECTED}
+    assert rejected and len(rejected) < len(hs)
+    for h in rejected.values():
+        assert h.finish_reason == "overload"
+        assert h._req.retry_after >= 1
+        with pytest.raises(RequestRejected) as ei:
+            h.result()
+        assert ei.value.retry_after >= 1
+    for r, h in hs.items():
+        if r not in rejected:
+            assert len(h.result()) == 3
+    assert cl.sheds == len(rejected)
+    # shed is terminal at submit: nothing entered any scheduler
+    assert all(cl.request(r) is None for r in rejected)
+
+
+def test_shed_deadline_unmeetable(model):
+    """Deadline-aware early rejection: a deadline the router can
+    already prove unmeetable is rejected AT SUBMIT, not discovered as
+    a truncation later; meetable deadlines are admitted and met."""
+    cl = ServingCluster(model, n_replicas=1, cluster=True,
+                        shed_deadlines=True, **KW)
+    # pile up work so the best replica's TTFT bound exceeds 1 step
+    backlog = [cl.submit(np.arange(1, 9), max_new_tokens=6,
+                         rid=f"b{i}") for i in range(4)]
+    h_bad = cl.submit(np.arange(1, 5), max_new_tokens=2, deadline=1,
+                      rid="tight")
+    assert h_bad.state is RequestState.REJECTED
+    assert h_bad.finish_reason == "deadline_unmeetable"
+    assert h_bad._req.retry_after >= 1
+    h_ok = cl.submit(np.arange(1, 5), max_new_tokens=2, deadline=100,
+                     rid="loose")
+    assert h_ok.state is not RequestState.REJECTED
+    toks = h_ok.result()
+    assert len(toks) == 2           # deadline met, not truncated
+    assert h_ok._req.finish_reason != "deadline"
+    for h in backlog:
+        h.result()
+
+
+def test_shedding_off_by_default_is_bitexact_r20(model, baseline):
+    """No max_queue, no shed_deadlines: submits are never rejected and
+    streams equal r20's (the survivability plane is inert without
+    faults)."""
+    work, base = baseline
+    cl = ServingCluster(model, n_replicas=3, cluster=True, **KW)
+    handles = _drive(cl, work)
+    assert cl.sheds == 0 and cl.failovers == 0 and cl.restarts == 0
+    _assert_zero_loss(handles, base)
+
+
+# -- satellite regressions: drain/join determinism --------------------
+
+def test_router_rechecks_admitting_at_pick_time(model):
+    """Drain-while-routing: a replica that began drain() after the
+    candidate snapshot must not win the pick."""
+    cl = ServingCluster(model, n_replicas=3, cluster=True, **KW)
+    cands = cl._admitting()
+    assert len(cands) == 3
+    # make r0 the affinity-obvious winner, then drain it mid-decision
+    prompt = np.arange(1, 9).astype(np.int32)
+    cl.drain("r0")
+    rep, _ = cl.router.pick(cands, prompt)      # stale snapshot
+    assert rep.name != "r0"
+    # random policy re-checks too
+    r = Router(policy="random", seed=0)
+    picked = {r.pick(cands, prompt)[0].name for _ in range(20)}
+    assert "r0" not in picked
+
+
+def test_double_drain_is_noop(model):
+    cl = ServingCluster(model, n_replicas=2, cluster=True, **KW)
+    h = cl.submit(np.arange(1, 9), max_new_tokens=6, rid="d0")
+    rep = cl.drain("r0")
+    drains_before = cl.drains
+    again = cl.drain("r0")          # idempotent: same object back
+    assert again is rep
+    assert cl.drains == drains_before
+    assert cl.resteered <= 1        # nothing re-steered twice
+    assert len(h.result()) == 6
+
+
+def test_drain_dead_replica_raises(model):
+    cl = ServingCluster(model, n_replicas=2, cluster=True, **KW)
+    cl.fail("r0", reason="test")
+    with pytest.raises(ValueError, match="cannot drain"):
+        cl.drain("r0")
+
+
+@pytest.mark.slow
+def test_join_while_draining_is_deterministic(model, baseline):
+    """join() mid-drain commits independently: fresh replica, the
+    draining replica untouched, zero loss."""
+    work, base = baseline
+    cl = ServingCluster(model, n_replicas=2, cluster=True, **KW)
+    pending = sorted(work, key=lambda w: (w["arrival_tick"],
+                                          w["rid"]))
+    handles, joined = {}, False
+    while pending or cl.in_flight:
+        assert cl.tick < 400
+        while pending and pending[0]["arrival_tick"] <= cl.tick:
+            w = pending.pop(0)
+            handles[w["rid"]] = cl.submit(
+                w["prompt_ids"], max_new_tokens=w["max_new_tokens"],
+                rid=w["rid"])
+        if cl.tick == 3:
+            cl.drain("r0")
+            assert cl.replica("r0").state == "draining"
+            rep = cl.join()
+            joined = True
+            assert rep is not None and rep.state == "active"
+            assert cl.replica("r0").state == "draining"  # untouched
+        cl.step()
+        _audit(cl)
+    assert joined
+    with pytest.raises(ValueError, match="role"):
+        cl.join(role="bogus")
+    _assert_zero_loss(handles, base)
+
+
+# -- journal + telemetry ----------------------------------------------
+
+def test_survivability_events_and_counters(model, baseline, tmp_path):
+    """PT_OBS=on: replica.fail / req.failover / replica.restart land
+    in the journal, cluster_failovers_total/cluster_shed_total in the
+    registry, and the /statusz survivability provider reports the
+    breaker table."""
+    from paddle_tpu import obs
+
+    work, base = baseline
+    obs.configure(mode="on", clock=obs.LogicalClock(),
+                  events_path=str(tmp_path / "events.log"))
+    try:
+        faults.reset("replica.fail:before:4=crash")
+        cl = ServingCluster(model, n_replicas=2, cluster=True,
+                            max_queue=64, **KW)
+        handles = _drive(cl, work)
+        _assert_zero_loss(handles, base)
+        cl.submit(np.arange(1, 5), max_new_tokens=2, deadline=0,
+                  rid="doomed")
+        kinds = {e["kind"] for e in obs.handle().events.events()}
+        assert "replica.fail" in kinds
+        assert "req.failover" in kinds
+        assert "replica.restart" in kinds
+        assert "req.shed" in kinds
+        text = obs.handle().registry.prometheus_text()
+        assert "cluster_failovers_total" in text
+        assert "cluster_shed_total" in text
+        sz = obs.handle().statusz["survivability"]()
+        assert sz["failovers"] == cl.failovers
+        assert sz["shed"] == 1
+        assert {r["name"] for r in sz["replicas"]} \
+            == {r.name for r in cl.replicas}
+        assert all("fail_streak" in r and "missed_beats" in r
+                   for r in sz["replicas"])
+    finally:
+        faults.reset()
+        obs.configure(mode="off")

@@ -1417,3 +1417,101 @@ def test_multi_dot_rejects_nd_middle():
 def test_multi_dot_rejects_chain_mismatch():
     with pytest.raises(InvalidArgumentError, match="adjacent"):
         paddle.linalg.multi_dot([_f32(2, 3), _f32(4, 5)])
+
+
+# -- batch 14: construction (block_diag / vander) + statistics --------
+# -- (corrcoef / cov) + in-place random fills (cauchy_ / geometric_)
+
+
+def test_block_diag_accepts_mixed_blocks():
+    out = paddle.block_diag([_f32(2, 3), _f32(2), _f32(1, 1)])
+    assert list(out.shape) == [4, 6]
+
+
+def test_block_diag_rejects_3d_block():
+    with pytest.raises(InvalidArgumentError, match="2-D"):
+        paddle.block_diag([_f32(2, 2), _f32(2, 2, 2)])
+
+
+def test_vander_accepts_vector():
+    out = paddle.vander(_f32(4), n=3)
+    assert list(out.shape) == [4, 3]
+
+
+def test_vander_rejects_matrix():
+    with pytest.raises(InvalidArgumentError, match="1-D"):
+        paddle.vander(_f32(3, 4))
+
+
+def test_vander_rejects_negative_n():
+    with pytest.raises(InvalidArgumentError, match="non-negative"):
+        paddle.vander(_f32(4), n=-1)
+
+
+def test_corrcoef_accepts_matrix():
+    out = paddle.linalg.corrcoef(_f32(3, 8))
+    assert list(out.shape) == [3, 3]
+
+
+def test_corrcoef_rejects_3d():
+    with pytest.raises(InvalidArgumentError, match="1-D or 2-D"):
+        paddle.linalg.corrcoef(_f32(2, 3, 4))
+
+
+def test_corrcoef_rejects_integer_dtype():
+    ints = paddle.to_tensor(np.arange(6, dtype=np.int64).reshape(2, 3))
+    with pytest.raises(InvalidArgumentError, match="floating"):
+        paddle.linalg.corrcoef(ints)
+
+
+def test_cov_accepts_weights():
+    fw = paddle.to_tensor(np.ones(8, np.int64))
+    out = paddle.linalg.cov(_f32(3, 8), fweights=fw)
+    assert list(out.shape) == [3, 3]
+
+
+def test_cov_rejects_3d():
+    with pytest.raises(InvalidArgumentError, match="1-D or 2-D"):
+        paddle.linalg.cov(_f32(2, 3, 4))
+
+
+def test_cov_rejects_weight_length_mismatch():
+    fw = paddle.to_tensor(np.ones(5, np.int64))
+    with pytest.raises(InvalidArgumentError, match="observations"):
+        paddle.linalg.cov(_f32(3, 8), fweights=fw)
+
+
+def test_cov_rejects_2d_weights():
+    aw = paddle.to_tensor(np.ones((2, 4), np.float32))
+    with pytest.raises(InvalidArgumentError, match="1-D"):
+        paddle.linalg.cov(_f32(3, 4), aweights=aw)
+
+
+def test_cauchy_fills_in_place():
+    t = _f32(3, 4)
+    out = t.cauchy_(loc=0.0, scale=2.0)
+    assert out is t and list(t.shape) == [3, 4]
+
+
+def test_cauchy_rejects_nonpositive_scale():
+    with pytest.raises(InvalidArgumentError, match="positive"):
+        _f32(3).cauchy_(scale=0.0)
+
+
+def test_cauchy_rejects_integer_destination():
+    ints = paddle.to_tensor(np.zeros((3,), np.int32))
+    with pytest.raises(InvalidArgumentError, match="floating"):
+        ints.cauchy_()
+
+
+def test_geometric_fills_support():
+    t = _f32(64)
+    t.geometric_(0.5)
+    assert float(t.numpy().min()) >= 1.0
+
+
+def test_geometric_rejects_probs_out_of_range():
+    with pytest.raises(InvalidArgumentError, match="open interval"):
+        _f32(3).geometric_(1.0)
+    with pytest.raises(InvalidArgumentError, match="open interval"):
+        _f32(3).geometric_(0.0)

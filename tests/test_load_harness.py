@@ -87,6 +87,7 @@ def test_load_matches_sequential_baseline(model):
         assert res["handles"][w["rid"]].tokens == want, w["rid"]
 
 
+@pytest.mark.slow
 def test_fault_under_load_keeps_engine_serviceable(model):
     """A serve.step raise mid-load is recorded by on_error='continue'
     and every request still finishes with exact tokens."""

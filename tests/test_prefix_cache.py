@@ -296,6 +296,7 @@ TIGHT_KW = dict(max_seqs=2, page_size=4, max_len=64, num_pages=11,
                 prefill_chunk=8, prefix_cache=True)
 
 
+@pytest.mark.slow
 def test_refcount_invariant_under_seeded_load(model):
     """The pool audit passes after EVERY scheduler step of a seeded
     prefix-heavy load on an undersized pool (preemption + eviction both
@@ -387,6 +388,7 @@ def test_prefix_cow_fault_leaves_engine_serviceable(model):
         check_pool_invariants(eng.executor.cache, eng.prefix)
 
 
+@pytest.mark.slow
 def test_prefix_evict_fault_leaves_engine_serviceable(model):
     """An injected raise mid-eviction (either phase) escapes the step
     with the pool consistent; the retry completes every request with

@@ -165,7 +165,12 @@ def test_none_mode_is_legacy_path(model):
 # -- PT_QUANT=none bit-parity under load --------------------------------
 
 
-@pytest.mark.parametrize("variant", ["plain", "prefix", "spec", "async"])
+@pytest.mark.parametrize("variant", [
+    "plain",
+    pytest.param("prefix", marks=pytest.mark.slow),
+    pytest.param("spec", marks=pytest.mark.slow),
+    pytest.param("async", marks=pytest.mark.slow),
+])
 def test_none_load_parity(model, variant, monkeypatch):
     """The acceptance-criteria run: the seeded load on an undersized
     pool emits bit-identical PER-STEP maps with PT_QUANT=none set via
@@ -190,7 +195,12 @@ def test_none_load_parity(model, variant, monkeypatch):
 # -- int8 under load ----------------------------------------------------
 
 
-@pytest.mark.parametrize("variant", ["plain", "prefix", "spec", "async"])
+@pytest.mark.parametrize("variant", [
+    pytest.param("plain", marks=pytest.mark.slow),
+    pytest.param("prefix", marks=pytest.mark.slow),
+    "spec",
+    pytest.param("async", marks=pytest.mark.slow),
+])
 def test_int8_load_drains_with_invariants(model, variant):
     """The int8 engine drains the same seeded loads — preemption,
     prefix COW/eviction, spec windows with rollback, async double
@@ -266,6 +276,7 @@ def test_cow_copies_quantized_page_with_scale(model):
 # -- AOT warmup over the int8 pool --------------------------------------
 
 
+@pytest.mark.slow
 def test_aot_warmup_covers_int8_pool(model, tmp_path):
     """aot='warm' over a quantized build: every (program x rung) entry
     compiles against the (pages, scales) pool signature, nothing
@@ -290,7 +301,10 @@ def test_aot_warmup_covers_int8_pool(model, tmp_path):
 # -- fault matrix -------------------------------------------------------
 
 
-@pytest.mark.parametrize("phase", ["before", "after"])
+@pytest.mark.parametrize("phase", [
+    pytest.param("before", marks=pytest.mark.slow),
+    "after",
+])
 def test_quant_pack_fault_fails_the_build(model, phase):
     """quant.pack fires during weight quantization at engine BUILD: the
     constructor raises (no half-quantized engine escapes), and a fresh
@@ -305,8 +319,12 @@ def test_quant_pack_fault_fails_the_build(model, phase):
     assert eng.submit(PROMPT, max_new_tokens=8).result() == want
 
 
-@pytest.mark.parametrize("point", ["quant.kv_write", "quant.dequant"])
-@pytest.mark.parametrize("phase", ["before", "after"])
+@pytest.mark.parametrize("phase,point", [
+    ("before", "quant.kv_write"),
+    pytest.param("after", "quant.kv_write", marks=pytest.mark.slow),
+    pytest.param("before", "quant.dequant", marks=pytest.mark.slow),
+    pytest.param("after", "quant.dequant", marks=pytest.mark.slow),
+])
 def test_quant_fault_confined_to_one_request(model, point, phase):
     """An injected raise at the host-side quantized page write or the
     dequantizing gather lands inside the per-request bracket: the hit

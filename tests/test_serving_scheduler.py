@@ -117,9 +117,16 @@ def test_cancellation_mid_decode(model):
     assert eng.executor.free_slots == 2 and eng.in_flight == 0
 
 
-@pytest.mark.parametrize("point", ["serve.step", "serve.admit",
-                                   "serve.decode", "serve.request"])
-@pytest.mark.parametrize("phase", ["before", "after"])
+@pytest.mark.parametrize("phase,point", [
+    ("before", "serve.step"),
+    ("after", "serve.request"),
+    pytest.param("before", "serve.admit", marks=pytest.mark.slow),
+    pytest.param("before", "serve.decode", marks=pytest.mark.slow),
+    pytest.param("before", "serve.request", marks=pytest.mark.slow),
+    pytest.param("after", "serve.step", marks=pytest.mark.slow),
+    pytest.param("after", "serve.admit", marks=pytest.mark.slow),
+    pytest.param("after", "serve.decode", marks=pytest.mark.slow),
+])
 def test_crash_at_every_serve_point_leaves_engine_serviceable(
         model, point, phase):
     """An injected raise at ANY serve.* site must leave the engine able
@@ -242,6 +249,7 @@ def test_streaming_callback_and_iterator(model):
     assert all(rid == h.rid for rid, _ in seen)
 
 
+@pytest.mark.slow
 def test_stats_expose_slo_fields(model):
     prompts = _prompts(9, (7, 13))
     eng = ServingEngine(model, prefill_chunk=4, **ENGINE_KW)

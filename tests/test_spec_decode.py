@@ -237,6 +237,7 @@ def _drive_load(model, spec, engine_kw, check_invariants=False,
     return eng, work, handles, errors
 
 
+@pytest.mark.slow
 def test_spec_under_load_with_preemption_eviction_prefix(model):
     """The acceptance-criteria run: seeded load on an undersized pool
     with the prefix cache on and ngram drafting on — preemption,

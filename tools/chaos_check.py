@@ -1,0 +1,174 @@
+"""make chaos-check — fleet survivability smoke on CPU.
+
+Runs the survivability plane end to end under PT_OBS: a three-replica
+``ServingCluster`` serving a seeded burst takes an injected replica
+crash mid-load (failover + auto-restart), then a PT_CHAOS-style seeded
+schedule over every registered fault point, then saturating submits
+against a bounded queue (overload shedding).  Asserts the contract:
+zero request loss with streams bit-identical to a fault-free
+single-engine baseline, the crashed replica restarts and rejoins, shed
+requests end REJECTED with a retry-after hint (never silently
+dropped), and the failure/shed/restart telemetry lands in the journal,
+the Prometheus exposition, and ``/statusz``.
+
+Exits non-zero naming every violated check — wired into ``make smoke``.
+"""
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+
+FAILURES = []
+
+
+def check(ok, what):
+    print(f"  {'ok' if ok else 'FAIL'}: {what}")
+    if not ok:
+        FAILURES.append(what)
+
+
+def _drive(cl, work, faults, max_steps=600):
+    pending = sorted(work, key=lambda w: (w["arrival_tick"], w["rid"]))
+    handles = {}
+    while pending or cl.in_flight:
+        if cl.tick >= max_steps:
+            raise RuntimeError("chaos load did not drain")
+        while pending and pending[0]["arrival_tick"] <= cl.tick:
+            w = pending.pop(0)
+            handles[w["rid"]] = cl.submit(
+                w["prompt_ids"], max_new_tokens=w["max_new_tokens"],
+                priority=w["priority"], rid=w["rid"])
+        try:
+            cl.step()
+        except faults.InjectedFault:
+            pass    # raise-action chaos escaping a step is survivable
+    return handles
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu import obs
+    from paddle_tpu.inference.server import (RequestRejected,
+                                             RequestState,
+                                             ServingCluster,
+                                             ServingEngine)
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.obs import health
+    from paddle_tpu.testing import faults
+    from paddle_tpu.testing.load import LoadSpec, generate_load
+
+    tmp = tempfile.mkdtemp(prefix="pt-chaos-")
+    journal = os.path.join(tmp, "events.jsonl")
+    h = obs.configure(mode="on", clock=obs.LogicalClock(),
+                      events_path=journal)
+
+    paddle.seed(11)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    kw = dict(max_seqs=2, page_size=4, max_len=64, prefill_chunk=8)
+    work = generate_load(LoadSpec(
+        n_requests=8, mean_interarrival=1.0, prompt_len=(4, 14),
+        max_new=(4, 8), vocab=256, seed=3))
+
+    print("== fault-free baseline ==")
+    eng = ServingEngine(model, **kw)
+    base = {w["rid"]: eng.submit(w["prompt_ids"],
+                                 max_new_tokens=w["max_new_tokens"],
+                                 rid=w["rid"]).result()
+            for w in sorted(work, key=lambda w: w["rid"])}
+    check(all(base.values()), "baseline streams generated")
+
+    print("== replica crash mid-load ==")
+    faults.reset("replica.fail:before:7=crash")
+    cl = ServingCluster(model, n_replicas=3, cluster=True, **kw)
+    handles = _drive(cl, work, faults)
+    faults.reset()
+    check(all(handles[r].tokens == base[r] for r in base),
+          "streams bit-identical through the crash")
+    check(cl.failovers > 0, "in-flight requests failed over")
+    check(cl.restarts == 1, "crashed replica auto-restarted")
+    check(all(r.state == "active" for r in cl.replicas),
+          "whole fleet active again")
+    # snapshot /statusz NOW: each cluster registers the provider, so a
+    # later cluster's registration would shadow this one's restart
+    sz = health.statusz_payload(h)
+
+    print("== seeded chaos schedule ==")
+    specs = faults.chaos_schedule(17, steps=48)
+    check(specs == faults.chaos_schedule(17, steps=48),
+          "chaos schedule deterministic per seed")
+    faults.reset(",".join(specs))
+    cl2 = ServingCluster(model, n_replicas=3, cluster=True, **kw)
+    handles2 = _drive(cl2, work, faults)
+    faults.reset()
+    check(all(handles2[r].tokens == base[r] for r in base),
+          "streams bit-identical through the chaos schedule")
+    check(cl2.in_flight == 0 and not cl2._orphans,
+          "chaos run drained clean (no orphans)")
+
+    print("== overload shedding ==")
+    cl3 = ServingCluster(model, n_replicas=2, cluster=True,
+                         max_queue=2, **kw)
+    hs = [cl3.submit(np.arange(1, 9), max_new_tokens=3, rid=f"s{i}")
+          for i in range(8)]
+    shed = [x for x in hs if x.state is RequestState.REJECTED]
+    check(cl3.sheds > 0 and len(shed) == cl3.sheds,
+          "overflow shed with terminal REJECTED (never silent)")
+    check(all(x.metrics()["retry_after"] >= 1 for x in shed),
+          "shed requests carry a retry-after hint")
+    try:
+        shed[0].result()
+        check(False, "shed result() raises RequestRejected")
+    except RequestRejected as e:
+        check(e.reason == "overload", "shed result() raises RequestRejected")
+    admitted = [x for x in hs if x.state is not RequestState.REJECTED]
+    check(all(len(x.result()) == 3 for x in admitted),
+          "admitted requests finish under shedding")
+
+    print("== telemetry ==")
+    prom = h.registry.prometheus_text()
+    for fam in ("cluster_failovers_total", "cluster_shed_total",
+                "cluster_orphan_requests"):
+        check(fam in prom, f"metric family {fam}")
+    kinds = {e["kind"] for e in h.events.events()}
+    for kind in ("replica.fail", "replica.restart", "req.failover",
+                 "req.shed"):
+        check(kind in kinds, f"{kind} journaled")
+    evs = [json.loads(ln) for ln in open(journal)]
+    check(any(e["kind"] == "replica.fail" for e in evs),
+          "failure events reached the on-disk journal")
+
+    sv = sz["providers"].get("survivability", {})
+    for key in ("tick", "policy", "admission", "failovers", "shed",
+                "orphans", "restarts", "retired", "replicas"):
+        check(key in sv, f"/statusz survivability key {key}")
+    check(sv.get("restarts", {}).get("done", 0) >= 1,
+          "/statusz counts the restart")
+    rows = {r["name"]: r for r in sv.get("replicas", [])}
+    check("r0" in rows and "last_beat" in rows.get("r0", {}),
+          "/statusz replica table carries heartbeat ages")
+
+    obs.reset()
+    if FAILURES:
+        print(f"\nchaos-check: {len(FAILURES)} check(s) FAILED")
+        for f in FAILURES:
+            print(f"  - {f}")
+        return 1
+    print(f"\nchaos-check: all checks passed "
+          f"({len(evs)} journal events)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

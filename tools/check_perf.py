@@ -59,7 +59,10 @@ METRICS = (
     # bytes is analytic (layout-derived) and must not drift; the int8
     # leg must keep serving throughput and its logit-accuracy bound
     ("serving.quant.occupancy_ratio", "higher", 0.05),
-    ("serving.quant.int8.serving_tok_s", "higher", 0.10),
+    # wall-clock CPU serving tok/s swings hard across bench hosts
+    # (r9->r10 recorded +298% on this row with no quant change): gate
+    # only collapses, not host drift
+    ("serving.quant.int8.serving_tok_s", "higher", 0.25),
     ("serving.quant.logit_drift_rel_rms", "lower", 0.50),
     # multi-replica fleet (r20): logical-clock aggregate throughput
     # must keep scaling with N, affinity routing must keep beating
@@ -70,6 +73,13 @@ METRICS = (
     ("serving.cluster.affinity_tok_ratio", "higher", 0.10),
     ("serving.cluster.hit_rate_delta", "higher", 0.25),
     ("serving.cluster.ttft_steps_p99_n4", "lower", 0.25),
+    # fleet survivability (r21): killing 1 of 4 replicas mid-load must
+    # keep retaining throughput through the incident, the restarted
+    # replica must keep rejoining promptly, and the TTFT tax paid by
+    # failed-over requests must not balloon
+    ("serving.cluster_failover.value", "higher", 0.10),
+    ("serving.cluster_failover.recovery_steps", "lower", 0.50),
+    ("serving.cluster_failover.failover_ttft_tax_mean", "lower", 0.50),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

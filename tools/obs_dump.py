@@ -299,6 +299,41 @@ def main():
     check([r["role"] for r in cz.get("replicas", [])]
           == ["prefill", "decode"], "/statusz replica roles")
 
+    # -- 9. survivability plane: fail/shed telemetry + /statusz ----------
+    print("== survivability plane ==")
+    from paddle_tpu.testing import faults
+    faults.reset("replica.fail:before:5=crash")
+    cl9 = ServingCluster(model, n_replicas=2, cluster=True, max_seqs=2,
+                         page_size=4, max_len=64, max_queue=2, slos=[])
+    h9 = [cl9.submit(rng.randint(1, 256, (n,)).astype(np.int32),
+                     max_new_tokens=6, rid=f"sv{i}")
+          for i, n in enumerate((6, 10, 14, 8, 12, 7))]
+    cl9.run()
+    faults.reset()
+    check(all(hd.state in (RequestState.FINISHED, RequestState.REJECTED)
+              for hd in h9), "fleet drained through crash + shedding")
+    check(cl9.failovers > 0 and cl9.sheds > 0,
+          "crash failed requests over AND the backlog shed")
+    prom = h.registry.prometheus_text()
+    for fam in ("cluster_failovers_total", "cluster_shed_total",
+                "cluster_orphan_requests"):
+        check(fam in prom, f"family {fam}")
+    ev_kinds = {e["kind"] for e in h.events.events()}
+    for kind in ("replica.fail", "req.failover", "req.shed",
+                 "replica.restart"):
+        check(kind in ev_kinds, f"{kind} journaled")
+    sz = health.statusz_payload(h)
+    sv = sz["providers"].get("survivability", {})
+    for key in ("tick", "policy", "admission", "failovers", "shed",
+                "orphans", "restarts", "retired", "replicas"):
+        check(key in sv, f"/statusz survivability key {key}")
+    check(sv.get("admission", {}).get("max_queue") == 2,
+          "/statusz admission shows the backlog bound")
+    for row in sv.get("replicas", []):
+        check({"name", "state", "hung", "last_beat", "missed_beats",
+               "fails", "fail_streak", "restarts"} <= set(row),
+              f"survivability row schema for {row.get('name')}")
+
     if FAILURES:
         print(f"\nobs-check: {len(FAILURES)} check(s) FAILED")
         for f in FAILURES:
