@@ -4,8 +4,8 @@
 PY ?= python
 
 .PHONY: smoke test test-fast verify-fast lint-graph obs-check \
-	health-check aot-check cluster-check chaos-check perf-report \
-	perf-check bench
+	health-check aot-check cluster-check chaos-check \
+	durability-check perf-report perf-check bench
 
 # <3 min sanity gate: import + one eager op, one jitted llama forward
 # step (the driver's entry()), and a 2-virtual-device multichip train
@@ -50,12 +50,14 @@ smoke:
 		tests/test_aot.py \
 		tests/test_quant.py \
 		tests/test_cluster.py \
-		tests/test_chaos.py
+		tests/test_chaos.py \
+		tests/test_durability.py
 	$(MAKE) obs-check
 	$(MAKE) health-check
 	$(MAKE) aot-check
 	$(MAKE) cluster-check
 	$(MAKE) chaos-check
+	$(MAKE) durability-check
 
 # Fast lane — must be green before any snapshot commit (see README).
 test-fast:
@@ -107,6 +109,12 @@ cluster-check:
 # retry-after shedding, and the fail/restart/shed telemetry contract.
 chaos-check:
 	JAX_PLATFORMS=cpu $(PY) tools/chaos_check.py
+
+# Durable-serving end-to-end smoke: WAL journal roundtrip, a real
+# subprocess SIGKILLed mid-load and recovered zero-loss/bit-identical,
+# hung-replica KV-page salvage, and the durability telemetry contract.
+durability-check:
+	JAX_PLATFORMS=cpu $(PY) tools/durability_check.py
 
 # Per-program roofline table: analytical cost (FLOPs / HBM bytes /
 # intensity from the jaxpr cost model) vs achieved wall time for every

@@ -15,6 +15,10 @@ Layering (host control plane / device data plane):
     EngineMetrics (metrics.py) TTFT/TPOT/queue-wait/occupancy SLOs
     PagedExecutor (executor.py) jit'd prefill/chunk/decode forwards
                                 over paged.PagedKVCache slots
+  WriteAheadLog (wal.py)     durable request journal: crc32-framed
+                             lifecycle records feeding
+                             ServingCluster.recover (whole-process
+                             crash recovery, bit-identical streams)
 """
 from .cluster import (Replica, ReplicaSupervisor, Router,
                       ServingCluster)
@@ -26,6 +30,7 @@ from .request import (Request, RequestHandle, RequestRejected,
                       RequestState, TERMINAL)
 from .scheduler import Scheduler
 from .spec_decode import NGramProposer, SpecDecode, spec_mode
+from .wal import WriteAheadLog, replay, stream_crc, wal_enabled
 
 __all__ = [
     "ServingEngine", "PagedExecutor", "EngineMetrics", "Request",
@@ -34,4 +39,5 @@ __all__ = [
     "NGramProposer", "SpecDecode", "spec_mode",
     "ServingCluster", "Router", "Replica", "ReplicaSupervisor",
     "RequestRejected",
+    "WriteAheadLog", "replay", "stream_crc", "wal_enabled",
 ]

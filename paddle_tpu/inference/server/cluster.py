@@ -54,12 +54,15 @@ from __future__ import annotations
 
 import os
 import time
+import zlib
 
 import numpy as np
 
 from ... import obs
 from ...testing import faults
+from . import wal as wal_mod
 from .engine import ServingEngine
+from .wal import resolve_wal, stream_crc
 from .request import (Request, RequestHandle, RequestRejected,
                       RequestState)
 
@@ -285,6 +288,13 @@ class ReplicaSupervisor:
         cl = self.cluster
         if rep.state in ("failed", "restarting", "retired", "drained"):
             return
+        # a HUNG replica stopped stepping but its engine is intact:
+        # the page pool is still readable, so running requests can be
+        # salvaged (KV pages migrated) instead of re-prefilled.  A
+        # crashed/raising replica's engine is garbage — capture the
+        # distinction BEFORE the hung flag is cleared below.
+        salvageable = cl.salvage and (
+            rep.hung or reason in ("missed_beats", "watchdog"))
         in_flight = rep.engine.in_flight
         rep.state = "failed"
         rep.hung = False
@@ -319,6 +329,10 @@ class ReplicaSupervisor:
                 except Exception:
                     pass    # dead engine's draft state is garbage too
             cl._owner.pop(req.rid, None)
+            if salvageable and req.state is RequestState.RUNNING \
+                    and req.sid is not None \
+                    and self._salvage(req, rep):
+                continue
             self._failover(req, rep)
         # schedule the restart — or trip the breaker.
         if rep.fail_streak > self.restart_budget:
@@ -358,6 +372,105 @@ class ReplicaSupervisor:
                     "req.failover", rid=req.rid, src=src.name,
                     dst=None, orphaned=1,
                     tokens_done=len(req.generated), tick=cl._tick)
+
+    def _salvage(self, req, src) -> bool:
+        """Migrate one RUNNING request's committed KV pages off a hung
+        replica onto an admitting one through the dense gather→write
+        handoff path, skipping the re-prefill entirely: decoding
+        resumes from the same last token over the same pages, so the
+        stream continues bit-identically at recompute-free cost.
+
+        The copy is crc32-verified end to end (gather source → land →
+        re-gather target); any mismatch, capacity shortfall, injected
+        ``kv.salvage`` raise, or unreadable source degrades to False —
+        the caller falls back to the recompute failover, never loss."""
+        cl = self.cluster
+        src_ex = src.engine.executor
+        try:
+            length = int(src_ex.cache.lengths[req.sid])
+        except Exception:
+            return False
+        if length <= 0:
+            return False
+        dst = None
+        for cand in sorted(
+                (r for r in cl._admitting() if r is not src),
+                key=lambda r: (r.depth, -r.engine.executor.free_pages)):
+            ex = cand.engine.executor
+            if ex.free_slots >= 1 \
+                    and ex.free_pages >= ex.pages_for(length + 1):
+                dst = cand
+                break
+        if dst is None:
+            return False
+        try:
+            faults.fire("kv.salvage", "before")
+            k, v = src_ex.cache.gather_dense(req.sid, length)
+        except Exception:
+            cl.salvages_failed += 1
+            return False
+        # gather_dense pads to the page-multiple cover: positions >=
+        # length are garbage and must never enter the checksum
+        k = np.asarray(k)[:, :, :length]
+        v = np.asarray(v)[:, :, :length]
+        crc = zlib.crc32(v.tobytes(), zlib.crc32(k.tobytes()))
+        if faults.poll("kv.salvage") is not None:
+            # injected in-flight corruption: the verify MUST catch it
+            k = k.copy()
+            k.flat[k.size // 2] = k.flat[k.size // 2] + 1
+        dst_ex = dst.engine.executor
+        dst_sid = dst_ex.alloc_slot()
+        crc_got = None
+        try:
+            dst_ex.cache.write_at(dst_sid, k, v, 0)
+            k2, v2 = dst_ex.cache.gather_dense(dst_sid, length)
+            k2 = np.asarray(k2)[:, :, :length]
+            v2 = np.asarray(v2)[:, :, :length]
+            crc_got = zlib.crc32(v2.tobytes(), zlib.crc32(k2.tobytes()))
+        except Exception:
+            pass
+        if crc_got != crc:
+            # corrupt copy: give the pages back, recompute instead
+            dst_ex.free_slot(dst_sid)
+            cl.salvages_failed += 1
+            if cl._obs is not None:
+                cl._obs.events.log(
+                    "kv.salvage", rid=req.rid, src=src.name,
+                    dst=dst.name, ok=0, crc_ok=0, tokens=length,
+                    tick=cl._tick)
+            return False
+        dst_ex.last_token[dst_sid] = src_ex.last_token[req.sid]
+        try:
+            faults.fire("kv.salvage", "after")
+        except faults.InjectedFault:
+            pass            # pages landed verified: the salvage commits
+        req.sid = dst_sid
+        dst_sch = dst.engine.scheduler
+        dst_sch.requests[req.rid] = req
+        dst_sch.running.append(req)
+        dst_sch._pending = None     # stale async plan must replan
+        if dst_sch.spec is not None:
+            dst_sch.spec.on_running(req)
+        cl._owner[req.rid] = dst
+        cl.failovers += 1           # a salvage IS a (cheap) failover
+        cl.salvages += 1
+        pages = int((dst_ex.cache.page_table[dst_sid] >= 0).sum())
+        cl.salvaged_pages += pages
+        if cl._obs is not None:
+            cl._obs.registry.counter(
+                "cluster_failovers_total",
+                "Requests failed over off a dead replica").inc()
+            cl._obs.registry.counter(
+                "kv_pages_salvaged_total",
+                "KV pages migrated off hung replicas").inc(pages)
+            cl._obs.events.log(
+                "kv.salvage", rid=req.rid, src=src.name, dst=dst.name,
+                ok=1, crc_ok=1, tokens=length, pages=pages,
+                tick=cl._tick)
+            cl._obs.tracer.instant(
+                "kv.salvage", cat="cluster", trace_id=req.rid,
+                src=src.name, dst=dst.name, tokens=length, pages=pages)
+        return True
 
     def _place(self, req, src=None) -> bool:
         """Route one failed-over request onto an admitting replica;
@@ -493,8 +606,8 @@ class ServingCluster:
                  disaggregated=False, n_prefill=None, clock=None,
                  compile_cache=None, beat_timeout=3, watchdog_s=None,
                  auto_restart=True, restart_budget=3, backoff_base=2,
-                 max_queue=None, shed_deadlines=None,
-                 **engine_kwargs):
+                 max_queue=None, shed_deadlines=None, wal=None,
+                 salvage=True, **engine_kwargs):
         if cluster is None:
             cluster = _cluster_enabled()
         self.enabled = bool(cluster)
@@ -510,6 +623,19 @@ class ServingCluster:
         self.model = model
         self.disaggregated = bool(disaggregated)
         self._engine_kwargs = dict(engine_kwargs)
+        # durable serving: ONE write-ahead journal shared by the whole
+        # fleet (wal: None = follow PT_WAL, default off/bit-exact;
+        # a path or WriteAheadLog forces on).  The cluster resolves
+        # the gate once and pins the engines to its decision — two
+        # engines must never race separate writers onto one journal
+        # directory.
+        self.wal = resolve_wal(wal)
+        self._engine_kwargs["wal"] = (self.wal if self.wal is not None
+                                      else False)
+        # salvage: migrate a HUNG victim's committed KV pages to the
+        # failover target instead of re-prefilling (crash victims
+        # still recompute — a crashed engine's pool is garbage)
+        self.salvage = bool(salvage)
         self._clock = clock
         # one persistent compile cache shared by the whole fleet when
         # AOT is in play: join() re-warms a fresh replica from disk
@@ -560,7 +686,15 @@ class ServingCluster:
         self.restarts = 0
         self.restarts_failed = 0
         self.retired = 0
+        self.salvages = 0           # hung-replica KV-page migrations
+        self.salvages_failed = 0    # fell back to recompute
+        self.salvaged_pages = 0
+        self.dedup_hits = 0         # duplicate submits deduplicated
         self._orphans: list = []    # failed-over, awaiting a home
+        self._served: dict = {}     # rid -> terminal Request restored
+        #                             from the WAL (served from the log)
+        self.recovery = None        # report dict set by recover()
+        self.recovered_handles = {}  # rid -> handle, set by recover()
         self._obs = obs.handle()
         n_pre = 0
         if self.disaggregated:
@@ -579,14 +713,20 @@ class ServingCluster:
             self._obs.statusz["cluster"] = self._statusz
             self._obs.statusz["survivability"] = \
                 self._survivability_statusz
+            self._obs.statusz["durability"] = self._durability_statusz
 
     def _build_engine(self) -> ServingEngine:
         """One replica engine, AOT-warmed (when on) from the fleet's
         shared persistent compile cache — the join() AND restart
         rebuild path."""
-        return ServingEngine(self.model, clock=self._clock,
-                             compile_cache=self._compile_cache,
-                             **self._engine_kwargs)
+        eng = ServingEngine(self.model, clock=self._clock,
+                            compile_cache=self._compile_cache,
+                            **self._engine_kwargs)
+        # a fresh engine (restart/join) registers its engine-scoped
+        # durability provider; the fleet-level view stays authoritative
+        if self._obs is not None:
+            self._obs.statusz["durability"] = self._durability_statusz
+        return eng
 
     def _build_replica(self, role="mixed") -> Replica:
         name = f"r{self._n_built}"
@@ -655,8 +795,20 @@ class ServingCluster:
         replica), so it stays live across re-steers and handoffs."""
         if rid is None:
             rid = f"req-{self._next_rid}"
-        if rid in self._owner:
-            raise ValueError(f"duplicate request id {rid!r}")
+        known = self._known(rid)
+        if known is not None:
+            # idempotent duplicate submit: at-least-once clients get
+            # the ORIGINAL request back (live, orphaned, or terminal —
+            # including streams recovered from the WAL), never a
+            # second stream; the dedup is journaled.
+            self.dedup_hits += 1
+            if self.wal is not None:
+                self.wal.append({"t": "dedup", "rid": rid})
+            if self._obs is not None:
+                self._obs.events.log("req.dedup", rid=rid,
+                                     state=known.state.value,
+                                     tick=self._tick)
+            return RequestHandle(self, known)
         self._next_rid += 1
         verdict = self._shed_verdict(deadline)
         if verdict is not None:
@@ -678,6 +830,14 @@ class ServingCluster:
             if req.max_new_tokens < 1:
                 raise ValueError("max_new_tokens must be >= 1")
             self._orphans.append(req)
+            if self.wal is not None:
+                # parked submits are accepted work: journal them like
+                # any other so a crash while orphaned still recovers
+                self.wal.append({
+                    "t": "submit", "rid": rid,
+                    "prompt": req.prompt_ids.tolist(),
+                    "max_new": req.max_new_tokens,
+                    "prio": req.priority, "deadline": req.deadline})
             if self._obs is not None:
                 self._obs.events.log("req.parked", rid=rid,
                                      tick=self._tick)
@@ -742,6 +902,12 @@ class ServingCluster:
         req.retry_after = int(retry_after)
         req.error = RequestRejected(rid, reason, retry_after)
         self.sheds += 1
+        # NOT added to the dedup set: a retry_after verdict is an
+        # invitation to resubmit the same rid after backing off
+        if self.wal is not None:
+            self.wal.append({"t": "reject", "rid": rid,
+                             "reason": reason,
+                             "retry_after": int(retry_after)})
         if self._obs is not None:
             self._obs.registry.counter(
                 "cluster_shed_total",
@@ -766,8 +932,24 @@ class ServingCluster:
                 req.cancel_flag = True
 
     def request(self, rid):
+        return self._known(rid)
+
+    def _known(self, rid):
+        """The live/terminal Request for ``rid`` wherever it lives —
+        its owning replica, the WAL-restored terminal set, or the
+        orphan list — else None."""
         rep = self._owner.get(rid)
-        return None if rep is None else rep.engine.request(rid)
+        if rep is not None:
+            req = rep.engine.request(rid)
+            if req is not None:
+                return req
+        req = self._served.get(rid)
+        if req is not None:
+            return req
+        for req in self._orphans:
+            if req.rid == rid:
+                return req
+        return None
 
     # -- driving ---------------------------------------------------------
 
@@ -1096,6 +1278,21 @@ class ServingCluster:
             ],
         }
 
+    def _durability_statusz(self) -> dict:
+        """/statusz provider: WAL segment/fsync state, dedup hits,
+        salvage counters and the last recovery report."""
+        return {
+            "wal": None if self.wal is None else self.wal.statusz(),
+            "dedup_hits": self.dedup_hits,
+            "salvage": {
+                "enabled": self.salvage,
+                "done": self.salvages,
+                "failed": self.salvages_failed,
+                "pages": self.salvaged_pages,
+            },
+            "recovery": self.recovery,
+        }
+
     def _survivability_statusz(self) -> dict:
         """/statusz provider: supervisor policy, recovery counters,
         and the per-replica breaker table."""
@@ -1169,5 +1366,135 @@ class ServingCluster:
             "restarts": self.restarts,
             "restarts_failed": self.restarts_failed,
             "retired": self.retired,
+            "salvages": self.salvages,
+            "salvages_failed": self.salvages_failed,
+            "salvaged_pages": self.salvaged_pages,
+            "dedup_hits": self.dedup_hits,
+            "wal_appended": (0 if self.wal is None
+                             else self.wal.appended),
             "per_replica": per,
         }
+
+    # -- whole-process crash recovery -----------------------------------
+
+    @classmethod
+    def recover(cls, model, wal_dir, **kwargs) -> "ServingCluster":
+        """Rebuild a serving fleet from its write-ahead journal after
+        a whole-process crash (SIGKILL included).
+
+        Replays the journal (torn tails truncated, corrupt records
+        skipped and counted), rebuilds the cluster — AOT re-warmed
+        from the persistent compile cache when configured, so a warmed
+        cache means zero fresh compiles — and then settles every
+        journaled request into exactly one of:
+
+        - **served from the log**: a finish record whose token count
+          and crc32 match the replayed stream (or a reject record)
+          restores the terminal request verbatim — no recompute;
+        - **resubmitted**: anything in flight at the crash (or whose
+          tail records were torn/corrupt) re-enters through the
+          preemption-recompute idiom — prompt + replayed tokens
+          re-prefill and decoding resumes, so the final stream is
+          bit-identical to an uninterrupted run.
+
+        Journaling continues into the same directory (a fresh
+        segment), so recovery is itself crash-safe and repeatable.
+        Client resubmits of any journaled rid dedupe to the restored
+        request (at-least-once submission, exactly-once result).
+        ``cluster.recovery`` holds the report; ``recovered_handles``
+        maps every journaled rid to a live handle.  Deadlines are not
+        reconstructed — the logical clock restarted.
+        """
+        records, report = wal_mod.replay(wal_dir)
+        cl = cls(model, wal=wal_dir, **kwargs)
+        by: dict = {}
+        order: list = []
+        for rec in records:
+            t, rid = rec.get("t"), rec.get("rid")
+            if rid is None:
+                continue
+            e = by.get(rid)
+            if e is None:
+                e = by[rid] = {"tokens": []}
+                order.append(rid)
+            if t == "submit" and "submit" not in e:
+                e["submit"] = rec    # at-least-once: first write wins
+            elif t == "token":
+                # only the contiguous-from-zero prefix is trustworthy:
+                # a corrupt interior token record leaves a gap, and a
+                # token past a gap must be recomputed, not replayed (a
+                # later incarnation's re-journaled tokens re-extend the
+                # prefix exactly where the verified copy ends)
+                if int(rec.get("i", len(e["tokens"]))) == len(e["tokens"]):
+                    e["tokens"].append(int(rec["tok"]))
+            elif t == "finish":
+                e["finish"] = rec
+            elif t == "reject":
+                e["reject"] = rec
+        served = resubmitted = 0
+        cl.recovered_handles = {}
+        for seq, rid in enumerate(order):
+            e = by[rid]
+            sub = e.get("submit")
+            if sub is None:
+                # lifecycle records without a submit record (its line
+                # was corrupt): there is no prompt to recompute from —
+                # surface it in the report, the client's at-least-once
+                # resubmit serves it fresh
+                report["corrupt"] += 1
+                continue
+            req = Request(rid, np.asarray(sub["prompt"], np.int32),
+                          max_new_tokens=sub["max_new"],
+                          priority=sub.get("prio", 0),
+                          arrival_seq=seq)
+            req.recovered = True
+            fin, rej, toks = e.get("finish"), e.get("reject"), e["tokens"]
+            if rej is not None:
+                req.state = RequestState.REJECTED
+                req.finish_reason = rej["reason"]
+                req.retry_after = int(rej["retry_after"])
+                req.error = RequestRejected(rid, rej["reason"],
+                                            rej["retry_after"])
+                cl._served[rid] = req
+                served += 1
+            elif fin is not None and fin["n"] == len(toks) \
+                    and fin["crc"] == stream_crc(toks):
+                # the journaled stream is provably complete: serve it
+                # straight from the log, zero recompute
+                req.generated = list(toks)
+                req.state = RequestState(fin["state"])
+                req.finish_reason = fin["reason"]
+                if req.state is RequestState.FAILED:
+                    req.error = RuntimeError(fin["reason"])
+                cl._served[rid] = req
+                served += 1
+            else:
+                # in flight at the crash (or its finish/token records
+                # were torn): the preemption-recompute idiom resumes
+                # it bit-identically after the replayed prefix
+                req.generated = list(toks)
+                req.resume_ids = np.concatenate(
+                    [req.prompt_ids,
+                     np.asarray(toks, np.int32)]).astype(np.int32)
+                req.prefill_done = 0
+                req.state = RequestState.QUEUED
+                if not cl.supervisor._place(req):
+                    cl._orphans.append(req)
+                resubmitted += 1
+            cl.recovered_handles[rid] = RequestHandle(cl, req)
+        cl.recovery = {
+            "segments": report["segments"],
+            "records": report["records"],
+            "corrupt": report["corrupt"],
+            "torn_bytes": report["torn_bytes"],
+            "served_from_log": served,
+            "resubmitted": resubmitted,
+            "orphaned": len(cl._orphans),
+        }
+        if cl.wal is not None:
+            cl.wal.append({"t": "recover", **cl.recovery})
+            cl.wal.fsync()
+        if cl._obs is not None:
+            cl._obs.events.log("wal.replay", dir=os.fspath(wal_dir),
+                               **cl.recovery)
+        return cl

@@ -24,6 +24,7 @@ from .prefix_cache import PrefixCache
 from .request import Request, RequestHandle, RequestState
 from .scheduler import Scheduler
 from .spec_decode import SpecDecode, spec_mode
+from .wal import resolve_wal
 
 
 def _prefix_cache_enabled() -> bool:
@@ -49,7 +50,8 @@ class ServingEngine:
                  max_preemptions=4, prefix_cache=None,
                  spec_decode=None, clock=None, slos=None,
                  slo_rules=None, async_exec=None, aot=None,
-                 compile_cache=None, decode_n_steps=(), quant=None):
+                 compile_cache=None, decode_n_steps=(), quant=None,
+                 wal=None):
         # quant: None = follow PT_QUANT (default none, bit-exact legacy
         # path); "none"/"int8" force it (bench A/B).  int8 = per-channel
         # int8 projection weights + per-page int8 KV pools.
@@ -94,11 +96,17 @@ class ServingEngine:
         # planning overlapped behind the device, commit at the fence.
         if async_exec is None:
             async_exec = _async_exec_enabled()
+        # wal: None = follow PT_WAL (default off, bit-exact legacy
+        # path); False forces off (a cluster passes its own shared
+        # journal or False so engines never double-resolve the env);
+        # a path/WriteAheadLog forces on (bench A/B, recovery).
+        self.wal = resolve_wal(wal)
+        self.dedup_hits = 0
         self.scheduler = Scheduler(
             self.executor, self.metrics, policy=policy,
             prefill_chunk=prefill_chunk, eos_token_id=eos_token_id,
             max_preemptions=max_preemptions, prefix_cache=self.prefix,
-            spec=self.spec, async_exec=async_exec)
+            spec=self.spec, async_exec=async_exec, wal=self.wal)
         self._next_rid = 0
         # aot: None = follow PT_AOT (default off, bit-exact legacy
         # path); "off"/"warm"/"strict" force it (bench A/B).  warm =
@@ -154,6 +162,10 @@ class ServingEngine:
                     handle=h, source="serving",
                     now=self.metrics._t_start)
             h.statusz["serving"] = self._statusz
+            if self.wal is not None:
+                # a cluster re-registers its own provider after its
+                # engines are built (last registration wins)
+                h.statusz["durability"] = self._durability_statusz
 
     # -- submission ------------------------------------------------------
 
@@ -167,7 +179,11 @@ class ServingEngine:
         if rid is None:
             rid = f"req-{self._next_rid}"
         if rid in self.scheduler.requests:
-            raise ValueError(f"duplicate request id {rid!r}")
+            # idempotent duplicate submit: at-least-once clients get
+            # the ORIGINAL handle (live or terminal), never a second
+            # stream — the dedup is journaled so recovery replays to
+            # the same exactly-once outcome
+            return self._dedup(rid, self.scheduler.requests[rid])
         req = Request(rid, prompt_ids, max_new_tokens=max_new_tokens,
                       priority=priority, deadline=deadline,
                       on_token=on_token, arrival_seq=self._next_rid,
@@ -177,7 +193,27 @@ class ServingEngine:
             raise ValueError("prompt_ids must be non-empty")
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if self.wal is not None:
+            # journal acceptance BEFORE the scheduler sees the request
+            # so no accepted request can outrun its submit record
+            self.wal.append({
+                "t": "submit", "rid": rid,
+                "prompt": req.prompt_ids.tolist(),
+                "max_new": req.max_new_tokens,
+                "prio": req.priority, "deadline": req.deadline})
         self.scheduler.add(req)
+        return RequestHandle(self, req)
+
+    def _dedup(self, rid, req) -> RequestHandle:
+        self.dedup_hits += 1
+        if self.wal is not None:
+            self.wal.append({"t": "dedup", "rid": rid})
+        from paddle_tpu import obs
+
+        h = obs.handle()
+        if h is not None:
+            h.events.log("req.dedup", rid=rid,
+                         state=req.state.value)
         return RequestHandle(self, req)
 
     def cancel(self, rid) -> None:
@@ -272,4 +308,10 @@ class ServingEngine:
                 "phase_seconds_total": dict(s.phase_totals),
             },
             "stats": self.stats(),
+        }
+
+    def _durability_statusz(self) -> dict:
+        return {
+            "wal": None if self.wal is None else self.wal.statusz(),
+            "dedup_hits": self.dedup_hits,
         }

@@ -71,7 +71,7 @@ class Request:
         "first_token_step", "first_token_time", "finish_step",
         "finish_time", "last_token_time", "decode_time_s",
         "cached_tokens", "draft_proposed", "draft_accepted", "clock",
-        "retry_after",
+        "retry_after", "recovered",
     )
 
     def __init__(self, rid, prompt_ids, max_new_tokens=16, priority=0,
@@ -99,6 +99,7 @@ class Request:
         self.cancel_flag = False
         self.preempt_count = 0
         self.retry_after = None    # set when shed (state REJECTED)
+        self.recovered = False     # rebuilt from the WAL after a crash
         self.cached_tokens = 0     # prompt tokens attached from cache
         self.draft_proposed = 0    # speculative draft tokens offered
         self.draft_accepted = 0    # ...committed by verification
@@ -212,6 +213,7 @@ class RequestHandle:
             "tokens": len(r.generated),
             "preemptions": r.preempt_count,
             "retry_after": r.retry_after,
+            "recovered": r.recovered,
             "cached_tokens": r.cached_tokens,
             "draft_proposed": r.draft_proposed,
             "draft_accepted": r.draft_accepted,

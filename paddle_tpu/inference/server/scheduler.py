@@ -50,6 +50,7 @@ from ... import obs
 from ...profiler import RecordEvent
 from ...testing import faults
 from .request import Request, RequestState
+from .wal import stream_crc
 
 _POOL_EXHAUSTED = "KV page pool exhausted"
 
@@ -81,7 +82,7 @@ class Scheduler:
     def __init__(self, executor, metrics, policy="fifo",
                  prefill_chunk=None, eos_token_id=None,
                  max_preemptions=4, prefix_cache=None, spec=None,
-                 async_exec=False):
+                 async_exec=False, wal=None):
         if policy not in ("fifo", "priority"):
             raise ValueError(
                 f"policy must be 'fifo' or 'priority', got {policy!r}")
@@ -104,6 +105,11 @@ class Scheduler:
         # None check per site, and tests reconfigure obs BEFORE
         # building the engine under test
         self._obs = obs.handle()
+        # write-ahead request journal (None = off, bit-exact): the
+        # scheduler owns the admit/token/finish records — every token
+        # from the sync, async, spec-verify and prefill-final paths
+        # funnels through _on_token, so one hook covers all variants
+        self.wal = wal
         # double-buffered execution state (PT_ASYNC_EXEC=on): the plan
         # built while the previous step was in flight, a commit a
         # fault interrupted mid-step, the replan audit counter, and
@@ -680,6 +686,9 @@ class Scheduler:
                     "req.admit", rid=req.rid, tick=self.tick,
                     cached_tokens=int(hit_tokens),
                     resume=int(req.preempt_count > 0))
+            if self.wal is not None:
+                self.wal.append({"t": "admit", "rid": req.rid,
+                                 "tick": self.tick})
             faults.fire("serve.admit", "after")
 
     def _pick_next(self):
@@ -779,6 +788,13 @@ class Scheduler:
         if self.spec is not None:
             self.spec.on_token(req, tok)
         emitted.setdefault(req.rid, []).append(int(tok))
+        if self.wal is not None:
+            # "i" is the token's stream index: replay only trusts a
+            # contiguous-from-zero prefix, so one bit-rotted token
+            # record downgrades everything past it to recompute
+            self.wal.append({"t": "token", "rid": req.rid,
+                             "tok": int(tok),
+                             "i": len(req.generated) - 1})
         if req.first_token_step is None:
             self.metrics.on_first_token(req, self.tick)
             if self._obs is not None:
@@ -844,6 +860,14 @@ class Scheduler:
         req.state = state
         req.finish_reason = reason
         self.metrics.on_terminal(req, self.tick)
+        if self.wal is not None:
+            # n + crc let replay PROVE a journaled stream is complete
+            # before serving it from the log; any mismatch downgrades
+            # the request to the bit-identical recompute path
+            self.wal.append({
+                "t": "finish", "rid": req.rid, "state": state.value,
+                "reason": reason, "n": len(req.generated),
+                "crc": stream_crc(req.generated)})
         if self._obs is not None:
             self._obs.tracer.instant(
                 "req.finish", cat="serve", trace_id=req.rid,

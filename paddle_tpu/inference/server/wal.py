@@ -1,0 +1,306 @@
+"""Write-ahead request journal for durable serving.
+
+An append-only log of request lifecycle records (submit / admit /
+token-emission / finish / reject / dedup) that makes an accepted
+request survive the loss of the whole serving process: after a crash,
+``ServingCluster.recover(wal_dir)`` replays the journal, serves
+already-finished streams straight from the log, and re-submits
+in-flight requests through the preemption-recompute idiom so recovered
+streams are bit-identical to an uninterrupted run.
+
+Layout and framing (references: classic ARIES-style WAL, LevelDB log
+format):
+
+- the journal is a directory of numbered **segments**
+  (``wal-00000001.jsonl`` ...); a writer always starts a fresh segment
+  so a torn tail from a previous incarnation is never appended to;
+- each record is one line: ``<crc32 hex8> <compact json>\\n`` — the
+  crc32 is over the json bytes, so replay detects both torn tails
+  (half-written final lines: physically truncated on replay) and
+  interior bit-rot (crc mismatch: the record is skipped and counted;
+  a finish record whose token count/crc no longer matches the replayed
+  stream downgrades that request to the recompute path, never to a
+  wrong answer; token records carry their stream index ``i`` so replay
+  trusts only a contiguous-from-zero prefix — a token past a bit-rot
+  gap is recomputed, not replayed);
+- each append is one raw ``write(2)`` straight to the OS (a SIGKILL
+  loses nothing) and ``fsync()`` runs every ``fsync_every`` records —
+  the batching keeps the WAL-on throughput tax within the gated ≥0.95×
+  budget.  Records past the last fsync can be lost to power failure;
+  replay then simply sees a shorter prefix and recomputes the rest
+  bit-identically.
+
+Journaling must never take serving down: append/fsync failures
+(injected via the ``wal.append``/``wal.fsync`` fault points or real
+``OSError``) are absorbed into ``errors`` and serving continues with a
+degraded journal.  The gate is ``PT_WAL={off,on}`` (+ ``PT_WAL_DIR``);
+off is bit-exact with the WAL-free engine.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+import zlib
+
+import numpy as np
+
+from ... import obs
+from ...testing import faults
+
+__all__ = [
+    "WriteAheadLog", "replay", "stream_crc", "wal_enabled",
+    "default_wal", "resolve_wal", "segment_paths",
+]
+
+_SEG_FMT = "wal-{:08d}.jsonl"
+_SEG_GLOB = "wal-*.jsonl"
+
+
+def wal_enabled() -> bool:
+    mode = os.environ.get("PT_WAL", "off").lower()
+    if mode not in ("off", "on"):
+        raise ValueError(f"PT_WAL={mode!r}: expected off|on")
+    return mode == "on"
+
+
+def default_wal():
+    """WriteAheadLog from PT_WAL / PT_WAL_DIR, or None when off."""
+    if not wal_enabled():
+        return None
+    path = os.environ.get("PT_WAL_DIR")
+    if not path:
+        raise ValueError("PT_WAL=on requires PT_WAL_DIR=<journal dir>")
+    return WriteAheadLog(path)
+
+
+def resolve_wal(wal):
+    """None = follow PT_WAL; False forces off; a path string or a
+    WriteAheadLog force on (bench A/B and cluster-owned journals)."""
+    if wal is None:
+        return default_wal()
+    if wal is False:
+        return None
+    if isinstance(wal, WriteAheadLog):
+        return wal
+    if isinstance(wal, (str, os.PathLike)):
+        return WriteAheadLog(os.fspath(wal))
+    raise ValueError(f"wal={wal!r}: expected None|False|path|WriteAheadLog")
+
+
+def stream_crc(tokens) -> int:
+    """crc32 over a token stream; stamped into finish records so replay
+    can prove a journaled stream is complete before serving it."""
+    return zlib.crc32(np.asarray(list(tokens), np.int32).tobytes())
+
+
+def segment_paths(path):
+    return sorted(glob.glob(os.path.join(path, _SEG_GLOB)))
+
+
+class WriteAheadLog:
+    """Append-only crc32-framed JSON-lines journal with segment
+    rotation and batched fsync.  Single writer per directory."""
+
+    def __init__(self, path, fsync_every=None, segment_bytes=256 * 1024):
+        if fsync_every is None:
+            fsync_every = int(os.environ.get("PT_WAL_FSYNC_EVERY", "32"))
+        if fsync_every < 1:
+            raise ValueError("fsync_every must be >= 1")
+        self.dir = os.fspath(path)
+        os.makedirs(self.dir, exist_ok=True)
+        self.fsync_every = fsync_every
+        self.segment_bytes = segment_bytes
+        self.appended = 0
+        self.fsyncs = 0
+        self.errors = 0
+        # wall seconds spent inside append/fsync: the journal's true
+        # serving-path cost, measured within-run so host drift between
+        # bench legs can't fake (or hide) a tax
+        self.write_s = 0.0
+        self.last_fsync_at = 0      # `appended` watermark at last fsync
+        self._since_fsync = 0
+        self._f = None
+        self._seg_path = None
+        self._seg_bytes = 0
+        self._pub_appended = 0
+        self._pub_fsyncs = 0
+        existing = segment_paths(self.dir)
+        # never append to an old segment: its tail may be torn, and
+        # replay truncates tears — a fresh segment keeps new records
+        # safely after any repair point
+        self._seg_index = (int(os.path.basename(existing[-1])[4:12])
+                           if existing else 0)
+        self._obs = obs.handle()
+
+    # -- writing ---------------------------------------------------------
+
+    def _roll(self):
+        if self._f is not None:
+            self._do_fsync()
+            os.close(self._f)
+        self._seg_index += 1
+        self._seg_path = os.path.join(
+            self.dir, _SEG_FMT.format(self._seg_index))
+        # raw fd: each record is exactly one write(2) straight to the
+        # OS (SIGKILL-durable) with no buffered-writer bookkeeping on
+        # the serving hot path
+        self._f = os.open(self._seg_path,
+                          os.O_CREAT | os.O_APPEND | os.O_WRONLY, 0o644)
+        self._seg_bytes = 0
+
+    def append(self, rec: dict) -> None:
+        """Journal one record.  Failures (injected or OSError) degrade
+        to ``errors`` — the serving path never pays for a sick disk."""
+        t0 = time.perf_counter()
+        try:
+            faults.fire("wal.append", "before", path=self._seg_path)
+            if self._f is None or self._seg_bytes >= self.segment_bytes:
+                self._roll()
+            body = json.dumps(rec, separators=(",", ":")).encode()
+            line = b"%08x " % zlib.crc32(body) + body + b"\n"
+            os.write(self._f, line)
+            self._seg_bytes += len(line)
+            self.appended += 1
+            self._since_fsync += 1
+            faults.fire("wal.append", "after", path=self._seg_path)
+        except (faults.InjectedFault, OSError):
+            self.errors += 1
+            self.write_s += time.perf_counter() - t0
+        else:
+            # stop the clock before the batched fsync: fsync() keeps
+            # its own time, so the barrier is never counted twice
+            self.write_s += time.perf_counter() - t0
+            if self._since_fsync >= self.fsync_every:
+                self.fsync()
+        self._publish()
+
+    def _do_fsync(self):
+        faults.fire("wal.fsync", "before", path=self._seg_path)
+        os.fsync(self._f)
+        self.fsyncs += 1
+        self.last_fsync_at = self.appended
+        self._since_fsync = 0
+        faults.fire("wal.fsync", "after", path=self._seg_path)
+
+    def fsync(self) -> None:
+        if self._f is None:
+            return
+        t0 = time.perf_counter()
+        try:
+            self._do_fsync()
+        except (faults.InjectedFault, OSError):
+            self.errors += 1
+        self.write_s += time.perf_counter() - t0
+        self._publish()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self.fsync()
+            os.close(self._f)
+            self._f = None
+
+    # -- telemetry -------------------------------------------------------
+
+    def _publish(self):
+        h = self._obs
+        if h is None:
+            return
+        h.registry.counter(
+            "wal_appended_total", "WAL records appended",
+        ).inc(self.appended - self._pub_appended)
+        self._pub_appended = self.appended
+        h.registry.counter(
+            "wal_fsyncs_total", "WAL fsync barriers",
+        ).inc(self.fsyncs - self._pub_fsyncs)
+        self._pub_fsyncs = self.fsyncs
+        h.registry.gauge(
+            "wal_lag_records",
+            "records appended since the last fsync barrier",
+        ).set(self._since_fsync)
+
+    def statusz(self) -> dict:
+        segs = segment_paths(self.dir)
+        return {
+            "dir": self.dir,
+            "segments": len(segs),
+            "bytes": sum(os.path.getsize(p) for p in segs),
+            "appended": self.appended,
+            "fsyncs": self.fsyncs,
+            "errors": self.errors,
+            "lag_records": self._since_fsync,
+            "last_fsync_at_record": self.last_fsync_at,
+            "write_s": round(self.write_s, 6),
+        }
+
+
+def _decode_line(line: bytes):
+    """(record, crc_ok) — (None, False) when the frame/json is
+    unparseable (candidate torn tail)."""
+    if len(line) < 10 or line[8:9] != b" ":
+        return None, False
+    body = line[9:]
+    try:
+        want = int(line[:8], 16)
+        rec = json.loads(body)
+    except ValueError:
+        return None, False
+    if not isinstance(rec, dict):
+        return None, False
+    return rec, zlib.crc32(body) == want
+
+
+def replay(path, repair=True):
+    """Replay a journal directory -> (records, report).
+
+    Torn tails (a trailing run of unparseable lines in a segment — a
+    crash mid-append) are physically truncated when ``repair`` so a
+    later writer never lands records behind garbage.  Interior corrupt
+    records (bit-rot: crc mismatch or garbage followed by valid lines)
+    are skipped and counted — recovery downgrades any stream they
+    touched to the recompute path.
+    """
+    faults.fire("wal.replay", "before", path=path)
+    records = []
+    report = {"segments": 0, "records": 0, "corrupt": 0, "torn_bytes": 0}
+    for seg in segment_paths(path):
+        report["segments"] += 1
+        with open(seg, "rb") as f:
+            raw = f.read()
+        entries = []         # (start_offset, rec|None, crc_ok)
+        pos = 0
+        while pos < len(raw):
+            nl = raw.find(b"\n", pos)
+            end = len(raw) if nl == -1 else nl
+            rec, ok = _decode_line(raw[pos:end])
+            if nl == -1:     # unterminated final line is always torn
+                entries.append((pos, None, False))
+                break
+            entries.append((pos, rec if ok else None, ok))
+            pos = nl + 1
+        # split the trailing run of invalid entries: that's the torn
+        # tail; invalid entries before any later valid one are bit-rot
+        tail = len(entries)
+        while tail > 0 and entries[tail - 1][1] is None:
+            tail -= 1
+        for start, rec, _ok in entries[:tail]:
+            if rec is None:
+                report["corrupt"] += 1
+            else:
+                records.append(rec)
+                report["records"] += 1
+        if tail < len(entries):
+            torn_at = entries[tail][0]
+            report["torn_bytes"] += len(raw) - torn_at
+            if repair:
+                with open(seg, "ab") as f:
+                    f.truncate(torn_at)
+    faults.fire("wal.replay", "after", path=path)
+    h = obs.handle()
+    if h is not None:
+        h.registry.counter(
+            "wal_replayed_total", "WAL records replayed during recovery",
+        ).inc(report["records"])
+        h.events.log("wal.replay", dir=os.fspath(path), **report)
+    return records, report

@@ -171,6 +171,32 @@ REGISTERED = {
                 "boundary (before=verdict computed, nothing rejected "
                 "— a raise degrades to ADMITTING the request; after="
                 "terminal REJECTED with retry_after set)",
+    "wal.append": "one write-ahead-log record append (before=no line "
+                  "written — truncate/corrupt target the live "
+                  "segment, crash simulates a SIGKILL mid-append; "
+                  "after=line flushed to the OS, fsync possibly "
+                  "pending — a raise at either phase DEGRADES "
+                  "journaling into wal.errors, never the serving "
+                  "path)",
+    "wal.fsync": "one batched WAL fsync barrier (before=records "
+                 "flushed but not yet durable — a crash here loses "
+                 "at most the unsynced tail, which replay recomputes "
+                 "bit-identically; after=segment durable through its "
+                 "last appended record; a raise degrades to "
+                 "wal.errors)",
+    "wal.replay": "one WAL directory replay during crash recovery "
+                  "(before=nothing read — truncate/corrupt target a "
+                  "segment file, a raise aborts this recovery "
+                  "attempt cleanly and the journal stays replayable; "
+                  "after=records reconstructed, nothing resubmitted "
+                  "yet)",
+    "kv.salvage": "one hung-replica KV-page salvage (before=pages "
+                  "still readable on the victim — a raise falls back "
+                  "to the recompute failover, never loses the "
+                  "request; after=pages landed crc32-verified on the "
+                  "target, request not yet moved; inject=corrupt the "
+                  "copy in flight so the crc check must catch it and "
+                  "fall back to recompute)",
 }
 
 _PHASES = ("before", "after")

@@ -1,0 +1,68 @@
+"""Serving subprocess for the durability chaos tests.
+
+Builds the SAME seeded model/cluster/workload as
+``tests/test_durability.py``, journals into the WAL directory given on
+argv, and prints one progress line per step so the parent can SIGKILL
+it at a deterministic journal depth.  Not a pytest module (leading
+underscore keeps collection away).
+
+Usage: python tests/_durability_worker.py <wal_dir> [fault_spec]
+
+The optional fault spec is handed to ``faults.reset`` — the crash /
+truncate actions hard-kill this process (``os._exit``) exactly like
+the parent's SIGKILL, but at a fault-point-precise location.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(wal_dir, fault_spec=""):
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.server import ServingCluster
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.testing import faults
+    from paddle_tpu.testing.load import LoadSpec, generate_load
+
+    # identical seed/config to the test module: the parent rebuilds
+    # these exact weights, so recovered streams must match its baseline
+    paddle.seed(11)
+    cfg = LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    cl = ServingCluster(
+        model, n_replicas=2, cluster=True, wal=wal_dir,
+        max_seqs=4, page_size=4, max_len=64, prefill_chunk=8)
+    cl.wal.fsync_every = 1   # every record visible to the parent's poll
+    if fault_spec:
+        faults.reset(fault_spec)
+    work = sorted(generate_load(LoadSpec(
+        n_requests=8, mean_interarrival=1.0, prompt_len=(4, 14),
+        max_new=(4, 8), vocab=256, seed=3)),
+        key=lambda w: w["arrival_tick"])
+    i = 0
+    while i < len(work) or cl.in_flight:
+        while i < len(work) and work[i]["arrival_tick"] <= cl.tick:
+            w = work[i]
+            i += 1
+            cl.submit(w["prompt_ids"],
+                      max_new_tokens=w["max_new_tokens"],
+                      rid=w["rid"])
+        cl.step()
+        # the parent reads this to pick its SIGKILL moment
+        print(f"tick {cl.tick} appended {cl.wal.appended}", flush=True)
+        if cl.tick > 400:
+            print("STUCK", flush=True)
+            return 2
+    print("DRAINED", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], sys.argv[2] if len(sys.argv) > 2 else ""))

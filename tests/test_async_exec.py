@@ -226,11 +226,12 @@ def test_replan_on_cancel_keeps_streams_exact(model):
 # -- fault points -------------------------------------------------------
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("phase,point", [
     ("before", "async.plan"),
-    pytest.param("before", "async.commit", marks=pytest.mark.slow),
-    pytest.param("after", "async.plan", marks=pytest.mark.slow),
-    pytest.param("after", "async.commit", marks=pytest.mark.slow),
+    ("before", "async.commit"),
+    ("after", "async.plan"),
+    ("after", "async.commit"),
 ])
 def test_async_fault_leaves_engine_serviceable(model, point, phase):
     """An injected raise at every async point x phase escapes step()

@@ -76,6 +76,7 @@ def test_load_run_completes_and_is_deterministic(model):
     assert r1["stats"]["requests"]["finished"] == SPEC["n_requests"]
 
 
+@pytest.mark.slow
 def test_load_matches_sequential_baseline(model):
     """Interleaved load emits the same per-request tokens as feeding
     the workload one request at a time."""

@@ -165,11 +165,12 @@ def test_none_mode_is_legacy_path(model):
 # -- PT_QUANT=none bit-parity under load --------------------------------
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("variant", [
     "plain",
-    pytest.param("prefix", marks=pytest.mark.slow),
-    pytest.param("spec", marks=pytest.mark.slow),
-    pytest.param("async", marks=pytest.mark.slow),
+    "prefix",
+    "spec",
+    "async",
 ])
 def test_none_load_parity(model, variant, monkeypatch):
     """The acceptance-criteria run: the seeded load on an undersized
@@ -195,11 +196,12 @@ def test_none_load_parity(model, variant, monkeypatch):
 # -- int8 under load ----------------------------------------------------
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("variant", [
-    pytest.param("plain", marks=pytest.mark.slow),
-    pytest.param("prefix", marks=pytest.mark.slow),
+    "plain",
+    "prefix",
     "spec",
-    pytest.param("async", marks=pytest.mark.slow),
+    "async",
 ])
 def test_int8_load_drains_with_invariants(model, variant):
     """The int8 engine drains the same seeded loads — preemption,

@@ -3,6 +3,7 @@ LSTM chunks (i,f,c,o); GRU chunks (r,z,c) with h = (h_prev-c)*z + c,
 reset applied after the recurrent matmul — nn/layer/rnn.py:741/918/1144).
 """
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import nn
@@ -120,6 +121,7 @@ def test_bidirectional_and_reverse():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_multilayer_time_major_and_training():
     paddle.seed(5)
     m = nn.GRU(4, 8, num_layers=2, time_major=True)

@@ -31,8 +31,13 @@ import sys
 #: bigger-is-better: new < old*(1-tol) is a regression; "lower" means
 #: smaller-is-better: new > old*(1+tol) is a regression.
 METRICS = (
-    ("value", "higher", 0.10),                    # headline tok/s/chip
-    ("mfu", "higher", 0.10),
+    # headline tok/s/chip + mfu are wall-clock on whatever vCPU slice
+    # the bench host grants: four same-day r22 runs of identical
+    # pretrain code measured 1110.5/1103.3/992.0/944.7 tok/s (±15%
+    # spread, 1-vCPU microVM) — like int8.serving_tok_s below, gate
+    # only collapses, not host drift
+    ("value", "higher", 0.25),
+    ("mfu", "higher", 0.25),
     ("bert_base_squad.value", "higher", 0.10),
     ("bert_base_squad.mfu", "higher", 0.10),
     ("resnet50.value", "higher", 0.10),
@@ -52,7 +57,10 @@ METRICS = (
     ("serving.async_exec.on.host_overlap_ratio", "higher", 0.20),
     # AOT cold-start leg (r18): warmed-cache cold-process TTFT, the
     # cold-vs-warm speedup and the persistent-cache hit rate must hold
-    ("coldstart.coldstart_ttft_s", "lower", 0.25),
+    # absolute warm-start seconds ride the same host slice as the
+    # headline (r22 same-day spread 1.82-2.21s); a dead cache shows up
+    # as ~10x here and as a collapse of the within-run speedup ratio
+    ("coldstart.coldstart_ttft_s", "lower", 0.60),
     ("coldstart.speedup", "higher", 0.15),
     ("coldstart.compile_cache_hit_rate", "higher", 0.10),
     # quantized serving (r19): the KV capacity multiplier at fixed pool
@@ -80,6 +88,15 @@ METRICS = (
     ("serving.cluster_failover.value", "higher", 0.10),
     ("serving.cluster_failover.recovery_steps", "lower", 0.50),
     ("serving.cluster_failover.failover_ttft_tax_mean", "lower", 0.50),
+    # durable serving (r22): the journal's wall-clock throughput tax
+    # must stay within budget (ratio >= ~0.95 measured; gate drift),
+    # whole-process recovery must keep draining promptly, and salvage
+    # must keep beating recompute failover on re-prefilled tokens
+    # (step-deterministic, so the tight-ish gates are safe)
+    ("serving.durability.wal_tok_ratio", "higher", 0.10),
+    ("serving.durability.recovery_steps", "lower", 0.50),
+    ("serving.durability.salvage_reprefill_saved_tokens",
+     "higher", 0.50),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

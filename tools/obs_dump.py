@@ -334,6 +334,39 @@ def main():
                "fails", "fail_streak", "restarts"} <= set(row),
               f"survivability row schema for {row.get('name')}")
 
+    # -- 10. durability plane: WAL counters + dedup + /statusz ----------
+    print("== durability plane ==")
+    from paddle_tpu.inference.server import wal as wal_mod
+
+    wal_dir = os.path.join(tempfile.mkdtemp(prefix="pt-obs-wal-"), "j")
+    cl10 = ServingCluster(model, n_replicas=2, cluster=True, max_seqs=2,
+                          page_size=4, max_len=64, wal=wal_dir, slos=[])
+    p10 = rng.randint(1, 256, (9,)).astype(np.int32)
+    h10 = cl10.submit(p10, max_new_tokens=5, rid="dur0")
+    toks10 = h10.result()
+    dup10 = cl10.submit(p10, max_new_tokens=5, rid="dur0")
+    check(dup10.tokens == toks10 and cl10.dedup_hits == 1,
+          "duplicate rid deduped to the journaled stream")
+    recs10, rep10 = wal_mod.replay(wal_dir)
+    check(rep10["corrupt"] == 0 and rep10["records"] == len(recs10),
+          "journal replays clean")
+    kinds10 = {r["t"] for r in recs10}
+    check({"submit", "admit", "token", "finish", "dedup"} <= kinds10,
+          "lifecycle record kinds journaled")
+    prom = h.registry.prometheus_text()
+    for fam in ("wal_appended_total", "wal_fsyncs_total",
+                "wal_replayed_total", "wal_lag_records"):
+        check(fam in prom, f"family {fam}")
+    ev_kinds = {e["kind"] for e in h.events.events()}
+    for kind in ("req.dedup", "wal.replay"):
+        check(kind in ev_kinds, f"{kind} journaled")
+    dz = health.statusz_payload(h)["providers"].get("durability", {})
+    for key in ("wal", "dedup_hits", "salvage", "recovery"):
+        check(key in dz, f"/statusz durability key {key}")
+    check((dz.get("wal") or {}).get("appended", 0) > 0
+          and "lag_records" in (dz.get("wal") or {}),
+          "/statusz WAL table live")
+
     if FAILURES:
         print(f"\nobs-check: {len(FAILURES)} check(s) FAILED")
         for f in FAILURES:
