@@ -794,7 +794,13 @@ class ServingCluster:
         the whole CLUSTER (handle.result()/stream() step every
         replica), so it stays live across re-steers and handoffs."""
         if rid is None:
+            # auto rids must never collide with journaled, recovered or
+            # client-supplied rids: skip ahead until unused (recover()
+            # also advances _next_rid past every replayed req-N)
             rid = f"req-{self._next_rid}"
+            while self._known(rid) is not None:
+                self._next_rid += 1
+                rid = f"req-{self._next_rid}"
         known = self._known(rid)
         if known is not None:
             # idempotent duplicate submit: at-least-once clients get
@@ -1417,8 +1423,17 @@ class ServingCluster:
             if e is None:
                 e = by[rid] = {"tokens": []}
                 order.append(rid)
-            if t == "submit" and "submit" not in e:
-                e["submit"] = rec    # at-least-once: first write wins
+            if t == "submit":
+                if "reject" in e:
+                    # shed rids are deliberately not deduped, so a
+                    # submit record AFTER a reject is the client's
+                    # post-backoff retry: it supersedes the rejection
+                    # and starts a fresh stream
+                    e["submit"] = rec
+                    e["tokens"] = []
+                    del e["reject"]
+                elif "submit" not in e:
+                    e["submit"] = rec   # at-least-once: first write wins
             elif t == "token":
                 # only the contiguous-from-zero prefix is trustworthy:
                 # a corrupt interior token record leaves a gap, and a
@@ -1431,12 +1446,27 @@ class ServingCluster:
                 e["finish"] = rec
             elif t == "reject":
                 e["reject"] = rec
+        # advance the auto-rid counter past every journaled req-N so a
+        # fresh anonymous submit can never collide with (and silently
+        # dedup to) a recovered request
+        for rid in by:
+            if isinstance(rid, str) and rid.startswith("req-"):
+                try:
+                    cl._next_rid = max(cl._next_rid, int(rid[4:]) + 1)
+                except ValueError:
+                    pass
         served = resubmitted = 0
         cl.recovered_handles = {}
         for seq, rid in enumerate(order):
             e = by[rid]
             sub = e.get("submit")
             if sub is None:
+                if "reject" in e:
+                    # shed at the boundary and never resubmitted: the
+                    # rejection (with its retry_after) was already
+                    # delivered live, and shed rids are deliberately
+                    # not deduped — nothing to restore
+                    continue
                 # lifecycle records without a submit record (its line
                 # was corrupt): there is no prompt to recompute from —
                 # surface it in the report, the client's at-least-once
@@ -1455,7 +1485,9 @@ class ServingCluster:
                 req.retry_after = int(rej["retry_after"])
                 req.error = RequestRejected(rid, rej["reason"],
                                             rej["retry_after"])
-                cl._served[rid] = req
+                # like the live shed path, NOT added to the dedup set:
+                # a retry_after verdict is an invitation to resubmit
+                # the same rid after backing off
                 served += 1
             elif fin is not None and fin["n"] == len(toks) \
                     and fin["crc"] == stream_crc(toks):

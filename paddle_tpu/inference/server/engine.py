@@ -177,8 +177,14 @@ class ServingEngine:
         submission; ``on_token(rid, tok)`` streams tokens as they land.
         """
         if rid is None:
+            # auto rids must never collide with client-supplied rids:
+            # skip ahead until unused so an anonymous submit can never
+            # silently dedup to someone else's stream
             rid = f"req-{self._next_rid}"
-        if rid in self.scheduler.requests:
+            while rid in self.scheduler.requests:
+                self._next_rid += 1
+                rid = f"req-{self._next_rid}"
+        elif rid in self.scheduler.requests:
             # idempotent duplicate submit: at-least-once clients get
             # the ORIGINAL handle (live or terminal), never a second
             # stream — the dedup is journaled so recovery replays to

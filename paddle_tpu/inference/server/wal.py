@@ -138,8 +138,17 @@ class WriteAheadLog:
 
     def _roll(self):
         if self._f is not None:
-            self._do_fsync()
-            os.close(self._f)
+            # the final fsync of the outgoing segment degrades like any
+            # other journal failure, and the fd closes regardless —
+            # rotation must complete even on a sick disk, or persistent
+            # fsync errors would leak the fd and pin the segment
+            try:
+                self._do_fsync()
+            except (faults.InjectedFault, OSError):
+                self.errors += 1
+            finally:
+                fd, self._f = self._f, None
+                os.close(fd)
         self._seg_index += 1
         self._seg_path = os.path.join(
             self.dir, _SEG_FMT.format(self._seg_index))

@@ -168,7 +168,7 @@ def test_disaggregated_needs_two_replicas(model):
 
 #: fast lane keeps one abort-style and one skip-style before-phase
 #: cell; the remaining six fleet rebuilds ride the slow lane
-_FAST_FAULTS = {("route.pick", "before"), ("kv.handoff", "before")}
+_FAST_FAULTS = {("route.pick", "before")}
 
 
 @pytest.mark.parametrize(

@@ -173,6 +173,24 @@ def test_wal_segment_rotation(tmp_path):
     assert [r["tok"] for r in recs2] == list(range(11))
 
 
+def test_wal_roll_survives_fsync_failure(tmp_path):
+    # a persistently failing fsync must not abort rotation: the old fd
+    # still closes, the new segment opens, and every record lands —
+    # otherwise a sick disk leaks the fd and pins the segment forever
+    wal = WriteAheadLog(tmp_path / "j", fsync_every=100,
+                        segment_bytes=64)
+    faults.reset("wal.fsync:before:*=raise")
+    for i in range(6):
+        wal.append({"t": "token", "rid": "r", "tok": i})
+    faults.reset("")
+    wal.close()
+    assert wal.errors >= 1              # the fsyncs degraded...
+    assert wal.statusz()["segments"] > 1    # ...rotation did not
+    recs, report = replay(tmp_path / "j")
+    assert [r["tok"] for r in recs] == list(range(6))
+    assert report["corrupt"] == 0
+
+
 @pytest.mark.slow
 def test_wal_journal_roundtrip(model, work, baseline, tmp_path):
     cl = ServingCluster(model, n_replicas=2, cluster=True,
@@ -213,6 +231,25 @@ def test_engine_duplicate_submit_returns_original(model, tmp_path):
     recs, _ = replay(tmp_path / "j")
     assert sum(1 for r in recs if r["t"] == "dedup") == 2
     assert sum(1 for r in recs if r["t"] == "submit") == 1
+
+
+def test_anonymous_rids_skip_explicit_collisions(model):
+    # a client-supplied rid squatting on the auto-rid namespace must
+    # never capture an anonymous submit as a silent dedup
+    eng = ServingEngine(model, **KW)
+    h0 = eng.submit(np.asarray([1, 2, 3], np.int32), max_new_tokens=2,
+                    rid="req-0")
+    h1 = eng.submit(np.asarray([4, 5, 6], np.int32), max_new_tokens=2)
+    h2 = eng.submit(np.asarray([7, 8, 9], np.int32), max_new_tokens=2)
+    assert len({h0._req.rid, h1._req.rid, h2._req.rid}) == 3
+    assert eng.dedup_hits == 0
+    cl = ServingCluster(model, n_replicas=2, cluster=True, **KW)
+    c0 = cl.submit(np.asarray([1, 2, 3], np.int32), max_new_tokens=2,
+                   rid="req-0")
+    c1 = cl.submit(np.asarray([4, 5, 6], np.int32), max_new_tokens=2)
+    c2 = cl.submit(np.asarray([7, 8, 9], np.int32), max_new_tokens=2)
+    assert len({c0._req.rid, c1._req.rid, c2._req.rid}) == 3
+    assert cl.dedup_hits == 0
 
 
 # -- crash recovery (in-process) ---------------------------------------
@@ -275,6 +312,53 @@ def test_recover_resubmits_in_flight(model, work, baseline, tmp_path):
                                  n_replicas=2, cluster=True, **KW)
     for rid, toks in baseline.items():
         assert cl3.recovered_handles[rid].tokens == toks
+
+
+def test_recover_advances_anonymous_rids(model, tmp_path):
+    # journaled req-N rids must not capture post-recovery anonymous
+    # submits: _next_rid restarts at 0, so recover() advances it past
+    # every replayed auto rid
+    cl = ServingCluster(model, n_replicas=2, cluster=True,
+                        wal=str(tmp_path / "j"), **KW)
+    old = [cl.submit(np.asarray([3, i + 1], np.int32), max_new_tokens=3)
+           for i in range(3)]
+    rids = {h._req.rid for h in old}
+    assert rids == {"req-0", "req-1", "req-2"}
+    for h in old:
+        h.result()
+    del cl
+    cl2 = ServingCluster.recover(model, str(tmp_path / "j"),
+                                 n_replicas=2, cluster=True, **KW)
+    assert cl2._next_rid == 3
+    h = cl2.submit(np.asarray([9, 9], np.int32), max_new_tokens=2)
+    assert h._req.rid not in rids and not h._req.recovered
+    assert cl2.dedup_hits == 0
+    assert h.result()   # a live fresh stream, not someone's log copy
+
+
+def test_recover_resubmit_after_shed_supersedes_reject(model, tmp_path):
+    # "r1" was shed with retry_after, then resubmitted and finished
+    # before the crash: recovery restores the finished stream, not the
+    # stale rejection.  A shed-only rid ("r2") restores nothing and is
+    # neither corrupt nor deduped — post-crash retries serve it fresh,
+    # exactly like the live shed path.
+    eng = ServingEngine(model, wal=str(tmp_path / "j"), **KW)
+    for rid in ("r1", "r2"):
+        eng.wal.append({"t": "reject", "rid": rid,
+                        "reason": "overload", "retry_after": 2})
+    toks = eng.submit(np.asarray([1, 2, 3], np.int32), max_new_tokens=4,
+                      rid="r1").result()
+    eng.wal.close()
+    cl = ServingCluster.recover(model, str(tmp_path / "j"),
+                                n_replicas=2, cluster=True, **KW)
+    assert set(cl.recovered_handles) == {"r1"}
+    h = cl.recovered_handles["r1"]
+    assert h.state is not RequestState.REJECTED and h.tokens == toks
+    assert cl.recovery["corrupt"] == 0
+    assert cl.recovery["served_from_log"] == 1
+    h2 = cl.submit(np.asarray([5, 6], np.int32), max_new_tokens=2,
+                   rid="r2")
+    assert cl.dedup_hits == 0 and h2.result()
 
 
 # -- crash recovery (real subprocess, SIGKILL) --------------------------
