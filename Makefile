@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: smoke test test-fast verify-fast lint-graph obs-check \
 	health-check aot-check cluster-check chaos-check \
-	durability-check perf-report perf-check bench
+	durability-check sp-check perf-report perf-check bench
 
 # <3 min sanity gate: import + one eager op, one jitted llama forward
 # step (the driver's entry()), and a 2-virtual-device multichip train
@@ -58,6 +58,7 @@ smoke:
 	$(MAKE) cluster-check
 	$(MAKE) chaos-check
 	$(MAKE) durability-check
+	$(MAKE) sp-check
 
 # Fast lane — must be green before any snapshot commit (see README).
 test-fast:
@@ -115,6 +116,14 @@ chaos-check:
 # hung-replica KV-page salvage, and the durability telemetry contract.
 durability-check:
 	JAX_PLATFORMS=cpu $(PY) tools/durability_check.py
+
+# Long-context end-to-end smoke: sequence-parallel chunked prefill on
+# a forced-CPU mesh — streams bit-identical to single-device, the
+# PT_SP_PREFILL=off gate bit-exact, the serve.prefill_sp contract
+# (ring collective inventory + host-sync ban) linted, sp telemetry in
+# Prometheus and /statusz.
+sp-check:
+	JAX_PLATFORMS=cpu $(PY) tools/sp_prefill_check.py
 
 # Per-program roofline table: analytical cost (FLOPs / HBM bytes /
 # intensity from the jaxpr cost model) vs achieved wall time for every

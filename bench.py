@@ -341,6 +341,20 @@ def main():
                 "error": str(e)[:200]}
         print(json.dumps(result), flush=True)
 
+    # Long-context sequence-parallel prefill leg (r23): TTFT critical
+    # path vs prompt length at sp 1/2/4.  Runs in a subprocess (the
+    # coldstart-worker pattern) because the sp mesh needs forced host
+    # devices, and XLA_FLAGS is dead once jax has initialized here.
+    if on_cpu and os.environ.get("PT_BENCH_SP_PREFILL", "1") == "1":
+        try:
+            result.setdefault("serving", {})["sp_prefill"] = \
+                _measure_sp_prefill()
+        except Exception as e:  # never lose earlier measurements
+            print(f"sp_prefill: FAILED: {e}", file=sys.stderr)
+            result.setdefault("serving", {})["sp_prefill"] = {
+                "error": str(e)[:200]}
+        print(json.dumps(result), flush=True)
+
     if not on_cpu:
         # Free the small config's HBM state before the extended runs.
         import gc
@@ -1673,6 +1687,148 @@ def _measure_durability(model):
     return out
 
 
+def _measure_sp_prefill():
+    """Long-context sequence-parallel prefill A/B (r23).
+
+    The question: how does time-to-first-token scale with prompt
+    length when chunked prefill is sharded across a sequence-parallel
+    mesh?  On one shared CPU host, wall clock cannot honestly show an
+    n-way speedup (all "devices" share the same cores), so the gated
+    number is the **per-device TTFT critical path in FLOPs**: every
+    chunk of the prompt priced through the jaxpr cost model at its
+    exact shapes — the dense ``serve.prefill_chunk`` body for sp=1,
+    the per-rank ``serve.prefill_sp`` shard_map body for sp=2/4 (the
+    cost walker prices shard_map bodies at per-shard shapes, i.e. the
+    work ONE device must retire before the first token; the ring's
+    ppermute hops move bytes, not FLOPs).  A least-squares slope of
+    critical-path FLOPs vs prompt length per sp degree, gated on the
+    stripe-balance claim slope(sp4)/slope(sp1) <= 0.45 (ideal 0.25
+    compute + the replicated non-attention epilogue).  Wall TTFT is
+    recorded informationally (host-noisy, like every CPU wall row).
+
+    Runs as a fresh subprocess so the mesh gets forced host devices.
+    """
+    import subprocess
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                         + " --xla_force_host_platform_device_count=8"),
+           "PT_BENCH_SP_PREFILL": "0"}
+    p = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py"), "--sp-worker"],
+        capture_output=True, text=True, timeout=1800, env=env)
+    if p.returncode != 0:
+        raise RuntimeError(f"sp worker rc={p.returncode}: "
+                           f"{p.stderr[-400:]}")
+    doc = json.loads([ln for ln in p.stdout.splitlines()
+                      if ln.strip().startswith("{")][-1])
+    print(f"serving[sp_prefill]: slope ratio sp2 "
+          f"x{doc['slope_ratio_sp2']}, sp4 x{doc['slope_ratio_sp4']} "
+          f"(gate <= 0.45), {doc['sp_prefill_tokens']} sp tokens, "
+          f"{doc['gather_pages']} pages gathered", file=sys.stderr)
+    return doc
+
+
+def _sp_worker():
+    """Child side of the sp-prefill leg: one fresh process with 8
+    forced host devices.  Prints a single JSON line."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis import estimate_fn_cost
+    from paddle_tpu.distributed import ProcessMesh
+    from paddle_tpu.inference.server import ServingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(11)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=256)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    kw = dict(max_seqs=2, page_size=4, max_len=256, prefill_chunk=32)
+    C = kw["prefill_chunk"]
+    lens = (64, 128, 192, 224)       # multiples of the chunk: every
+    rng = np.random.RandomState(9)   # chunk rides the sp program
+    prompts = {n: rng.randint(0, 256, (n,)).astype(np.int64)
+               for n in lens}
+
+    def i32(*shape):
+        return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+    def critical_path_flops(ex, fn, L):
+        """Per-device FLOPs retired before the first token: each chunk
+        priced at its exact (chunk, past-cover) shapes."""
+        sds = jax.tree.map(lambda a: jax.ShapeDtypeStruct(
+            jnp.shape(a), a.dtype), (ex.layers, ex.tops))
+        layers, tops = sds
+        nl = ex.config.num_hidden_layers
+        kv, d = ex.config.num_key_value_heads, ex.config.head_dim
+        total = 0
+        for start in range(0, L, C):
+            past = jax.ShapeDtypeStruct((nl, kv, start, d),
+                                        ex.cache.compute_dtype)
+            total += estimate_fn_cost(
+                fn, layers, tops, i32(1, C), i32(), past, past,
+                i32()).flops
+        return total
+
+    def ttft_wall_s(eng, ids):
+        t0 = time.perf_counter()
+        h = eng.submit(ids, max_new_tokens=8)
+        while not h.tokens:
+            eng.step()
+        dt = time.perf_counter() - t0
+        while eng.in_flight:
+            eng.step()
+        return dt, h.tokens
+
+    out = {"chunk": C, "prompt_lens": list(lens), "ttft_flops": {},
+           "slope_flops_per_token": {}, "ttft_wall_s": {}}
+    streams, slopes = {}, {}
+    for n_sp in (1, 2, 4):
+        if n_sp == 1:
+            eng = ServingEngine(model, **kw)
+            fn = eng.executor._chunk_fwd
+        else:
+            mesh = ProcessMesh(list(range(n_sp)), dim_names=["sp"])
+            eng = ServingEngine(model, sp_mesh=mesh, sp_prefill=True,
+                                sp_min_tokens=C, **kw)
+            fn = eng.executor._sp_chunk_fwd
+        key = f"sp{n_sp}"
+        flops = [critical_path_flops(eng.executor, fn, L)
+                 for L in lens]
+        slopes[key] = float(np.polyfit(lens, flops, 1)[0])
+        out["ttft_flops"][key] = flops
+        out["slope_flops_per_token"][key] = round(slopes[key], 1)
+        # untimed warm-up serve (compiles), then the timed one
+        ttft_wall_s(eng, prompts[lens[0]])
+        wall, toks = ttft_wall_s(eng, prompts[lens[-1]])
+        out["ttft_wall_s"][key] = round(wall, 4)
+        streams[key] = toks
+        if n_sp == 4:
+            out["sp_prefill_tokens"] = eng.executor.sp_prefill_tokens
+            out["gather_pages"] = int(
+                sum(-(-n // kw["page_size"]) for n in
+                    (lens[0], lens[-1])))
+    if not (streams["sp1"] == streams["sp2"] == streams["sp4"]):
+        raise RuntimeError(f"sp streams diverged: {streams}")
+    r2 = slopes["sp2"] / slopes["sp1"]
+    r4 = slopes["sp4"] / slopes["sp1"]
+    out["slope_ratio_sp2"] = round(r2, 4)
+    out["slope_ratio_sp4"] = round(r4, 4)
+    # the stripe-balance acceptance bound is absolute, not just
+    # round-over-round: fail the leg outright if sharding stops paying
+    if r4 > 0.45:
+        raise RuntimeError(f"sp4/sp1 slope ratio {r4:.3f} > 0.45")
+    out["value"] = out["slope_ratio_sp4"]
+    out["unit"] = "ratio"
+    print(json.dumps(out), flush=True)
+
+
 def _bench_moe(jax):
     """Fused-MoE step A/B (ROADMAP: >=1.5x vs the jnp path at d_model
     2048 / 8 experts / top-2 on-chip).  One train-step body of the MoE
@@ -1895,9 +2051,14 @@ if __name__ == "__main__":
                          "after-any-PR rule in README)")
     ap.add_argument("--coldstart-worker", default=None, metavar="DIR",
                     help=argparse.SUPPRESS)  # child of _bench_coldstart
+    ap.add_argument("--sp-worker", action="store_true",
+                    help=argparse.SUPPRESS)  # child of _measure_sp_prefill
     args = ap.parse_args()
     if args.coldstart_worker is not None:
         _coldstart_worker(args.coldstart_worker)
+        sys.exit(0)
+    if args.sp_worker:
+        _sp_worker()
         sys.exit(0)
     if args.round is None:
         main()

@@ -30,7 +30,8 @@ from . import spmd  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
-from .ring_attention import ring_attention, ulysses_attention  # noqa: F401
+from .ring_attention import (ring_attention, ring_gather_seq,  # noqa: F401
+                             ulysses_attention)
 from . import auto_tuner  # noqa: F401
 from . import watchdog  # noqa: F401
 from . import rpc  # noqa: F401

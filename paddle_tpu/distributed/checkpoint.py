@@ -125,7 +125,10 @@ def _prepare_save(state_dict, path, rank=None):
     for name, value in state_dict.items():
         arr = _arr(value)
         if not isinstance(arr, jax.Array):
-            arr = jax.numpy.asarray(arr)
+            # copy=True: on CPU, a 64-byte-aligned host buffer would
+            # otherwise be adopted zero-copy, and the caller's later
+            # in-place writes would reach this "snapshot"
+            arr = jax.numpy.array(arr, copy=True)
         entry = {"global_shape": list(arr.shape), "dtype": str(arr.dtype),
                  "shards": []}
         seen_index = set()

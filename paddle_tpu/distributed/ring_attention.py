@@ -72,6 +72,45 @@ def _ring_attention_local(q, k, v, axis_name, n_blocks, scale, causal):
 
 
 
+def ring_gather_seq(x, axis_name, n_blocks, seq_axis=2):
+    """Ring-gather the ``seq_axis``-sharded blocks of ``x`` into
+    canonical order on EVERY rank of the ring: n-1 ``ppermute`` neighbor
+    hops, each landing the in-flight block at its global offset via
+    ``dynamic_update_slice``.
+
+    This is the serving-shaped sibling of the online-softmax ring in
+    :func:`_ring_attention_local`.  The online form re-associates the
+    softmax reduction (running max / denominator), so its output is
+    only numerically close to the dense path — but chunked-prefill
+    serving (``serve.prefill_sp``) must stay BIT-identical to the
+    single-device program, because recovery, prefix caching and the
+    off-gate all compare token streams exactly.  Gathering K/V back
+    into canonical order first and then running the unmodified dense
+    mask/softmax per query stripe keeps every per-(row, col) dot
+    product — and therefore every reduction order — byte-for-byte the
+    same as ``_chunk_fwd``.  Communication volume is identical to the
+    online ring (each block traverses the whole ring); only peak
+    memory differs (O(S) keys per rank instead of O(S/n)), which is
+    fine for a bounded prefill chunk.
+    """
+    r = jax.lax.axis_index(axis_name)
+    bl = x.shape[seq_axis]
+    shape = list(x.shape)
+    shape[seq_axis] = n_blocks * bl
+    out = jnp.zeros(tuple(shape), x.dtype)
+    cur = x
+    perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
+    z = jnp.int32(0)
+    for step in range(n_blocks):
+        src = (r - step) % n_blocks      # whose block we hold now
+        idx = [z] * len(shape)
+        idx[seq_axis] = (src * bl).astype(jnp.int32)
+        out = jax.lax.dynamic_update_slice(out, cur, tuple(idx))
+        if step != n_blocks - 1:
+            cur = jax.lax.ppermute(cur, axis_name, perm)
+    return out
+
+
 def _local_sdpa_fallback(q, k, v, qd, kd, vd, causal, scale,
                          default_scale):
     """Single-device attention for axis size 1 (shared by ring/ulysses)."""

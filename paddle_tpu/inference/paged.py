@@ -554,6 +554,56 @@ class PagedKVCache:
             t += n
         self.lengths[seq] = start + T
 
+    def write_sharded(self, seq: int, k, v, start: int,
+                      n_ranks: int) -> int:
+        """Write one sequence-parallel prefill chunk's KV as
+        ``n_ranks`` contiguous per-rank ranges (serve.prefill_sp):
+        rank r owns positions ``start + r*(T/n) .. start +
+        (r+1)*(T/n) - 1`` — the same stripes the ring-gathered
+        attention computed.  Ranges land in ascending rank order, so
+        the final sequence length is exactly ``start + T`` like one
+        dense :meth:`write_at`; every range write is bracketed by the
+        ``sp.shard`` fault point, and a raise there fails ONLY the
+        bracketed request (the scheduler's serve.request isolation),
+        never the pool.  Returns the number of ranges written."""
+        T = int(np.shape(k)[2])
+        if n_ranks < 1 or T % n_ranks:
+            raise ValueError(
+                f"sp chunk of {T} tokens does not split into "
+                f"{n_ranks} equal per-rank ranges")
+        cl = T // n_ranks
+        for r in range(n_ranks):
+            _faults.fire("sp.shard", "before")
+            self.write_at(seq, k[:, :, r * cl:(r + 1) * cl],
+                          v[:, :, r * cl:(r + 1) * cl], start + r * cl)
+            _faults.fire("sp.shard", "after")
+        return n_ranks
+
+    def gather_shards(self, seq: int) -> int:
+        """One-shot page all-gather at the prefill->decode transition
+        of a sequence-parallel prefill: after it, every rank holds the
+        sequence's full page set and decode runs byte-identical to the
+        single-device path.  On this single-host pool the page arrays
+        are already globally addressable, so the data movement itself
+        is a no-op — what this models (and meters: the ``sp.gather``
+        fault point plus ``sp_gather_pages_total``) is the one
+        ``all_gather`` of pages a range-sharded multi-host pool pays
+        HERE, once, instead of every decode step gathering across the
+        mesh.  Returns the number of pages covered."""
+        _faults.fire("sp.gather", "before")
+        pages = -(-int(self.lengths[seq]) // self.page_size)
+        from .. import obs as _obs
+
+        h = _obs.handle()
+        if h is not None:
+            h.registry.counter(
+                "sp_gather_pages_total",
+                "KV pages all-gathered at sequence-parallel "
+                "prefill->decode transitions",
+            ).inc(pages)
+        _faults.fire("sp.gather", "after")
+        return pages
+
     def gather_dense(self, seq: int, length=None):
         """Gather a sequence's pages into dense [L, KV, P, D] arrays
         (P = page-multiple cover of ``length``) — the past-KV operand of

@@ -126,9 +126,11 @@ class Router:
 
     ``affinity`` (default): maximize the prefix-affinity probe
     (tokens of the prompt already resident in the replica's radix
-    tree), tie-broken by lowest queue depth, then most free pages,
-    then lowest replica index — fully deterministic.  ``random``:
-    seeded uniform pick, the bench A/B control arm.
+    tree), tie-broken by sequence-parallel fit (a long prompt prefers
+    a mesh-backed replica that can stripe its prefill), then lowest
+    queue depth, then most free pages, then lowest replica index —
+    fully deterministic.  ``random``: seeded uniform pick, the bench
+    A/B control arm.
     """
 
     POLICIES = ("affinity", "random")
@@ -165,7 +167,18 @@ class Router:
             prefix = rep.engine.prefix
             aff = (prefix.match_len(prompt_ids)
                    if prefix is not None else 0)
-            key = (aff, -rep.depth, rep.engine.executor.free_pages, -i)
+            ex = rep.engine.executor
+            # long prompts score toward a mesh-backed replica: when
+            # this prompt meets the replica's sequence-parallel
+            # threshold, its prefill cost divides by the sp degree
+            # there.  Ranked BELOW affinity (resident prefix pages
+            # save recompute outright) and ABOVE depth; zero on every
+            # replica of an sp-free fleet, so those orderings are
+            # byte-identical to r22.
+            sp_fit = int(getattr(ex, "sp_degree", 1) > 1
+                         and len(prompt_ids)
+                         >= ex.sp_min_tokens_effective())
+            key = (aff, sp_fit, -rep.depth, ex.free_pages, -i)
             if best is None or key > best_key:
                 best, best_key = rep, key
         if best_key[0] > 0:

@@ -51,14 +51,21 @@ class ServingEngine:
                  spec_decode=None, clock=None, slos=None,
                  slo_rules=None, async_exec=None, aot=None,
                  compile_cache=None, decode_n_steps=(), quant=None,
-                 wal=None):
+                 wal=None, sp_mesh=None, sp_prefill=None,
+                 sp_min_tokens=None, sp_axis=None):
         # quant: None = follow PT_QUANT (default none, bit-exact legacy
         # path); "none"/"int8" force it (bench A/B).  int8 = per-channel
         # int8 projection weights + per-page int8 KV pools.
+        # sp_prefill: None = follow PT_SP_PREFILL (default off,
+        # bit-exact legacy path); True/False force it.  On, prompts at
+        # or above sp_min_tokens (PT_SP_PREFILL_MIN_TOKENS) prefill
+        # sequence-parallel over sp_mesh's sp axis (default: a 1-D
+        # mesh over every local device).
         self.executor = PagedExecutor(
             model, max_seqs=max_seqs, page_size=page_size,
             max_len=max_len, dtype=dtype, num_pages=num_pages,
-            quant=quant)
+            quant=quant, sp_mesh=sp_mesh, sp_prefill=sp_prefill,
+            sp_min_tokens=sp_min_tokens, sp_axis=sp_axis)
         # clock: injectable wall-clock source for the SLO metrics and
         # per-request timestamps (default time.perf_counter; seeded
         # tests pass obs.LogicalClock() for exact ms percentiles)
@@ -305,6 +312,14 @@ class ServingEngine:
                 "kv_scale_bytes": (0 if cache.k_scales is None else
                                    cache.k_scales.nbytes
                                    + cache.v_scales.nbytes),
+            },
+            "sp": {
+                "mode": ("on" if self.executor.sp_degree > 1
+                         else "off"),
+                "degree": self.executor.sp_degree,
+                "axis": self.executor._sp_axis,
+                "min_tokens": self.executor.sp_min_tokens_effective(),
+                "prefill_tokens": self.executor.sp_prefill_tokens,
             },
             "async": {
                 "mode": "on" if s.async_mode else "off",

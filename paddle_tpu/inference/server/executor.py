@@ -19,18 +19,36 @@ scheduled paths execute byte-identical programs.
 """
 from __future__ import annotations
 
+import os
 import warnings
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as _P
 
 from ... import obs
 from ...analysis import CountedJit, ProgramContract, register_program
 from ...ops import quant as _quant
 from ...ops.nn_ops import _rms_norm_plain, _rope_plain
 from ..paged import PagedKVCache, paged_decode_attention
+
+
+def _sp_prefill_enabled() -> bool:
+    """PT_SP_PREFILL={off,on} — sequence-parallel prefill of long
+    prompts over a mesh (serve.prefill_sp).  Off is bit-exact r22."""
+    mode = os.environ.get("PT_SP_PREFILL", "off").lower()
+    if mode not in ("off", "on"):
+        raise ValueError(f"PT_SP_PREFILL={mode!r}: expected off|on")
+    return mode == "on"
+
+
+def _sp_min_tokens_default() -> int:
+    """PT_SP_PREFILL_MIN_TOKENS — raw prompt-length threshold above
+    which prefill is planned sequence-parallel (floor-quantized onto
+    the AOT bucket ladder when one is armed)."""
+    return int(os.environ.get("PT_SP_PREFILL_MIN_TOKENS", "64"))
 
 
 def _mm(x, w):
@@ -130,7 +148,9 @@ class PagedExecutor:
     """
 
     def __init__(self, model, max_seqs=4, page_size=16, max_len=256,
-                 dtype=jnp.float32, num_pages=None, quant=None):
+                 dtype=jnp.float32, num_pages=None, quant=None,
+                 sp_mesh=None, sp_prefill=None, sp_min_tokens=None,
+                 sp_axis=None):
         from ...models.generation import _stack_layer_params
         from ...models.llama import _rope_tables
 
@@ -224,6 +244,38 @@ class PagedExecutor:
         self._jit_verify = CountedJit(self._verify_fwd,
                                       name="serve.verify",
                                       donate_argnums=(3, 4))
+        # -- sequence-parallel prefill (serve.prefill_sp) -------------
+        # param forces on/off, None follows PT_SP_PREFILL; off (the
+        # default) builds no program and changes nothing — bit-exact
+        # r22.  Armed, long-prompt chunks stripe across the mesh's sp
+        # axis: each rank ring-gathers the chunk K/V into canonical
+        # order and runs the UNMODIFIED dense mask/softmax on its row
+        # stripe, so the output is bit-identical to _chunk_fwd.
+        sp_on = (_sp_prefill_enabled() if sp_prefill is None
+                 else bool(sp_prefill))
+        self._sp_mesh = None
+        self._sp_jmesh = None
+        self._sp_axis = None
+        self._sp_n = 1
+        self._jit_chunk_sp = None
+        if sp_on:
+            mesh, axis = self._resolve_sp_mesh(sp_mesh, sp_axis)
+            if mesh is not None and mesh.get_dim_size(axis) > 1:
+                self._sp_mesh = mesh
+                self._sp_jmesh = mesh.jax_mesh
+                self._sp_axis = axis
+                self._sp_n = int(mesh.get_dim_size(axis))
+                self._jit_chunk_sp = CountedJit(
+                    self._sp_chunk_fwd, name="serve.prefill_sp",
+                    donate_argnums=(4, 5))
+        self._sp_min_tokens = (int(sp_min_tokens)
+                               if sp_min_tokens is not None
+                               else _sp_min_tokens_default())
+        # slots holding range-sharded pages from an sp chunk: the
+        # prefill->decode gather must fire for these even when the
+        # (small) FINAL chunk itself routed to the dense program
+        self._sp_written = set()
+        self.sp_prefill_tokens = 0
         self.rollback_pages = 0
         # AOT plane state (core/aot.py): a non-None ladder switches the
         # executor into bucketed-shape mode — the scheduler quantizes
@@ -237,13 +289,71 @@ class PagedExecutor:
 
     @property
     def programs(self) -> dict:
-        """The six jitted programs, by contract name suffix."""
-        return {"prefill": self._jit_prefill,
-                "prefill_chunk": self._jit_chunk,
-                "decode": self._jit_decode,
-                "decode_async": self._jit_decode_async,
-                "decode_n": self._jit_decode_n,
-                "verify": self._jit_verify}
+        """The jitted programs, by contract name suffix (prefill_sp
+        only when the sequence-parallel plane is armed)."""
+        progs = {"prefill": self._jit_prefill,
+                 "prefill_chunk": self._jit_chunk,
+                 "decode": self._jit_decode,
+                 "decode_async": self._jit_decode_async,
+                 "decode_n": self._jit_decode_n,
+                 "verify": self._jit_verify}
+        if self._jit_chunk_sp is not None:
+            progs["prefill_sp"] = self._jit_chunk_sp
+        return progs
+
+    # -- sequence-parallel plane ----------------------------------------
+
+    @staticmethod
+    def _resolve_sp_mesh(sp_mesh, sp_axis):
+        """(1-D ProcessMesh, axis name) for sequence-parallel prefill.
+
+        ``sp_mesh=None`` builds a 1-D mesh over every local device.  A
+        multi-dim mesh (the dp x sep hybrid a training job hands over)
+        is reduced to the 1-D submesh along ``sp_axis`` — auto-detected
+        as ``sp`` then ``sep``, else the largest dim — by fixing every
+        other dim at index 0: prefill shards the SEQUENCE, so exactly
+        one mesh axis participates.  Returns (None, None) when no
+        multi-device axis exists (the caller disarms)."""
+        from ...distributed.auto_parallel import ProcessMesh
+
+        if sp_mesh is None:
+            n = jax.device_count()
+            if n < 2:
+                return None, None
+            return (ProcessMesh(list(range(n)), dim_names=["sp"]),
+                    sp_axis or "sp")
+        mesh = sp_mesh
+        if sp_axis is None:
+            for cand in ("sp", "sep"):
+                if cand in mesh.dim_names:
+                    sp_axis = cand
+                    break
+            else:
+                sp_axis = max(mesh.dim_names, key=mesh.get_dim_size)
+        for d in list(mesh.dim_names):
+            if d != sp_axis and mesh.ndim > 1:
+                mesh = mesh.get_mesh_with_dim(d, 0)
+        return mesh, sp_axis
+
+    @property
+    def sp_degree(self) -> int:
+        """Ranks a sequence-parallel chunk stripes across (1 = the
+        plane is off and every prompt takes the single-device path)."""
+        return self._sp_n if self._jit_chunk_sp is not None else 1
+
+    def sp_min_tokens_effective(self) -> int:
+        """The sequence-parallel length threshold the scheduler plans
+        with: the raw PT_SP_PREFILL_MIN_TOKENS, floor-quantized onto
+        the armed bucket ladder so the threshold sits ON a warmed rung
+        — AOT warmup covers every (prefill_sp x rung) pair at or above
+        it and a sealed engine never misses.  Below the lowest rung the
+        lowest rung is the floor."""
+        raw = self._sp_min_tokens
+        ladder = self.aot_ladder
+        if ladder is None:
+            return raw
+        rung = ladder.floor(raw)
+        return int(rung) if rung is not None else int(min(ladder.rungs))
 
     # speculative-decode audit counters, kept as properties over the
     # CountedJit wrapper: traces counts how many times _verify_fwd was
@@ -341,6 +451,23 @@ class PagedExecutor:
             name="serve.prefill_chunk" + sfx, fn=self._chunk_fwd,
             args=(layers, tops, i32(1, ps), i32(), past, past, i32()),
             donate_argnums=self._jit_chunk.donate_argnums, **common))
+        if self._jit_chunk_sp is not None:
+            # the ONLY serving program allowed collectives, and its
+            # inventory is exact: the per-layer ring-gather costs
+            # 2*(n-1) ppermute hops (k and v, counted once for the
+            # scan body), and the final-logits row costs exactly one
+            # all_gather at the end — anything else (a stray psum, a
+            # per-layer all_gather) is a regression lint must catch.
+            # Host-sync stays banned like every serving program.
+            nsp = self._sp_n
+            register_program(ProgramContract(
+                name="serve.prefill_sp" + sfx, fn=self._sp_chunk_fwd,
+                args=(layers, tops, i32(1, nsp * max(2, ps)), i32(),
+                      past, past, i32()),
+                donate_argnums=self._jit_chunk_sp.donate_argnums,
+                **{**common,
+                   "expected_collectives": {"ppermute": 2 * (nsp - 1),
+                                            "all_gather": 1}}))
         register_program(ProgramContract(
             name="serve.decode" + sfx, fn=self._decode_fwd,
             args=(layers, tops, i32(B), i32(B), kp, kp, i32(B),
@@ -434,6 +561,23 @@ class PagedExecutor:
                 plan.append((self._jit_chunk,
                              (layers, tops, i32(1, C), i32(), past,
                               past, i32()), {}))
+        if self.sp_degree > 1:
+            # sequence-parallel rungs: a chunk only stripes when its
+            # length splits evenly across the ranks, so warmup covers
+            # exactly the (prefill_sp x rung) pairs the scheduler can
+            # dispatch — the sp_min_tokens_effective() floor sits on a
+            # rung by construction
+            nsp = self._sp_n
+            for C in (c for c in ladder.rungs
+                      if c % nsp == 0 and c >= 2 * nsp):
+                pmax = aot.bucket_pages(-(-(self.max_len - C) // ps),
+                                        buckets)
+                for b in (x for x in buckets if x <= pmax):
+                    past = jax.ShapeDtypeStruct((L, KV, b * ps, D),
+                                                past_dt)
+                    plan.append((self._jit_chunk_sp,
+                                 (layers, tops, i32(1, C), i32(),
+                                  past, past, i32()), {}))
         for B in range(1, kvc.max_seqs + 1):
             dec = (layers, tops, i32(B), i32(B), kp, kp, i32(B),
                    i32(B, pps))
@@ -622,6 +766,120 @@ class PagedExecutor:
         x, (ks, vs) = jax.lax.scan(block, x, (layers, past_k, past_v))
         x = _rms_norm_plain(x, tops["norm_w"], epsilon=cfg.rms_norm_eps)
         return self._head(x[:, -1], tops)[0], ks[:, 0], vs[:, 0]
+
+    def _sp_chunk_fwd(self, layers, tops, ids, pos0, past_k, past_v,
+                      past_len):
+        """Sequence-parallel twin of :meth:`_chunk_fwd`: the chunk's
+        ``C`` rows stripe contiguously across the mesh's sp axis (rank
+        r owns rows ``[r*C/n, (r+1)*C/n)``), weights/past-KV stay
+        replicated, and the outputs are the SAME (logits [V], chunk k/v
+        [L, KV, C, D]) — k/v assembled sequence-sharded by the
+        out_specs.
+
+        Bit-identity with the single-device program is the design
+        constraint (the off-gate, recovery and the prefix cache all
+        compare token streams exactly), which rules out the training
+        ring's online softmax: instead each rank ring-gathers the chunk
+        K/V into canonical order (:func:`ring_gather_seq`, n-1 ppermute
+        hops each for k and v) and runs the unmodified dense
+        mask/softmax/PV math on its row stripe, so every per-(row, col)
+        dot product — and every reduction order — is byte-for-byte the
+        dense path's.  The final logits row lives on the last rank, so
+        one ``all_gather`` of the last hidden row ends the program:
+        total collective inventory exactly {ppermute: 2*(n-1),
+        all_gather: 1}, which the registered contract pins.
+
+        ``check_vma=False``: the all_gather-derived replication of the
+        logits output is not statically inferable by the old check_rep
+        machinery this jax's shard_map shim maps onto."""
+        rep = _P()
+        mapped = jax.shard_map(
+            self._sp_chunk_local, mesh=self._sp_jmesh,
+            in_specs=(jax.tree.map(lambda _: rep, layers),
+                      jax.tree.map(lambda _: rep, tops),
+                      _P(None, self._sp_axis), rep, rep, rep, rep),
+            out_specs=(rep, _P(None, None, self._sp_axis, None),
+                       _P(None, None, self._sp_axis, None)),
+            check_vma=False)
+        return mapped(layers, tops, ids, pos0, past_k, past_v,
+                      past_len)
+
+    def _sp_chunk_local(self, layers, tops, ids, pos0, past_k, past_v,
+                        past_len):
+        """Per-rank body of :meth:`_sp_chunk_fwd`.  ``ids`` [1, C/n] is
+        this rank's row stripe; everything else is replicated."""
+        from ...distributed.ring_attention import ring_gather_seq
+
+        cfg = self.config
+        nh, nkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                      cfg.head_dim)
+        axis, n = self._sp_axis, self._sp_n
+        B, Cl = ids.shape
+        C = Cl * n
+        P = past_k.shape[2]
+        r = jax.lax.axis_index(axis)
+        x = tops["embed"][ids]
+        rows = r * Cl + jnp.arange(Cl)               # global row ids
+        pos = pos0 + jnp.broadcast_to(rows[None], (B, Cl))
+        scale = 1.0 / np.sqrt(d)
+        # same mask as _chunk_fwd, restricted to this rank's rows:
+        # past cols valid below past_len; chunk cols causal globally
+        mask = jnp.concatenate(
+            [jnp.broadcast_to((jnp.arange(P) < past_len)[None],
+                              (Cl, P)),
+             rows[:, None] >= jnp.arange(C)[None]], axis=1)
+
+        def block(x, lp_kv):
+            lp, pk, pv = lp_kv
+            h = _rms_norm_plain(x, lp["input_layernorm.weight"],
+                                epsilon=cfg.rms_norm_eps)
+            q = _mm(h, lp["self_attn.q_proj.weight"]) \
+                .reshape(B, Cl, nh, d)
+            k = _mm(h, lp["self_attn.k_proj.weight"]) \
+                .reshape(B, Cl, nkv, d)
+            v = _mm(h, lp["self_attn.v_proj.weight"]) \
+                .reshape(B, Cl, nkv, d)
+            q, k = _rope_plain(q, k, tops["cos"], tops["sin"],
+                               position_ids=pos)
+            g = nh // nkv
+            qt = jnp.swapaxes(q, 1, 2)              # [B, nh, Cl, d]
+            kt = jnp.swapaxes(k, 1, 2)              # [B, nkv, Cl, d]
+            vt = jnp.swapaxes(v, 1, 2)
+            # every rank needs every chunk key: ring-gather the K/V
+            # stripes into canonical order (the bit-exact alternative
+            # to streaming blocks through an online softmax)
+            ktf = ring_gather_seq(kt, axis, n)      # [B, nkv, C, d]
+            vtf = ring_gather_seq(vt, axis, n)
+            kf = jnp.concatenate([pk[None].astype(ktf.dtype), ktf],
+                                 axis=2)
+            vf = jnp.concatenate([pv[None].astype(vtf.dtype), vtf],
+                                 axis=2)
+            if g > 1:                               # GQA: expand KV heads
+                kf = jnp.repeat(kf, g, axis=1)
+                vf = jnp.repeat(vf, g, axis=1)
+            logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kf) * scale
+            logits = jnp.where(mask[None, None], logits,
+                               jnp.finfo(logits.dtype).min)
+            p = jax.nn.softmax(logits.astype(jnp.float32), -1) \
+                .astype(x.dtype)
+            o = jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+            o = jnp.swapaxes(o, 1, 2).reshape(B, Cl, nh * d)
+            x = x + _mm(o, lp["self_attn.o_proj.weight"])
+            h2 = _rms_norm_plain(x, lp["post_attention_layernorm.weight"],
+                                 epsilon=cfg.rms_norm_eps)
+            gate = _mm(h2, lp["mlp.gate_proj.weight"])
+            up = _mm(h2, lp["mlp.up_proj.weight"])
+            x = x + _mm(jax.nn.silu(gate) * up,
+                        lp["mlp.down_proj.weight"])
+            return x, (jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2))
+
+        x, (ks, vs) = jax.lax.scan(block, x, (layers, past_k, past_v))
+        x = _rms_norm_plain(x, tops["norm_w"], epsilon=cfg.rms_norm_eps)
+        # the chunk's last row lives on the last rank: one all_gather
+        # of the final hidden row, then every rank computes the same
+        # replicated logits (the head matmul is cheap at [1, V])
+        last = jax.lax.all_gather(x[:, -1], axis)     # [n, B, h]
+        return self._head(last[n - 1], tops)[0], ks[:, 0], vs[:, 0]
 
     def _decode_fwd(self, layers, tops, ids, positions, k_pages, v_pages,
                     lengths, page_tables):
@@ -851,6 +1109,7 @@ class PagedExecutor:
     def free_slot(self, sid: int) -> None:
         self.cache.free(sid)
         self.last_token.pop(sid, None)
+        self._sp_written.discard(sid)
 
     def attach_prefix(self, sid: int, page_ids, n_tokens: int) -> None:
         """Point a fresh slot's page table at already-computed prefix
@@ -924,6 +1183,88 @@ class PagedExecutor:
         self.cache.write_at(sid, k, v, start)
         if not final:
             return None
+        if sid in self._sp_written:
+            # earlier chunks of this prompt landed range-sharded: the
+            # prefill->decode page gather still belongs to THIS
+            # transition even though the last (short) chunk ran dense
+            self.cache.gather_shards(sid)
+            self._sp_written.discard(sid)
+        tok = int(jnp.argmax(logits))
+        self.last_token[sid] = tok
+        return tok
+
+    def prefill_sp(self, sid: int, chunk_ids, start: int,
+                   final: bool) -> int | None:
+        """One SEQUENCE-PARALLEL prefill chunk at position ``start``:
+        the chunk stripes across the mesh (serve.prefill_sp), its KV
+        lands in the pool as per-rank ranges (``write_sharded``), and
+        the final chunk all-gathers the pages once so decode runs
+        byte-identical to the single-device path.  Same signature and
+        same results as :meth:`prefill_chunk` — the scheduler swaps
+        one for the other above the length threshold."""
+        n = self.sp_degree
+        # stripes of a single row hit XLA's matrix-VECTOR matmul path,
+        # whose accumulation order differs from the gemm the dense
+        # program runs — measurably (1e-6) non-bit-identical on CPU.
+        # A chunk must give every rank >= 2 rows; anything smaller
+        # takes the single-device program (same results by definition).
+        if n <= 1 or int(np.shape(chunk_ids)[0]) < 2 * n:
+            return self.prefill_chunk(sid, chunk_ids, start, final)
+        past_k, past_v = self.cache.gather_dense(sid, start)
+        if self.aot_ladder is not None:
+            # page-bucket the past cover exactly like prefill_chunk:
+            # the in-graph past_len mask zeroes the padding
+            from ...core.aot import bucket_pages
+
+            ps = self.cache.page_size
+            pages = past_k.shape[2] // ps
+            b = bucket_pages(pages, self._aot_page_buckets)
+            if b > pages:
+                pad = ((0, 0), (0, 0), (0, (b - pages) * ps), (0, 0))
+                past_k = jnp.pad(past_k, pad)
+                past_v = jnp.pad(past_v, pad)
+        ids = jnp.asarray(np.asarray(chunk_ids)[None], jnp.int32)
+        C = int(ids.shape[1])
+        if C % n:
+            raise ValueError(
+                f"sp prefill chunk of {C} tokens does not stripe over "
+                f"{n} ranks — the scheduler must plan sp chunks on "
+                f"rank-divisible rungs")
+        self.prefill_events.append((sid, C))
+        # placement bracket: the pool (and everything derived from it,
+        # like the gathered past) lives on the scheduler's home device,
+        # while the shard_map program computes over the mesh's device
+        # set — committed single-device operands would be refused.  The
+        # past-KV broadcast IN and the chunk-KV landing OUT are exactly
+        # the per-chunk transfers a range-sharded sp prefill pays, made
+        # explicit here so the pool's own placement never changes and
+        # the dense programs (plain jit AND rigid AOT-compiled
+        # executables) keep their single-device signatures.
+        rep = jax.NamedSharding(self._sp_jmesh, _P())
+        past_k = jax.device_put(past_k, rep)
+        past_v = jax.device_put(past_v, rep)
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            logits, k, v = self._jit_chunk_sp(
+                self.layers, self.tops, ids, jnp.int32(start), past_k,
+                past_v, jnp.int32(start))
+        k = jax.device_put(k, self.cache.k_pages.sharding)
+        v = jax.device_put(v, self.cache.v_pages.sharding)
+        self.cache.write_sharded(sid, k, v, start, n)
+        self._sp_written.add(sid)
+        self.sp_prefill_tokens += C
+        h = obs.handle()
+        if h is not None:
+            h.registry.counter(
+                "sp_prefill_tokens_total",
+                "prompt tokens prefilled sequence-parallel over the "
+                "mesh",
+            ).inc(C)
+        if not final:
+            return None
+        self.cache.gather_shards(sid)
+        self._sp_written.discard(sid)
         tok = int(jnp.argmax(logits))
         self.last_token[sid] = tok
         return tok

@@ -744,9 +744,25 @@ class Scheduler:
                                     tokens=chunk, final=final,
                                     tick=self.tick)
                       if h is not None else obs.NULL_SPAN)
+                # long prompts plan sequence-parallel: above the
+                # (rung-quantized) length threshold, and only when the
+                # chunk stripes evenly with >= 2 rows per rank —
+                # everything else is the bit-exact single-device path,
+                # so PT_SP_PREFILL=off changes nothing at all
+                spn = getattr(self.executor, "sp_degree", 1)
+                use_sp = (
+                    spn > 1
+                    and total >=
+                    self.executor.sp_min_tokens_effective()
+                    and chunk % spn == 0 and chunk >= 2 * spn)
                 with sp, RecordEvent("serve.prefill"):
-                    if start == 0 and final and ladder is None:
+                    if (start == 0 and final and ladder is None
+                            and not use_sp):
                         tok = self.executor.prefill(req.sid, ids)
+                    elif use_sp:
+                        tok = self.executor.prefill_sp(
+                            req.sid, ids[start:start + chunk], start,
+                            final)
                     else:
                         tok = self.executor.prefill_chunk(
                             req.sid, ids[start:start + chunk], start,

@@ -51,7 +51,7 @@ from ...testing import faults
 
 __all__ = [
     "WriteAheadLog", "replay", "stream_crc", "wal_enabled",
-    "default_wal", "resolve_wal", "segment_paths",
+    "default_wal", "resolve_wal", "segment_paths", "compact",
 ]
 
 _SEG_FMT = "wal-{:08d}.jsonl"
@@ -103,17 +103,24 @@ class WriteAheadLog:
     """Append-only crc32-framed JSON-lines journal with segment
     rotation and batched fsync.  Single writer per directory."""
 
-    def __init__(self, path, fsync_every=None, segment_bytes=256 * 1024):
+    def __init__(self, path, fsync_every=None, segment_bytes=256 * 1024,
+                 compact_every=None):
         if fsync_every is None:
             fsync_every = int(os.environ.get("PT_WAL_FSYNC_EVERY", "32"))
         if fsync_every < 1:
             raise ValueError("fsync_every must be >= 1")
+        if compact_every is None:
+            compact_every = int(os.environ.get("PT_WAL_COMPACT_EVERY", "0"))
+        if compact_every < 0:
+            raise ValueError("compact_every must be >= 0 (0 = never)")
         self.dir = os.fspath(path)
         os.makedirs(self.dir, exist_ok=True)
         self.fsync_every = fsync_every
         self.segment_bytes = segment_bytes
+        self.compact_every = compact_every
         self.appended = 0
         self.fsyncs = 0
+        self.compactions = 0
         self.errors = 0
         # wall seconds spent inside append/fsync: the journal's true
         # serving-path cost, measured within-run so host drift between
@@ -121,11 +128,13 @@ class WriteAheadLog:
         self.write_s = 0.0
         self.last_fsync_at = 0      # `appended` watermark at last fsync
         self._since_fsync = 0
+        self._since_compact = 0
         self._f = None
         self._seg_path = None
         self._seg_bytes = 0
         self._pub_appended = 0
         self._pub_fsyncs = 0
+        self._pub_compactions = 0
         existing = segment_paths(self.dir)
         # never append to an old segment: its tail may be torn, and
         # replay truncates tears — a fresh segment keeps new records
@@ -173,6 +182,7 @@ class WriteAheadLog:
             self._seg_bytes += len(line)
             self.appended += 1
             self._since_fsync += 1
+            self._since_compact += 1
             faults.fire("wal.append", "after", path=self._seg_path)
         except (faults.InjectedFault, OSError):
             self.errors += 1
@@ -183,6 +193,8 @@ class WriteAheadLog:
             self.write_s += time.perf_counter() - t0
             if self._since_fsync >= self.fsync_every:
                 self.fsync()
+            if self.compact_every and self._since_compact >= self.compact_every:
+                self.compact()
         self._publish()
 
     def _do_fsync(self):
@@ -210,6 +222,45 @@ class WriteAheadLog:
             os.close(self._f)
             self._f = None
 
+    def compact(self):
+        """Rewrite the journal's live state into one fresh segment and
+        drop the finished history (module :func:`compact`), coordinating
+        with this open writer: the current segment is fsynced and closed
+        first (so the rewrite sees every appended record and may unlink
+        the segment), and the next ``append`` rolls a brand-new segment
+        strictly after the compacted one.  Runs inline on the append
+        path when ``compact_every``/``PT_WAL_COMPACT_EVERY`` is set, so
+        like every other journal operation a failure degrades to
+        ``errors`` and serving continues on the uncompacted directory.
+        Returns the compaction report, or None on a degraded failure."""
+        t0 = time.perf_counter()
+        report = None
+        try:
+            if self._f is not None:
+                try:
+                    self._do_fsync()
+                except (faults.InjectedFault, OSError):
+                    self.errors += 1
+                finally:
+                    fd, self._f = self._f, None
+                    os.close(fd)
+            report = compact(self.dir)
+            self.compactions += 1
+        except (faults.InjectedFault, OSError):
+            self.errors += 1
+        finally:
+            # re-anchor the segment counter on what is actually on disk:
+            # whether the rewrite landed or died half-way, the next roll
+            # must pick an index after every existing segment (reusing a
+            # live name would interleave new appends into old history)
+            existing = segment_paths(self.dir)
+            if existing:
+                self._seg_index = int(os.path.basename(existing[-1])[4:12])
+            self._since_compact = 0
+        self.write_s += time.perf_counter() - t0
+        self._publish()
+        return report
+
     # -- telemetry -------------------------------------------------------
 
     def _publish(self):
@@ -224,6 +275,10 @@ class WriteAheadLog:
             "wal_fsyncs_total", "WAL fsync barriers",
         ).inc(self.fsyncs - self._pub_fsyncs)
         self._pub_fsyncs = self.fsyncs
+        h.registry.counter(
+            "wal_compactions_total", "WAL journal compactions",
+        ).inc(self.compactions - self._pub_compactions)
+        self._pub_compactions = self.compactions
         h.registry.gauge(
             "wal_lag_records",
             "records appended since the last fsync barrier",
@@ -237,6 +292,7 @@ class WriteAheadLog:
             "bytes": sum(os.path.getsize(p) for p in segs),
             "appended": self.appended,
             "fsyncs": self.fsyncs,
+            "compactions": self.compactions,
             "errors": self.errors,
             "lag_records": self._since_fsync,
             "last_fsync_at_record": self.last_fsync_at,
@@ -313,3 +369,124 @@ def replay(path, repair=True):
         ).inc(report["records"])
         h.events.log("wal.replay", dir=os.fspath(path), **report)
     return records, report
+
+
+def _terminal_rids(records):
+    """rids whose journaled lifecycle is finished business — safe to
+    drop under at-least-once delivery.  Mirrors ``recover``'s fold:
+
+    - **finished & proven**: a submit plus a finish whose token count
+      and crc match the replayed contiguous-from-zero token prefix.
+      Dropping it loses only the serve-from-log dedup fast path; a
+      client resubmit recomputes the same stream bit-identically
+      (deterministic greedy decode).
+    - **rejected & not superseded**: the reject was delivered live when
+      it happened (rejects are never deduped), and no later submit
+      restarted the rid, so nothing remains to restore.
+    - **unrestorable**: a rid with lifecycle records but no surviving
+      submit (interior bit-rot ate it).  Recovery could only count it
+      corrupt, never restore it; the client's resubmit arrives as a
+      fresh stream either way.
+
+    Everything else — unfinished streams, finishes that fail their own
+    proof, resubmitted-after-reject rids — is live and must be kept.
+    """
+    by = {}
+    for rec in records:
+        rid = rec.get("rid")
+        if rid is None:
+            continue
+        e = by.setdefault(rid, {"tokens": [], "submit": None,
+                                "finish": None, "reject": None})
+        t = rec.get("t")
+        if t == "submit":
+            if e["reject"] is not None:
+                # post-backoff retry supersedes the shed attempt: the
+                # rid is a fresh stream from here (same rule as recover)
+                e.update(submit=rec, finish=None, reject=None, tokens=[])
+            elif e["submit"] is None:
+                e["submit"] = rec
+        elif t == "token":
+            if int(rec.get("i", -1)) == len(e["tokens"]):
+                e["tokens"].append(int(rec.get("tok", -1)))
+        elif t == "finish":
+            e["finish"] = rec
+        elif t == "reject":
+            e["reject"] = rec
+    out = set()
+    for rid, e in by.items():
+        if e["submit"] is None:
+            out.add(rid)
+        elif e["reject"] is not None:
+            out.add(rid)
+        elif (e["finish"] is not None
+              and int(e["finish"].get("n", -1)) == len(e["tokens"])
+              and int(e["finish"].get("crc", -1)) == stream_crc(e["tokens"])):
+            out.add(rid)
+    return out
+
+
+def compact(path):
+    """Rewrite a journal directory's **live** state into one fresh
+    segment and unlink the finished history -> report dict.
+
+    The journal is append-only, so a long-lived server accretes
+    segments full of finished streams that recovery would only replay
+    to dedup.  Compaction replays the directory (repairing torn
+    tails), keeps every record of every live rid verbatim (so a
+    post-compaction ``recover`` folds them identically), writes them
+    crc-framed into a fresh segment numbered after all existing ones,
+    fsyncs it, and only then unlinks the old segments.
+
+    Crash safety leans entirely on ``recover``'s duplicate-idempotent
+    replay — every window leaves a directory that recovers to the same
+    state:
+
+    - **before the new segment is durable**: old segments are intact;
+      the partial new segment is at worst a torn tail (truncated on
+      replay) holding duplicates of records still present in the old
+      segments — submit is first-write-wins and token replay only
+      extends a contiguous prefix, so duplicates are no-ops;
+    - **mid-unlink**: the new segment is complete and holds all live
+      state; surviving old segments add only duplicates and
+      already-terminal lifecycles.
+
+    Single writer per directory: callers with an open
+    :class:`WriteAheadLog` must use its :meth:`~WriteAheadLog.compact`
+    method, which closes the active segment first.
+    """
+    faults.fire("wal.compact", "before", path=path)
+    old = segment_paths(path)
+    report = {"segments_dropped": 0, "records_kept": 0,
+              "records_dropped": 0, "live_rids": 0, "segment_index": 0}
+    if not old:
+        faults.fire("wal.compact", "after", path=path)
+        return report
+    records, _rep = replay(path)
+    terminal = _terminal_rids(records)
+    keep = [r for r in records
+            if r.get("rid") is not None and r["rid"] not in terminal]
+    live = {r["rid"] for r in keep}
+    new_index = max(int(os.path.basename(p)[4:12]) for p in old) + 1
+    new_path = os.path.join(os.fspath(path), _SEG_FMT.format(new_index))
+    fd = os.open(new_path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o644)
+    try:
+        for rec in keep:
+            body = json.dumps(rec, separators=(",", ":")).encode()
+            os.write(fd, b"%08x " % zlib.crc32(body) + body + b"\n")
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    # the "after" phase sits between the durable rewrite and the
+    # unlinks: a crash injected here leaves old+new coexisting, the
+    # exact window the docstring's idempotence argument covers
+    faults.fire("wal.compact", "after", path=path)
+    for p in old:
+        os.unlink(p)
+    report.update(segments_dropped=len(old), records_kept=len(keep),
+                  records_dropped=len(records) - len(keep),
+                  live_rids=len(live), segment_index=new_index)
+    h = obs.handle()
+    if h is not None:
+        h.events.log("wal.compact", dir=os.fspath(path), **report)
+    return report

@@ -1679,3 +1679,111 @@ def _geometric_(datas, attrs):
         _fail("geometric_",
               f"The probs parameter should be in the open interval "
               f"(0, 1), but received {probs}")
+
+
+# -- batch 15: broadcast-shaping + dedup + distribution draws -----------------
+
+
+@register_validator("expand_as")
+def _expand_as(datas, attrs):
+    # binary.cc ExpandAsInferMeta: the source rank must not exceed the
+    # target's, and every source dim must equal the right-aligned
+    # target dim or be 1 (otherwise the failure is a jnp broadcast
+    # error deep inside expand's dispatch)
+    xs = _shape(datas[0])
+    ts = tuple(int(d) for d in attrs.get("target_shape", ()))
+    if len(xs) > len(ts):
+        _fail("expand_as",
+              f"The rank of Input(X) {list(xs)} must not be greater "
+              f"than the rank of Input(Y) {list(ts)}")
+    for i in range(1, len(xs) + 1):
+        if xs[-i] != ts[-i] and xs[-i] != 1:
+            _fail("expand_as",
+                  f"The value of the non-singleton dimension {len(ts) - i} "
+                  f"of Input(X) ({xs[-i]}) must match Input(Y) "
+                  f"({ts[-i]}); X'shape: {list(xs)}, Y'shape: {list(ts)}")
+
+
+@register_validator("chunk")
+def _chunk(datas, attrs):
+    # unary.cc SplitWithNumInferMeta (chunk == split by count): a
+    # positive chunk count, an in-range axis, and an axis extent the
+    # count divides evenly
+    xs = _shape(datas[0])
+    chunks = int(attrs.get("chunks", 0))
+    if chunks <= 0:
+        _fail("chunk",
+              f"Attr(chunks) should be greater than 0, but received "
+              f"{chunks}")
+    axis = _axis_in("chunk", int(attrs.get("axis", 0)), len(xs))
+    if xs[axis] % chunks:
+        _fail("chunk",
+              f"The input's size along the split dimension must be "
+              f"evenly divisible by Attr(chunks), but received "
+              f"input shape {list(xs)}, axis {axis} and chunks {chunks}")
+
+
+@register_validator("unique_consecutive")
+def _unique_consecutive(datas, attrs):
+    # unary.cc UniqueConsecutiveInferMeta: the index dtype attr is
+    # int32/int64 only, and the axis (when given) must be in rank range
+    xs = _shape(datas[0])
+    dtype = str(attrs.get("dtype", "int64")).replace("paddle.", "")
+    if dtype not in ("int32", "int64"):
+        _fail("unique_consecutive",
+              f"The dtype of attr(dtype) should be int32 or int64, "
+              f"but got {dtype}")
+    axis = attrs.get("axis")
+    if axis is not None:
+        _axis_in("unique_consecutive", int(axis), max(len(xs), 1))
+
+
+@register_validator("poisson")
+def _poisson(datas, attrs):
+    # unary.cc PoissonInferMeta: the rate tensor must be floating
+    if not _float_dtype(datas[0]):
+        _fail("poisson",
+              f"The rate tensor must be a floating dtype, got "
+              f"{getattr(datas[0], 'dtype', None)}")
+
+
+@register_validator("exponential_")
+def _exponential_(datas, attrs):
+    # unary.cc ExponentialInferMeta — in-place fill: floating
+    # destination, strictly positive rate
+    if not _float_dtype(datas[0]):
+        _fail("exponential_",
+              f"The tensor to fill must be a floating dtype, got "
+              f"{getattr(datas[0], 'dtype', None)}")
+    lam = attrs.get("lam", 1.0)
+    if not float(lam) > 0:
+        _fail("exponential_",
+              f"The lam parameter should be positive, but received "
+              f"{lam}")
+
+
+@register_validator("log_normal_")
+def _log_normal_(datas, attrs):
+    # unary.cc LogNormalInferMeta — in-place fill: floating
+    # destination, strictly positive std of the underlying normal
+    if not _float_dtype(datas[0]):
+        _fail("log_normal_",
+              f"The tensor to fill must be a floating dtype, got "
+              f"{getattr(datas[0], 'dtype', None)}")
+    std = attrs.get("std", 2.0)
+    if not float(std) > 0:
+        _fail("log_normal_",
+              f"The std parameter should be positive, but received "
+              f"{std}")
+
+
+@register_validator("binomial")
+def _binomial(datas, attrs):
+    # binary.cc BinomialInferMeta: count and prob are drawn
+    # elementwise, so their shapes must match exactly
+    cs, ps = _shape(datas[0]), _shape(datas[1])
+    if cs != ps:
+        _fail("binomial",
+              f"Input(count) and Input(prob) should have the same "
+              f"shape, but received count's shape {list(cs)} and "
+              f"prob's shape {list(ps)}")

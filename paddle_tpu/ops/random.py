@@ -214,7 +214,11 @@ def bernoulli(x, name=None):
 
 
 def poisson(x, name=None):
+    from ..ops import infermeta
+
     lam = x._data if isinstance(x, Tensor) else x
+    # host path, so it never passes registry.apply's validator hook
+    infermeta.validate("poisson", (lam,), {})
     return Tensor(jax.random.poisson(default_generator.next_key(), lam)
                   .astype(lam.dtype))
 
@@ -241,6 +245,10 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
 
 
 def exponential_(x, lam=1.0, name=None):
+    from ..ops import infermeta
+
+    # in-place host path, so it never passes registry.apply's hook
+    infermeta.validate("exponential_", (x._data,), {"lam": lam})
     u = jax.random.exponential(default_generator.next_key(),
                                jnp.shape(x._data)) / lam
     x.set_value(u.astype(x.dtype))
@@ -299,6 +307,11 @@ def geometric_(x, probs, name=None):
 
 def log_normal_(x, mean=1.0, std=2.0, name=None):
     """reference tensor/random.log_normal_."""
+    from ..ops import infermeta
+
+    # in-place host path, so it never passes registry.apply's hook
+    infermeta.validate("log_normal_", (x._data,),
+                       {"mean": mean, "std": std})
     z = _jit_normal(default_generator.next_key(), tuple(x.shape),
                     jnp.float32)
     x.set_value(jnp.exp(mean + std * z).astype(x.dtype))
@@ -331,9 +344,16 @@ def binomial(count, prob, name=None):
     """reference tensor/random.binomial (elementwise draws)."""
     from ..core.tensor import Tensor
 
+    from ..ops import infermeta
+
     n = count._data if hasattr(count, "_data") else jnp.asarray(count)
     p = prob._data if hasattr(prob, "_data") else jnp.asarray(prob)
+    # host path, so it never passes registry.apply's validator hook
+    infermeta.validate("binomial", (n, p), {})
+    # jax's binomial kernel compares against float literals of the
+    # DEFAULT float dtype: forcing float32 operands under x64 trips a
+    # lax.clamp dtype mismatch inside it
+    dt = jnp.result_type(float)
     out = jax.random.binomial(default_generator.next_key(),
-                              n.astype(jnp.float32),
-                              p.astype(jnp.float32))
+                              n.astype(dt), p.astype(dt))
     return Tensor(out.astype(jnp.int64))

@@ -197,6 +197,26 @@ REGISTERED = {
                   "target, request not yet moved; inject=corrupt the "
                   "copy in flight so the crc check must catch it and "
                   "fall back to recompute)",
+    "wal.compact": "one WAL journal compaction (before=nothing "
+                   "rewritten — a crash leaves the old segments "
+                   "intact; after=live records rewritten into the "
+                   "fresh segment and fsynced, old segments not yet "
+                   "unlinked — a crash here leaves old+new segments "
+                   "whose duplicate records replay idempotently; a "
+                   "raise degrades to wal.errors and the journal "
+                   "keeps appending uncompacted)",
+    "sp.shard": "one per-rank KV page-range write during "
+                "sequence-parallel prefill (before=no range of this "
+                "chunk written; after=this rank's stripe landed at "
+                "its offset — a raise fails ONLY the bracketed "
+                "request via the serve.request isolation path, the "
+                "engine and its pool stay serviceable)",
+    "sp.gather": "one prefill->decode page all-gather at the end of a "
+                 "sequence-parallel prefill (before=pages still "
+                 "sharded-by-range; after=every rank holds the full "
+                 "page set and decode proceeds byte-identical to the "
+                 "single-device path — a raise fails only the "
+                 "request, never the engine)",
 }
 
 _PHASES = ("before", "after")

@@ -238,6 +238,28 @@ def test_async_save_snapshots_state_at_call_time(tmp_path):
         np.arange(16, dtype=np.float32).reshape(4, 4))
 
 
+def test_async_save_snapshots_aligned_host_buffer(tmp_path):
+    """A 64-byte-aligned numpy buffer is the case jax's CPU backend can
+    adopt zero-copy — the snapshot must still be a real copy, or the
+    caller's later in-place writes reach the checkpoint."""
+    root = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(root, world_size=1, rank=0)
+    raw = np.empty(64 + 64, np.uint8)
+    off = (-raw.ctypes.data) % 64
+    w = raw[off:off + 64].view(np.float32).reshape(4, 4)
+    w[:] = np.arange(16, dtype=np.float32).reshape(4, 4)
+    faults.arm("ckpt.shard_write", phase="before", nth=1,
+               action="delay", arg="0.2")
+    h = mgr.save({"w": w}, 1, async_save=True)
+    w[:] = -1.0
+    h.result()
+    loaded = {"w": np.zeros((4, 4), np.float32)}
+    mgr.load(loaded, step=1)
+    np.testing.assert_array_equal(
+        np.asarray(loaded["w"]),
+        np.arange(16, dtype=np.float32).reshape(4, 4))
+
+
 def test_keep_last_k_retention(tmp_path):
     root = str(tmp_path / "ckpt")
     mgr = CheckpointManager(root, keep_last_k=2, world_size=1, rank=0)

@@ -22,8 +22,8 @@ from paddle_tpu.inference.server import (RequestState, ServingCluster,
                                          ServingEngine, WriteAheadLog,
                                          check_pool_invariants, replay)
 from paddle_tpu.inference.server.cluster import DEAD_STATES
-from paddle_tpu.inference.server.wal import (resolve_wal, segment_paths,
-                                             stream_crc)
+from paddle_tpu.inference.server.wal import (compact, resolve_wal,
+                                             segment_paths, stream_crc)
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.testing import faults
 from paddle_tpu.testing.load import LoadSpec, generate_load
@@ -189,6 +189,95 @@ def test_wal_roll_survives_fsync_failure(tmp_path):
     recs, report = replay(tmp_path / "j")
     assert [r["tok"] for r in recs] == list(range(6))
     assert report["corrupt"] == 0
+
+
+# -- journal compaction -------------------------------------------------
+
+def _journal_stream(wal, rid, toks, finish=True):
+    wal.append({"t": "submit", "rid": rid, "prompt": [1, 2, 3]})
+    for i, t in enumerate(toks):
+        wal.append({"t": "token", "rid": rid, "i": i, "tok": t})
+    if finish:
+        wal.append({"t": "finish", "rid": rid, "n": len(toks),
+                    "crc": stream_crc(toks)})
+
+
+def test_wal_compact_drops_terminal_keeps_live(tmp_path):
+    """Compaction folds the journal with recover's own semantics:
+    proven-finished and rejected-not-superseded rids drop, in-flight
+    and resubmitted-after-reject rids keep their full record sets
+    verbatim, and the writer continues on a strictly newer segment."""
+    wal = WriteAheadLog(tmp_path / "j", fsync_every=1,
+                        segment_bytes=200)
+    _journal_stream(wal, "a", [5, 6, 7])
+    _journal_stream(wal, "b", [9])
+    _journal_stream(wal, "d", [4, 4], finish=False)     # in flight
+    wal.append({"t": "submit", "rid": "e", "prompt": [7]})
+    wal.append({"t": "reject", "rid": "e", "reason": "shed"})
+    wal.append({"t": "submit", "rid": "f", "prompt": [8]})
+    wal.append({"t": "reject", "rid": "f", "reason": "shed"})
+    wal.append({"t": "submit", "rid": "f", "prompt": [8]})  # supersedes
+    wal.append({"t": "token", "rid": "f", "i": 0, "tok": 3})
+    n_before = len(segment_paths(tmp_path / "j"))
+    assert n_before > 1                 # rotation actually happened
+    rep = wal.compact()
+    assert rep["live_rids"] == 2 and rep["segments_dropped"] == n_before
+    assert rep["records_dropped"] > 0
+    assert len(segment_paths(tmp_path / "j")) == 1
+    recs, report = replay(tmp_path / "j")
+    assert sorted({r["rid"] for r in recs}) == ["d", "f"]
+    assert [r["tok"] for r in recs
+            if r.get("t") == "token" and r["rid"] == "d"] == [4, 4]
+    assert report["corrupt"] == 0 and report["torn_bytes"] == 0
+    # appends land on a fresh segment strictly after the compacted one
+    _journal_stream(wal, "g", [1])
+    assert int(os.path.basename(
+        segment_paths(tmp_path / "j")[-1])[4:12]) \
+        == rep["segment_index"] + 1
+    assert wal.compactions == 1
+    assert wal.statusz()["compactions"] == 1
+    wal.close()
+
+
+def test_wal_compact_every_trigger(tmp_path, monkeypatch):
+    # PT_WAL_COMPACT_EVERY arms the append-count trigger; a journal
+    # whose rids are all terminal compacts down to nothing
+    monkeypatch.setenv("PT_WAL_COMPACT_EVERY", "4")
+    wal = WriteAheadLog(tmp_path / "j", fsync_every=1)
+    _journal_stream(wal, "a", [1, 2])   # submit + 2 tokens + finish
+    assert wal.compactions == 1
+    recs, _ = replay(tmp_path / "j")
+    assert recs == []
+    wal.close()
+    with pytest.raises(ValueError, match="compact_every"):
+        WriteAheadLog(tmp_path / "k", compact_every=-1)
+
+
+def test_wal_compact_crash_window_degrades(tmp_path):
+    """A raise in the window between the durable rewrite and the old-
+    segment unlinks degrades (errors counted, no report) and leaves
+    old + new segments coexisting — safe because replay's recover fold
+    is duplicate-idempotent."""
+    wal = WriteAheadLog(tmp_path / "j", fsync_every=1)
+    wal.append({"t": "submit", "rid": "x", "prompt": [1]})
+    wal.append({"t": "token", "rid": "x", "i": 0, "tok": 2})
+    faults.reset("wal.compact:after:1=raise")
+    rep = wal.compact()
+    faults.reset("")
+    assert rep is None and wal.errors >= 1
+    assert len(segment_paths(tmp_path / "j")) == 2   # old + complete new
+    recs, _ = replay(tmp_path / "j")
+    toks = [r for r in recs if r.get("t") == "token"]
+    assert len(toks) == 2               # the duplicate is present...
+    got = []
+    for r in toks:
+        if int(r["i"]) == len(got):
+            got.append(r["tok"])
+    assert got == [2]                   # ...and folds to one token
+    # the writer survives the degraded compaction on a newer segment
+    wal.append({"t": "token", "rid": "x", "i": 1, "tok": 9})
+    assert len(segment_paths(tmp_path / "j")) == 3
+    wal.close()
 
 
 @pytest.mark.slow
@@ -435,6 +524,19 @@ def test_crash_fault_subprocess_recovers(model, work, baseline,
     rc, drained = _run_worker_until(tmp_path / "j", None,
                                     fault_spec=fault_spec)
     assert rc == faults.EXIT_CODE and not drained
+    _recover_and_verify(model, tmp_path / "j", work, baseline)
+
+
+@pytest.mark.slow
+def test_wal_compact_preserves_recovery(model, work, baseline,
+                                        tmp_path):
+    """Compacting a SIGKILLed process's journal (the ops idiom before
+    archiving or re-serving it) must not change what recover
+    reconstructs: zero loss, streams bit-identical."""
+    rc, drained = _run_worker_until(tmp_path / "j", 20)
+    assert rc == -signal.SIGKILL and not drained
+    rep = compact(tmp_path / "j")
+    assert rep["records_kept"] > 0
     _recover_and_verify(model, tmp_path / "j", work, baseline)
 
 

@@ -1515,3 +1515,100 @@ def test_geometric_rejects_probs_out_of_range():
         _f32(3).geometric_(1.0)
     with pytest.raises(InvalidArgumentError, match="open interval"):
         _f32(3).geometric_(0.0)
+
+
+# -- batch 15: broadcast-shaping + dedup + distribution draws -----------------
+
+
+def test_expand_as_accepts_broadcastable():
+    small = _f32(1, 4)
+    target = _f32(3, 4)
+    assert list(paddle.expand_as(small, target).shape) == [3, 4]
+
+
+def test_expand_as_rejects_mismatched_dim():
+    with pytest.raises(InvalidArgumentError, match="must match"):
+        paddle.expand_as(_f32(3, 5), _f32(3, 4))
+
+
+def test_expand_as_rejects_higher_rank_source():
+    with pytest.raises(InvalidArgumentError, match="rank"):
+        paddle.expand_as(_f32(2, 3, 4), _f32(3, 4))
+
+
+def test_chunk_accepts_even_split():
+    parts = paddle.chunk(_f32(6, 4), 3, axis=0)
+    assert len(parts) == 3
+    assert all(list(p.shape) == [2, 4] for p in parts)
+
+
+def test_chunk_rejects_indivisible_extent():
+    with pytest.raises(InvalidArgumentError, match="evenly divisible"):
+        paddle.chunk(_f32(7, 4), 3, axis=0)
+
+
+def test_chunk_rejects_axis_out_of_range():
+    with pytest.raises(InvalidArgumentError, match="axis"):
+        paddle.chunk(_f32(6, 4), 2, axis=5)
+
+
+def test_chunk_rejects_nonpositive_count():
+    with pytest.raises(InvalidArgumentError, match="greater than 0"):
+        paddle.chunk(_f32(6, 4), 0, axis=0)
+
+
+def test_unique_consecutive_accepts_runs():
+    x = paddle.to_tensor(np.array([1, 1, 2, 2, 2, 3, 1], np.int64))
+    out, counts = paddle.unique_consecutive(x, return_counts=True)
+    np.testing.assert_array_equal(out.numpy(), [1, 2, 3, 1])
+    np.testing.assert_array_equal(counts.numpy(), [2, 3, 1, 1])
+
+
+def test_unique_consecutive_rejects_bad_dtype():
+    x = paddle.to_tensor(np.array([1, 1, 2], np.int64))
+    with pytest.raises(InvalidArgumentError, match="int32 or int64"):
+        paddle.unique_consecutive(x, dtype="float32")
+
+
+def test_poisson_accepts_float_rates():
+    out = paddle.poisson(_f32(3, 4) * 0 + 2.0)
+    assert list(out.shape) == [3, 4]
+    assert float(out.numpy().min()) >= 0.0
+
+
+def test_poisson_rejects_integer_rates():
+    ints = paddle.to_tensor(np.ones((3,), np.int64))
+    with pytest.raises(InvalidArgumentError, match="floating"):
+        paddle.poisson(ints)
+
+
+def test_exponential_rejects_nonpositive_lam():
+    with pytest.raises(InvalidArgumentError, match="positive"):
+        _f32(3).exponential_(lam=0.0)
+
+
+def test_log_normal_fills_positive_support():
+    t = _f32(64)
+    t.log_normal_(mean=0.0, std=1.0)
+    assert float(t.numpy().min()) > 0.0
+
+
+def test_log_normal_rejects_nonpositive_std():
+    with pytest.raises(InvalidArgumentError, match="positive"):
+        _f32(3).log_normal_(std=0.0)
+
+
+def test_binomial_accepts_matching_shapes():
+    n = paddle.to_tensor(np.full((3, 2), 8, np.float32))
+    p = paddle.to_tensor(np.full((3, 2), 0.5, np.float32))
+    out = paddle.binomial(n, p)
+    assert list(out.shape) == [3, 2]
+    draws = out.numpy()
+    assert draws.min() >= 0 and draws.max() <= 8
+
+
+def test_binomial_rejects_shape_mismatch():
+    n = paddle.to_tensor(np.full((3, 2), 8, np.float32))
+    p = paddle.to_tensor(np.full((2, 3), 0.5, np.float32))
+    with pytest.raises(InvalidArgumentError, match="same"):
+        paddle.binomial(n, p)

@@ -1,0 +1,337 @@
+"""Sequence-parallel chunked prefill (serve.prefill_sp, r23).
+
+The load-bearing property is BIT-IDENTITY: striping a long prompt's
+prefill chunks across a sequence-parallel mesh must not move a single
+token OR a single KV byte.  The sp body ring-GATHERS the chunk's K/V
+stripes back into canonical order (2*(n-1) ppermute hops) and runs the
+unmodified dense mask/softmax/PV math on each rank's contiguous row
+stripe — per-(row, col) arithmetic identical to the single-device
+program, unlike an online-softmax ring which re-associates the
+normalizer.  Asserted here at the engine level against single-device
+baselines — plain, prefix-cache, spec-decode and async variants — plus
+the page-range write/gather invariants, the PT_SP_PREFILL=off gate,
+the scheduler's rung-quantized length floor, the Cl>=2 fallback (one
+row per rank hits XLA's gemv path whose accumulation order differs
+from gemm), and sp.shard/sp.gather fault serviceability.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.aot import BucketLadder
+from paddle_tpu.distributed import ProcessMesh
+from paddle_tpu.inference.server import (
+    ServingCluster, ServingEngine, check_pool_invariants,
+)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(11)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+KW = dict(max_seqs=2, page_size=4, max_len=64, prefill_chunk=8)
+SP_KW = dict(sp_prefill=True, sp_min_tokens=16)
+
+# lengths around every routing edge: long (all chunks sp), long with a
+# short dense tail chunk, below the sp floor, exactly at the floor
+_RNG = np.random.RandomState(7)
+PROMPTS = [_RNG.randint(1, 256, (n,)).astype(np.int32)
+           for n in (40, 33, 9, 16)]
+
+
+def _mesh(n):
+    return ProcessMesh(list(range(n)), dim_names=["sp"])
+
+
+def _serve(eng, prompts, max_new=6, check=False):
+    handles = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+    while eng.in_flight:
+        assert eng.tick < 3000, "load did not drain"
+        eng.step()
+        if check:
+            check_pool_invariants(eng.executor.cache, eng.prefix)
+    return [h.tokens for h in handles]
+
+
+@pytest.fixture(scope="module")
+def base_streams(model):
+    return _serve(ServingEngine(model, **KW), PROMPTS)
+
+
+# -- mode knob ----------------------------------------------------------
+
+
+def test_env_gate(model, monkeypatch):
+    monkeypatch.setenv("PT_SP_PREFILL", "on")
+    assert ServingEngine(model, **KW).executor.sp_degree > 1
+    monkeypatch.setenv("PT_SP_PREFILL", "off")
+    assert ServingEngine(model, **KW).executor.sp_degree == 1
+    monkeypatch.delenv("PT_SP_PREFILL")
+    assert ServingEngine(model, **KW).executor.sp_degree == 1
+    # param forces over env
+    monkeypatch.setenv("PT_SP_PREFILL", "on")
+    off = ServingEngine(model, sp_prefill=False, **KW)
+    assert off.executor.sp_degree == 1
+    assert "prefill_sp" not in off.executor.programs
+    monkeypatch.setenv("PT_SP_PREFILL", "ring")
+    with pytest.raises(ValueError, match="PT_SP_PREFILL"):
+        ServingEngine(model, **KW)
+
+
+@pytest.mark.slow
+def test_off_gate_is_legacy_path(model, base_streams):
+    """sp_prefill=False (and the default) never builds the mesh or the
+    program: the r22 dispatch runs untouched, streams bit-exact."""
+    eng = ServingEngine(model, sp_prefill=False, **KW)
+    ex = eng.executor
+    assert ex.sp_degree == 1 and ex._jit_chunk_sp is None
+    assert "prefill_sp" not in ex.programs
+    assert _serve(eng, PROMPTS) == base_streams
+    assert ex.sp_prefill_tokens == 0
+
+
+# -- bit-identity -------------------------------------------------------
+
+
+def test_sp_streams_bit_identical(model, base_streams):
+    """Degree-2 mesh, every routing edge in PROMPTS: streams must be
+    bit-identical to single-device with the pool green every step."""
+    eng = ServingEngine(model, sp_mesh=_mesh(2), **SP_KW, **KW)
+    assert eng.executor.sp_degree == 2
+    assert _serve(eng, PROMPTS, check=True) == base_streams
+    # the 40- and 33- and 16-token prompts rode the sp program
+    assert eng.executor.sp_prefill_tokens >= 40 + 32 + 16
+
+
+@pytest.mark.slow
+def test_sp_kv_pages_bit_identical(model):
+    """The pages a sharded prefill writes are byte-for-byte the pages
+    a dense prefill writes — decode provenance, not just tokens."""
+    prompt = PROMPTS[0]
+    pools = []
+    for mk in (dict(), dict(sp_mesh=_mesh(2), **SP_KW)):
+        eng = ServingEngine(model, **mk, **KW)
+        _serve(eng, [prompt], max_new=1)
+        c = eng.executor.cache
+        n = -(-len(prompt) // c.page_size)
+        pids = np.asarray(c.page_table[0, :n])
+        pools.append((np.asarray(c.k_pages[:, :, pids]),
+                      np.asarray(c.v_pages[:, :, pids])))
+    (k0, v0), (k1, v1) = pools
+    assert k0.tobytes() == k1.tobytes()
+    assert v0.tobytes() == v1.tobytes()
+
+
+@pytest.mark.slow
+def test_sp_degree4_and_2d_mesh(model, base_streams):
+    """Degree-4 stripes, and a 2-D dp x sp hybrid mesh reduced to its
+    sequence axis — both bit-identical."""
+    for mesh in (_mesh(4),
+                 ProcessMesh(np.arange(8).reshape(2, 4).tolist(),
+                             dim_names=["dp", "sp"])):
+        eng = ServingEngine(model, sp_mesh=mesh, **SP_KW, **KW)
+        ex = eng.executor
+        assert ex.sp_degree == 4 and ex._sp_axis == "sp"
+        assert _serve(eng, PROMPTS, check=True) == base_streams
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("variant", [
+    dict(prefix_cache=True),
+    dict(spec_decode="ngram"),
+    dict(async_exec=True),
+])
+def test_sp_composes_with_serving_variants(model, variant):
+    """sp prefill under each serving variant matches that variant's
+    own single-device streams (prefix hits, speculative drafts and the
+    async double-buffer all compose with sharded prefill)."""
+    # a shared long prefix makes the prefix-cache variant actually hit
+    pre = _RNG.randint(1, 256, (12,)).astype(np.int32)
+    prompts = [np.concatenate([pre, p]) for p in PROMPTS[:2]] + PROMPTS
+    want = _serve(ServingEngine(model, **variant, **KW), prompts)
+    eng = ServingEngine(model, sp_mesh=_mesh(2), **variant,
+                        **SP_KW, **KW)
+    assert _serve(eng, prompts, check=True) == want
+    assert eng.executor.sp_prefill_tokens > 0
+
+
+# -- scheduler floor + fallbacks ----------------------------------------
+
+
+def test_below_floor_routes_dense(model):
+    eng = ServingEngine(model, sp_mesh=_mesh(2), **SP_KW, **KW)
+    h = eng.submit(PROMPTS[2], max_new_tokens=4)   # 9 < 16
+    while eng.in_flight:
+        eng.step()
+    assert len(h.tokens) == 4
+    assert eng.executor.sp_prefill_tokens == 0
+
+
+def test_min_tokens_quantized_onto_ladder(model):
+    """The scheduler plans with the raw floor quantized DOWN onto the
+    armed bucket ladder (so AOT warmup covers every dispatchable
+    (prefill_sp x rung) pair); below the lowest rung, the lowest rung."""
+    ex = ServingEngine(model, sp_mesh=_mesh(2), **SP_KW, **KW).executor
+    assert ex.sp_min_tokens_effective() == 16     # no ladder: raw
+    ex.aot_ladder = BucketLadder([8, 16, 32])
+    ex._sp_min_tokens = 50
+    assert ex.sp_min_tokens_effective() == 32     # floor rung
+    ex._sp_min_tokens = 4
+    assert ex.sp_min_tokens_effective() == 8      # lowest rung
+    ex._sp_min_tokens = 16
+    assert ex.sp_min_tokens_effective() == 16     # already on a rung
+
+
+def test_narrow_chunk_falls_back_to_dense(model):
+    """A chunk with fewer than 2 rows per rank must take the dense
+    path: a 1-row stripe lowers to XLA's gemv whose accumulation order
+    differs from the gemm the dense program runs — the fallback is
+    what keeps the bit-identity contract."""
+    eng = ServingEngine(model, sp_mesh=_mesh(4), **SP_KW, **KW)
+    ex = eng.executor
+    sid = ex.alloc_slot()
+    tok = ex.prefill_sp(sid, PROMPTS[2][:7], 0, True)   # 7 < 2*4
+    assert ex.sp_prefill_tokens == 0                    # dense served it
+    want = ServingEngine(model, **KW).executor
+    sid2 = want.alloc_slot()
+    assert tok == want.prefill_chunk(sid2, PROMPTS[2][:7], 0, True)
+
+
+def test_sp_requires_divisible_chunk(model):
+    eng = ServingEngine(model, sp_mesh=_mesh(4), **SP_KW, **KW)
+    ex = eng.executor
+    sid = ex.alloc_slot()
+    with pytest.raises(ValueError, match="does not split|divisible"):
+        ex.prefill_sp(sid, PROMPTS[0][:30], 0, False)   # 30 % 4 != 0
+
+
+def test_write_sharded_page_invariants(model):
+    """write_sharded lands n contiguous per-rank ranges == one dense
+    write_at: same final length, same bytes, pool green; a chunk that
+    does not split evenly is refused."""
+    exs = [ServingEngine(model, **KW).executor for _ in range(2)]
+    L, KV, D = 2, 2, 16
+    rng = np.random.RandomState(3)
+    k = rng.randn(L, KV, 8, D).astype(np.float32)
+    v = rng.randn(L, KV, 8, D).astype(np.float32)
+    for ex, n_ranks in zip(exs, (1, 4)):
+        sid = ex.alloc_slot()
+        if n_ranks == 1:
+            ex.cache.write_at(sid, k, v, 0)
+        else:
+            assert ex.cache.write_sharded(sid, k, v, 0, n_ranks) == 4
+        assert int(ex.cache.lengths[sid]) == 8
+        check_pool_invariants(ex.cache)
+    a, b = (np.asarray(ex.cache.k_pages) for ex in exs)
+    assert a.tobytes() == b.tobytes()
+    with pytest.raises(ValueError, match="does not split"):
+        exs[1].cache.write_sharded(exs[1].alloc_slot(), k, v, 0, 3)
+    assert exs[1].cache.gather_shards(0) == 2          # 8 tokens / ps 4
+
+
+# -- fault serviceability -----------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("point", ["sp.shard", "sp.gather"])
+def test_sp_fault_raise_is_retryable(model, point, base_streams):
+    """A raise at an sp fault point fails ONLY the faulted request
+    (the per-request bracket absorbs it — request isolation) and
+    corrupts nothing: the pool stays green every step, the co-resident
+    requests finish bit-identical, and resubmitting the victim
+    completes bit-identical too."""
+    from paddle_tpu.inference.server import RequestState
+
+    eng = ServingEngine(model, sp_mesh=_mesh(2), **SP_KW, **KW)
+    handles = [eng.submit(p, max_new_tokens=6) for p in PROMPTS]
+    faults.reset(f"{point}:before:1=raise")
+    while eng.in_flight:
+        assert eng.tick < 3000, "load did not drain"
+        eng.step()
+        check_pool_invariants(eng.executor.cache, eng.prefix)
+    failed = [i for i, h in enumerate(handles)
+              if h.state is RequestState.FAILED]
+    assert len(failed) == 1
+    (i,) = failed
+    assert "InjectedFault" in handles[i].finish_reason
+    assert [h.tokens for j, h in enumerate(handles) if j != i] \
+        == [s for j, s in enumerate(base_streams) if j != i]
+    faults.reset()
+    retry = eng.submit(PROMPTS[i], max_new_tokens=6)
+    while eng.in_flight:
+        eng.step()
+        check_pool_invariants(eng.executor.cache, eng.prefix)
+    assert retry.tokens == base_streams[i]
+
+
+@pytest.mark.slow
+def test_sp_fault_in_fleet_is_request_scoped(model, base_streams):
+    """An injected sp raise inside a fleet replica is absorbed by the
+    per-request bracket: the VICTIM fails alone — its replica stays
+    active (no replica.fail, no failover storm), every other request
+    completes bit-identical, and resubmitting the victim completes
+    bit-identical too."""
+    from paddle_tpu.inference.server import RequestState
+
+    faults.reset("sp.shard:before:1=raise")
+    cl = ServingCluster(model, n_replicas=2, cluster=True,
+                        sp_mesh=_mesh(2), **SP_KW, **KW)
+    handles = [cl.submit(p, max_new_tokens=6, rid=f"r{i}")
+               for i, p in enumerate(PROMPTS)]
+    cl.run()
+    faults.reset()
+    assert all(r.state == "active" for r in cl.replicas)
+    assert cl.failovers == 0
+    failed = [i for i, h in enumerate(handles)
+              if h.state is RequestState.FAILED]
+    assert len(failed) == 1
+    (i,) = failed
+    assert [h.tokens for j, h in enumerate(handles) if j != i] \
+        == [s for j, s in enumerate(base_streams) if j != i]
+    retry = cl.submit(PROMPTS[i], max_new_tokens=6, rid="retry")
+    cl.run()
+    assert retry.tokens == base_streams[i]
+
+
+# -- AOT / contracts ----------------------------------------------------
+
+
+@pytest.mark.slow
+def test_aot_warmup_covers_sp_rungs(model, tmp_path, base_streams):
+    """A warmed sp engine serves long prompts with ZERO post-warmup
+    traces: the ladder's sp-eligible rungs (chunk % n == 0, >= 2n) all
+    pre-compiled."""
+    eng = ServingEngine(model, sp_mesh=_mesh(2), aot="warm",
+                        compile_cache=str(tmp_path), **SP_KW, **KW)
+    ex = eng.executor
+    rep = eng._aot_report
+    assert "serve.prefill_sp" in rep["programs"] and not rep["failed"]
+    t0 = ex._jit_chunk_sp.traces
+    assert _serve(eng, PROMPTS) == base_streams
+    assert ex._jit_chunk_sp.traces == t0
+    assert ex.sp_prefill_tokens > 0
+
+
+def test_contract_registered_with_ring_inventory(model):
+    from paddle_tpu import analysis
+
+    ServingEngine(model, sp_mesh=_mesh(4), **SP_KW, **KW)
+    con = analysis.registered().get("serve.prefill_sp")
+    assert con is not None
+    assert con.expected_collectives == {"ppermute": 6, "all_gather": 1}
+    assert not con.allow_host_sync

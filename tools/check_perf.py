@@ -97,6 +97,14 @@ METRICS = (
     ("serving.durability.recovery_steps", "lower", 0.50),
     ("serving.durability.salvage_reprefill_saved_tokens",
      "higher", 0.50),
+    # long-context sp prefill (r23): the per-device TTFT critical-path
+    # slope ratio is analytic over exact traced shapes (the bench leg
+    # additionally fails itself outright past the 0.45 acceptance
+    # bound), so tight drift gates are safe — a fatter ratio means the
+    # ring stopped sharding the attention rows
+    ("serving.sp_prefill.value", "lower", 0.10),
+    ("serving.sp_prefill.slope_ratio_sp2", "lower", 0.10),
+    ("serving.sp_prefill.slope_ratio_sp4", "lower", 0.10),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

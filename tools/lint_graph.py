@@ -51,6 +51,12 @@ def build_programs():
     engine_q = ServingEngine(model, max_seqs=2, page_size=4,
                              max_len=128, quant="int8")
 
+    # serve.prefill_sp — context-parallel chunked prefill over the
+    # forced-CPU device mesh; the contract pins the ring's collective
+    # inventory (2*(sp-1) ppermutes + the one-shot logits all-gather).
+    engine_sp = ServingEngine(model, max_seqs=2, page_size=4,
+                              max_len=128, sp_prefill=True)
+
     # moe.ep_alltoall — the fused shard_map body over the ep=8 mesh.
     mesh = ProcessMesh(list(range(8)), dim_names=["ep"])
     moe = MoELayer(d_model=16, d_hidden=32, num_experts=8,
@@ -59,7 +65,7 @@ def build_programs():
                    moe_impl="fused")
     moe._ep_opdef()
     # keep owners alive through the lint
-    return step, engine, engine_q, moe
+    return step, engine, engine_q, engine_sp, moe
 
 
 def main():

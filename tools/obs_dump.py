@@ -22,6 +22,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the sequence-parallel plane (section 11) needs a real device mesh
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import numpy as np  # noqa: E402
@@ -366,6 +369,38 @@ def main():
     check((dz.get("wal") or {}).get("appended", 0) > 0
           and "lag_records" in (dz.get("wal") or {}),
           "/statusz WAL table live")
+    # journal compaction: live state rewritten, telemetry published
+    rep10c = cl10.wal.compact()
+    check(rep10c is not None and rep10c["segments_dropped"] >= 1,
+          "WAL compaction rewrote the journal")
+    check("wal_compactions_total" in h.registry.prometheus_text(),
+          "family wal_compactions_total")
+    check(cl10.wal.statusz().get("compactions") == 1,
+          "/statusz WAL compactions counter")
+
+    # -- 11. sequence-parallel plane: sp counters + /statusz sp ---------
+    print("== sequence-parallel plane ==")
+    from paddle_tpu.distributed import ProcessMesh
+
+    mesh11 = ProcessMesh(list(range(2)), dim_names=["sp"])
+    eng11 = ServingEngine(model, max_seqs=2, page_size=4, max_len=128,
+                          prefill_chunk=16, sp_mesh=mesh11,
+                          sp_prefill=True, sp_min_tokens=16)
+    h11 = eng11.submit(rng.randint(1, 256, (48,)).astype(np.int32),
+                       max_new_tokens=4, rid="sp0")
+    check(len(h11.result()) == 4, "sp engine served a long prompt")
+    check(eng11.executor.sp_prefill_tokens >= 48,
+          "prompt prefilled through serve.prefill_sp")
+    prom = h.registry.prometheus_text()
+    for fam in ("sp_prefill_tokens_total", "sp_gather_pages_total"):
+        check(fam in prom, f"family {fam}")
+    spz = (health.statusz_payload(h)["providers"].get("serving")
+           or {}).get("sp") or {}
+    for key in ("mode", "degree", "axis", "min_tokens",
+                "prefill_tokens"):
+        check(key in spz, f"/statusz sp key {key}")
+    check(spz.get("mode") == "on" and spz.get("degree") == 2,
+          "/statusz sp table live")
 
     if FAILURES:
         print(f"\nobs-check: {len(FAILURES)} check(s) FAILED")
